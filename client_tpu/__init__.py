@@ -1,0 +1,25 @@
+"""client_tpu — a TPU-native client framework for the KServe v2 inference protocol.
+
+A from-scratch rebuild of the capabilities of the Triton Inference Server
+client libraries (triton-inference-server/client), designed TPU-first:
+
+- ``client_tpu.http`` / ``client_tpu.grpc``: sync, callback-async, asyncio and
+  bi-directional streaming clients for the KServe v2 protocol (HTTP/REST and
+  GRPC), including the full server-management surface.
+- ``client_tpu.utils``: Triton<->numpy dtype mapping with *native* bfloat16
+  (via ml_dtypes), BYTES/BF16 wire serialization.
+- ``client_tpu.utils.shared_memory``: POSIX system shared memory data plane.
+- ``client_tpu.utils.tpu_shared_memory``: the TPU-native zero-copy data plane
+  (replaces the reference's ``cuda_shared_memory``): regions backed by
+  host-mapped buffers bridged to jax.Array / XLA device buffers via DLPack.
+- ``client_tpu.server``: an in-process KServe v2 server with a JAX/XLA
+  execution backend (the reference has no server; ours makes the framework
+  self-contained and testable on a TPU VM).
+- ``client_tpu.models`` / ``client_tpu.ops`` / ``client_tpu.parallel``: the
+  JAX model zoo, jitted data-plane ops, and device-mesh sharding used by the
+  server backend.
+
+Reference parity map: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,506 @@
+"""Synchronous KServe v2 HTTP/REST client.
+
+Full-surface parity with the reference's
+``tritonclient.http.InferenceServerClient`` (http/_client.py:102-1658):
+infer / async_infer, health, metadata, config, repository control,
+statistics, trace & log settings, and shared-memory registration — plus the
+TPU extension endpoints (``v2/tpusharedmemory/...``) that pair with
+``client_tpu.utils.tpu_shared_memory``.
+
+Transport: urllib3 connection pool (the reference uses geventhttpclient;
+urllib3 gives the same persistent-connection pooling without a greenlet
+runtime). ``async_infer`` runs on a thread pool and returns an
+``InferAsyncRequest`` future wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+from urllib.parse import quote, urlencode
+
+import urllib3
+
+from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
+from .._tensor import InferInput, InferRequestedOutput
+from ..utils import InferenceServerException
+from ._infer_result import InferResult
+from ._utils import build_infer_body, compress_body, decompress_body, raise_if_error
+
+
+class _Response:
+    """A fully-read HTTP response (body already Content-Encoding-decoded)."""
+
+    __slots__ = ("status", "headers", "data")
+
+    def __init__(self, status, headers, data):
+        self.status = status
+        self.headers = headers
+        self.data = data
+
+
+class InferAsyncRequest:
+    """Handle for an in-flight async_infer; ``get_result`` blocks for the result."""
+
+    def __init__(self, future: Future, verbose: bool = False):
+        self._future = future
+        self._verbose = verbose
+
+    def get_result(self, block: bool = True, timeout: Optional[float] = None) -> InferResult:
+        if not block and not self._future.done():
+            raise InferenceServerException("inference request not yet completed")
+        try:
+            return self._future.result(timeout=timeout)
+        except InferenceServerException:
+            raise
+        except Exception as e:  # transport-level failure
+            raise InferenceServerException(f"inference request failed: {e}") from e
+
+    def cancel(self) -> bool:
+        return self._future.cancel()
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Client for the KServe v2 HTTP/REST protocol.
+
+    Note: like the reference client, one instance should be driven from one
+    thread at a time for sync calls; ``async_infer`` is internally pooled.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        concurrency: int = 1,
+        connection_timeout: float = 60.0,
+        network_timeout: float = 60.0,
+        max_greenlets: Optional[int] = None,  # accepted for API parity; unused
+        ssl: bool = False,
+        ssl_options: Optional[Dict[str, Any]] = None,
+        ssl_context_factory: Any = None,
+        insecure: bool = False,
+    ):
+        super().__init__()
+        if "://" in url:
+            raise InferenceServerException(
+                f"unexpected scheme in url '{url}' (pass host:port; use ssl=True for https)"
+            )
+        self._url = url
+        self._verbose = verbose
+        self._concurrency = max(1, concurrency)
+        self._timeout = urllib3.Timeout(connect=connection_timeout, read=network_timeout)
+        host, _, port = url.partition(":")
+        port_num = int(port) if port else (443 if ssl else 80)
+        pool_kwargs: Dict[str, Any] = dict(
+            host=host,
+            port=port_num,
+            maxsize=self._concurrency,
+            timeout=self._timeout,
+            retries=False,
+        )
+        if ssl:
+            opts = dict(ssl_options or {})
+            if insecure:
+                pool_kwargs["cert_reqs"] = "CERT_NONE"
+            if "keyfile" in opts:
+                pool_kwargs["key_file"] = opts["keyfile"]
+            if "certfile" in opts:
+                pool_kwargs["cert_file"] = opts["certfile"]
+            if "ca_certs" in opts:
+                pool_kwargs["ca_certs"] = opts["ca_certs"]
+            if ssl_context_factory is not None:
+                pool_kwargs["ssl_context"] = ssl_context_factory()
+            self._pool = urllib3.HTTPSConnectionPool(**pool_kwargs)
+        else:
+            self._pool = urllib3.HTTPConnectionPool(**pool_kwargs)
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._executor_lock = threading.Lock()
+        self._infer_stat = InferStat()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+        self._pool.close()
+
+    def __enter__(self) -> "InferenceServerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- stats -------------------------------------------------------------
+    def client_infer_stat(self) -> Dict[str, int]:
+        """Cumulative client-side inference statistics (see InferStat)."""
+        return self._infer_stat.as_dict()
+
+    # -- transport ---------------------------------------------------------
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[Dict[str, Any]] = None,
+        timeout: Optional[float] = None,
+        timers: Optional[RequestTimers] = None,
+    ):
+        """Issue one HTTP request; returns the response with the body read.
+
+        Content-Encoding is decoded by urllib3 (``decode_content``), so
+        ``resp.data`` is always the plain payload. When ``timers`` is given,
+        SEND_END is captured once response headers arrive and RECV_START/END
+        bracket the body read.
+        """
+        hdrs = dict(headers or {})
+        request = Request(hdrs)
+        self._call_plugin(request)
+        uri = "/" + path
+        if query_params:
+            uri += "?" + urlencode(query_params)
+        if self._verbose:
+            print(f"{method} {uri}, headers {request.headers}")
+        kwargs: Dict[str, Any] = dict(headers=request.headers, preload_content=False)
+        if body is not None:
+            kwargs["body"] = body
+        if timeout is not None:
+            kwargs["timeout"] = urllib3.Timeout(connect=timeout, read=timeout)
+        resp = None
+        try:
+            resp = self._pool.request(method, uri, **kwargs)
+            if timers is not None:
+                timers.capture(RequestTimers.SEND_END)
+                timers.capture(RequestTimers.RECV_START)
+            data = resp.read(decode_content=True)
+            if timers is not None:
+                timers.capture(RequestTimers.RECV_END)
+        except urllib3.exceptions.TimeoutError as e:
+            raise InferenceServerException("Deadline Exceeded", status="499") from e
+        except urllib3.exceptions.HTTPError as e:
+            raise InferenceServerException(f"connection error: {e}") from e
+        finally:
+            if resp is not None:
+                resp.release_conn()
+        if self._verbose:
+            print(f"-> {resp.status}, headers {dict(resp.headers)}")
+        return _Response(resp.status, resp.headers, data)
+
+    def _get(self, path, headers=None, query_params=None):
+        return self._request("GET", path, headers=headers, query_params=query_params)
+
+    def _post(self, path, body=b"", headers=None, query_params=None, timeout=None, timers=None):
+        return self._request(
+            "POST", path, body=body, headers=headers, query_params=query_params,
+            timeout=timeout, timers=timers,
+        )
+
+    @staticmethod
+    def _json_of(resp) -> Dict[str, Any]:
+        raise_if_error(resp.status, resp.data)
+        return json.loads(resp.data) if resp.data else {}
+
+    # -- health / metadata -------------------------------------------------
+    def is_server_live(self, headers=None, query_params=None) -> bool:
+        return self._get("v2/health/live", headers, query_params).status == 200
+
+    def is_server_ready(self, headers=None, query_params=None) -> bool:
+        return self._get("v2/health/ready", headers, query_params).status == 200
+
+    def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return self._get(path + "/ready", headers, query_params).status == 200
+
+    def get_server_metadata(self, headers=None, query_params=None) -> Dict[str, Any]:
+        return self._json_of(self._get("v2", headers, query_params))
+
+    def get_model_metadata(
+        self, model_name, model_version="", headers=None, query_params=None
+    ) -> Dict[str, Any]:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return self._json_of(self._get(path, headers, query_params))
+
+    def get_model_config(
+        self, model_name, model_version="", headers=None, query_params=None
+    ) -> Dict[str, Any]:
+        path = f"v2/models/{quote(model_name)}"
+        if model_version:
+            path += f"/versions/{model_version}"
+        return self._json_of(self._get(path + "/config", headers, query_params))
+
+    # -- repository control ------------------------------------------------
+    def get_model_repository_index(self, headers=None, query_params=None) -> List[Dict[str, Any]]:
+        resp = self._post("v2/repository/index", b"", headers, query_params)
+        raise_if_error(resp.status, resp.data)
+        return json.loads(resp.data) if resp.data else []
+
+    def load_model(
+        self, model_name, headers=None, query_params=None, config: Optional[str] = None,
+        files: Optional[Dict[str, bytes]] = None,
+    ) -> None:
+        import base64
+
+        body: Dict[str, Any] = {}
+        params: Dict[str, Any] = {}
+        if config is not None:
+            params["config"] = config
+        if files:
+            for path, content in files.items():
+                params[path] = base64.b64encode(content).decode("ascii")
+        if params:
+            body["parameters"] = params
+        resp = self._post(
+            f"v2/repository/models/{quote(model_name)}/load",
+            json.dumps(body).encode("utf-8"),
+            headers,
+            query_params,
+        )
+        raise_if_error(resp.status, resp.data)
+
+    def unload_model(
+        self, model_name, headers=None, query_params=None, unload_dependents: bool = False
+    ) -> None:
+        body = {"parameters": {"unload_dependents": unload_dependents}}
+        resp = self._post(
+            f"v2/repository/models/{quote(model_name)}/unload",
+            json.dumps(body).encode("utf-8"),
+            headers,
+            query_params,
+        )
+        raise_if_error(resp.status, resp.data)
+
+    # -- statistics / trace / log -------------------------------------------
+    def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, query_params=None
+    ) -> Dict[str, Any]:
+        if model_name:
+            path = f"v2/models/{quote(model_name)}"
+            if model_version:
+                path += f"/versions/{model_version}"
+            path += "/stats"
+        else:
+            path = "v2/models/stats"
+        return self._json_of(self._get(path, headers, query_params))
+
+    def update_trace_settings(
+        self, model_name=None, settings: Optional[Dict[str, Any]] = None,
+        headers=None, query_params=None,
+    ) -> Dict[str, Any]:
+        path = (
+            f"v2/models/{quote(model_name)}/trace/setting" if model_name else "v2/trace/setting"
+        )
+        resp = self._post(
+            path, json.dumps(settings or {}).encode("utf-8"), headers, query_params
+        )
+        return self._json_of(resp)
+
+    def get_trace_settings(self, model_name=None, headers=None, query_params=None) -> Dict[str, Any]:
+        path = (
+            f"v2/models/{quote(model_name)}/trace/setting" if model_name else "v2/trace/setting"
+        )
+        return self._json_of(self._get(path, headers, query_params))
+
+    def update_log_settings(
+        self, settings: Dict[str, Any], headers=None, query_params=None
+    ) -> Dict[str, Any]:
+        resp = self._post("v2/logging", json.dumps(settings).encode("utf-8"), headers, query_params)
+        return self._json_of(resp)
+
+    def get_log_settings(self, headers=None, query_params=None) -> Dict[str, Any]:
+        return self._json_of(self._get("v2/logging", headers, query_params))
+
+    # -- shared memory -----------------------------------------------------
+    def get_system_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ) -> List[Dict[str, Any]]:
+        return self._shm_status("systemsharedmemory", region_name, headers, query_params)
+
+    def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, query_params=None
+    ) -> None:
+        body = {"key": key, "offset": offset, "byte_size": byte_size}
+        resp = self._post(
+            f"v2/systemsharedmemory/region/{quote(name)}/register",
+            json.dumps(body).encode("utf-8"),
+            headers,
+            query_params,
+        )
+        raise_if_error(resp.status, resp.data)
+
+    def unregister_system_shared_memory(
+        self, name="", headers=None, query_params=None
+    ) -> None:
+        self._shm_unregister("systemsharedmemory", name, headers, query_params)
+
+    def _shm_register(self, family, name, raw_handle, device_id, byte_size, headers, query_params):
+        body = {
+            "raw_handle": {"b64": raw_handle},
+            "device_id": device_id,
+            "byte_size": byte_size,
+        }
+        resp = self._post(
+            f"v2/{family}/region/{quote(name)}/register",
+            json.dumps(body).encode("utf-8"),
+            headers,
+            query_params,
+        )
+        raise_if_error(resp.status, resp.data)
+
+    def _shm_status(self, family, region_name, headers, query_params):
+        path = f"v2/{family}"
+        if region_name:
+            path += f"/region/{quote(region_name)}"
+        path += "/status"
+        resp = self._get(path, headers, query_params)
+        raise_if_error(resp.status, resp.data)
+        return json.loads(resp.data) if resp.data else []
+
+    def _shm_unregister(self, family, name, headers, query_params):
+        path = f"v2/{family}"
+        if name:
+            path += f"/region/{quote(name)}"
+        path += "/unregister"
+        resp = self._post(path, b"", headers, query_params)
+        raise_if_error(resp.status, resp.data)
+
+    def get_cuda_shared_memory_status(self, region_name="", headers=None, query_params=None):
+        return self._shm_status("cudasharedmemory", region_name, headers, query_params)
+
+    def register_cuda_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, query_params=None
+    ) -> None:
+        self._shm_register(
+            "cudasharedmemory", name, raw_handle, device_id, byte_size, headers, query_params
+        )
+
+    def unregister_cuda_shared_memory(self, name="", headers=None, query_params=None) -> None:
+        self._shm_unregister("cudasharedmemory", name, headers, query_params)
+
+    def get_tpu_shared_memory_status(self, region_name="", headers=None, query_params=None):
+        return self._shm_status("tpusharedmemory", region_name, headers, query_params)
+
+    def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, query_params=None
+    ) -> None:
+        """Register a tpu_shared_memory region (see utils.tpu_shared_memory).
+
+        ``raw_handle`` is the base64 descriptor from ``get_raw_handle``.
+        """
+        self._shm_register(
+            "tpusharedmemory", name, raw_handle, device_id, byte_size, headers, query_params
+        )
+
+    def unregister_tpu_shared_memory(self, name="", headers=None, query_params=None) -> None:
+        self._shm_unregister("tpusharedmemory", name, headers, query_params)
+
+    # -- inference ---------------------------------------------------------
+    @staticmethod
+    def generate_request_body(
+        inputs: Sequence[InferInput],
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        **kwargs,
+    ):
+        """Offline marshaling: returns (body, json_size)."""
+        return build_infer_body(inputs, outputs, **kwargs)
+
+    @staticmethod
+    def parse_response_body(
+        response_body: bytes, verbose: bool = False, header_length: Optional[int] = None,
+        content_encoding: Optional[str] = None,
+    ) -> InferResult:
+        body = decompress_body(response_body, content_encoding)
+        return InferResult.from_response_body(body, header_length)
+
+    def _infer_uri(self, model_name: str, model_version: str) -> str:
+        uri = f"v2/models/{quote(model_name)}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        return uri + "/infer"
+
+    def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[Dict[str, Any]] = None,
+        request_compression_algorithm: Optional[str] = None,
+        response_compression_algorithm: Optional[str] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> InferResult:
+        """Run a synchronous inference."""
+        timers = RequestTimers()
+        timers.capture(RequestTimers.REQUEST_START)
+        body, json_size = build_infer_body(
+            inputs,
+            outputs,
+            request_id,
+            sequence_id,
+            sequence_start,
+            sequence_end,
+            priority,
+            timeout,
+            parameters,
+        )
+        hdrs = dict(headers or {})
+        body, encoding = compress_body(body, request_compression_algorithm)
+        if encoding:
+            hdrs["Content-Encoding"] = encoding
+        if response_compression_algorithm in ("gzip", "deflate"):
+            hdrs["Accept-Encoding"] = response_compression_algorithm
+        if json_size is not None:
+            hdrs["Inference-Header-Content-Length"] = str(json_size)
+            hdrs["Content-Type"] = "application/octet-stream"
+        else:
+            hdrs["Content-Type"] = "application/json"
+
+        timers.capture(RequestTimers.SEND_START)
+        resp = self._post(
+            self._infer_uri(model_name, model_version),
+            body,
+            hdrs,
+            query_params,
+            timeout=client_timeout,
+            timers=timers,
+        )
+        # urllib3 already decoded any Content-Encoding; resp.data is plain.
+        raise_if_error(resp.status, resp.data)
+        header_length = resp.headers.get("Inference-Header-Content-Length")
+        result = InferResult.from_response_body(
+            resp.data, int(header_length) if header_length is not None else None
+        )
+        timers.capture(RequestTimers.REQUEST_END)
+        self._infer_stat.update(timers)
+        if self._verbose:
+            print(result.get_response())
+        return result
+
+    def async_infer(self, model_name: str, inputs: Sequence[InferInput], **kwargs) -> InferAsyncRequest:
+        """Submit an inference on the client's thread pool; returns a handle."""
+        with self._executor_lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self._concurrency, thread_name_prefix="client_tpu_http"
+                )
+        future = self._executor.submit(self.infer, model_name, inputs, **kwargs)
+        return InferAsyncRequest(future, self._verbose)

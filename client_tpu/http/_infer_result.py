@@ -1,0 +1,112 @@
+"""HTTP InferResult: split the JSON header from the binary tail, decode tensors.
+
+Reference parity: http/_infer_result.py:54-210 (offset map over the binary
+tail, ``as_numpy`` frombuffer+reshape). TPU-first addition: ``as_jax`` places
+the decoded tensor on a jax device with a single async host->device transfer.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    triton_to_np_dtype,
+)
+
+
+class InferResult:
+    """The result of an inference request over HTTP."""
+
+    def __init__(self, response_body: bytes, header_length: Optional[int] = None):
+        self._buffer = memoryview(response_body)
+        if header_length is None:
+            self._response: Dict[str, Any] = json.loads(response_body)
+            self._binary_start = len(response_body)
+        else:
+            self._response = json.loads(bytes(self._buffer[:header_length]))
+            self._binary_start = header_length
+        # Map output name -> (start, end) into the binary tail, walked in
+        # output order using each output's binary_data_size parameter.
+        self._offsets: Dict[str, Tuple[int, int]] = {}
+        cursor = self._binary_start
+        for output in self._response.get("outputs", []):
+            params = output.get("parameters", {})
+            size = params.get("binary_data_size")
+            if size is not None:
+                self._offsets[output["name"]] = (cursor, cursor + size)
+                cursor += size
+
+    @classmethod
+    def from_response_body(
+        cls, response_body: bytes, header_length: Optional[int] = None
+    ) -> "InferResult":
+        return cls(response_body, header_length)
+
+    # -- accessors ---------------------------------------------------------
+    def get_response(self) -> Dict[str, Any]:
+        return self._response
+
+    def get_output(self, name: str) -> Optional[Dict[str, Any]]:
+        for output in self._response.get("outputs", []):
+            if output["name"] == name:
+                return output
+        return None
+
+    def _decode(self, output: Dict[str, Any]) -> Optional[np.ndarray]:
+        name = output["name"]
+        datatype = output["datatype"]
+        shape = output["shape"]
+        params = output.get("parameters", {})
+        if "shared_memory_region" in params:
+            return None  # contents live in the shared-memory region
+        if name in self._offsets:
+            start, end = self._offsets[name]
+            raw = self._buffer[start:end]
+            if datatype == "BYTES":
+                arr = deserialize_bytes_tensor(raw)
+            elif datatype == "BF16":
+                arr = deserialize_bf16_tensor(raw)
+            else:
+                np_dtype = triton_to_np_dtype(datatype)
+                if np_dtype is None:
+                    raise InferenceServerException(
+                        f"unknown datatype '{datatype}' for output '{name}'"
+                    )
+                arr = np.frombuffer(raw, dtype=np_dtype)
+            return arr.reshape(shape)
+        if "data" in output:
+            np_dtype = triton_to_np_dtype(datatype)
+            if datatype == "BYTES":
+                arr = np.array(
+                    [d.encode("utf-8") if isinstance(d, str) else d for d in output["data"]],
+                    dtype=np.object_,
+                )
+            else:
+                arr = np.array(output["data"], dtype=np_dtype)
+            return arr.reshape(shape)
+        return None
+
+    def as_numpy(self, name: str) -> Optional[np.ndarray]:
+        """Decode output ``name`` as a numpy array (zero-copy for fixed-width
+        binary outputs); None if the output lives in shared memory."""
+        output = self.get_output(name)
+        if output is None:
+            return None
+        return self._decode(output)
+
+    def as_jax(self, name: str, device=None):
+        """Decode output ``name`` and place it on a jax device (async)."""
+        arr = self.as_numpy(name)
+        if arr is None:
+            return None
+        import jax
+
+        if arr.dtype == np.object_:
+            raise InferenceServerException("BYTES outputs cannot be placed on device")
+        return jax.device_put(arr, device)

@@ -1,0 +1,142 @@
+"""KServe v2 HTTP/REST request-body builder and response helpers.
+
+Wire contract (identical to the reference so bodies interoperate with a real
+tritonserver — reference http/_utils.py:90-151, http/_infer_result.py:54-106):
+
+- Request body = UTF-8 JSON header, then the raw binary payloads of every
+  input that staged binary data, concatenated in input order. When any binary
+  payload is present the ``Inference-Header-Content-Length`` request header
+  carries the JSON byte length.
+- Response body = JSON header (+ binary tail located by the response's
+  ``Inference-Header-Content-Length``), each binary output described by a
+  ``binary_data_size`` parameter; outputs appear in the tail in order.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .._tensor import InferInput, InferRequestedOutput
+from ..utils import RESERVED_REQUEST_PARAMETERS, InferenceServerException
+
+
+def build_request_parameters(
+    request_id: str = "",
+    sequence_id: int = 0,
+    sequence_start: bool = False,
+    sequence_end: bool = False,
+    priority: int = 0,
+    timeout: Optional[int] = None,
+    parameters: Optional[Dict[str, Any]] = None,
+) -> Tuple[Optional[str], Dict[str, Any]]:
+    """Validate custom parameters and assemble the request-level parameter bag."""
+    out: Dict[str, Any] = {}
+    if sequence_id:
+        out["sequence_id"] = sequence_id
+        out["sequence_start"] = sequence_start
+        out["sequence_end"] = sequence_end
+    if priority:
+        out["priority"] = priority
+    if timeout is not None:
+        out["timeout"] = timeout
+    if parameters:
+        for key, value in parameters.items():
+            if key in RESERVED_REQUEST_PARAMETERS:
+                raise InferenceServerException(
+                    f"parameter '{key}' is a reserved parameter and cannot be "
+                    "specified as a custom parameter"
+                )
+            out[key] = value
+    return (request_id if request_id else None), out
+
+
+def build_infer_body(
+    inputs: Sequence[InferInput],
+    outputs: Optional[Sequence[InferRequestedOutput]] = None,
+    request_id: str = "",
+    sequence_id: int = 0,
+    sequence_start: bool = False,
+    sequence_end: bool = False,
+    priority: int = 0,
+    timeout: Optional[int] = None,
+    parameters: Optional[Dict[str, Any]] = None,
+) -> Tuple[bytes, Optional[int]]:
+    """Build the two-part infer body.
+
+    Returns ``(body, json_size)``; ``json_size`` is None when the body is pure
+    JSON (no binary tensor payloads).
+    """
+    rid, params = build_request_parameters(
+        request_id, sequence_id, sequence_start, sequence_end, priority, timeout, parameters
+    )
+    header: Dict[str, Any] = {}
+    if rid is not None:
+        header["id"] = rid
+
+    if outputs:
+        header["outputs"] = [o._get_tensor_json() for o in outputs]
+    else:
+        # No explicit outputs: ask the server to return everything as binary.
+        params["binary_data_output"] = True
+
+    if params:
+        header["parameters"] = params
+
+    header["inputs"] = [i._get_tensor_json() for i in inputs]
+
+    json_bytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    chunks: List[bytes] = [json_bytes]
+    has_binary = False
+    for i in inputs:
+        raw = i._get_binary_data()
+        if raw is not None:
+            has_binary = True
+            chunks.append(raw if isinstance(raw, bytes) else bytes(raw))
+    if not has_binary:
+        return json_bytes, None
+    return b"".join(chunks), len(json_bytes)
+
+
+def compress_body(body: bytes, algorithm: Optional[str]) -> Tuple[bytes, Optional[str]]:
+    """Compress a request body; returns (body, Content-Encoding header value)."""
+    if algorithm is None or algorithm == "none":
+        return body, None
+    if algorithm == "gzip":
+        return gzip.compress(body), "gzip"
+    if algorithm == "deflate":
+        return zlib.compress(body), "deflate"
+    raise InferenceServerException(f"unsupported compression algorithm '{algorithm}'")
+
+
+def decompress_body(body: bytes, content_encoding: Optional[str]) -> bytes:
+    if not content_encoding or content_encoding == "identity":
+        return body
+    if content_encoding == "gzip":
+        return gzip.decompress(body)
+    if content_encoding == "deflate":
+        return zlib.decompress(body)
+    raise InferenceServerException(
+        f"unsupported response Content-Encoding '{content_encoding}'"
+    )
+
+
+def raise_if_error(status: int, body: bytes) -> None:
+    """Raise InferenceServerException for HTTP error statuses.
+
+    The server reports errors as ``{"error": msg}``; tolerate non-JSON bodies.
+    """
+    if status < 400:
+        return
+    msg = None
+    try:
+        parsed = json.loads(body)
+        if isinstance(parsed, dict):
+            msg = parsed.get("error")
+    except Exception:
+        pass
+    if msg is None:
+        msg = body.decode("utf-8", errors="replace") if body else f"HTTP {status}"
+    raise InferenceServerException(msg=msg, status=str(status))

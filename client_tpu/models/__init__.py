@@ -1,0 +1,30 @@
+"""JAX model zoo backing the in-process server and examples.
+
+The reference client ships no models — it tests against fixture models hosted
+by a real tritonserver (``simple``, ``simple_identity``,
+``custom_identity_int32``, ``simple_sequence``, ``repeat_int32``,
+``densenet_onnx``; see SURVEY.md §2.4). Here those fixture contracts are
+implemented as jitted JAX programs so the framework is self-contained on a
+TPU VM: the same wire contracts, but the compute runs on XLA.
+"""
+
+from .base import Model, TensorSpec
+from .simple import (
+    AddSubModel,
+    IdentityModel,
+    RepeatModel,
+    SequenceAccumulatorModel,
+    StringAddSubModel,
+    default_model_zoo,
+)
+
+__all__ = [
+    "AddSubModel",
+    "IdentityModel",
+    "Model",
+    "RepeatModel",
+    "SequenceAccumulatorModel",
+    "StringAddSubModel",
+    "TensorSpec",
+    "default_model_zoo",
+]

@@ -1,0 +1,213 @@
+"""Fixture-contract models as jitted JAX programs.
+
+Contracts mirror the tritonserver QA fixture models the reference examples
+target (SURVEY.md §2.4): ``simple`` (INT32 sum/diff), ``simple_identity``
+(BYTES passthrough), ``custom_identity_int32`` (configurable-delay identity,
+used by timeout tests), ``simple_sequence`` (stateful per-sequence
+accumulator), ``repeat_int32`` (decoupled N-response streamer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterable, List
+
+import numpy as np
+
+from .base import Model, TensorSpec
+
+
+def _jit_add_sub():
+    import jax
+
+    @jax.jit
+    def add_sub(a, b):
+        return a + b, a - b
+
+    return add_sub
+
+
+class AddSubModel(Model):
+    """``simple``: INPUT0,INPUT1 INT32[1,16] -> OUTPUT0=sum, OUTPUT1=diff."""
+
+    name = "simple"
+
+    def __init__(self, batch_dim: int = 1, width: int = 16):
+        super().__init__()
+        self._shape = [batch_dim, width]
+        self._fn = None
+        self._lock = threading.Lock()
+
+    def inputs(self) -> List[TensorSpec]:
+        return [
+            TensorSpec("INPUT0", "INT32", list(self._shape)),
+            TensorSpec("INPUT1", "INT32", list(self._shape)),
+        ]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [
+            TensorSpec("OUTPUT0", "INT32", list(self._shape)),
+            TensorSpec("OUTPUT1", "INT32", list(self._shape)),
+        ]
+
+    def execute(self, inputs, parameters):
+        with self._lock:
+            if self._fn is None:
+                self._fn = _jit_add_sub()
+        s, d = self._fn(inputs["INPUT0"], inputs["INPUT1"])
+        return {"OUTPUT0": np.asarray(s), "OUTPUT1": np.asarray(d)}
+
+
+class StringAddSubModel(Model):
+    """``simple_string``: BYTES-encoded integers in, sum/diff as BYTES out."""
+
+    name = "simple_string"
+
+    def inputs(self):
+        return [
+            TensorSpec("INPUT0", "BYTES", [1, 16]),
+            TensorSpec("INPUT1", "BYTES", [1, 16]),
+        ]
+
+    def outputs(self):
+        return [
+            TensorSpec("OUTPUT0", "BYTES", [1, 16]),
+            TensorSpec("OUTPUT1", "BYTES", [1, 16]),
+        ]
+
+    def execute(self, inputs, parameters):
+        a = np.vectorize(int)(inputs["INPUT0"]).astype(np.int32)
+        b = np.vectorize(int)(inputs["INPUT1"]).astype(np.int32)
+        to_bytes = np.vectorize(lambda v: str(int(v)).encode(), otypes=[np.object_])
+        return {"OUTPUT0": to_bytes(a + b), "OUTPUT1": to_bytes(a - b)}
+
+
+class IdentityModel(Model):
+    """``simple_identity`` / ``custom_identity_int32``: passthrough.
+
+    ``delay_s`` simulates a slow model for client/stream timeout tests
+    (reference: client_timeout_test.cc vs custom_identity_int32).
+    """
+
+    def __init__(
+        self,
+        name: str = "simple_identity",
+        datatype: str = "BYTES",
+        input_name: str = "INPUT0",
+        output_name: str = "OUTPUT0",
+        delay_s: float = 0.0,
+    ):
+        super().__init__()
+        self.name = name
+        self._datatype = datatype
+        self._input_name = input_name
+        self._output_name = output_name
+        self.delay_s = delay_s
+
+    def inputs(self):
+        return [TensorSpec(self._input_name, self._datatype, [-1, -1])]
+
+    def outputs(self):
+        return [TensorSpec(self._output_name, self._datatype, [-1, -1])]
+
+    def execute(self, inputs, parameters):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        arr = inputs[self._input_name]
+        if arr.dtype != np.object_:
+            # run the copy through XLA so the data path is exercised on-device
+            import jax.numpy as jnp
+
+            arr = np.asarray(jnp.asarray(arr))
+        return {self._output_name: arr}
+
+
+class SequenceAccumulatorModel(Model):
+    """``simple_sequence``: per-sequence running INT32 accumulator.
+
+    Control semantics follow the fixture: ``sequence_start`` resets the
+    accumulator, every request adds its input value, the response carries the
+    running total, ``sequence_end`` drops the sequence state.
+    """
+
+    name = "simple_sequence"
+    stateful = True
+
+    def __init__(self):
+        super().__init__()
+        self._state: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def inputs(self):
+        return [TensorSpec("INPUT", "INT32", [1, 1])]
+
+    def outputs(self):
+        return [TensorSpec("OUTPUT", "INT32", [1, 1])]
+
+    def execute(self, inputs, parameters):
+        seq_id = parameters.get("sequence_id", 0)
+        start = parameters.get("sequence_start", False)
+        end = parameters.get("sequence_end", False)
+        if not seq_id:
+            raise ValueError("simple_sequence requires a sequence_id")
+        value = int(np.asarray(inputs["INPUT"]).reshape(-1)[0])
+        with self._lock:
+            acc = 0 if start else self._state.get(seq_id, 0)
+            acc += value
+            if end:
+                self._state.pop(seq_id, None)
+            else:
+                self._state[seq_id] = acc
+        return {"OUTPUT": np.array([[acc]], dtype=np.int32)}
+
+
+class RepeatModel(Model):
+    """``repeat_int32``: decoupled — emit one response per input element.
+
+    Inputs: IN (INT32[-1]), DELAY (UINT32[-1], per-response delay in ms),
+    WAIT (UINT32[1], initial wait in ms). Output: OUT (INT32[1]) streamed
+    len(IN) times, plus IDX (UINT32[1]) with the response index.
+    """
+
+    name = "repeat_int32"
+    decoupled = True
+
+    def inputs(self):
+        return [
+            TensorSpec("IN", "INT32", [-1]),
+            TensorSpec("DELAY", "UINT32", [-1], optional=True),
+            TensorSpec("WAIT", "UINT32", [1], optional=True),
+        ]
+
+    def outputs(self):
+        return [TensorSpec("OUT", "INT32", [1]), TensorSpec("IDX", "UINT32", [1])]
+
+    def execute(self, inputs, parameters):
+        raise ValueError("repeat_int32 is a decoupled model; use streaming infer")
+
+    def execute_decoupled(self, inputs, parameters) -> Iterable[Dict[str, np.ndarray]]:
+        values = np.asarray(inputs["IN"]).reshape(-1)
+        delays = np.asarray(inputs.get("DELAY", np.zeros(len(values), np.uint32))).reshape(-1)
+        wait = int(np.asarray(inputs.get("WAIT", np.zeros(1, np.uint32))).reshape(-1)[0])
+        if wait:
+            time.sleep(wait / 1000.0)
+        for idx, v in enumerate(values):
+            if idx < len(delays) and delays[idx]:
+                time.sleep(int(delays[idx]) / 1000.0)
+            yield {
+                "OUT": np.array([v], dtype=np.int32),
+                "IDX": np.array([idx], dtype=np.uint32),
+            }
+
+
+def default_model_zoo() -> List[Model]:
+    """The fixture set every test/example expects to find on the server."""
+    return [
+        AddSubModel(),
+        StringAddSubModel(),
+        IdentityModel("simple_identity", "BYTES"),
+        IdentityModel("custom_identity_int32", "INT32", delay_s=0.0),
+        SequenceAccumulatorModel(),
+        RepeatModel(),
+    ]

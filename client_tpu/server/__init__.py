@@ -1,0 +1,13 @@
+"""In-process KServe v2 inference server with a JAX/XLA backend.
+
+The reference is client-only and relies on an external ``tritonserver`` for
+integration tests (SURVEY.md §4). This package makes the framework
+self-contained: a protocol-complete v2 server whose model execution runs on
+JAX (TPU when available), with the system/TPU shared-memory data planes.
+Frontends: HTTP (``http_server``), GRPC (``grpc_server``).
+"""
+
+from .core import ServerCore
+from .http_server import HttpInferenceServer
+
+__all__ = ["ServerCore", "HttpInferenceServer"]

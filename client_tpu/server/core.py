@@ -1,0 +1,501 @@
+"""Protocol-neutral server core: model registry, shm data plane, stats, infer.
+
+Both the HTTP and GRPC frontends marshal requests into the neutral dict shape
+consumed by :meth:`ServerCore.infer`; the core resolves shared-memory
+placement, executes the model, tracks statistics, and applies the
+classification extension.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.base import Model
+from ..utils import triton_to_np_dtype
+
+_BUILTIN_SHM_FAMILIES = ("system", "cuda", "tpu")
+
+
+class _Region:
+    """A registered shared-memory region the server can read/write."""
+
+    def __init__(
+        self,
+        name: str,
+        family: str,
+        key: str,
+        offset: int,
+        byte_size: int,
+        device_id: int = 0,
+        raw_handle: Optional[str] = None,
+    ):
+        self.name = name
+        self.family = family
+        self.key = key
+        self.offset = offset
+        self.byte_size = byte_size
+        self.device_id = device_id
+        self.raw_handle = raw_handle
+        self._shm = None
+
+    def _buffer(self) -> memoryview:
+        if self._shm is None:
+            from multiprocessing import shared_memory as mpshm
+
+            # resource_tracker would unlink on process exit even for regions
+            # we merely attach to; track=False leaves ownership to the creator
+            self._shm = mpshm.SharedMemory(name=self.key.lstrip("/"), track=False)
+        return self._shm.buf
+
+    def _check_range(self, nbytes: int, offset: int, op: str) -> int:
+        if offset < 0 or nbytes < 0 or nbytes + offset > self.byte_size:
+            raise ValueError(
+                f"shared-memory {op} of {nbytes}B at offset {offset} exceeds "
+                f"region '{self.name}' ({self.byte_size}B)"
+            )
+        return self.offset + offset
+
+    def read(self, byte_size: int, offset: int) -> memoryview:
+        base = self._check_range(byte_size, offset, "read")
+        return self._buffer()[base : base + byte_size]
+
+    def write(self, data: bytes, offset: int) -> None:
+        base = self._check_range(len(data), offset, "write")
+        self._buffer()[base : base + len(data)] = data
+
+    def close(self) -> None:
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
+
+    def status(self) -> Dict[str, Any]:
+        if self.family == "system":
+            return {
+                "name": self.name,
+                "key": self.key,
+                "offset": self.offset,
+                "byte_size": self.byte_size,
+            }
+        return {
+            "name": self.name,
+            "device_id": self.device_id,
+            "byte_size": self.byte_size,
+        }
+
+
+class _ModelStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.inference_count = 0
+        self.execution_count = 0
+        self.last_inference = 0
+        self.success = [0, 0]  # count, ns
+        self.fail = [0, 0]
+        self.compute_infer = [0, 0]
+
+    def record(self, ok: bool, total_ns: int, infer_ns: int, batch: int) -> None:
+        with self.lock:
+            if ok:
+                self.inference_count += batch
+                self.execution_count += 1
+                self.last_inference = int(time.time() * 1000)
+                self.success[0] += 1
+                self.success[1] += total_ns
+                self.compute_infer[0] += 1
+                self.compute_infer[1] += infer_ns
+            else:
+                self.fail[0] += 1
+                self.fail[1] += total_ns
+
+    def as_dict(self, name: str, version: str) -> Dict[str, Any]:
+        with self.lock:
+            return {
+                "name": name,
+                "version": version,
+                "last_inference": self.last_inference,
+                "inference_count": self.inference_count,
+                "execution_count": self.execution_count,
+                "inference_stats": {
+                    "success": {"count": self.success[0], "ns": self.success[1]},
+                    "fail": {"count": self.fail[0], "ns": self.fail[1]},
+                    "queue": {"count": 0, "ns": 0},
+                    "compute_input": {"count": 0, "ns": 0},
+                    "compute_infer": {
+                        "count": self.compute_infer[0],
+                        "ns": self.compute_infer[1],
+                    },
+                    "compute_output": {"count": 0, "ns": 0},
+                },
+                "batch_stats": [],
+            }
+
+
+class InferError(Exception):
+    """Server-side inference failure with an HTTP-ish status code."""
+
+    def __init__(self, msg: str, status: int = 400):
+        super().__init__(msg)
+        self.status = status
+
+
+class ServerCore:
+    """Registry + data plane + execution; shared by all protocol frontends."""
+
+    def __init__(self, models: Optional[List[Model]] = None, name: str = "client_tpu_server"):
+        self._name = name
+        self._lock = threading.Lock()
+        self._models: Dict[str, Model] = {}
+        self._stats: Dict[str, _ModelStats] = {}
+        self._regions: Dict[str, _Region] = {}
+        self.trace_settings: Dict[str, Any] = {
+            "trace_level": ["OFF"],
+            "trace_rate": "1000",
+            "trace_count": "-1",
+            "log_frequency": "0",
+            "trace_file": "",
+            "trace_mode": "triton",
+        }
+        self.log_settings: Dict[str, Any] = {
+            "log_file": "",
+            "log_info": True,
+            "log_warning": True,
+            "log_error": True,
+            "log_verbose_level": 0,
+            "log_format": "default",
+        }
+        self.live = True
+        for m in models or []:
+            self.add_model(m)
+
+    # -- registry ----------------------------------------------------------
+    def add_model(self, model: Model) -> None:
+        with self._lock:
+            self._models[model.name] = model
+            self._stats.setdefault(model.name, _ModelStats())
+
+    def model(self, name: str, version: str = "") -> Model:
+        m = self._models.get(name)
+        if m is None:
+            raise InferError(f"Request for unknown model: '{name}' is not found", 400)
+        if version and version not in m.versions:
+            raise InferError(
+                f"Request for unknown model: '{name}' version {version} is not found", 400
+            )
+        return m
+
+    def model_ready(self, name: str, version: str = "") -> bool:
+        try:
+            return self.model(name, version).ready
+        except InferError:
+            return False
+
+    def server_metadata(self) -> Dict[str, Any]:
+        return {
+            "name": self._name,
+            "version": "2.x-client_tpu",
+            "extensions": [
+                "classification",
+                "sequence",
+                "model_repository",
+                "model_repository(unload_dependents)",
+                "schedule_policy",
+                "model_configuration",
+                "system_shared_memory",
+                "cuda_shared_memory",
+                "tpu_shared_memory",
+                "binary_tensor_data",
+                "parameters",
+                "statistics",
+                "trace",
+                "logging",
+            ],
+        }
+
+    def repository_index(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {
+                    "name": m.name,
+                    "version": m.versions[-1],
+                    "state": "READY" if m.ready else "UNAVAILABLE",
+                    "reason": "",
+                }
+                for m in self._models.values()
+            ]
+
+    def load_model(self, name: str) -> None:
+        self.model(name).load()
+
+    def unload_model(self, name: str) -> None:
+        self.model(name).unload()
+
+    def statistics(self, name: str = "", version: str = "") -> Dict[str, Any]:
+        with self._lock:
+            names = [name] if name else list(self._models.keys())
+        out = []
+        for n in names:
+            m = self.model(n)
+            out.append(self._stats[n].as_dict(n, version or m.versions[-1]))
+        return {"model_stats": out}
+
+    # -- shared memory -----------------------------------------------------
+    def register_system_region(self, name: str, key: str, offset: int, byte_size: int) -> None:
+        self._register(_Region(name, "system", key, offset, byte_size))
+
+    def register_handle_region(
+        self, family: str, name: str, raw_handle_b64: str, device_id: int, byte_size: int
+    ) -> None:
+        """Register a tpu (or cuda-format) region from its serialized handle.
+
+        tpu raw handles are base64 JSON descriptors produced by
+        ``utils.tpu_shared_memory.get_raw_handle`` and carry the host shm key
+        of the region's host window.
+        """
+        try:
+            desc = json.loads(base64.b64decode(raw_handle_b64))
+            key = desc["shm_key"]
+        except Exception as e:
+            raise InferError(f"failed to decode {family} shared-memory handle: {e}", 400)
+        self._register(
+            _Region(
+                name,
+                family,
+                key,
+                int(desc.get("offset", 0)),
+                byte_size,
+                device_id,
+                raw_handle=raw_handle_b64,
+            )
+        )
+
+    def _register(self, region: _Region) -> None:
+        with self._lock:
+            existing = self._regions.get(region.name)
+            if existing is not None and existing.family != region.family:
+                raise InferError(
+                    f"shared memory region '{region.name}' already registered "
+                    f"as {existing.family}", 400,
+                )
+            self._regions[region.name] = region
+
+    def unregister_region(self, name: str = "", family: Optional[str] = None) -> None:
+        with self._lock:
+            if name:
+                r = self._regions.pop(name, None)
+                if r is not None:
+                    r.close()
+            else:
+                for key in list(self._regions):
+                    if family is None or self._regions[key].family == family:
+                        self._regions.pop(key).close()
+
+    def region_status(self, family: str, name: str = "") -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                r.status()
+                for r in self._regions.values()
+                if r.family == family and (not name or r.name == name)
+            ]
+
+    def _region(self, name: str) -> _Region:
+        with self._lock:
+            r = self._regions.get(name)
+        if r is None:
+            raise InferError(
+                f"Unable to find shared memory region: '{name}'", 400
+            )
+        return r
+
+    # -- inference ---------------------------------------------------------
+    def infer(self, model_name: str, model_version: str, request: Dict[str, Any],
+              decoupled_ok: bool = False):
+        """Execute one inference.
+
+        ``request``: {"id", "parameters", "inputs": [...], "outputs": [...]}
+        where each input dict has name/datatype/shape plus exactly one of
+        "array" (host ndarray) or "shm" ((region, byte_size, offset)).
+
+        Returns a list of response dicts (len>1 only for decoupled models);
+        each response: {"model_name","model_version","id","parameters",
+        "outputs": [{name, datatype, shape, "array"|"shm"}]}.
+        """
+        t0 = time.perf_counter_ns()
+        model = self.model(model_name, model_version)
+        if not model.ready:
+            raise InferError(f"Request for unknown model: '{model_name}' is not ready", 400)
+        if model.decoupled and not decoupled_ok:
+            raise InferError(
+                f"model '{model_name}' is a decoupled model: use streaming inference", 400
+            )
+        try:
+            inputs = self._resolve_inputs(model, request)
+            params = request.get("parameters", {})
+            t_infer = time.perf_counter_ns()
+            if model.decoupled:
+                raw_responses = list(model.execute_decoupled(inputs, params))
+            else:
+                raw_responses = [model.execute(inputs, params)]
+            infer_ns = time.perf_counter_ns() - t_infer
+        except InferError:
+            self._stats[model_name].record(False, time.perf_counter_ns() - t0, 0, 0)
+            raise
+        except Exception as e:
+            self._stats[model_name].record(False, time.perf_counter_ns() - t0, 0, 0)
+            raise InferError(f"inference failed: {e}", 400)
+
+        responses = []
+        for raw in raw_responses:
+            responses.append(
+                self._build_response(model, model_version, request, raw)
+            )
+        batch = 1
+        if responses and model.max_batch_size:
+            first = next(iter(raw_responses[0].values()))
+            batch = int(first.shape[0]) if first.ndim else 1
+        self._stats[model_name].record(True, time.perf_counter_ns() - t0, infer_ns, batch)
+        return responses
+
+    def _resolve_inputs(self, model: Model, request: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        specs = {s.name: s for s in model.inputs()}
+        out: Dict[str, np.ndarray] = {}
+        for inp in request.get("inputs", []):
+            name = inp["name"]
+            spec = specs.get(name)
+            if spec is None:
+                raise InferError(
+                    f"unexpected inference input '{name}' for model '{model.name}'", 400
+                )
+            datatype = inp.get("datatype", spec.datatype)
+            if datatype != spec.datatype:
+                raise InferError(
+                    f"inference input '{name}' has datatype {datatype}; "
+                    f"model expects {spec.datatype}", 400,
+                )
+            shape = inp.get("shape", [])
+            if not spec.matches(shape):
+                raise InferError(
+                    f"unexpected shape {shape} for input '{name}' "
+                    f"(model expects {spec.shape})", 400,
+                )
+            shm = inp.get("shm")
+            if shm is not None:
+                region_name, byte_size, offset = shm
+                raw = self._region(region_name).read(byte_size, offset)
+                out[name] = _bytes_to_array(bytes(raw), datatype, shape)
+            else:
+                arr = inp.get("array")
+                if arr is None:
+                    raise InferError(f"input '{name}' has no data", 400)
+                out[name] = arr
+        missing = {s for s in set(specs) - set(out) if not specs[s].optional}
+        if missing:
+            raise InferError(
+                f"expected {len(specs)} inputs but got {len(out)} inputs for "
+                f"model '{model.name}' (missing: {sorted(missing)})", 400,
+            )
+        return out
+
+    def _build_response(
+        self, model: Model, model_version: str, request: Dict[str, Any],
+        raw: Dict[str, np.ndarray],
+    ) -> Dict[str, Any]:
+        requested = request.get("outputs")
+        out_specs: List[Dict[str, Any]] = []
+        if requested:
+            for r in requested:
+                if r["name"] not in raw:
+                    raise InferError(
+                        f"unexpected inference output '{r['name']}' for model "
+                        f"'{model.name}'", 400,
+                    )
+                out_specs.append(r)
+        else:
+            out_specs = [{"name": n} for n in raw.keys()]
+
+        outputs = []
+        for spec in out_specs:
+            name = spec["name"]
+            arr = np.asarray(raw[name])
+            class_count = spec.get("classification", 0)
+            if class_count:
+                arr = _classification(arr, class_count, model.labels())
+                datatype = "BYTES"
+            else:
+                from ..utils import np_to_triton_dtype
+
+                datatype = np_to_triton_dtype(arr.dtype)
+            entry: Dict[str, Any] = {
+                "name": name,
+                "datatype": datatype,
+                "shape": list(arr.shape),
+            }
+            shm = spec.get("shm")
+            if shm is not None:
+                region_name, byte_size, offset = shm
+                payload = _array_to_bytes(arr, datatype)
+                if len(payload) > byte_size:
+                    raise InferError(
+                        f"output '{name}' ({len(payload)}B) exceeds shared-memory "
+                        f"region size {byte_size}B", 400,
+                    )
+                self._region(region_name).write(payload, offset)
+                entry["shm"] = (region_name, len(payload), offset)
+            else:
+                entry["array"] = arr
+            outputs.append(entry)
+        resp: Dict[str, Any] = {
+            "model_name": model.name,
+            "model_version": model_version or model.versions[-1],
+            "outputs": outputs,
+        }
+        if request.get("id"):
+            resp["id"] = request["id"]
+        return resp
+
+
+def _bytes_to_array(buf: bytes, datatype: str, shape) -> np.ndarray:
+    from ..utils import deserialize_bf16_tensor, deserialize_bytes_tensor
+
+    if datatype == "BYTES":
+        return deserialize_bytes_tensor(buf).reshape(shape)
+    if datatype == "BF16":
+        return deserialize_bf16_tensor(buf).reshape(shape)
+    return np.frombuffer(buf, dtype=triton_to_np_dtype(datatype)).reshape(shape)
+
+
+def _array_to_bytes(arr: np.ndarray, datatype: str) -> bytes:
+    from ..utils import serialize_bf16_tensor, serialize_byte_tensor
+
+    if datatype == "BYTES":
+        s = serialize_byte_tensor(arr)
+        return s.item() if s.size else b""
+    if datatype == "BF16":
+        s = serialize_bf16_tensor(arr)
+        return s.item() if s.size else b""
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def _classification(arr: np.ndarray, k: int, labels: Optional[List[str]]) -> np.ndarray:
+    """classification extension: top-k "value:index[:label]" strings per row."""
+    flat_batch = arr.reshape((-1, arr.shape[-1])) if arr.ndim > 1 else arr.reshape((1, -1))
+    k = min(k, flat_batch.shape[-1])
+    rows = []
+    for row in flat_batch:
+        idx = np.argsort(row)[::-1][:k]
+        entries = []
+        for i in idx:
+            s = f"{row[i]:f}:{i}"
+            if labels and i < len(labels):
+                s += f":{labels[i]}"
+            entries.append(s.encode("utf-8"))
+        rows.append(entries)
+    out = np.array(rows, dtype=np.object_)
+    if arr.ndim == 1:
+        return out.reshape(-1)
+    return out.reshape(arr.shape[:-1] + (k,))

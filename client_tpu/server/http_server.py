@@ -1,0 +1,396 @@
+"""HTTP/REST frontend for ServerCore: the KServe v2 protocol + extensions.
+
+Implements the same route surface the reference client targets (SURVEY.md
+§2.1 http_client rows): health, metadata, config, repository control, stats,
+trace/log settings, shared-memory registration (system / cuda-format / tpu),
+and two-part binary inference bodies with ``Inference-Header-Content-Length``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import re
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import unquote
+
+import numpy as np
+
+from ..utils import triton_to_np_dtype
+from .core import InferError, ServerCore, _array_to_bytes, _bytes_to_array
+
+_MODEL_RE = re.compile(r"^/v2/models/([^/]+)(?:/versions/([^/]+))?(?:/(.*))?$")
+_SHM_RE = re.compile(
+    r"^/v2/(systemsharedmemory|cudasharedmemory|tpusharedmemory)"
+    r"(?:/region/([^/]+))?/(status|register|unregister)$"
+)
+_FAMILY = {
+    "systemsharedmemory": "system",
+    "cudasharedmemory": "cuda",
+    "tpusharedmemory": "tpu",
+}
+
+
+def _decode_input(entry: Dict[str, Any], tail: memoryview, cursor: int) -> Tuple[Dict[str, Any], int]:
+    """Convert one JSON input descriptor (+binary tail slice) to the core shape."""
+    params = entry.get("parameters", {})
+    out: Dict[str, Any] = {
+        "name": entry["name"],
+        "datatype": entry["datatype"],
+        "shape": entry["shape"],
+    }
+    if "shared_memory_region" in params:
+        out["shm"] = (
+            params["shared_memory_region"],
+            params.get("shared_memory_byte_size", 0),
+            params.get("shared_memory_offset", 0),
+        )
+        return out, cursor
+    size = params.get("binary_data_size")
+    if size is not None:
+        raw = bytes(tail[cursor : cursor + size])
+        out["array"] = _bytes_to_array(raw, entry["datatype"], entry["shape"])
+        return out, cursor + size
+    data = entry.get("data")
+    if data is None:
+        raise InferError(f"input '{entry['name']}' has no data", 400)
+    if entry["datatype"] == "BYTES":
+        arr = np.array(
+            [d.encode("utf-8") if isinstance(d, str) else bytes(d) for d in _flatten(data)],
+            dtype=np.object_,
+        ).reshape(entry["shape"])
+    else:
+        arr = np.array(data, dtype=triton_to_np_dtype(entry["datatype"])).reshape(entry["shape"])
+    out["array"] = arr
+    return out, cursor
+
+
+def _flatten(data):
+    if isinstance(data, (list, tuple)):
+        for item in data:
+            yield from _flatten(item)
+    else:
+        yield data
+
+
+def parse_infer_request(body: bytes, header_length: Optional[int]) -> Dict[str, Any]:
+    """Parse a two-part infer body into the neutral core request dict."""
+    if header_length is None:
+        header = json.loads(body)
+        tail = memoryview(b"")
+    else:
+        header = json.loads(body[:header_length])
+        tail = memoryview(body)[header_length:]
+    request: Dict[str, Any] = {
+        "id": header.get("id", ""),
+        "parameters": header.get("parameters", {}),
+        "inputs": [],
+    }
+    cursor = 0
+    for entry in header.get("inputs", []):
+        decoded, cursor = _decode_input(entry, tail, cursor)
+        request["inputs"].append(decoded)
+    outputs = []
+    binary_default = bool(request["parameters"].get("binary_data_output", False))
+    for entry in header.get("outputs", []) or []:
+        params = entry.get("parameters", {})
+        spec: Dict[str, Any] = {
+            "name": entry["name"],
+            "binary": params.get("binary_data", binary_default),
+            "classification": params.get("classification", 0),
+        }
+        if "shared_memory_region" in params:
+            spec["shm"] = (
+                params["shared_memory_region"],
+                params.get("shared_memory_byte_size", 0),
+                params.get("shared_memory_offset", 0),
+            )
+        outputs.append(spec)
+    if outputs:
+        request["outputs"] = outputs
+    elif binary_default:
+        request["outputs"] = None
+        request["binary_default"] = True
+    return request
+
+
+def encode_infer_response(
+    response: Dict[str, Any], requested: Optional[List[Dict[str, Any]]],
+    binary_default: bool,
+) -> Tuple[bytes, Optional[int]]:
+    """Encode a core response dict into (body, json_header_length)."""
+    req_by_name = {r["name"]: r for r in requested or []}
+    header: Dict[str, Any] = {
+        "model_name": response["model_name"],
+        "model_version": response["model_version"],
+    }
+    if response.get("id"):
+        header["id"] = response["id"]
+    if response.get("parameters"):
+        header["parameters"] = response["parameters"]
+    out_entries = []
+    tails: List[bytes] = []
+    for out in response["outputs"]:
+        entry: Dict[str, Any] = {
+            "name": out["name"],
+            "datatype": out["datatype"],
+            "shape": out["shape"],
+        }
+        if "shm" in out:
+            region, byte_size, offset = out["shm"]
+            entry["parameters"] = {
+                "shared_memory_region": region,
+                "shared_memory_byte_size": byte_size,
+            }
+            if offset:
+                entry["parameters"]["shared_memory_offset"] = offset
+        else:
+            spec = req_by_name.get(out["name"], {})
+            binary = spec.get("binary", binary_default)
+            arr = out["array"]
+            if out["datatype"] in ("BF16",):
+                binary = True  # no JSON representation
+            if binary:
+                payload = _array_to_bytes(arr, out["datatype"])
+                tails.append(payload)
+                entry["parameters"] = {"binary_data_size": len(payload)}
+            else:
+                if out["datatype"] == "BYTES":
+                    entry["data"] = [
+                        e.decode("utf-8", errors="replace") if isinstance(e, bytes) else str(e)
+                        for e in arr.reshape(-1).tolist()
+                    ]
+                else:
+                    entry["data"] = [v.item() for v in np.nditer(arr, order="C")]
+        out_entries.append(entry)
+    header["outputs"] = out_entries
+    hj = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    if not tails:
+        return hj, None
+    return hj + b"".join(tails), len(hj)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    core: ServerCore  # set by server factory
+
+    def log_message(self, fmt, *args):  # quiet
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    # -- plumbing ----------------------------------------------------------
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        encoding = self.headers.get("Content-Encoding")
+        if encoding == "gzip":
+            body = gzip.decompress(body)
+        elif encoding == "deflate":
+            body = zlib.decompress(body)
+        return body
+
+    def _send(self, status: int, body: bytes = b"", headers: Optional[Dict[str, str]] = None):
+        # Honor Accept-Encoding (clients only send it when they asked for
+        # response compression). Inference-Header-Content-Length refers to the
+        # *uncompressed* body, matching the protocol.
+        accept = self.headers.get("Accept-Encoding", "")
+        headers = dict(headers or {})
+        if body and "Content-Encoding" not in headers:
+            if "gzip" in accept:
+                body = gzip.compress(body)
+                headers["Content-Encoding"] = "gzip"
+            elif "deflate" in accept:
+                body = zlib.compress(body)
+                headers["Content-Encoding"] = "deflate"
+        self.send_response(status)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_json(self, obj: Any, status: int = 200):
+        self._send(
+            status,
+            json.dumps(obj, separators=(",", ":")).encode("utf-8"),
+            {"Content-Type": "application/json"},
+        )
+
+    def _send_error_json(self, e: Exception):
+        status = e.status if isinstance(e, InferError) else 500
+        self._send_json({"error": str(e)}, status)
+
+    # -- GET ---------------------------------------------------------------
+    def do_GET(self):
+        core = self.core
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/v2" or path == "/v2/":
+                return self._send_json(core.server_metadata())
+            if path == "/v2/health/live":
+                return self._send(200 if core.live else 503)
+            if path == "/v2/health/ready":
+                return self._send(200 if core.live else 503)
+            if path == "/v2/models/stats":
+                return self._send_json(core.statistics())
+            if path == "/v2/trace/setting":
+                return self._send_json(core.trace_settings)
+            if path == "/v2/logging":
+                return self._send_json(core.log_settings)
+            m = _SHM_RE.match(path)
+            if m and m.group(3) == "status":
+                return self._send_json(
+                    core.region_status(_FAMILY[m.group(1)], unquote(m.group(2) or ""))
+                )
+            m = _MODEL_RE.match(path)
+            if m:
+                name, version, tail = unquote(m.group(1)), m.group(2) or "", m.group(3) or ""
+                if tail == "ready":
+                    return self._send(200 if core.model_ready(name, version) else 400)
+                if tail == "config":
+                    return self._send_json(core.model(name, version).config())
+                if tail == "stats":
+                    return self._send_json(core.statistics(name, version))
+                if tail == "trace/setting":
+                    return self._send_json(core.trace_settings)
+                if tail == "":
+                    return self._send_json(core.model(name, version).metadata())
+            self._send_json({"error": f"unknown route {path}"}, 404)
+        except Exception as e:
+            self._send_error_json(e)
+
+    # -- POST --------------------------------------------------------------
+    def do_POST(self):
+        core = self.core
+        path = self.path.split("?", 1)[0]
+        try:
+            body = self._read_body()
+            if path == "/v2/repository/index":
+                return self._send_json(core.repository_index())
+            m = re.match(r"^/v2/repository/models/([^/]+)/(load|unload)$", path)
+            if m:
+                if m.group(2) == "load":
+                    core.load_model(unquote(m.group(1)))
+                else:
+                    core.unload_model(unquote(m.group(1)))
+                return self._send_json({})
+            if path == "/v2/trace/setting" or re.match(
+                r"^/v2/models/[^/]+/trace/setting$", path
+            ):
+                settings = json.loads(body) if body else {}
+                for k, v in settings.items():
+                    core.trace_settings[k] = v
+                return self._send_json(core.trace_settings)
+            if path == "/v2/logging":
+                settings = json.loads(body) if body else {}
+                for k, v in settings.items():
+                    core.log_settings[k] = v
+                return self._send_json(core.log_settings)
+            m = _SHM_RE.match(path)
+            if m:
+                family, action = _FAMILY[m.group(1)], m.group(3)
+                region = unquote(m.group(2)) if m.group(2) else None
+                payload = json.loads(body) if body else {}
+                if action == "register":
+                    if family == "system":
+                        core.register_system_region(
+                            region,
+                            payload["key"],
+                            payload.get("offset", 0),
+                            payload["byte_size"],
+                        )
+                    else:
+                        core.register_handle_region(
+                            family,
+                            region,
+                            payload["raw_handle"]["b64"],
+                            payload.get("device_id", 0),
+                            payload["byte_size"],
+                        )
+                elif action == "unregister":
+                    core.unregister_region(region or "", None if region else family)
+                return self._send_json({})
+            m = _MODEL_RE.match(path)
+            if m and (m.group(3) or "") == "infer":
+                return self._do_infer(unquote(m.group(1)), m.group(2) or "", body)
+            self._send_json({"error": f"unknown route {path}"}, 404)
+        except InferError as e:
+            self._send_error_json(e)
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            self._send_json({"error": f"failed to parse request: {e}"}, 400)
+        except Exception as e:
+            self._send_json({"error": f"internal error: {e}"}, 500)
+
+    def _do_infer(self, model_name: str, model_version: str, body: bytes):
+        header_length = self.headers.get("Inference-Header-Content-Length")
+        request = parse_infer_request(
+            body, int(header_length) if header_length is not None else None
+        )
+        requested = request.get("outputs")
+        binary_default = bool(
+            request.get("binary_default")
+            or request.get("parameters", {}).get("binary_data_output", False)
+        )
+        responses = self.core.infer(model_name, model_version, request)
+        body_out, json_size = encode_infer_response(
+            responses[0], requested, binary_default
+        )
+        headers = {"Content-Type": "application/json"}
+        if json_size is not None:
+            headers = {
+                "Content-Type": "application/octet-stream",
+                "Inference-Header-Content-Length": str(json_size),
+            }
+        self._send(200, body_out, headers)
+
+
+class HttpInferenceServer:
+    """An in-process threaded v2 HTTP server bound to localhost.
+
+    Usage::
+
+        server = HttpInferenceServer(ServerCore(default_model_zoo()))
+        server.start()
+        client = InferenceServerClient(server.url)
+        ...
+        server.stop()
+    """
+
+    def __init__(self, core: ServerCore, port: int = 0, verbose: bool = False):
+        self.core = core
+        handler = type("BoundHandler", (_Handler,), {"core": core})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._httpd.verbose = verbose
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "HttpInferenceServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="client_tpu_http_server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+    def __enter__(self) -> "HttpInferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

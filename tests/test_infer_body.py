@@ -1,0 +1,173 @@
+"""Unit tests for the HTTP two-part body builder / result parser (serverless)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from client_tpu.http import InferInput, InferRequestedOutput, InferResult
+from client_tpu.http._utils import build_infer_body, compress_body, decompress_body
+from client_tpu.utils import InferenceServerException
+
+
+def _split(body, json_size):
+    header = json.loads(body[:json_size]) if json_size else json.loads(body)
+    tail = body[json_size:] if json_size else b""
+    return header, tail
+
+
+def test_binary_body_layout():
+    in0 = InferInput("INPUT0", [1, 4], "INT32")
+    in1 = InferInput("INPUT1", [1, 4], "INT32")
+    a = np.arange(4, dtype=np.int32).reshape(1, 4)
+    b = np.arange(4, 8, dtype=np.int32).reshape(1, 4)
+    in0.set_data_from_numpy(a)
+    in1.set_data_from_numpy(b)
+    outs = [InferRequestedOutput("OUTPUT0"), InferRequestedOutput("OUTPUT1", binary_data=False)]
+    body, json_size = build_infer_body([in0, in1], outs, request_id="42")
+    header, tail = _split(body, json_size)
+    assert header["id"] == "42"
+    assert header["inputs"][0]["parameters"]["binary_data_size"] == 16
+    assert tail == a.tobytes() + b.tobytes()
+    assert header["outputs"][0]["parameters"]["binary_data"] is True
+    assert header["outputs"][1]["parameters"]["binary_data"] is False
+
+
+def test_json_body_no_binary():
+    in0 = InferInput("IN", [2, 2], "FP32")
+    in0.set_data_from_numpy(np.ones((2, 2), dtype=np.float32), binary_data=False)
+    body, json_size = build_infer_body([in0])
+    assert json_size is None
+    header = json.loads(body)
+    assert header["inputs"][0]["data"] == [1.0, 1.0, 1.0, 1.0]
+    # no explicit outputs => binary_data_output requested
+    assert header["parameters"]["binary_data_output"] is True
+
+
+def test_sequence_and_custom_parameters():
+    in0 = InferInput("IN", [1], "INT32")
+    in0.set_data_from_numpy(np.array([1], dtype=np.int32))
+    body, json_size = build_infer_body(
+        [in0], sequence_id=7, sequence_start=True, sequence_end=False,
+        priority=3, timeout=1000, parameters={"custom": "yes"},
+    )
+    header, _ = _split(body, json_size)
+    p = header["parameters"]
+    assert p["sequence_id"] == 7 and p["sequence_start"] is True and p["sequence_end"] is False
+    assert p["priority"] == 3 and p["timeout"] == 1000 and p["custom"] == "yes"
+
+
+def test_reserved_parameter_rejected():
+    in0 = InferInput("IN", [1], "INT32")
+    in0.set_data_from_numpy(np.array([1], dtype=np.int32))
+    with pytest.raises(InferenceServerException):
+        build_infer_body([in0], parameters={"sequence_id": 5})
+
+
+def test_shared_memory_params_replace_data():
+    in0 = InferInput("IN", [1, 4], "INT32")
+    in0.set_data_from_numpy(np.arange(4, dtype=np.int32).reshape(1, 4))
+    in0.set_shared_memory("region0", 16, offset=8)
+    out0 = InferRequestedOutput("OUT")
+    out0.set_shared_memory("region1", 16)
+    body, json_size = build_infer_body([in0], [out0])
+    assert json_size is None  # shm input carries no binary payload
+    header = json.loads(body)
+    ip = header["inputs"][0]["parameters"]
+    assert ip == {
+        "shared_memory_region": "region0",
+        "shared_memory_byte_size": 16,
+        "shared_memory_offset": 8,
+    }
+    op = header["outputs"][0]["parameters"]
+    assert op["shared_memory_region"] == "region1"
+    assert "binary_data" not in op
+
+
+def test_datatype_mismatch_raises():
+    in0 = InferInput("IN", [2], "FP32")
+    with pytest.raises(InferenceServerException):
+        in0.set_data_from_numpy(np.array([1, 2], dtype=np.int64))
+
+
+def test_shape_mismatch_raises():
+    in0 = InferInput("IN", [3], "INT32")
+    with pytest.raises(InferenceServerException):
+        in0.set_data_from_numpy(np.array([1, 2], dtype=np.int32))
+
+
+def test_dlpack_input_zero_copy():
+    in0 = InferInput("IN", [4], "FP32")
+    arr = np.arange(4, dtype=np.float32)
+    in0.set_data_from_dlpack(arr)
+    body, json_size = build_infer_body([in0])
+    assert body[json_size:] == arr.tobytes()
+
+
+def test_jax_array_input():
+    import jax.numpy as jnp
+
+    in0 = InferInput("IN", [4], "FP32")
+    in0.set_data_from_numpy(jnp.arange(4, dtype=jnp.float32))
+    body, json_size = build_infer_body([in0])
+    assert body[json_size:] == np.arange(4, dtype=np.float32).tobytes()
+
+
+def test_bf16_input_binary_only():
+    import ml_dtypes
+
+    in0 = InferInput("IN", [2], "BF16")
+    arr = np.array([1.5, 2.5], dtype=ml_dtypes.bfloat16)
+    with pytest.raises(InferenceServerException):
+        in0.set_data_from_numpy(arr, binary_data=False)
+    in0.set_data_from_numpy(arr)
+    body, json_size = build_infer_body([in0])
+    assert body[json_size:] == arr.tobytes()
+
+
+def test_result_binary_and_json_outputs():
+    out_bin = np.arange(6, dtype=np.float32).reshape(2, 3)
+    header = {
+        "model_name": "m",
+        "model_version": "1",
+        "outputs": [
+            {
+                "name": "B",
+                "datatype": "FP32",
+                "shape": [2, 3],
+                "parameters": {"binary_data_size": out_bin.nbytes},
+            },
+            {"name": "J", "datatype": "INT32", "shape": [2], "data": [7, 8]},
+        ],
+    }
+    hj = json.dumps(header).encode()
+    body = hj + out_bin.tobytes()
+    result = InferResult.from_response_body(body, len(hj))
+    np.testing.assert_array_equal(result.as_numpy("B"), out_bin)
+    np.testing.assert_array_equal(result.as_numpy("J"), np.array([7, 8], dtype=np.int32))
+    assert result.as_numpy("missing") is None
+    assert result.get_output("B")["shape"] == [2, 3]
+
+
+def test_result_shm_output_returns_none():
+    header = {
+        "outputs": [
+            {
+                "name": "S",
+                "datatype": "FP32",
+                "shape": [2],
+                "parameters": {"shared_memory_region": "r0", "shared_memory_byte_size": 8},
+            }
+        ]
+    }
+    result = InferResult.from_response_body(json.dumps(header).encode(), None)
+    assert result.as_numpy("S") is None
+
+
+def test_compression_roundtrip():
+    body = b"x" * 1000
+    for algo in ("gzip", "deflate"):
+        compressed, enc = compress_body(body, algo)
+        assert enc == algo and len(compressed) < len(body)
+        assert decompress_body(compressed, enc) == body
+    assert compress_body(body, None) == (body, None)

@@ -1,0 +1,100 @@
+"""Unit tests for dtype maps and wire serializers (no server, no network)."""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_triton_dtype,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    serialized_byte_size,
+    triton_to_np_dtype,
+)
+
+
+@pytest.mark.parametrize(
+    "np_dtype,triton",
+    [
+        (np.bool_, "BOOL"),
+        (np.int8, "INT8"),
+        (np.int16, "INT16"),
+        (np.int32, "INT32"),
+        (np.int64, "INT64"),
+        (np.uint8, "UINT8"),
+        (np.uint16, "UINT16"),
+        (np.uint32, "UINT32"),
+        (np.uint64, "UINT64"),
+        (np.float16, "FP16"),
+        (np.float32, "FP32"),
+        (np.float64, "FP64"),
+        (np.object_, "BYTES"),
+        (ml_dtypes.bfloat16, "BF16"),
+    ],
+)
+def test_dtype_roundtrip(np_dtype, triton):
+    assert np_to_triton_dtype(np_dtype) == triton
+    assert np.dtype(triton_to_np_dtype(triton)) == np.dtype(np_dtype)
+
+
+def test_string_kinds_map_to_bytes():
+    assert np_to_triton_dtype(np.dtype("S8")) == "BYTES"
+    assert np_to_triton_dtype(np.dtype("U8")) == "BYTES"
+
+
+def test_bytes_tensor_roundtrip():
+    data = np.array([b"hello", b"", b"\x00\x01binary\xff", "unicodeé".encode()], dtype=np.object_)
+    serialized = serialize_byte_tensor(data)
+    buf = serialized.item()
+    # wire format: 4-byte LE length prefix per element
+    assert buf[:4] == (5).to_bytes(4, "little")
+    out = deserialize_bytes_tensor(buf)
+    assert out.tolist() == data.tolist()
+
+
+def test_bytes_tensor_from_strings_and_2d_order():
+    data = np.array([["ab", "c"], ["", "defg"]], dtype=np.object_)
+    buf = serialize_byte_tensor(data).item()
+    out = deserialize_bytes_tensor(buf)
+    assert out.tolist() == [b"ab", b"c", b"", b"defg"]  # C order
+    assert serialized_byte_size(data) == len(buf)
+
+
+def test_bytes_tensor_empty():
+    assert serialize_byte_tensor(np.array([], dtype=np.object_)).size == 0
+    assert deserialize_bytes_tensor(b"").size == 0
+
+
+def test_bytes_tensor_malformed():
+    with pytest.raises(InferenceServerException):
+        deserialize_bytes_tensor(b"\x05\x00\x00\x00ab")  # truncated element
+    with pytest.raises(InferenceServerException):
+        deserialize_bytes_tensor(b"\x05\x00")  # truncated prefix
+
+
+def test_bf16_roundtrip_native():
+    arr = np.array([1.5, -2.25, 0.0, 3e38], dtype=ml_dtypes.bfloat16)
+    buf = serialize_bf16_tensor(arr).item()
+    assert len(buf) == arr.size * 2
+    out = deserialize_bf16_tensor(buf)
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_bf16_from_float32():
+    arr = np.array([1.0, 2.5, -0.125], dtype=np.float32)
+    buf = serialize_bf16_tensor(arr).item()
+    out = deserialize_bf16_tensor(buf).astype(np.float32)
+    np.testing.assert_array_equal(out, arr)  # exactly representable values
+
+
+def test_exception_fields():
+    e = InferenceServerException("boom", status="400", debug_details={"x": 1})
+    assert e.message() == "boom"
+    assert e.status() == "400"
+    assert e.debug_details() == {"x": 1}
+    assert "[400] boom" == str(e)
