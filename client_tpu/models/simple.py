@@ -56,7 +56,9 @@ class AddSubModel(Model):
             if self._fn is None:
                 self._fn = _jit_add_sub()
         s, d = self._fn(inputs["INPUT0"], inputs["INPUT1"])
-        return {"OUTPUT0": np.asarray(s), "OUTPUT1": np.asarray(d)}
+        # returned as live jax.Arrays: the tpu-shm response path pins them
+        # on-device; wire paths materialize to host at serialization time
+        return {"OUTPUT0": s, "OUTPUT1": d}
 
 
 class StringAddSubModel(Model):
@@ -116,10 +118,11 @@ class IdentityModel(Model):
             time.sleep(self.delay_s)
         arr = inputs[self._input_name]
         if arr.dtype != np.object_:
-            # run the copy through XLA so the data path is exercised on-device
+            # route through XLA so the data path is exercised on-device; the
+            # result stays a jax.Array for the tpu-shm zero-copy response path
             import jax.numpy as jnp
 
-            arr = np.asarray(jnp.asarray(arr))
+            arr = jnp.asarray(arr)
         return {self._output_name: arr}
 
 

@@ -46,11 +46,9 @@ class _Region:
 
     def _buffer(self) -> memoryview:
         if self._shm is None:
-            from multiprocessing import shared_memory as mpshm
+            from ..utils.shared_memory import attach_shared_memory
 
-            # resource_tracker would unlink on process exit even for regions
-            # we merely attach to; track=False leaves ownership to the creator
-            self._shm = mpshm.SharedMemory(name=self.key.lstrip("/"), track=False)
+            self._shm = attach_shared_memory(self.key)
         return self._shm.buf
 
     def _check_range(self, nbytes: int, offset: int, op: str) -> int:
@@ -68,6 +66,21 @@ class _Region:
     def write(self, data: bytes, offset: int) -> None:
         base = self._check_range(len(data), offset, "write")
         self._buffer()[base : base + len(data)] = data
+
+    def read_tensor(self, datatype: str, shape, byte_size: int, offset: int):
+        """Materialize a tensor of ``datatype``/``shape`` from the region."""
+        return _bytes_to_array(bytes(self.read(byte_size, offset)), datatype, shape)
+
+    def write_tensor(self, arr, datatype: str, offset: int, limit: int, name: str = "?") -> int:
+        """Serialize ``arr`` into the region; returns bytes written."""
+        payload = _array_to_bytes(np.asarray(arr), datatype)
+        if len(payload) > limit:
+            raise InferError(
+                f"output '{name}' ({len(payload)}B) exceeds shared-memory region "
+                f"allotment of {limit}B", 400,
+            )
+        self.write(payload, offset)
+        return len(payload)
 
     def close(self) -> None:
         if self._shm is not None:
@@ -87,6 +100,72 @@ class _Region:
             "device_id": self.device_id,
             "byte_size": self.byte_size,
         }
+
+
+class _TpuRegion(_Region):
+    """A registered tpu_shared_memory region — device-aware data plane.
+
+    In-process registrations resolve to the client's own
+    ``TpuSharedMemoryRegion`` object, so tensors bound with
+    ``set_shared_memory_region_from_jax`` are handed to the model as live
+    ``jax.Array``s (zero copies) and jax outputs are pinned back into the
+    region's device cache the same way.
+    """
+
+    def __init__(self, name: str, raw_handle_b64: str, device_id: int, byte_size: int):
+        from ..utils.tpu_shared_memory import attach_from_raw_handle
+
+        self._region = attach_from_raw_handle(raw_handle_b64)
+        super().__init__(
+            name, "tpu", self._region.shm_key, 0, byte_size, device_id,
+            raw_handle=raw_handle_b64,
+        )
+
+    def read(self, byte_size: int, offset: int) -> memoryview:
+        return self._region.read_host(byte_size, offset)
+
+    def write(self, data: bytes, offset: int) -> None:
+        self._region.write_host(data, offset)
+
+    def read_tensor(self, datatype: str, shape, byte_size: int, offset: int):
+        if datatype == "BYTES":
+            return super().read_tensor(datatype, shape, byte_size, offset)
+        from ..utils import triton_to_np_dtype
+        from ..utils.tpu_shared_memory import get_contents_as_jax
+
+        nbytes = int(np.prod(shape)) * np.dtype(triton_to_np_dtype(datatype)).itemsize
+        if nbytes > byte_size:
+            raise InferError(
+                f"shm input needs {nbytes}B for shape {list(shape)} {datatype} but "
+                f"only {byte_size}B were supplied", 400,
+            )
+        return get_contents_as_jax(self._region, datatype, shape, offset)
+
+    def write_tensor(self, arr, datatype: str, offset: int, limit: int, name: str = "?") -> int:
+        from ..utils.tpu_shared_memory import (
+            _is_jax_array,
+            set_shared_memory_region_from_jax,
+        )
+
+        if datatype != "BYTES" and _is_jax_array(arr):
+            nbytes = arr.dtype.itemsize * arr.size
+            if nbytes > limit:
+                raise InferError(
+                    f"output '{name}' ({nbytes}B) exceeds shared-memory region "
+                    f"allotment of {limit}B", 400,
+                )
+            set_shared_memory_region_from_jax(self._region, arr, offset)
+            return nbytes
+        return super().write_tensor(arr, datatype, offset, limit, name)
+
+    def close(self) -> None:
+        # drop the attachment we opened for a cross-process region; in-process
+        # registrations share the client's object, whose lifetime the client owns
+        if not self._region._cache_enabled and self._region._shm is not None:
+            from ..utils.shared_memory import _safe_close
+
+            _safe_close(self._region._shm, unlink=False)
+            self._region._shm = None
 
 
 class _ModelStats:
@@ -257,13 +336,20 @@ class ServerCore:
         ``utils.tpu_shared_memory.get_raw_handle`` and carry the host shm key
         of the region's host window.
         """
-        try:
-            desc = json.loads(base64.b64decode(raw_handle_b64))
-            key = desc["shm_key"]
-        except Exception as e:
-            raise InferError(f"failed to decode {family} shared-memory handle: {e}", 400)
-        self._register(
-            _Region(
+        if family == "tpu":
+            try:
+                region: _Region = _TpuRegion(name, raw_handle_b64, device_id, byte_size)
+            except Exception as e:
+                raise InferError(f"failed to attach tpu shared-memory region: {e}", 400)
+        else:
+            try:
+                desc = json.loads(base64.b64decode(raw_handle_b64))
+                key = desc["shm_key"]
+            except Exception as e:
+                raise InferError(
+                    f"failed to decode {family} shared-memory handle: {e}", 400
+                )
+            region = _Region(
                 name,
                 family,
                 key,
@@ -272,7 +358,7 @@ class ServerCore:
                 device_id,
                 raw_handle=raw_handle_b64,
             )
-        )
+        self._register(region)
 
     def _register(self, region: _Region) -> None:
         with self._lock:
@@ -386,8 +472,12 @@ class ServerCore:
             shm = inp.get("shm")
             if shm is not None:
                 region_name, byte_size, offset = shm
-                raw = self._region(region_name).read(byte_size, offset)
-                out[name] = _bytes_to_array(bytes(raw), datatype, shape)
+                region = self._region(region_name)
+                try:
+                    region._check_range(byte_size, offset, "read")
+                except ValueError as e:
+                    raise InferError(str(e), 400)
+                out[name] = region.read_tensor(datatype, shape, byte_size, offset)
             else:
                 arr = inp.get("array")
                 if arr is None:
@@ -421,10 +511,10 @@ class ServerCore:
         outputs = []
         for spec in out_specs:
             name = spec["name"]
-            arr = np.asarray(raw[name])
+            arr = raw[name]  # np.ndarray or jax.Array; stays on device if jax
             class_count = spec.get("classification", 0)
             if class_count:
-                arr = _classification(arr, class_count, model.labels())
+                arr = _classification(np.asarray(arr), class_count, model.labels())
                 datatype = "BYTES"
             else:
                 from ..utils import np_to_triton_dtype
@@ -438,16 +528,12 @@ class ServerCore:
             shm = spec.get("shm")
             if shm is not None:
                 region_name, byte_size, offset = shm
-                payload = _array_to_bytes(arr, datatype)
-                if len(payload) > byte_size:
-                    raise InferError(
-                        f"output '{name}' ({len(payload)}B) exceeds shared-memory "
-                        f"region size {byte_size}B", 400,
-                    )
-                self._region(region_name).write(payload, offset)
-                entry["shm"] = (region_name, len(payload), offset)
+                written = self._region(region_name).write_tensor(
+                    arr, datatype, offset, byte_size, name
+                )
+                entry["shm"] = (region_name, written, offset)
             else:
-                entry["array"] = arr
+                entry["array"] = np.asarray(arr)
             outputs.append(entry)
         resp: Dict[str, Any] = {
             "model_name": model.name,

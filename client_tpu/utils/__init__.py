@@ -207,13 +207,16 @@ def serialize_byte_tensor(input_tensor) -> np.ndarray:
     return out
 
 
-def deserialize_bytes_tensor(encoded_tensor: bytes) -> np.ndarray:
-    """Deserialize a BYTES wire payload to a flat object ndarray of ``bytes``."""
+def deserialize_bytes_tensor(encoded_tensor: bytes, count: Optional[int] = None) -> np.ndarray:
+    """Deserialize a BYTES wire payload to a flat object ndarray of ``bytes``.
+
+    ``count`` bounds the number of elements (used when reading from a region
+    larger than the payload, e.g. shared memory)."""
     strs: List[bytes] = []
     buf = memoryview(encoded_tensor)
     offset = 0
     n = len(buf)
-    while offset < n:
+    while offset < n and (count is None or len(strs) < count):
         if offset + 4 > n:
             raise InferenceServerException(
                 "malformed BYTES tensor: truncated length prefix"

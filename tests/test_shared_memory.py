@@ -1,0 +1,78 @@
+"""System shared-memory module tests (serverless; reference tier-1 mirror:
+src/python/library/tests/test_shared_memory.py:34-170)."""
+
+import numpy as np
+import pytest
+
+import client_tpu.utils.shared_memory as shm
+from client_tpu.utils.shared_memory import SharedMemoryException
+
+
+@pytest.fixture
+def region():
+    h = shm.create_shared_memory_region("test_region", "/cltpu_test_0", 256)
+    yield h
+    shm.destroy_shared_memory_region(h)
+
+
+def test_lifecycle(region):
+    assert region.name == "test_region"
+    assert region.byte_size == 256
+    assert "test_region" in shm.mapped_shared_memory_regions()
+
+
+def test_set_and_get_roundtrip(region):
+    arr = np.arange(16, dtype=np.int32)
+    shm.set_shared_memory_region(region, [arr])
+    out = shm.get_contents_as_numpy(region, np.int32, [16])
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_two_tensors_with_offsets(region):
+    a = np.arange(8, dtype=np.float32)
+    b = np.arange(8, 16, dtype=np.float32)
+    shm.set_shared_memory_region(region, [a])
+    shm.set_shared_memory_region(region, [b], offset=32)
+    np.testing.assert_array_equal(shm.get_contents_as_numpy(region, np.float32, [8]), a)
+    np.testing.assert_array_equal(
+        shm.get_contents_as_numpy(region, np.float32, [8], offset=32), b
+    )
+
+
+def test_oversize_write_raises(region):
+    with pytest.raises(SharedMemoryException):
+        shm.set_shared_memory_region(region, [np.zeros(1024, dtype=np.int64)])
+
+
+def test_create_only_duplicate_raises(region):
+    with pytest.raises(SharedMemoryException):
+        shm.create_shared_memory_region("dup", "/cltpu_test_0", 256, create_only=True)
+
+
+def test_attach_shares_memory(region):
+    second = shm.create_shared_memory_region("attached", "/cltpu_test_0", 256)
+    try:
+        shm.set_shared_memory_region(region, [np.array([42], dtype=np.int32)])
+        out = shm.get_contents_as_numpy(second, np.int32, [1])
+        assert out[0] == 42
+    finally:
+        shm.destroy_shared_memory_region(second)
+
+
+def test_bytes_roundtrip(region):
+    arr = np.array([b"ab", b"", b"hello world"], dtype=np.object_)
+    shm.set_shared_memory_region(region, [arr])
+    out = shm.get_contents_as_numpy(region, "BYTES", [3])
+    assert out.tolist() == arr.tolist()
+
+
+def test_zero_copy_view(region):
+    shm.set_shared_memory_region(region, [np.zeros(4, dtype=np.int32)])
+    view = shm.get_contents_as_numpy(region, np.int32, [4])
+    region.buf()[0:4] = (7).to_bytes(4, "little")
+    assert view[0] == 7  # the view aliases the region
+
+
+def test_invalid_byte_size():
+    with pytest.raises(SharedMemoryException):
+        shm.create_shared_memory_region("bad", "/cltpu_bad", 0)

@@ -1,0 +1,171 @@
+"""End-to-end shared-memory inference: the zero-copy negotiation (SURVEY §3.5).
+
+Covers both families against the live in-process server:
+- system shm: create -> register -> set -> infer(shm in/out) -> read -> unregister
+- tpu shm: same lifecycle with jax.Array producers and device-cache handover
+"""
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+import client_tpu.utils.shared_memory as shm
+import client_tpu.utils.tpu_shared_memory as tpushm
+from client_tpu.models import default_model_zoo
+from client_tpu.server import HttpInferenceServer, ServerCore
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    with HttpInferenceServer(ServerCore(default_model_zoo())) as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    with httpclient.InferenceServerClient(server.url) as c:
+        yield c
+
+
+def test_system_shm_full_lifecycle(client):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    nbytes = a.nbytes
+
+    in_region = shm.create_shared_memory_region("input_data", "/e2e_shm_in", 2 * nbytes)
+    out_region = shm.create_shared_memory_region("output_data", "/e2e_shm_out", 2 * nbytes)
+    try:
+        shm.set_shared_memory_region(in_region, [a])
+        shm.set_shared_memory_region(in_region, [b], offset=nbytes)
+        client.register_system_shared_memory("input_data", "/e2e_shm_in", 2 * nbytes)
+        client.register_system_shared_memory("output_data", "/e2e_shm_out", 2 * nbytes)
+
+        status = client.get_system_shared_memory_status()
+        assert {s["name"] for s in status} == {"input_data", "output_data"}
+
+        in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        in0.set_shared_memory("input_data", nbytes)
+        in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+        in1.set_shared_memory("input_data", nbytes, offset=nbytes)
+        out0 = httpclient.InferRequestedOutput("OUTPUT0")
+        out0.set_shared_memory("output_data", nbytes)
+        out1 = httpclient.InferRequestedOutput("OUTPUT1")
+        out1.set_shared_memory("output_data", nbytes, offset=nbytes)
+
+        result = client.infer("simple", [in0, in1], outputs=[out0, out1])
+        # response carries no data; contents are in the output region
+        assert result.as_numpy("OUTPUT0") is None
+        o0 = result.get_output("OUTPUT0")
+        assert o0["parameters"]["shared_memory_region"] == "output_data"
+        np.testing.assert_array_equal(
+            shm.get_contents_as_numpy(out_region, np.int32, [1, 16]), a + b
+        )
+        np.testing.assert_array_equal(
+            shm.get_contents_as_numpy(out_region, np.int32, [1, 16], offset=nbytes), a - b
+        )
+
+        client.unregister_system_shared_memory("input_data")
+        client.unregister_system_shared_memory("output_data")
+        assert client.get_system_shared_memory_status() == []
+    finally:
+        shm.destroy_shared_memory_region(in_region)
+        shm.destroy_shared_memory_region(out_region)
+
+
+def test_system_shm_unregistered_region_errors(client):
+    in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    in0.set_shared_memory("never_registered", 64)
+    in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    in1.set_shared_memory("never_registered", 64, offset=64)
+    with pytest.raises(InferenceServerException, match="shared memory region"):
+        client.infer("simple", [in0, in1])
+
+
+def test_tpu_shm_full_lifecycle(client):
+    import jax.numpy as jnp
+
+    a = jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
+    b = jnp.ones((1, 16), dtype=jnp.int32)
+    nbytes = 64
+
+    in_region = tpushm.create_shared_memory_region("tpu_in", 2 * nbytes)
+    out_region = tpushm.create_shared_memory_region("tpu_out", 2 * nbytes)
+    try:
+        # jax.Arrays bind into the region (device cache + host mirror)
+        tpushm.set_shared_memory_region_from_jax(in_region, a)
+        tpushm.set_shared_memory_region_from_jax(in_region, b, offset=nbytes)
+        client.register_tpu_shared_memory(
+            "tpu_in", tpushm.get_raw_handle(in_region), 0, 2 * nbytes
+        )
+        client.register_tpu_shared_memory(
+            "tpu_out", tpushm.get_raw_handle(out_region), 0, 2 * nbytes
+        )
+        status = client.get_tpu_shared_memory_status()
+        assert {s["name"] for s in status} == {"tpu_in", "tpu_out"}
+
+        in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        in0.set_shared_memory("tpu_in", nbytes)
+        in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+        in1.set_shared_memory("tpu_in", nbytes, offset=nbytes)
+        out0 = httpclient.InferRequestedOutput("OUTPUT0")
+        out0.set_shared_memory("tpu_out", nbytes)
+        out1 = httpclient.InferRequestedOutput("OUTPUT1")
+        out1.set_shared_memory("tpu_out", nbytes, offset=nbytes)
+
+        result = client.infer("simple", [in0, in1], outputs=[out0, out1])
+        assert result.as_numpy("OUTPUT0") is None
+
+        # device-path read: the server pinned its jax output into the region
+        sum_jax = tpushm.get_contents_as_jax(out_region, "INT32", [1, 16])
+        np.testing.assert_array_equal(np.asarray(sum_jax), np.asarray(a + b))
+        # host-path read works too (flushes the device entry)
+        diff = tpushm.get_contents_as_numpy(out_region, "INT32", [1, 16], offset=nbytes)
+        np.testing.assert_array_equal(diff, np.asarray(a - b))
+
+        client.unregister_tpu_shared_memory()
+        assert client.get_tpu_shared_memory_status() == []
+    finally:
+        tpushm.destroy_shared_memory_region(in_region)
+        tpushm.destroy_shared_memory_region(out_region)
+
+
+def test_tpu_shm_string_model(client):
+    """BYTES tensors ride the tpu region host window (reference:
+    simple_grpc_shm_string_client.py equivalent)."""
+    data = np.array([[str(i) for i in range(16)]], dtype=np.object_)
+    ones = np.array([["1"] * 16], dtype=np.object_)
+    from client_tpu.utils import serialized_byte_size
+
+    sz = max(serialized_byte_size(data), serialized_byte_size(ones))
+    region = tpushm.create_shared_memory_region("tpu_str", 2 * sz)
+    try:
+        tpushm.set_shared_memory_region(region, [data])
+        tpushm.set_shared_memory_region(region, [ones], offset=sz)
+        client.register_tpu_shared_memory(
+            "tpu_str", tpushm.get_raw_handle(region), 0, 2 * sz
+        )
+        in0 = httpclient.InferInput("INPUT0", [1, 16], "BYTES")
+        in0.set_shared_memory("tpu_str", serialized_byte_size(data))
+        in1 = httpclient.InferInput("INPUT1", [1, 16], "BYTES")
+        in1.set_shared_memory("tpu_str", serialized_byte_size(ones), offset=sz)
+        result = client.infer("simple_string", [in0, in1])
+        assert result.as_numpy("OUTPUT0")[0, 3] == b"4"
+        client.unregister_tpu_shared_memory("tpu_str")
+    finally:
+        tpushm.destroy_shared_memory_region(region)
+
+
+def test_shm_status_register_unregister_families(client):
+    # registering a tpu handle under the cuda family keeps protocol parity
+    region = tpushm.create_shared_memory_region("xcuda", 128)
+    try:
+        client.register_cuda_shared_memory(
+            "xcuda", tpushm.get_raw_handle(region), 0, 128
+        )
+        status = client.get_cuda_shared_memory_status()
+        assert status and status[0]["name"] == "xcuda"
+        client.unregister_cuda_shared_memory("xcuda")
+        assert client.get_cuda_shared_memory_status() == []
+    finally:
+        tpushm.destroy_shared_memory_region(region)

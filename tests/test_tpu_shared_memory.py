@@ -1,0 +1,138 @@
+"""tpu_shared_memory module tests: host/device paths, DLPack, raw handles.
+
+Mirrors the reference's test_cuda_shared_memory.py coverage (DLPackTest :37-81,
+NumpyTest :83-160) on the TPU data plane; runs on the CPU backend in CI.
+"""
+
+import base64
+import json
+
+import numpy as np
+import pytest
+
+import client_tpu.utils.tpu_shared_memory as tpushm
+from client_tpu.utils.shared_memory import SharedMemoryException
+
+
+@pytest.fixture
+def region():
+    h = tpushm.create_shared_memory_region("tpu_region", 1024)
+    yield h
+    tpushm.destroy_shared_memory_region(h)
+
+
+def test_raw_handle_roundtrip(region):
+    raw = tpushm.get_raw_handle(region)
+    desc = json.loads(base64.b64decode(raw))
+    assert desc["kind"] == "tpu_shared_memory"
+    assert desc["shm_key"] == region.shm_key
+    assert desc["byte_size"] == 1024
+    attached = tpushm.attach_from_raw_handle(raw)
+    assert attached is region  # in-process attach returns the original object
+
+
+def test_numpy_set_get(region):
+    arr = np.arange(32, dtype=np.float32)
+    tpushm.set_shared_memory_region(region, [arr])
+    out = tpushm.get_contents_as_numpy(region, "FP32", [32])
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_jax_set_and_device_cache_hit(region):
+    import jax.numpy as jnp
+
+    arr = jnp.arange(16, dtype=jnp.int32)
+    tpushm.set_shared_memory_region_from_jax(region, arr)
+    # device path: cache hit returns the pinned jax.Array (zero-copy)
+    out = tpushm.get_contents_as_jax(region, "INT32", [16])
+    assert type(out).__module__.startswith("jax")
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16, dtype=np.int32))
+    # host path sees the mirrored bytes
+    host = tpushm.get_contents_as_numpy(region, "INT32", [16])
+    np.testing.assert_array_equal(host, np.arange(16, dtype=np.int32))
+
+
+def test_colocated_region_skips_host_mirror():
+    import jax.numpy as jnp
+
+    h = tpushm.create_shared_memory_region("colo", 256, colocated=True)
+    try:
+        arr = jnp.full((8,), 7, dtype=jnp.int32)
+        tpushm.set_shared_memory_region_from_jax(h, arr)
+        # device read: zero-copy hit
+        out = tpushm.get_contents_as_jax(h, "INT32", [8])
+        np.testing.assert_array_equal(np.asarray(out), np.full(8, 7))
+        # host read flushes the device entry on demand
+        host = tpushm.get_contents_as_numpy(h, "INT32", [8])
+        np.testing.assert_array_equal(host, np.full(8, 7))
+    finally:
+        tpushm.destroy_shared_memory_region(h)
+
+
+def test_host_write_invalidates_device_entry(region):
+    import jax.numpy as jnp
+
+    tpushm.set_shared_memory_region_from_jax(region, jnp.zeros(4, jnp.int32))
+    tpushm.set_shared_memory_region(region, [np.full(4, 9, dtype=np.int32)])
+    out = tpushm.get_contents_as_jax(region, "INT32", [4])
+    np.testing.assert_array_equal(np.asarray(out), np.full(4, 9))
+
+
+def test_dlpack_ingest_numpy(region):
+    arr = np.arange(8, dtype=np.float64)
+    tpushm.set_shared_memory_region_from_dlpack(region, arr)
+    np.testing.assert_array_equal(
+        tpushm.get_contents_as_numpy(region, "FP64", [8]), arr
+    )
+
+
+def test_dlpack_ingest_torch(region):
+    torch = pytest.importorskip("torch")
+    t = torch.arange(6, dtype=torch.int64)
+    tpushm.set_shared_memory_region_from_dlpack(region, t)
+    np.testing.assert_array_equal(
+        tpushm.get_contents_as_numpy(region, "INT64", [6]), np.arange(6)
+    )
+
+
+def test_as_shared_memory_tensor_numpy_consumer(region):
+    arr = np.arange(12, dtype=np.float32)
+    tpushm.set_shared_memory_region(region, [arr])
+    producer = tpushm.as_shared_memory_tensor(region, "FP32", [12])
+    out = np.from_dlpack(producer)
+    np.testing.assert_array_equal(out, arr)
+    # zero copy: mutating the region is visible through the consumer
+    region.write_host(np.float32(99.0).tobytes(), 0)
+    assert out[0] == 99.0
+
+
+def test_as_shared_memory_tensor_jax_consumer(region):
+    import jax
+
+    arr = np.arange(4, dtype=np.float32)
+    tpushm.set_shared_memory_region(region, [arr])
+    producer = tpushm.as_shared_memory_tensor(region, "FP32", [4])
+    out = jax.dlpack.from_dlpack(producer)
+    np.testing.assert_array_equal(np.asarray(out), arr)
+
+
+def test_bounds_checking(region):
+    with pytest.raises(SharedMemoryException):
+        region.write_host(b"x" * 2048, 0)
+    with pytest.raises(SharedMemoryException):
+        region.read_host(16, -4)
+    with pytest.raises(SharedMemoryException):
+        tpushm.get_contents_as_numpy(region, "FP32", [1024])
+
+
+def test_bf16_roundtrip(region):
+    import ml_dtypes
+
+    arr = np.array([1.5, -2.0, 0.25], dtype=ml_dtypes.bfloat16)
+    tpushm.set_shared_memory_region(region, [arr])
+    out = tpushm.get_contents_as_numpy(region, "BF16", [3])
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_registry(region):
+    assert "tpu_region" in tpushm.allocated_shared_memory_regions()
