@@ -1,0 +1,483 @@
+"""Synchronous KServe v2 GRPC client.
+
+Full-surface parity with the reference's
+``tritonclient.grpc.InferenceServerClient`` (grpc/_client.py:119-1936):
+infer / async_infer (cancellable CallContext) / bi-di streaming with
+sequence support, plus the complete admin surface — over generic grpc
+callables bound to the schema-driven wire codec (no generated stubs).
+
+TPU extensions: ``register_tpu_shared_memory`` RPCs (this framework's
+server; a stock tritonserver can still be fed tpu regions through
+``register_system_shared_memory`` with the region's host shm key).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import grpc
+
+from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
+from .._tensor import InferInput, InferRequestedOutput
+from ..utils import InferenceServerException
+from . import _messages as M
+from ._infer import InferResult, build_infer_request, from_infer_parameter
+from ._stream import _InferStream
+from ._wire import decode_message, encode_message
+
+INT32_MAX = 2**31 - 1
+
+
+class KeepAliveOptions:
+    """GRPC keepalive configuration (maps to grpc channel args)."""
+
+    def __init__(
+        self,
+        keepalive_time_ms: int = INT32_MAX,
+        keepalive_timeout_ms: int = 20000,
+        keepalive_permit_without_calls: bool = False,
+        http2_max_pings_without_data: int = 2,
+    ):
+        self.keepalive_time_ms = keepalive_time_ms
+        self.keepalive_timeout_ms = keepalive_timeout_ms
+        self.keepalive_permit_without_calls = keepalive_permit_without_calls
+        self.http2_max_pings_without_data = http2_max_pings_without_data
+
+
+class CallContext:
+    """Handle for an in-flight async_infer supporting cancellation."""
+
+    def __init__(self, future: "grpc.Future"):
+        self._future = future
+
+    def cancel(self) -> bool:
+        return self._future.cancel()
+
+    def get_result(self, timeout: Optional[float] = None) -> InferResult:
+        try:
+            return InferResult(self._future.result(timeout=timeout))
+        except grpc.RpcError as e:
+            raise _to_exception(e) from e
+
+
+def _to_exception(rpc_error: grpc.RpcError) -> InferenceServerException:
+    code = rpc_error.code() if hasattr(rpc_error, "code") else None
+    details = rpc_error.details() if hasattr(rpc_error, "details") else str(rpc_error)
+    if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+        return InferenceServerException("Deadline Exceeded", status="StatusCode.DEADLINE_EXCEEDED")
+    return InferenceServerException(
+        details, status=f"StatusCode.{code.name}" if code else None
+    )
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Client for the KServe v2 GRPC protocol."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional["grpc.ChannelCredentials"] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args: Optional[List] = None,
+    ):
+        super().__init__()
+        self._verbose = verbose
+        if channel_args is not None:
+            options = list(channel_args)
+        else:
+            ka = keepalive_options or KeepAliveOptions()
+            options = [
+                ("grpc.max_send_message_length", INT32_MAX),
+                ("grpc.max_receive_message_length", INT32_MAX),
+                ("grpc.keepalive_time_ms", ka.keepalive_time_ms),
+                ("grpc.keepalive_timeout_ms", ka.keepalive_timeout_ms),
+                (
+                    "grpc.keepalive_permit_without_calls",
+                    int(ka.keepalive_permit_without_calls),
+                ),
+                (
+                    "grpc.http2.max_pings_without_data",
+                    ka.http2_max_pings_without_data,
+                ),
+            ]
+        if creds is not None:
+            self._channel = grpc.secure_channel(url, creds, options=options)
+        elif ssl:
+            rc = open(root_certificates, "rb").read() if root_certificates else None
+            pk = open(private_key, "rb").read() if private_key else None
+            cc = open(certificate_chain, "rb").read() if certificate_chain else None
+            credentials = grpc.ssl_channel_credentials(rc, pk, cc)
+            self._channel = grpc.secure_channel(url, credentials, options=options)
+        else:
+            self._channel = grpc.insecure_channel(url, options=options)
+        self._callables: Dict[str, Callable] = {}
+        self._stream: Optional[_InferStream] = None
+        self._stream_lock = threading.Lock()
+        self._infer_stat = InferStat()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self.stop_stream()
+        self._channel.close()
+
+    def __enter__(self) -> "InferenceServerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def client_infer_stat(self) -> Dict[str, int]:
+        return self._infer_stat.as_dict()
+
+    # -- transport ---------------------------------------------------------
+    def _callable(self, method: str, streaming: bool = False):
+        cached = self._callables.get(method)
+        if cached is not None:
+            return cached
+        req_spec, resp_spec = M.METHODS[method]
+        path = M.method_path(method)
+        serializer = lambda d: encode_message(req_spec, d)  # noqa: E731
+        deserializer = lambda b: decode_message(resp_spec, b)  # noqa: E731
+        if streaming:
+            c = self._channel.stream_stream(
+                path, request_serializer=serializer, response_deserializer=deserializer
+            )
+        else:
+            c = self._channel.unary_unary(
+                path, request_serializer=serializer, response_deserializer=deserializer
+            )
+        self._callables[method] = c
+        return c
+
+    def _metadata(self, headers: Optional[Dict[str, str]]):
+        hdrs = dict(headers or {})
+        request = Request(hdrs)
+        self._call_plugin(request)
+        return tuple(request.headers.items()) or None
+
+    def _call(
+        self,
+        method: str,
+        request: Dict[str, Any],
+        headers: Optional[Dict[str, str]] = None,
+        client_timeout: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        if self._verbose:
+            print(f"{method}, metadata {headers or {}}\n{request}")
+        try:
+            response = self._callable(method)(
+                request, metadata=self._metadata(headers), timeout=client_timeout
+            )
+        except grpc.RpcError as e:
+            raise _to_exception(e) from e
+        if self._verbose:
+            print(response)
+        return response
+
+    # -- health / metadata -------------------------------------------------
+    def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        return bool(self._call("ServerLive", {}, headers, client_timeout).get("live", False))
+
+    def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        return bool(self._call("ServerReady", {}, headers, client_timeout).get("ready", False))
+
+    def is_model_ready(self, model_name, model_version="", headers=None, client_timeout=None) -> bool:
+        req = {"name": model_name, "version": model_version}
+        try:
+            return bool(self._call("ModelReady", req, headers, client_timeout).get("ready", False))
+        except InferenceServerException:
+            return False
+
+    def get_server_metadata(self, headers=None, client_timeout=None) -> Dict[str, Any]:
+        return self._call("ServerMetadata", {}, headers, client_timeout)
+
+    def get_model_metadata(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ) -> Dict[str, Any]:
+        return self._call(
+            "ModelMetadata", {"name": model_name, "version": model_version},
+            headers, client_timeout,
+        )
+
+    def get_model_config(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ) -> Dict[str, Any]:
+        return self._call(
+            "ModelConfig", {"name": model_name, "version": model_version},
+            headers, client_timeout,
+        )
+
+    # -- repository --------------------------------------------------------
+    def get_model_repository_index(self, headers=None, client_timeout=None) -> List[Dict[str, Any]]:
+        resp = self._call("RepositoryIndex", {}, headers, client_timeout)
+        return resp.get("models", [])
+
+    def load_model(
+        self, model_name, headers=None, config: Optional[str] = None,
+        files: Optional[Dict[str, bytes]] = None, client_timeout=None,
+    ) -> None:
+        params: Dict[str, Any] = {}
+        if config is not None:
+            params["config"] = {"string_param": config}
+        for path, content in (files or {}).items():
+            params[path] = {"bytes_param": content}
+        req: Dict[str, Any] = {"model_name": model_name}
+        if params:
+            req["parameters"] = params
+        self._call("RepositoryModelLoad", req, headers, client_timeout)
+
+    def unload_model(
+        self, model_name, headers=None, unload_dependents: bool = False, client_timeout=None
+    ) -> None:
+        req = {
+            "model_name": model_name,
+            "parameters": {"unload_dependents": {"bool_param": unload_dependents}},
+        }
+        self._call("RepositoryModelUnload", req, headers, client_timeout)
+
+    # -- statistics / trace / log ------------------------------------------
+    def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, client_timeout=None
+    ) -> Dict[str, Any]:
+        return self._call(
+            "ModelStatistics", {"name": model_name, "version": model_version},
+            headers, client_timeout,
+        )
+
+    def update_trace_settings(
+        self, model_name=None, settings: Optional[Dict[str, Any]] = None,
+        headers=None, client_timeout=None,
+    ) -> Dict[str, Any]:
+        req: Dict[str, Any] = {"settings": {}}
+        if model_name:
+            req["model_name"] = model_name
+        for key, value in (settings or {}).items():
+            if value is None:
+                req["settings"][key] = {}
+            elif isinstance(value, (list, tuple)):
+                req["settings"][key] = {"value": [str(v) for v in value]}
+            else:
+                req["settings"][key] = {"value": [str(value)]}
+        resp = self._call("TraceSetting", req, headers, client_timeout)
+        return {k: v.get("value", []) for k, v in resp.get("settings", {}).items()}
+
+    def get_trace_settings(self, model_name=None, headers=None, client_timeout=None) -> Dict[str, Any]:
+        req = {"model_name": model_name} if model_name else {}
+        resp = self._call("TraceSetting", req, headers, client_timeout)
+        return {k: v.get("value", []) for k, v in resp.get("settings", {}).items()}
+
+    def update_log_settings(self, settings: Dict[str, Any], headers=None, client_timeout=None) -> Dict[str, Any]:
+        req: Dict[str, Any] = {"settings": {}}
+        for key, value in settings.items():
+            if isinstance(value, bool):
+                req["settings"][key] = {"bool_param": value}
+            elif isinstance(value, int):
+                req["settings"][key] = {"uint32_param": value}
+            else:
+                req["settings"][key] = {"string_param": str(value)}
+        resp = self._call("LogSettings", req, headers, client_timeout)
+        return {k: from_infer_parameter(v) for k, v in resp.get("settings", {}).items()}
+
+    def get_log_settings(self, headers=None, client_timeout=None) -> Dict[str, Any]:
+        resp = self._call("LogSettings", {}, headers, client_timeout)
+        return {k: from_infer_parameter(v) for k, v in resp.get("settings", {}).items()}
+
+    # -- shared memory -----------------------------------------------------
+    def get_system_shared_memory_status(
+        self, region_name="", headers=None, client_timeout=None
+    ) -> List[Dict[str, Any]]:
+        resp = self._call(
+            "SystemSharedMemoryStatus", {"name": region_name}, headers, client_timeout
+        )
+        return list(resp.get("regions", {}).values())
+
+    def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, client_timeout=None
+    ) -> None:
+        self._call(
+            "SystemSharedMemoryRegister",
+            {"name": name, "key": key, "offset": offset, "byte_size": byte_size},
+            headers, client_timeout,
+        )
+
+    def unregister_system_shared_memory(self, name="", headers=None, client_timeout=None) -> None:
+        self._call("SystemSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+
+    def _device_shm_status(self, method, region_name, headers, client_timeout):
+        resp = self._call(method, {"name": region_name}, headers, client_timeout)
+        return list(resp.get("regions", {}).values())
+
+    def _device_shm_register(self, method, name, raw_handle, device_id, byte_size, headers, client_timeout):
+        if isinstance(raw_handle, str):
+            raw_handle = raw_handle.encode("ascii")
+        self._call(
+            method,
+            {
+                "name": name,
+                "raw_handle": raw_handle,
+                "device_id": device_id,
+                "byte_size": byte_size,
+            },
+            headers, client_timeout,
+        )
+
+    def get_cuda_shared_memory_status(self, region_name="", headers=None, client_timeout=None):
+        return self._device_shm_status("CudaSharedMemoryStatus", region_name, headers, client_timeout)
+
+    def register_cuda_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None
+    ) -> None:
+        self._device_shm_register(
+            "CudaSharedMemoryRegister", name, raw_handle, device_id, byte_size, headers, client_timeout
+        )
+
+    def unregister_cuda_shared_memory(self, name="", headers=None, client_timeout=None) -> None:
+        self._call("CudaSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+
+    def get_tpu_shared_memory_status(self, region_name="", headers=None, client_timeout=None):
+        return self._device_shm_status("TpuSharedMemoryStatus", region_name, headers, client_timeout)
+
+    def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None
+    ) -> None:
+        """Register a tpu_shared_memory region by its base64 raw handle."""
+        self._device_shm_register(
+            "TpuSharedMemoryRegister", name, raw_handle, device_id, byte_size, headers, client_timeout
+        )
+
+    def unregister_tpu_shared_memory(self, name="", headers=None, client_timeout=None) -> None:
+        self._call("TpuSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+
+    # -- inference ---------------------------------------------------------
+    def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> InferResult:
+        timers = RequestTimers()
+        timers.capture(RequestTimers.REQUEST_START)
+        request = build_infer_request(
+            model_name, inputs, model_version, outputs, request_id,
+            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+        )
+        timers.capture(RequestTimers.SEND_START)
+        response = self._call("ModelInfer", request, headers, client_timeout)
+        timers.capture(RequestTimers.SEND_END)
+        timers.capture(RequestTimers.RECV_START)
+        result = InferResult(response)
+        timers.capture(RequestTimers.RECV_END)
+        timers.capture(RequestTimers.REQUEST_END)
+        self._infer_stat.update(timers)
+        return result
+
+    def async_infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        callback: Optional[Callable] = None,
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> CallContext:
+        """Fire an async inference; ``callback(result, error)`` when done."""
+        request = build_infer_request(
+            model_name, inputs, model_version, outputs, request_id,
+            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+        )
+        future = self._callable("ModelInfer").future(
+            request, metadata=self._metadata(headers), timeout=client_timeout
+        )
+        context = CallContext(future)
+        if callback is not None:
+            def _done(f):
+                try:
+                    callback(InferResult(f.result()), None)
+                except grpc.RpcError as e:
+                    callback(None, _to_exception(e))
+                except Exception as e:  # cancelled etc.
+                    callback(None, InferenceServerException(str(e)))
+
+            future.add_done_callback(_done)
+        return context
+
+    # -- streaming ---------------------------------------------------------
+    def start_stream(
+        self,
+        callback: Callable,
+        stream_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Open the bidi stream; ``callback(result, error)`` per response."""
+        with self._stream_lock:
+            if self._stream is not None:
+                raise InferenceServerException(
+                    "cannot start a stream: one is already active; stop it first"
+                )
+            stream = _InferStream(callback, self._verbose)
+            stream.start(
+                self._callable("ModelStreamInfer", streaming=True),
+                self._metadata(headers),
+                stream_timeout,
+            )
+            self._stream = stream
+
+    def async_stream_infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        enable_empty_final_response: bool = False,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Send one request on the open stream (sequences, decoupled models)."""
+        with self._stream_lock:
+            stream = self._stream
+        if stream is None:
+            raise InferenceServerException("stream not available: call start_stream first")
+        request = build_infer_request(
+            model_name, inputs, model_version, outputs, request_id,
+            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+        )
+        if enable_empty_final_response:
+            request.setdefault("parameters", {})[
+                "triton_enable_empty_final_response"
+            ] = {"bool_param": True}
+        stream.enqueue(request)
+
+    def stop_stream(self, cancel_requests: bool = False) -> None:
+        with self._stream_lock:
+            stream, self._stream = self._stream, None
+        if stream is not None:
+            stream.close(cancel_requests)

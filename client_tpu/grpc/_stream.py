@@ -1,0 +1,109 @@
+"""Bi-directional streaming machinery for GRPC inference.
+
+Parity with the reference's ``grpc/_infer_stream.py`` (:39-191): a request
+queue drained by a ``_RequestIterator`` feeding the bidi call, and a reader
+thread dispatching ``callback(result, error)`` per response. Stream death
+marks the stream inactive; a new stream must be started.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import grpc
+
+from ..utils import InferenceServerException
+from ._infer import InferResult
+
+
+class _RequestIterator:
+    """Blocking iterator over enqueued request dicts; ``None`` closes it."""
+
+    def __init__(self):
+        self._queue: "queue.Queue" = queue.Queue()
+
+    def put(self, request: Optional[Dict[str, Any]]) -> None:
+        self._queue.put(request)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        return item
+
+
+class _InferStream:
+    """One live bidi ModelStreamInfer call."""
+
+    def __init__(self, callback: Callable[[Optional[InferResult], Optional[Exception]], None], verbose: bool = False):
+        self._callback = callback
+        self._verbose = verbose
+        self._requests = _RequestIterator()
+        self._call = None
+        self._reader: Optional[threading.Thread] = None
+        self._active = True
+        self._lock = threading.Lock()
+
+    def start(self, stream_callable, metadata, timeout) -> None:
+        self._call = stream_callable(
+            self._requests, metadata=metadata, timeout=timeout
+        )
+        self._reader = threading.Thread(
+            target=self._read_loop, name="client_tpu_grpc_stream", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            for response in self._call:
+                err_msg = response.get("error_message")
+                if err_msg:
+                    self._callback(None, InferenceServerException(err_msg))
+                    continue
+                result = InferResult(response.get("infer_response", {}))
+                if self._verbose:
+                    print(result.get_response())
+                self._callback(result, None)
+        except grpc.RpcError as rpc_error:
+            with self._lock:
+                self._active = False
+            code = rpc_error.code() if hasattr(rpc_error, "code") else None
+            if code == grpc.StatusCode.CANCELLED:
+                return  # local cancellation is not an error to surface
+            self._callback(
+                None,
+                InferenceServerException(
+                    f"stream closed: {rpc_error.details() if hasattr(rpc_error, 'details') else rpc_error}",
+                    status=str(code.name) if code else None,
+                ),
+            )
+        except Exception as e:  # defensive: never kill the thread silently
+            with self._lock:
+                self._active = False
+            self._callback(None, InferenceServerException(f"stream failure: {e}"))
+
+    def is_active(self) -> bool:
+        with self._lock:
+            return self._active
+
+    def enqueue(self, request: Dict[str, Any]) -> None:
+        if not self.is_active():
+            raise InferenceServerException(
+                "the stream is no longer in a valid state; start a new stream"
+            )
+        self._requests.put(request)
+
+    def close(self, cancel_requests: bool = False) -> None:
+        if cancel_requests and self._call is not None:
+            self._call.cancel()
+        self._requests.put(None)
+        if self._reader is not None:
+            self._reader.join(timeout=30)
+            self._reader = None
+        with self._lock:
+            self._active = False

@@ -8,6 +8,7 @@ Frontends: HTTP (``http_server``), GRPC (``grpc_server``).
 """
 
 from .core import ServerCore
+from .grpc_server import GrpcInferenceServer
 from .http_server import HttpInferenceServer
 
-__all__ = ["ServerCore", "HttpInferenceServer"]
+__all__ = ["ServerCore", "GrpcInferenceServer", "HttpInferenceServer"]

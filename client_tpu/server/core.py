@@ -159,13 +159,7 @@ class _TpuRegion(_Region):
         return super().write_tensor(arr, datatype, offset, limit, name)
 
     def close(self) -> None:
-        # drop the attachment we opened for a cross-process region; in-process
-        # registrations share the client's object, whose lifetime the client owns
-        if not self._region._cache_enabled and self._region._shm is not None:
-            from ..utils.shared_memory import _safe_close
-
-            _safe_close(self._region._shm, unlink=False)
-            self._region._shm = None
+        self._region.detach()
 
 
 class _ModelStats:

@@ -1,0 +1,433 @@
+"""GRPC frontend for ServerCore: ``inference.GRPCInferenceService``.
+
+Serves the full 19-rpc v2 surface plus the Tpu shared-memory rpc pair and
+bidi ``ModelStreamInfer`` (sequences + decoupled models), using generic
+method handlers bound to the schema-driven wire codec — the server twin of
+``client_tpu.grpc``.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Any, Dict, List, Optional
+
+import grpc
+import numpy as np
+
+from ..grpc import _messages as M
+from ..grpc._infer import _CONTENTS_FIELD, from_infer_parameter, to_infer_parameter
+from ..grpc._wire import decode_message, encode_message
+from ..utils import triton_to_np_dtype
+from .core import InferError, ServerCore, _array_to_bytes
+
+_STATUS_OF_HTTP = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    404: grpc.StatusCode.NOT_FOUND,
+    499: grpc.StatusCode.CANCELLED,
+    500: grpc.StatusCode.INTERNAL,
+    503: grpc.StatusCode.UNAVAILABLE,
+}
+
+_CONFIG_TYPE_OF_TRITON = {
+    name: i
+    for i, name in enumerate(M.CONFIG_DATATYPE_NAMES)
+}
+
+
+def _to_core_request(decoded: Dict[str, Any]) -> Dict[str, Any]:
+    """ModelInferRequest dict -> the neutral ServerCore request shape."""
+    params = {
+        k: from_infer_parameter(v) for k, v in decoded.get("parameters", {}).items()
+    }
+    request: Dict[str, Any] = {
+        "id": decoded.get("id", ""),
+        "parameters": params,
+        "inputs": [],
+    }
+    raw = decoded.get("raw_input_contents", [])
+    raw_idx = 0
+    for t in decoded.get("inputs", []):
+        tp = {k: from_infer_parameter(v) for k, v in t.get("parameters", {}).items()}
+        entry: Dict[str, Any] = {
+            "name": t.get("name", ""),
+            "datatype": t.get("datatype", ""),
+            "shape": t.get("shape", []),
+        }
+        if "shared_memory_region" in tp:
+            entry["shm"] = (
+                tp["shared_memory_region"],
+                tp.get("shared_memory_byte_size", 0),
+                tp.get("shared_memory_offset", 0),
+            )
+        elif t.get("contents"):
+            contents = t["contents"]
+            field = _CONTENTS_FIELD.get(entry["datatype"])
+            data = contents.get(field, []) if field else []
+            if entry["datatype"] == "BYTES":
+                arr = np.array(data, dtype=np.object_).reshape(entry["shape"])
+            else:
+                arr = np.array(
+                    data, dtype=triton_to_np_dtype(entry["datatype"])
+                ).reshape(entry["shape"])
+            entry["array"] = arr
+        else:
+            if raw_idx >= len(raw):
+                raise InferError(
+                    f"input '{entry['name']}' has no data (raw_input_contents "
+                    f"has {len(raw)} entries)", 400,
+                )
+            from .core import _bytes_to_array
+
+            entry["array"] = _bytes_to_array(
+                raw[raw_idx], entry["datatype"], entry["shape"]
+            )
+            raw_idx += 1
+        request["inputs"].append(entry)
+
+    outputs = []
+    for o in decoded.get("outputs", []):
+        op = {k: from_infer_parameter(v) for k, v in o.get("parameters", {}).items()}
+        spec: Dict[str, Any] = {
+            "name": o.get("name", ""),
+            "binary": True,
+            "classification": op.get("classification", 0),
+        }
+        if "shared_memory_region" in op:
+            spec["shm"] = (
+                op["shared_memory_region"],
+                op.get("shared_memory_byte_size", 0),
+                op.get("shared_memory_offset", 0),
+            )
+        outputs.append(spec)
+    if outputs:
+        request["outputs"] = outputs
+    return request
+
+
+def _encode_core_response(resp: Dict[str, Any], final: Optional[bool] = None) -> Dict[str, Any]:
+    """Neutral core response -> ModelInferResponse dict."""
+    out: Dict[str, Any] = {
+        "model_name": resp.get("model_name", ""),
+        "model_version": resp.get("model_version", ""),
+    }
+    if resp.get("id"):
+        out["id"] = resp["id"]
+    params = {k: to_infer_parameter(v) for k, v in (resp.get("parameters") or {}).items()}
+    if final is not None:
+        params["triton_final_response"] = {"bool_param": final}
+    if params:
+        out["parameters"] = params
+    outputs = []
+    raws: List[bytes] = []
+    for o in resp.get("outputs", []):
+        entry: Dict[str, Any] = {
+            "name": o["name"],
+            "datatype": o["datatype"],
+            "shape": list(o["shape"]),
+        }
+        if "shm" in o:
+            region, byte_size, offset = o["shm"]
+            p = {
+                "shared_memory_region": to_infer_parameter(region),
+                "shared_memory_byte_size": to_infer_parameter(int(byte_size)),
+            }
+            if offset:
+                p["shared_memory_offset"] = to_infer_parameter(int(offset))
+            entry["parameters"] = p
+        else:
+            raws.append(_array_to_bytes(np.asarray(o["array"]), o["datatype"]))
+        outputs.append(entry)
+    out["outputs"] = outputs
+    if raws:
+        out["raw_output_contents"] = raws
+    return out
+
+
+class _Handlers(grpc.GenericRpcHandler):
+    def __init__(self, core: ServerCore, verbose: bool = False):
+        self._core = core
+        self._verbose = verbose
+
+    # -- routing -----------------------------------------------------------
+    def service(self, handler_call_details):
+        method = handler_call_details.method.rsplit("/", 1)[-1]
+        specs = M.METHODS.get(method)
+        if specs is None:
+            return None
+        req_spec, resp_spec = specs
+        deserializer = lambda b: decode_message(req_spec, b)  # noqa: E731
+        serializer = lambda d: encode_message(resp_spec, d)  # noqa: E731
+        if method == "ModelStreamInfer":
+            return grpc.stream_stream_rpc_method_handler(
+                self._model_stream_infer,
+                request_deserializer=deserializer,
+                response_serializer=serializer,
+            )
+        fn = getattr(self, f"_{_snake(method)}", None)
+        if fn is None:
+            return None
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=deserializer, response_serializer=serializer
+        )
+
+    def _abort(self, context, e: Exception):
+        if isinstance(e, InferError):
+            context.abort(
+                _STATUS_OF_HTTP.get(e.status, grpc.StatusCode.INVALID_ARGUMENT), str(e)
+            )
+        context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    # -- health / metadata ---------------------------------------------------
+    def _server_live(self, request, context):
+        return {"live": self._core.live}
+
+    def _server_ready(self, request, context):
+        return {"ready": self._core.live}
+
+    def _model_ready(self, request, context):
+        return {
+            "ready": self._core.model_ready(
+                request.get("name", ""), request.get("version", "")
+            )
+        }
+
+    def _server_metadata(self, request, context):
+        return self._core.server_metadata()
+
+    def _model_metadata(self, request, context):
+        try:
+            return self._core.model(
+                request.get("name", ""), request.get("version", "")
+            ).metadata()
+        except InferError as e:
+            self._abort(context, e)
+
+    def _model_config(self, request, context):
+        try:
+            cfg = self._core.model(
+                request.get("name", ""), request.get("version", "")
+            ).config()
+        except InferError as e:
+            self._abort(context, e)
+        # JSON-config -> proto-config field shapes
+        config = {
+            "name": cfg["name"],
+            "platform": cfg.get("platform", ""),
+            "backend": cfg.get("backend", ""),
+            "max_batch_size": cfg.get("max_batch_size", 0),
+            "input": [
+                {
+                    "name": i["name"],
+                    "data_type": _CONFIG_TYPE_OF_TRITON.get(i["data_type"], 0),
+                    "dims": i["dims"],
+                }
+                for i in cfg.get("input", [])
+            ],
+            "output": [
+                {
+                    "name": o["name"],
+                    "data_type": _CONFIG_TYPE_OF_TRITON.get(o["data_type"], 0),
+                    "dims": o["dims"],
+                }
+                for o in cfg.get("output", [])
+            ],
+            "model_transaction_policy": {
+                "decoupled": cfg.get("model_transaction_policy", {}).get("decoupled", False)
+            },
+        }
+        return {"config": config}
+
+    # -- inference -----------------------------------------------------------
+    def _model_infer(self, request, context):
+        try:
+            core_req = _to_core_request(request)
+            responses = self._core.infer(
+                request.get("model_name", ""), request.get("model_version", ""), core_req
+            )
+            return _encode_core_response(responses[0])
+        except InferError as e:
+            self._abort(context, e)
+
+    def _model_stream_infer(self, request_iterator, context):
+        for request in request_iterator:
+            model_name = request.get("model_name", "")
+            try:
+                core_req = _to_core_request(request)
+                want_final = bool(
+                    core_req["parameters"].get("triton_enable_empty_final_response")
+                )
+                model = self._core.model(model_name, request.get("model_version", ""))
+                responses = self._core.infer(
+                    model_name, request.get("model_version", ""), core_req,
+                    decoupled_ok=True,
+                )
+                for resp in responses:
+                    final = (want_final and not model.decoupled) or None
+                    yield {"infer_response": _encode_core_response(resp, final=final)}
+                if want_final and model.decoupled:
+                    empty: Dict[str, Any] = {
+                        "model_name": model_name,
+                        "model_version": request.get("model_version", "") or model.versions[-1],
+                        "outputs": [],
+                    }
+                    if request.get("id"):
+                        empty["id"] = request["id"]
+                    yield {"infer_response": _encode_core_response(empty, final=True)}
+            except Exception as e:  # in-band stream errors (Triton semantics)
+                yield {"error_message": str(e)}
+
+    # -- repository ----------------------------------------------------------
+    def _repository_index(self, request, context):
+        return {"models": self._core.repository_index()}
+
+    def _repository_model_load(self, request, context):
+        try:
+            self._core.load_model(request.get("model_name", ""))
+        except InferError as e:
+            self._abort(context, e)
+        return {}
+
+    def _repository_model_unload(self, request, context):
+        try:
+            self._core.unload_model(request.get("model_name", ""))
+        except InferError as e:
+            self._abort(context, e)
+        return {}
+
+    # -- statistics / trace / log ---------------------------------------------
+    def _model_statistics(self, request, context):
+        try:
+            return self._core.statistics(
+                request.get("name", ""), request.get("version", "")
+            )
+        except InferError as e:
+            self._abort(context, e)
+
+    def _trace_setting(self, request, context):
+        for key, value in request.get("settings", {}).items():
+            self._core.trace_settings[key] = value.get("value", [])
+        out = {}
+        for key, value in self._core.trace_settings.items():
+            out[key] = {"value": value if isinstance(value, list) else [str(value)]}
+        return {"settings": out}
+
+    def _log_settings(self, request, context):
+        for key, value in request.get("settings", {}).items():
+            self._core.log_settings[key] = from_infer_parameter(value)
+        out = {}
+        for key, value in self._core.log_settings.items():
+            if isinstance(value, bool):
+                out[key] = {"bool_param": value}
+            elif isinstance(value, int):
+                out[key] = {"uint32_param": value}
+            else:
+                out[key] = {"string_param": str(value)}
+        return {"settings": out}
+
+    # -- shared memory --------------------------------------------------------
+    def _system_shared_memory_status(self, request, context):
+        regions = self._core.region_status("system", request.get("name", ""))
+        return {"regions": {r["name"]: r for r in regions}}
+
+    def _system_shared_memory_register(self, request, context):
+        try:
+            self._core.register_system_region(
+                request.get("name", ""),
+                request.get("key", ""),
+                request.get("offset", 0),
+                request.get("byte_size", 0),
+            )
+        except InferError as e:
+            self._abort(context, e)
+        return {}
+
+    def _system_shared_memory_unregister(self, request, context):
+        self._core.unregister_region(request.get("name", ""), None if request.get("name") else "system")
+        return {}
+
+    def _device_shm_status(self, family, request):
+        regions = self._core.region_status(family, request.get("name", ""))
+        return {"regions": {r["name"]: r for r in regions}}
+
+    def _device_shm_register(self, family, request, context):
+        try:
+            raw = request.get("raw_handle", b"")
+            self._core.register_handle_region(
+                family,
+                request.get("name", ""),
+                raw.decode("ascii") if isinstance(raw, bytes) else raw,
+                request.get("device_id", 0),
+                request.get("byte_size", 0),
+            )
+        except InferError as e:
+            self._abort(context, e)
+        return {}
+
+    def _cuda_shared_memory_status(self, request, context):
+        return self._device_shm_status("cuda", request)
+
+    def _cuda_shared_memory_register(self, request, context):
+        return self._device_shm_register("cuda", request, context)
+
+    def _cuda_shared_memory_unregister(self, request, context):
+        self._core.unregister_region(request.get("name", ""), None if request.get("name") else "cuda")
+        return {}
+
+    def _tpu_shared_memory_status(self, request, context):
+        return self._device_shm_status("tpu", request)
+
+    def _tpu_shared_memory_register(self, request, context):
+        return self._device_shm_register("tpu", request, context)
+
+    def _tpu_shared_memory_unregister(self, request, context):
+        self._core.unregister_region(request.get("name", ""), None if request.get("name") else "tpu")
+        return {}
+
+
+def _snake(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+class GrpcInferenceServer:
+    """An in-process v2 GRPC server bound to localhost."""
+
+    def __init__(self, core: ServerCore, port: int = 0, max_workers: int = 8, verbose: bool = False):
+        self.core = core
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="client_tpu_grpc_server"
+            ),
+            options=[
+                ("grpc.max_send_message_length", 2**31 - 1),
+                ("grpc.max_receive_message_length", 2**31 - 1),
+            ],
+        )
+        self._server.add_generic_rpc_handlers((_Handlers(core, verbose),))
+        self._port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def url(self) -> str:
+        return f"127.0.0.1:{self._port}"
+
+    def start(self) -> "GrpcInferenceServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        self._server.stop(grace).wait()
+
+    def __enter__(self) -> "GrpcInferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

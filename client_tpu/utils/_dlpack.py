@@ -122,6 +122,33 @@ _pycapsule_get_pointer = ctypes.pythonapi.PyCapsule_GetPointer
 _pycapsule_get_pointer.restype = ctypes.c_void_p
 _pycapsule_get_pointer.argtypes = [ctypes.py_object, ctypes.c_char_p]
 
+# Raw-pointer variants for use inside the capsule destructor (separate PyDLL
+# handle so the py_object argtypes above are untouched).
+_capsule_api = ctypes.PyDLL(None)
+_raw_is_valid = _capsule_api.PyCapsule_IsValid
+_raw_is_valid.restype = ctypes.c_int
+_raw_is_valid.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+_raw_get_pointer = _capsule_api.PyCapsule_GetPointer
+_raw_get_pointer.restype = ctypes.c_void_p
+_raw_get_pointer.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+
+_PYCAPSULE_DESTRUCTOR = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+@_PYCAPSULE_DESTRUCTOR
+def _capsule_destructor(capsule_ptr):
+    # DLPack contract: if the capsule is garbage-collected while still named
+    # 'dltensor' (never consumed), the producer must invoke the deleter.
+    try:
+        if _raw_is_valid(capsule_ptr, _c_str_dltensor):
+            ptr = _raw_get_pointer(capsule_ptr, _c_str_dltensor)
+            if ptr:
+                managed = ctypes.cast(ptr, ctypes.POINTER(DLManagedTensor))
+                if managed.contents.deleter:
+                    managed.contents.deleter(managed)
+    except Exception:
+        pass
+
 
 def make_capsule(
     data_ptr: int,
@@ -150,7 +177,7 @@ def make_capsule(
     return _pycapsule_new(
         ctypes.cast(ctypes.byref(mgr.managed), ctypes.c_void_p),
         _c_str_dltensor,
-        None,
+        ctypes.cast(_capsule_destructor, ctypes.c_void_p),
     )
 
 

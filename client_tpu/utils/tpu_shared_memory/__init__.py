@@ -181,6 +181,13 @@ class TpuSharedMemoryRegion:
         self._invalidate_overlapping(offset, len(data))
         self._host_buf()[offset : offset + len(data)] = data
 
+    def detach(self) -> None:
+        """Release a cross-process attachment (no-op for owned/in-process
+        regions, whose lifetime belongs to their creator)."""
+        if not self._cache_enabled and self._shm is not None:
+            _safe_close(self._shm, unlink=False)
+            self._shm = None
+
     def host_address(self, offset: int = 0) -> int:
         """Raw address of the host window at ``offset`` (for DLPack export)."""
         import ctypes

@@ -3,14 +3,30 @@
 Sharding/parallel tests run on a virtual multi-device CPU topology
 (``--xla_force_host_platform_device_count=8``); bench.py and examples run on
 the real TPU instead.
+
+The environment may pre-register an experimental TPU PJRT plugin (axon) via
+sitecustomize and force ``JAX_PLATFORMS`` to it; tests must not depend on
+that tunnel being alive, so the CPU pin happens at the config level and the
+accelerator backend factories are deregistered before first backend init.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax._src.xla_bridge as _xb
+
+    for _name in ("axon", "tpu", "cuda", "rocm"):
+        _xb._backend_factories.pop(_name, None)
+except Exception:  # jax absent or internals moved; env vars still pin cpu
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

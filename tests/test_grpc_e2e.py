@@ -1,0 +1,309 @@
+"""End-to-end GRPC tests: client + bidi streaming against the live server.
+
+The GRPC twin of test_http_e2e.py, plus the streaming tier the reference
+exercises via simple_grpc_sequence_stream / simple_grpc_custom_repeat.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.shared_memory as shm
+import client_tpu.utils.tpu_shared_memory as tpushm
+from client_tpu.models import default_model_zoo
+from client_tpu.server import GrpcInferenceServer, ServerCore
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    with GrpcInferenceServer(ServerCore(default_model_zoo())) as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    with grpcclient.InferenceServerClient(server.url) as c:
+        yield c
+
+
+def _simple_inputs():
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+    in1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+    return a, b, [in0, in1]
+
+
+def test_health_and_metadata(client):
+    assert client.is_server_live()
+    assert client.is_server_ready()
+    assert client.is_model_ready("simple")
+    assert not client.is_model_ready("nope")
+    md = client.get_server_metadata()
+    assert "tpu_shared_memory" in md["extensions"]
+    mmd = client.get_model_metadata("simple")
+    assert mmd["name"] == "simple"
+    assert mmd["inputs"][0]["shape"] == [1, 16]
+
+
+def test_model_config(client):
+    cfg = client.get_model_config("simple")["config"]
+    assert cfg["name"] == "simple"
+    assert cfg["backend"] == "jax"
+    # TYPE_INT32 == 8 in the model_config DataType enum
+    assert cfg["input"][0]["data_type"] == 8
+
+
+def test_infer_binary(client):
+    a, b, inputs = _simple_inputs()
+    result = client.infer("simple", inputs, request_id="g1")
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), a - b)
+    assert result.get_response()["id"] == "g1"
+
+
+def test_infer_typed_contents(client):
+    a, b, _ = _simple_inputs()
+    in0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+    in1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32")
+    in0.set_data_from_numpy(a, binary_data=False)  # rides InferTensorContents
+    in1.set_data_from_numpy(b, binary_data=False)
+    result = client.infer("simple", [in0, in1])
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+
+
+def test_infer_bytes_model(client):
+    payload = np.array([[b"ab", b"\x00\xff"]], dtype=np.object_)
+    inp = grpcclient.InferInput("INPUT0", [1, 2], "BYTES").set_data_from_numpy(payload)
+    result = client.infer("simple_identity", [inp])
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), payload)
+
+
+def test_async_infer_callback_and_future(client):
+    a, b, inputs = _simple_inputs()
+    results = queue.Queue()
+    ctx = client.async_infer(
+        "simple", inputs, callback=lambda r, e: results.put((r, e))
+    )
+    r, e = results.get(timeout=10)
+    assert e is None
+    np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), a + b)
+    # future-style too
+    ctx2 = client.async_infer("simple", inputs)
+    np.testing.assert_array_equal(ctx2.get_result(timeout=10).as_numpy("OUTPUT1"), a - b)
+
+
+def test_error_unknown_model(client):
+    _, _, inputs = _simple_inputs()
+    with pytest.raises(InferenceServerException, match="unknown model") as exc:
+        client.infer("missing_model", inputs)
+    assert "INVALID_ARGUMENT" in exc.value.status()
+
+
+def test_classification_param(client):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    z = np.zeros((1, 16), dtype=np.int32)
+    in0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+    in1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(z)
+    outputs = [grpcclient.InferRequestedOutput("OUTPUT0", class_count=2)]
+    result = client.infer("simple", [in0, in1], outputs=outputs)
+    top = result.as_numpy("OUTPUT0")
+    assert top.shape == (1, 2)
+    assert int(top[0, 0].decode().split(":")[1]) == 15
+
+
+def test_statistics_and_settings(client):
+    _, _, inputs = _simple_inputs()
+    client.infer("simple", inputs)
+    stats = client.get_inference_statistics("simple")
+    entry = stats["model_stats"][0]
+    assert entry["name"] == "simple" and entry["inference_count"] >= 1
+    ts = client.get_trace_settings()
+    assert ts["trace_level"] == ["OFF"]
+    updated = client.update_trace_settings(settings={"trace_level": ["TIMESTAMPS"]})
+    assert updated["trace_level"] == ["TIMESTAMPS"]
+    client.update_trace_settings(settings={"trace_level": ["OFF"]})
+    ls = client.get_log_settings()
+    assert ls["log_info"] is True
+    assert client.update_log_settings({"log_verbose_level": 3})["log_verbose_level"] == 3
+
+
+def test_repository_control(client):
+    index = client.get_model_repository_index()
+    assert {"simple", "repeat_int32"} <= {m["name"] for m in index}
+    client.unload_model("simple_string")
+    assert not client.is_model_ready("simple_string")
+    client.load_model("simple_string")
+    assert client.is_model_ready("simple_string")
+
+
+def test_system_shm_over_grpc(client):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    region = shm.create_shared_memory_region("gshm", "/grpc_shm_io", 256)
+    try:
+        shm.set_shared_memory_region(region, [a, b])
+        client.register_system_shared_memory("gshm", "/grpc_shm_io", 256)
+        assert client.get_system_shared_memory_status()[0]["name"] == "gshm"
+        in0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_shared_memory("gshm", 64)
+        in1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32").set_shared_memory(
+            "gshm", 64, offset=64
+        )
+        out0 = grpcclient.InferRequestedOutput("OUTPUT0")
+        out0.set_shared_memory("gshm", 64, offset=128)
+        result = client.infer("simple", [in0, in1], outputs=[out0])
+        assert result.as_numpy("OUTPUT0") is None
+        np.testing.assert_array_equal(
+            shm.get_contents_as_numpy(region, np.int32, [1, 16], offset=128), a + b
+        )
+        client.unregister_system_shared_memory()
+        assert client.get_system_shared_memory_status() == []
+    finally:
+        shm.destroy_shared_memory_region(region)
+
+
+def test_tpu_shm_over_grpc(client):
+    import jax.numpy as jnp
+
+    a = jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
+    b = jnp.ones((1, 16), jnp.int32)
+    region = tpushm.create_shared_memory_region("gtpu", 256)
+    try:
+        tpushm.set_shared_memory_region_from_jax(region, a)
+        tpushm.set_shared_memory_region_from_jax(region, b, offset=64)
+        client.register_tpu_shared_memory("gtpu", tpushm.get_raw_handle(region), 0, 256)
+        assert client.get_tpu_shared_memory_status()[0]["name"] == "gtpu"
+        in0 = grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_shared_memory("gtpu", 64)
+        in1 = grpcclient.InferInput("INPUT1", [1, 16], "INT32").set_shared_memory(
+            "gtpu", 64, offset=64
+        )
+        out0 = grpcclient.InferRequestedOutput("OUTPUT0")
+        out0.set_shared_memory("gtpu", 64, offset=128)
+        result = client.infer("simple", [in0, in1], outputs=[out0])
+        assert result.as_numpy("OUTPUT0") is None
+        got = tpushm.get_contents_as_jax(region, "INT32", [1, 16], offset=128)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(a + b))
+        client.unregister_tpu_shared_memory()
+    finally:
+        tpushm.destroy_shared_memory_region(region)
+
+
+# ---------------------------------------------------------------------------
+# streaming
+# ---------------------------------------------------------------------------
+
+
+class _Collector:
+    def __init__(self):
+        self.queue = queue.Queue()
+
+    def __call__(self, result, error):
+        self.queue.put((result, error))
+
+    def get(self, timeout=10):
+        return self.queue.get(timeout=timeout)
+
+
+def test_stream_sequence(client):
+    """Stateful sequence over the bidi stream (reference:
+    simple_grpc_sequence_stream_infer_client.py:59-81)."""
+    collector = _Collector()
+    client.start_stream(collector)
+    try:
+        total = 0
+        for i, (start, end) in enumerate([(True, False), (False, False), (False, True)]):
+            inp = grpcclient.InferInput("INPUT", [1, 1], "INT32")
+            inp.set_data_from_numpy(np.array([[i + 2]], dtype=np.int32))
+            client.async_stream_infer(
+                "simple_sequence", [inp], sequence_id=1001,
+                sequence_start=start, sequence_end=end, request_id=f"s{i}",
+            )
+        for i in range(3):
+            result, error = collector.get()
+            assert error is None
+            total += i + 2
+            assert result.as_numpy("OUTPUT")[0, 0] == total
+            assert result.get_response()["id"] == f"s{i}"
+    finally:
+        client.stop_stream()
+
+
+def test_stream_decoupled_repeat(client):
+    """Decoupled model: N responses per request + empty final response."""
+    collector = _Collector()
+    client.start_stream(collector)
+    try:
+        values = np.array([4, 5, 6], dtype=np.int32)
+        in0 = grpcclient.InferInput("IN", [3], "INT32").set_data_from_numpy(values)
+        client.async_stream_infer(
+            "repeat_int32", [in0], enable_empty_final_response=True
+        )
+        seen = []
+        while True:
+            result, error = collector.get()
+            assert error is None
+            if result.is_null_response():
+                assert result.is_final_response()
+                break
+            seen.append(int(result.as_numpy("OUT")[0]))
+        assert seen == [4, 5, 6]
+    finally:
+        client.stop_stream()
+
+
+def test_stream_error_in_band(client):
+    collector = _Collector()
+    client.start_stream(collector)
+    try:
+        inp = grpcclient.InferInput("INPUT", [1, 1], "INT32")
+        inp.set_data_from_numpy(np.array([[1]], dtype=np.int32))
+        # missing sequence_id -> model error, delivered in-band
+        client.async_stream_infer("simple_sequence", [inp])
+        result, error = collector.get()
+        assert result is None
+        assert isinstance(error, InferenceServerException)
+        assert "sequence_id" in str(error)
+    finally:
+        client.stop_stream()
+
+
+def test_stream_restart_after_stop(client):
+    collector = _Collector()
+    client.start_stream(collector)
+    client.stop_stream()
+    client.start_stream(collector)
+    try:
+        a, b, inputs = _simple_inputs()
+        client.async_stream_infer("simple", inputs)
+        result, error = collector.get()
+        assert error is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+    finally:
+        client.stop_stream()
+
+
+def test_double_start_stream_rejected(client):
+    collector = _Collector()
+    client.start_stream(collector)
+    try:
+        with pytest.raises(InferenceServerException, match="already active"):
+            client.start_stream(collector)
+    finally:
+        client.stop_stream()
+
+
+def test_async_infer_cancellation(client):
+    # slow model: identity with delay via unloaded? use repeat WAIT on stream is
+    # decoupled; instead cancel a normal call race — cancel() may or may not
+    # win, both outcomes are valid; just assert the API works.
+    _, _, inputs = _simple_inputs()
+    ctx = client.async_infer("simple", inputs)
+    cancelled = ctx.cancel()
+    if not cancelled:
+        result = ctx.get_result(timeout=10)
+        assert result.as_numpy("OUTPUT0") is not None

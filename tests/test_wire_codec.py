@@ -1,0 +1,242 @@
+"""Wire-codec tests: self round-trips, known byte patterns, and a protoc
+cross-validation (our codec vs the official protobuf runtime on a test-only
+.proto mirroring the KServe message shapes)."""
+
+import struct
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import pytest
+
+from client_tpu.grpc import _messages as M
+from client_tpu.grpc._wire import decode_message, decode_varint, encode_message, encode_varint
+
+
+def _enc_varint(v):
+    out = []
+    encode_varint(v, out)
+    return b"".join(out)
+
+
+def test_varint_known_values():
+    assert _enc_varint(0) == b"\x00"
+    assert _enc_varint(1) == b"\x01"
+    assert _enc_varint(127) == b"\x7f"
+    assert _enc_varint(128) == b"\x80\x01"
+    assert _enc_varint(300) == b"\xac\x02"
+    # negative int64: 10-byte two's complement
+    assert len(_enc_varint(-1)) == 10
+    for v in (0, 1, 127, 128, 300, 2**32, 2**63 - 1):
+        assert decode_varint(_enc_varint(v), 0)[0] == v
+
+
+def test_simple_message_known_bytes():
+    # ServerLiveResponse{live: true} => tag(1,varint)=0x08, value=1
+    assert encode_message(M.SERVER_LIVE_RESPONSE, {"live": True}) == b"\x08\x01"
+    assert decode_message(M.SERVER_LIVE_RESPONSE, b"\x08\x01") == {"live": True}
+    # proto3 default not emitted
+    assert encode_message(M.SERVER_LIVE_RESPONSE, {"live": False}) == b""
+
+
+def test_string_field_known_bytes():
+    # ModelReadyRequest{name: "ab"} => tag(1,len)=0x0a, len=2, "ab"
+    assert encode_message(M.MODEL_READY_REQUEST, {"name": "ab"}) == b"\x0a\x02ab"
+
+
+def test_infer_request_roundtrip():
+    req = {
+        "model_name": "simple",
+        "model_version": "1",
+        "id": "req-7",
+        "parameters": {
+            "sequence_id": {"int64_param": 42},
+            "sequence_start": {"bool_param": True},
+            "note": {"string_param": "hi"},
+        },
+        "inputs": [
+            {
+                "name": "INPUT0",
+                "datatype": "INT32",
+                "shape": [1, 16],
+                "parameters": {"shared_memory_byte_size": {"int64_param": 64}},
+            },
+            {"name": "INPUT1", "datatype": "FP32", "shape": [2, 2, 2]},
+        ],
+        "outputs": [
+            {"name": "OUTPUT0", "parameters": {"classification": {"int64_param": 3}}}
+        ],
+        "raw_input_contents": [b"\x00" * 64, b"\x01\x02"],
+    }
+    buf = encode_message(M.MODEL_INFER_REQUEST, req)
+    out = decode_message(M.MODEL_INFER_REQUEST, buf)
+    assert out["model_name"] == "simple"
+    assert out["inputs"][0]["shape"] == [1, 16]
+    assert out["inputs"][1]["shape"] == [2, 2, 2]
+    assert out["parameters"]["sequence_id"]["int64_param"] == 42
+    assert out["parameters"]["sequence_start"]["bool_param"] is True
+    assert out["raw_input_contents"] == [b"\x00" * 64, b"\x01\x02"]
+    assert out["outputs"][0]["parameters"]["classification"]["int64_param"] == 3
+
+
+def test_negative_int_roundtrip():
+    req = {"inputs": [{"name": "x", "shape": [-1, 3]}]}
+    out = decode_message(M.MODEL_INFER_REQUEST, encode_message(M.MODEL_INFER_REQUEST, req))
+    assert out["inputs"][0]["shape"] == [-1, 3]
+
+
+def test_unknown_fields_skipped():
+    # encode with a spec containing field 99, decode with the normal spec
+    from client_tpu.grpc._wire import MessageSpec, scalar
+
+    fat = MessageSpec("Fat", [scalar("name", 1, "string"), scalar("extra", 99, "string")])
+    buf = encode_message(fat, {"name": "m", "extra": "ignored"})
+    out = decode_message(M.MODEL_READY_REQUEST, buf)
+    assert out == {"name": "m"}
+
+
+def test_float_contents_roundtrip():
+    msg = {"fp32_contents": [1.5, -2.25], "fp64_contents": [3.14], "bool_contents": [True, False]}
+    out = decode_message(
+        M.INFER_TENSOR_CONTENTS, encode_message(M.INFER_TENSOR_CONTENTS, msg)
+    )
+    assert out["fp32_contents"] == [1.5, -2.25]
+    assert out["fp64_contents"] == [3.14]
+    assert out["bool_contents"] == [True, False]
+
+
+# ---------------------------------------------------------------------------
+# protoc cross-validation
+# ---------------------------------------------------------------------------
+
+_TEST_PROTO = """
+syntax = "proto3";
+package ctest;
+
+message Param {
+  oneof choice {
+    bool bool_param = 1;
+    int64 int64_param = 2;
+    string string_param = 3;
+    double double_param = 4;
+    uint64 uint64_param = 5;
+  }
+}
+
+message InTensor {
+  string name = 1;
+  string datatype = 2;
+  repeated int64 shape = 3;
+  map<string, Param> parameters = 4;
+}
+
+message Req {
+  string model_name = 1;
+  string model_version = 2;
+  string id = 3;
+  map<string, Param> parameters = 4;
+  repeated InTensor inputs = 5;
+  repeated bytes raw = 7;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def protoc_module():
+    try:
+        subprocess.run(["protoc", "--version"], capture_output=True, check=True)
+    except Exception:
+        pytest.skip("protoc unavailable")
+    with tempfile.TemporaryDirectory() as td:
+        proto = Path(td) / "ctest.proto"
+        proto.write_text(_TEST_PROTO)
+        subprocess.run(
+            ["protoc", f"-I{td}", f"--python_out={td}", str(proto)], check=True
+        )
+        sys.path.insert(0, td)
+        try:
+            import ctest_pb2  # noqa
+
+            yield ctest_pb2
+        finally:
+            sys.path.remove(td)
+            sys.modules.pop("ctest_pb2", None)
+
+
+def _specs_for_ctest():
+    from client_tpu.grpc._wire import MessageSpec, map_field, message, scalar
+
+    param = MessageSpec(
+        "Param",
+        [
+            scalar("bool_param", 1, "bool"),
+            scalar("int64_param", 2, "int64"),
+            scalar("string_param", 3, "string"),
+            scalar("double_param", 4, "double"),
+            scalar("uint64_param", 5, "uint64"),
+        ],
+    )
+    tensor = MessageSpec(
+        "InTensor",
+        [
+            scalar("name", 1, "string"),
+            scalar("datatype", 2, "string"),
+            scalar("shape", 3, "int64", repeated=True),
+            map_field("parameters", 4, "string", param),
+        ],
+    )
+    req = MessageSpec(
+        "Req",
+        [
+            scalar("model_name", 1, "string"),
+            scalar("model_version", 2, "string"),
+            scalar("id", 3, "string"),
+            map_field("parameters", 4, "string", param),
+            message("inputs", 5, tensor, repeated=True),
+            scalar("raw", 7, "bytes", repeated=True),
+        ],
+    )
+    return req
+
+
+def test_protoc_decodes_our_bytes(protoc_module):
+    spec = _specs_for_ctest()
+    value = {
+        "model_name": "m",
+        "id": "abc",
+        "parameters": {"seq": {"int64_param": -5}, "flag": {"bool_param": True}},
+        "inputs": [
+            {"name": "I0", "datatype": "INT32", "shape": [4, -1],
+             "parameters": {"off": {"uint64_param": 2**40}}},
+        ],
+        "raw": [b"\xde\xad", b""],
+    }
+    buf = encode_message(spec, value)
+    msg = protoc_module.Req()
+    msg.ParseFromString(buf)
+    assert msg.model_name == "m" and msg.id == "abc"
+    assert msg.parameters["seq"].int64_param == -5
+    assert msg.parameters["flag"].bool_param is True
+    assert list(msg.inputs[0].shape) == [4, -1]
+    assert msg.inputs[0].parameters["off"].uint64_param == 2**40
+    assert list(msg.raw) == [b"\xde\xad", b""]
+
+
+def test_we_decode_protoc_bytes(protoc_module):
+    spec = _specs_for_ctest()
+    msg = protoc_module.Req()
+    msg.model_name = "served"
+    msg.model_version = "2"
+    msg.parameters["p"].double_param = 1.25
+    t = msg.inputs.add()
+    t.name = "X"
+    t.datatype = "FP32"
+    t.shape.extend([1, 2, 3])
+    msg.raw.append(b"\x00\x01")
+    out = decode_message(spec, msg.SerializeToString())
+    assert out["model_name"] == "served"
+    assert out["model_version"] == "2"
+    assert out["parameters"]["p"]["double_param"] == 1.25
+    assert out["inputs"][0]["shape"] == [1, 2, 3]
+    assert out["raw"] == [b"\x00\x01"]
