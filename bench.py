@@ -1,0 +1,169 @@
+"""Benchmark: the zero-copy TPU data plane vs the wire path.
+
+Measures the client-framework hot path end-to-end — a real KServe v2 HTTP
+round trip against the in-process server — for a 4 MiB FP32 identity
+inference in three data-plane modes:
+
+- wire:      tensor bytes serialized into the two-part HTTP body both ways
+- shm=system: POSIX shared-memory negotiation (no tensor bytes on the wire)
+- shm=tpu:   tpu_shared_memory with jax.Array binding (colocated regions:
+             tensors stay in HBM; only the control message rides HTTP)
+
+Prints ONE JSON line: the shm=tpu p50 latency, with vs_baseline = speedup
+over the wire path (the reference publishes no numbers — BASELINE.md — so
+the wire path is the measured baseline, exactly what `perf_analyzer
+--shared-memory=cuda vs none` reports on the reference stack).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_WARMUP = 5
+N_ITERS = 40
+N_ELEMS = 1 << 20  # 4 MiB of fp32
+
+
+def _percentile(values, q):
+    values = sorted(values)
+    idx = min(int(len(values) * q), len(values) - 1)
+    return values[idx]
+
+
+def bench_wire(client, httpclient, x_np):
+    import numpy as np
+
+    times = []
+    for i in range(N_WARMUP + N_ITERS):
+        t0 = time.perf_counter()
+        inp = httpclient.InferInput("INPUT0", list(x_np.shape), "FP32")
+        inp.set_data_from_numpy(x_np)
+        result = client.infer("identity_fp32", [inp])
+        out = result.as_numpy("OUTPUT0")
+        assert out.shape == x_np.shape
+        if i >= N_WARMUP:
+            times.append(time.perf_counter() - t0)
+    return times
+
+
+def bench_shm(client, httpclient, x_np, family):
+    import numpy as np
+
+    nbytes = x_np.nbytes
+    if family == "system":
+        import client_tpu.utils.shared_memory as shm
+
+        rin = shm.create_shared_memory_region("bench_in", "/bench_in", nbytes)
+        rout = shm.create_shared_memory_region("bench_out", "/bench_out", nbytes)
+        client.register_system_shared_memory("bench_in", "/bench_in", nbytes)
+        client.register_system_shared_memory("bench_out", "/bench_out", nbytes)
+
+        def write_input():
+            shm.set_shared_memory_region(rin, [x_np])
+
+        def read_output():
+            return shm.get_contents_as_numpy(rout, np.float32, list(x_np.shape))
+
+        def cleanup():
+            client.unregister_system_shared_memory()
+            shm.destroy_shared_memory_region(rin)
+            shm.destroy_shared_memory_region(rout)
+
+    else:  # tpu
+        import jax
+
+        import client_tpu.utils.tpu_shared_memory as tpushm
+
+        x_dev = jax.device_put(x_np)
+        x_dev.block_until_ready()
+        rin = tpushm.create_shared_memory_region("bench_in", nbytes, colocated=True)
+        rout = tpushm.create_shared_memory_region("bench_out", nbytes, colocated=True)
+        client.register_tpu_shared_memory("bench_in", tpushm.get_raw_handle(rin), 0, nbytes)
+        client.register_tpu_shared_memory("bench_out", tpushm.get_raw_handle(rout), 0, nbytes)
+
+        def write_input():
+            tpushm.set_shared_memory_region_from_jax(rin, x_dev)
+
+        def read_output():
+            out = tpushm.get_contents_as_jax(rout, "FP32", list(x_np.shape))
+            out.block_until_ready()
+            return out
+
+        def cleanup():
+            client.unregister_tpu_shared_memory()
+            tpushm.destroy_shared_memory_region(rin)
+            tpushm.destroy_shared_memory_region(rout)
+
+    try:
+        times = []
+        for i in range(N_WARMUP + N_ITERS):
+            t0 = time.perf_counter()
+            write_input()
+            inp = httpclient.InferInput("INPUT0", list(x_np.shape), "FP32")
+            inp.set_shared_memory("bench_in", nbytes)
+            out0 = httpclient.InferRequestedOutput("OUTPUT0")
+            out0.set_shared_memory("bench_out", nbytes)
+            client.infer("identity_fp32", [inp], outputs=[out0])
+            read_output()
+            if i >= N_WARMUP:
+                times.append(time.perf_counter() - t0)
+        return times
+    finally:
+        cleanup()
+
+
+def main():
+    import numpy as np
+
+    import client_tpu.http as httpclient
+    from client_tpu.models.simple import IdentityModel
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    import jax
+
+    platform = jax.default_backend()
+    core = ServerCore(
+        [IdentityModel("identity_fp32", "FP32", delay_s=0.0)]
+    )
+    server = HttpInferenceServer(core)
+    server.start()
+    client = httpclient.InferenceServerClient(server.url, concurrency=2)
+
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal(N_ELEMS, dtype=np.float32).reshape(1, N_ELEMS)
+
+    try:
+        wire = bench_wire(client, httpclient, x_np)
+        sysshm = bench_shm(client, httpclient, x_np, "system")
+        tpushm_t = bench_shm(client, httpclient, x_np, "tpu")
+    finally:
+        client.close()
+        server.stop()
+
+    wire_p50 = _percentile(wire, 0.5)
+    sys_p50 = _percentile(sysshm, 0.5)
+    tpu_p50 = _percentile(tpushm_t, 0.5)
+    result = {
+        "metric": f"identity 4MiB infer p50 latency, shm=tpu ({platform})",
+        "value": round(tpu_p50 * 1000, 3),
+        "unit": "ms",
+        "vs_baseline": round(wire_p50 / tpu_p50, 3),
+        "detail": {
+            "wire_p50_ms": round(wire_p50 * 1000, 3),
+            "system_shm_p50_ms": round(sys_p50 * 1000, 3),
+            "tpu_shm_p50_ms": round(tpu_p50 * 1000, 3),
+            "wire_p99_ms": round(_percentile(wire, 0.99) * 1000, 3),
+            "tpu_shm_p99_ms": round(_percentile(tpushm_t, 0.99) * 1000, 3),
+            "tpu_shm_infer_per_sec": round(1.0 / tpu_p50, 1),
+            "iters": N_ITERS,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -188,11 +188,10 @@ class InferenceServerClient(InferenceServerClientBase):
         return bool(self._call("ServerReady", {}, headers, client_timeout).get("ready", False))
 
     def is_model_ready(self, model_name, model_version="", headers=None, client_timeout=None) -> bool:
+        # transport errors propagate (matching the HTTP client and the
+        # reference); a served-but-unknown model comes back ready=False
         req = {"name": model_name, "version": model_version}
-        try:
-            return bool(self._call("ModelReady", req, headers, client_timeout).get("ready", False))
-        except InferenceServerException:
-            return False
+        return bool(self._call("ModelReady", req, headers, client_timeout).get("ready", False))
 
     def get_server_metadata(self, headers=None, client_timeout=None) -> Dict[str, Any]:
         return self._call("ServerMetadata", {}, headers, client_timeout)
@@ -415,12 +414,16 @@ class InferenceServerClient(InferenceServerClientBase):
         context = CallContext(future)
         if callback is not None:
             def _done(f):
+                result, error = None, None
                 try:
-                    callback(InferResult(f.result()), None)
+                    result = InferResult(f.result())
                 except grpc.RpcError as e:
-                    callback(None, _to_exception(e))
+                    error = _to_exception(e)
                 except Exception as e:  # cancelled etc.
-                    callback(None, InferenceServerException(str(e)))
+                    error = InferenceServerException(str(e))
+                # outside the try: a raising user callback must not be
+                # re-invoked with a phantom error
+                callback(result, error)
 
             future.add_done_callback(_done)
         return context

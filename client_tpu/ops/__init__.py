@@ -1,0 +1,65 @@
+"""Jitted data-plane ops and Pallas kernels for the hot client/server paths.
+
+The reference client's compute is numpy on the CUDA host (dtype conversion,
+image preprocessing in examples). Here those run through XLA/Pallas so the
+data plane stays on-device:
+
+- ``normalize_image``: fused scale/shift/cast preprocessing (the
+  image_client NONE/INCEPTION/VGG scaling modes) as a Pallas VPU kernel on
+  TPU, interpret-mode on CPU.
+- ``to_bf16`` / ``from_bf16``: BF16 wire conversion as jitted casts (the
+  serializers' device-side twin).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _normalize_kernel(x_ref, o_ref, *, scale, shift):
+    o_ref[...] = (x_ref[...] * scale + shift).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "shift", "out_dtype"))
+def normalize_image(x, scale: float = 1.0, shift: float = 0.0, out_dtype=jnp.bfloat16):
+    """Fused ``x * scale + shift`` cast to ``out_dtype``.
+
+    image_client scaling modes map directly: INCEPTION => scale=2/255,
+    shift=-1; VGG => per-channel shift (applied before this call); NONE =>
+    scale=1, shift=0 (pure cast).
+    """
+    from jax.experimental import pallas as pl
+
+    kernel = functools.partial(_normalize_kernel, scale=scale, shift=shift)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, out_dtype),
+        interpret=not _on_tpu(),
+    )(x)
+
+
+@jax.jit
+def to_bf16(x):
+    """Device-side BF16 downcast (round-to-nearest-even on the VPU)."""
+    return x.astype(jnp.bfloat16)
+
+
+@jax.jit
+def from_bf16(x):
+    """Device-side BF16 -> float32 upcast."""
+    return x.astype(jnp.float32)
+
+
+def stage_to_device(host_array, device=None):
+    """Async host->HBM staging (returns immediately; fence at use)."""
+    return jax.device_put(host_array, device)

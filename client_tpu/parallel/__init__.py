@@ -1,0 +1,121 @@
+"""Device-mesh sharding for multi-chip serving and the dry-run train step.
+
+The reference has no multi-device execution (it is a network client); this
+package is where the TPU build scales the *server side*: a
+``jax.sharding.Mesh`` over the chips, batch sharded on the ``data`` axis,
+wide layers sharded on the ``model`` axis, XLA inserting the collectives.
+Used by the in-process server for multi-chip model instances and by
+``__graft_entry__.dryrun_multichip`` to validate the shardings compile.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_names: Tuple[str, str] = ("data", "model")):
+    """A 2D (data x model) mesh over the first ``n_devices`` devices.
+
+    Factorizes n into (dp, tp) with tp as large as possible up to 4 — wide
+    enough to exercise tensor-parallel collectives, while keeping a data
+    axis for batch scaling.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices but only {len(devices)} available")
+    tp = 1
+    for cand in (4, 2):
+        if n % cand == 0:
+            tp = cand
+            break
+    dp = n // tp
+    import numpy as np
+
+    grid = np.array(devices[:n]).reshape(dp, tp)
+    return Mesh(grid, axis_names)
+
+
+def _param_sharding(mesh, path_leaf_shape):
+    """model-axis sharding rule: shard the last (output-feature) axis of
+    2D+ kernels over 'model'; replicate everything else."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def rule(path, leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 2 and leaf.shape[-1] % mesh.shape["model"] == 0:
+            spec = [None] * (leaf.ndim - 1) + ["model"]
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return rule
+
+
+def shard_params(params, mesh):
+    """Place a parameter pytree onto the mesh (tp on output features)."""
+    import jax
+
+    rule = _param_sharding(mesh, None)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jax.device_put(leaf, rule(path, leaf)), params
+    )
+
+
+def sharded_forward(module_apply, mesh):
+    """jit the forward pass with batch sharded over 'data'.
+
+    Parameters keep their (possibly model-sharded) placement; XLA inserts
+    the all-gathers/psums the tp layout requires.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_sharding = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def fwd(params, batch):
+        return module_apply(params, batch)
+
+    def run(params, batch):
+        batch = jax.device_put(batch, batch_sharding)
+        return fwd(params, batch)
+
+    return run
+
+
+def sharded_train_step(module_apply, optimizer, mesh):
+    """A full dp+tp training step over the mesh (used by dryrun_multichip).
+
+    Cross-entropy loss, grads averaged over the data axis (psum inserted by
+    XLA from the sharded batch), optimizer update applied in place on the
+    sharded params.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_sharding = NamedSharding(mesh, P("data"))
+
+    def loss_fn(params, images, labels):
+        logits = module_apply(params, images)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def run(params, opt_state, images, labels):
+        images = jax.device_put(images, batch_sharding)
+        labels = jax.device_put(labels, batch_sharding)
+        return step(params, opt_state, images, labels)
+
+    return run

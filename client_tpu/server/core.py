@@ -508,7 +508,10 @@ class ServerCore:
             arr = raw[name]  # np.ndarray or jax.Array; stays on device if jax
             class_count = spec.get("classification", 0)
             if class_count:
-                arr = _classification(np.asarray(arr), class_count, model.labels())
+                arr = _classification(
+                    np.asarray(arr), class_count, model.labels(),
+                    batched=model.max_batch_size > 0,
+                )
                 datatype = "BYTES"
             else:
                 from ..utils import np_to_triton_dtype
@@ -561,9 +564,20 @@ def _array_to_bytes(arr: np.ndarray, datatype: str) -> bytes:
     return np.ascontiguousarray(arr).tobytes()
 
 
-def _classification(arr: np.ndarray, k: int, labels: Optional[List[str]]) -> np.ndarray:
-    """classification extension: top-k "value:index[:label]" strings per row."""
-    flat_batch = arr.reshape((-1, arr.shape[-1])) if arr.ndim > 1 else arr.reshape((1, -1))
+def _classification(
+    arr: np.ndarray, k: int, labels: Optional[List[str]], batched: bool = False
+) -> np.ndarray:
+    """classification extension: top-k "value:index[:label]" strings.
+
+    Triton semantics: for batched models the first dim is the batch and each
+    element's (flattened) remainder is its class vector; for non-batched
+    models the whole (flattened) tensor is one class vector — e.g. densenet's
+    [1000,1,1] output.
+    """
+    if batched and arr.ndim >= 1:
+        flat_batch = arr.reshape((arr.shape[0], -1))
+    else:
+        flat_batch = arr.reshape((1, -1))
     k = min(k, flat_batch.shape[-1])
     rows = []
     for row in flat_batch:
@@ -576,6 +590,6 @@ def _classification(arr: np.ndarray, k: int, labels: Optional[List[str]]) -> np.
             entries.append(s.encode("utf-8"))
         rows.append(entries)
     out = np.array(rows, dtype=np.object_)
-    if arr.ndim == 1:
+    if not batched:
         return out.reshape(-1)
-    return out.reshape(arr.shape[:-1] + (k,))
+    return out.reshape((arr.shape[0], k))

@@ -175,6 +175,10 @@ def encode_infer_response(
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # TCP_NODELAY: without it, small control-message responses (the whole
+    # point of the shm data plane) eat the 40ms Nagle+delayed-ACK stall
+    # (reference sets it at http_client.cc PreRunProcessing)
+    disable_nagle_algorithm = True
     core: ServerCore  # set by server factory
 
     def log_message(self, fmt, *args):  # quiet

@@ -50,10 +50,17 @@ def _untrack(shm: mpshm.SharedMemory) -> None:
         pass
 
 
+# POSIX names created (and therefore legitimately resource-tracked) by this
+# process; attaches to these must NOT untrack, or the tracker loses the
+# creator's entry (tracker state is a set keyed by name).
+_owned_names: set = set()
+
+
 def attach_shared_memory(key: str) -> mpshm.SharedMemory:
     """Attach to an existing POSIX region without taking unlink ownership."""
     shm = mpshm.SharedMemory(name=_posix_name(key))
-    _untrack(shm)
+    if _posix_name(key) not in _owned_names:
+        _untrack(shm)
     return shm
 
 
@@ -132,6 +139,7 @@ def create_shared_memory_region(
             # created regions stay resource-tracked: unlink() deregisters, and
             # the tracker cleans up if the process dies before destroy
             handle._shm = mpshm.SharedMemory(name=name, create=True, size=byte_size)
+            _owned_names.add(name)
         except FileExistsError:
             if create_only:
                 raise SharedMemoryException(
@@ -234,6 +242,7 @@ def destroy_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
         remaining = _key_refcount.get(key, 1) - 1
         if remaining <= 0:
             _key_refcount.pop(key, None)
+            _owned_names.discard(_posix_name(key))
         else:
             _key_refcount[key] = remaining
         _safe_close(shm_handle._shm, unlink=remaining <= 0)

@@ -228,6 +228,9 @@ def create_shared_memory_region(
     region = TpuSharedMemoryRegion(triton_shm_name, shm_key, byte_size, device_id, colocated)
     try:
         region._shm = mpshm.SharedMemory(name=shm_key, create=True, size=byte_size)
+        from ..shared_memory import _owned_names, _posix_name
+
+        _owned_names.add(_posix_name(shm_key))
     except FileExistsError:
         raise SharedMemoryException(
             f"unable to create tpu shared-memory region: key '{shm_key}' exists"
@@ -409,5 +412,9 @@ def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion) -> None:
     with shm_handle._lock:
         shm_handle._device_entries.clear()
     if shm_handle._shm is not None:
+        if owned:
+            from ..shared_memory import _owned_names, _posix_name
+
+            _owned_names.discard(_posix_name(shm_handle.shm_key))
         _safe_close(shm_handle._shm, unlink=owned)
         shm_handle._shm = None

@@ -21,12 +21,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 try:
     import jax
 
+    # applied after any sitecustomize jax import, so it wins over the
+    # environment's JAX_PLATFORMS; accelerator plugins stay registered
+    # (pallas needs "tpu" as a known platform) but are never initialized
     jax.config.update("jax_platforms", "cpu")
-    import jax._src.xla_bridge as _xb
-
-    for _name in ("axon", "tpu", "cuda", "rocm"):
-        _xb._backend_factories.pop(_name, None)
-except Exception:  # jax absent or internals moved; env vars still pin cpu
+except Exception:  # jax absent; env vars still pin cpu
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -113,8 +113,8 @@ def test_classification_param(client):
     outputs = [grpcclient.InferRequestedOutput("OUTPUT0", class_count=2)]
     result = client.infer("simple", [in0, in1], outputs=outputs)
     top = result.as_numpy("OUTPUT0")
-    assert top.shape == (1, 2)
-    assert int(top[0, 0].decode().split(":")[1]) == 15
+    assert top.shape == (2,)  # non-batched model: single class vector
+    assert int(top[0].decode().split(":")[1]) == 15
 
 
 def test_statistics_and_settings(client):

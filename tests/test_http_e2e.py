@@ -193,9 +193,10 @@ def test_classification_extension(client):
     outputs = [httpclient.InferRequestedOutput("OUTPUT0", class_count=3)]
     result = client.infer("simple", [in0, in1], outputs=outputs)
     top = result.as_numpy("OUTPUT0")
-    assert top.shape == (1, 3)
+    # non-batched model (max_batch_size=0): whole tensor is one class vector
+    assert top.shape == (3,)
     # top value is 15 at index 15
-    value, idx = top[0, 0].decode().split(":")[:2]
+    value, idx = top[0].decode().split(":")[:2]
     assert int(idx) == 15 and float(value) == 15.0
 
 
