@@ -1,0 +1,323 @@
+"""Asyncio KServe v2 GRPC client (mirrors ``tritonclient.grpc.aio``).
+
+grpc.aio re-implementation over the same schema-driven wire codec
+(reference: grpc/aio/__init__.py:50-810, ``stream_infer`` :688-798).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
+
+import grpc
+import grpc.aio
+
+from ..._base import InferenceServerClientBase, Request
+from ..._tensor import InferInput, InferRequestedOutput
+from ...utils import InferenceServerException
+from .. import _messages as M
+from .._client import INT32_MAX, KeepAliveOptions, _to_exception
+from .._infer import InferResult, build_infer_request, from_infer_parameter
+from .._wire import decode_message, encode_message
+
+__all__ = [
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+    "InferenceServerClient",
+    "KeepAliveOptions",
+]
+
+
+class InferenceServerClient(InferenceServerClientBase):
+    """Asyncio client for the KServe v2 GRPC protocol."""
+
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds: Optional["grpc.ChannelCredentials"] = None,
+        keepalive_options: Optional[KeepAliveOptions] = None,
+        channel_args: Optional[List] = None,
+    ):
+        super().__init__()
+        self._verbose = verbose
+        if channel_args is not None:
+            options = list(channel_args)
+        else:
+            ka = keepalive_options or KeepAliveOptions()
+            options = [
+                ("grpc.max_send_message_length", INT32_MAX),
+                ("grpc.max_receive_message_length", INT32_MAX),
+                ("grpc.keepalive_time_ms", ka.keepalive_time_ms),
+                ("grpc.keepalive_timeout_ms", ka.keepalive_timeout_ms),
+                ("grpc.keepalive_permit_without_calls", int(ka.keepalive_permit_without_calls)),
+                ("grpc.http2.max_pings_without_data", ka.http2_max_pings_without_data),
+            ]
+        if creds is not None:
+            self._channel = grpc.aio.secure_channel(url, creds, options=options)
+        elif ssl:
+            rc = open(root_certificates, "rb").read() if root_certificates else None
+            pk = open(private_key, "rb").read() if private_key else None
+            cc = open(certificate_chain, "rb").read() if certificate_chain else None
+            self._channel = grpc.aio.secure_channel(
+                url, grpc.ssl_channel_credentials(rc, pk, cc), options=options
+            )
+        else:
+            self._channel = grpc.aio.insecure_channel(url, options=options)
+        self._callables: Dict[str, Any] = {}
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def __aenter__(self) -> "InferenceServerClient":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- transport ---------------------------------------------------------
+    def _callable(self, method: str, streaming: bool = False):
+        cached = self._callables.get(method)
+        if cached is not None:
+            return cached
+        req_spec, resp_spec = M.METHODS[method]
+        path = M.method_path(method)
+        serializer = lambda d: encode_message(req_spec, d)  # noqa: E731
+        deserializer = lambda b: decode_message(resp_spec, b)  # noqa: E731
+        if streaming:
+            c = self._channel.stream_stream(
+                path, request_serializer=serializer, response_deserializer=deserializer
+            )
+        else:
+            c = self._channel.unary_unary(
+                path, request_serializer=serializer, response_deserializer=deserializer
+            )
+        self._callables[method] = c
+        return c
+
+    def _metadata(self, headers: Optional[Dict[str, str]]):
+        hdrs = dict(headers or {})
+        request = Request(hdrs)
+        self._call_plugin(request)
+        return tuple(request.headers.items()) or None
+
+    async def _call(self, method, request, headers=None, client_timeout=None):
+        try:
+            return await self._callable(method)(
+                request, metadata=self._metadata(headers), timeout=client_timeout
+            )
+        except grpc.aio.AioRpcError as e:
+            raise _to_exception(e) from e
+
+    # -- surface (async twins of the sync client) ---------------------------
+    async def is_server_live(self, headers=None, client_timeout=None) -> bool:
+        return bool((await self._call("ServerLive", {}, headers, client_timeout)).get("live", False))
+
+    async def is_server_ready(self, headers=None, client_timeout=None) -> bool:
+        return bool((await self._call("ServerReady", {}, headers, client_timeout)).get("ready", False))
+
+    async def is_model_ready(self, model_name, model_version="", headers=None, client_timeout=None) -> bool:
+        resp = await self._call(
+            "ModelReady", {"name": model_name, "version": model_version}, headers, client_timeout
+        )
+        return bool(resp.get("ready", False))
+
+    async def get_server_metadata(self, headers=None, client_timeout=None):
+        return await self._call("ServerMetadata", {}, headers, client_timeout)
+
+    async def get_model_metadata(self, model_name, model_version="", headers=None, client_timeout=None):
+        return await self._call(
+            "ModelMetadata", {"name": model_name, "version": model_version}, headers, client_timeout
+        )
+
+    async def get_model_config(self, model_name, model_version="", headers=None, client_timeout=None):
+        return await self._call(
+            "ModelConfig", {"name": model_name, "version": model_version}, headers, client_timeout
+        )
+
+    async def get_model_repository_index(self, headers=None, client_timeout=None):
+        return (await self._call("RepositoryIndex", {}, headers, client_timeout)).get("models", [])
+
+    async def load_model(self, model_name, headers=None, config=None, files=None, client_timeout=None):
+        params: Dict[str, Any] = {}
+        if config is not None:
+            params["config"] = {"string_param": config}
+        for p, content in (files or {}).items():
+            params[p] = {"bytes_param": content}
+        req: Dict[str, Any] = {"model_name": model_name}
+        if params:
+            req["parameters"] = params
+        await self._call("RepositoryModelLoad", req, headers, client_timeout)
+
+    async def unload_model(self, model_name, headers=None, unload_dependents=False, client_timeout=None):
+        await self._call(
+            "RepositoryModelUnload",
+            {"model_name": model_name,
+             "parameters": {"unload_dependents": {"bool_param": unload_dependents}}},
+            headers, client_timeout,
+        )
+
+    async def get_inference_statistics(self, model_name="", model_version="", headers=None, client_timeout=None):
+        return await self._call(
+            "ModelStatistics", {"name": model_name, "version": model_version}, headers, client_timeout
+        )
+
+    async def get_system_shared_memory_status(self, region_name="", headers=None, client_timeout=None):
+        resp = await self._call("SystemSharedMemoryStatus", {"name": region_name}, headers, client_timeout)
+        return list(resp.get("regions", {}).values())
+
+    async def register_system_shared_memory(self, name, key, byte_size, offset=0, headers=None, client_timeout=None):
+        await self._call(
+            "SystemSharedMemoryRegister",
+            {"name": name, "key": key, "offset": offset, "byte_size": byte_size},
+            headers, client_timeout,
+        )
+
+    async def unregister_system_shared_memory(self, name="", headers=None, client_timeout=None):
+        await self._call("SystemSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+
+    async def _register_handle(self, method, name, raw_handle, device_id, byte_size, headers, client_timeout):
+        if isinstance(raw_handle, str):
+            raw_handle = raw_handle.encode("ascii")
+        await self._call(
+            method,
+            {"name": name, "raw_handle": raw_handle, "device_id": device_id, "byte_size": byte_size},
+            headers, client_timeout,
+        )
+
+    async def get_cuda_shared_memory_status(self, region_name="", headers=None, client_timeout=None):
+        resp = await self._call("CudaSharedMemoryStatus", {"name": region_name}, headers, client_timeout)
+        return list(resp.get("regions", {}).values())
+
+    async def register_cuda_shared_memory(self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None):
+        await self._register_handle("CudaSharedMemoryRegister", name, raw_handle, device_id, byte_size, headers, client_timeout)
+
+    async def unregister_cuda_shared_memory(self, name="", headers=None, client_timeout=None):
+        await self._call("CudaSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+
+    async def get_tpu_shared_memory_status(self, region_name="", headers=None, client_timeout=None):
+        resp = await self._call("TpuSharedMemoryStatus", {"name": region_name}, headers, client_timeout)
+        return list(resp.get("regions", {}).values())
+
+    async def register_tpu_shared_memory(self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None):
+        await self._register_handle("TpuSharedMemoryRegister", name, raw_handle, device_id, byte_size, headers, client_timeout)
+
+    async def unregister_tpu_shared_memory(self, name="", headers=None, client_timeout=None):
+        await self._call("TpuSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+
+    async def update_log_settings(self, settings, headers=None, client_timeout=None):
+        req: Dict[str, Any] = {"settings": {}}
+        for key, value in settings.items():
+            if isinstance(value, bool):
+                req["settings"][key] = {"bool_param": value}
+            elif isinstance(value, int):
+                req["settings"][key] = {"uint32_param": value}
+            else:
+                req["settings"][key] = {"string_param": str(value)}
+        resp = await self._call("LogSettings", req, headers, client_timeout)
+        return {k: from_infer_parameter(v) for k, v in resp.get("settings", {}).items()}
+
+    async def get_log_settings(self, headers=None, client_timeout=None):
+        resp = await self._call("LogSettings", {}, headers, client_timeout)
+        return {k: from_infer_parameter(v) for k, v in resp.get("settings", {}).items()}
+
+    async def update_trace_settings(self, model_name=None, settings=None, headers=None, client_timeout=None):
+        req: Dict[str, Any] = {"settings": {}}
+        if model_name:
+            req["model_name"] = model_name
+        for key, value in (settings or {}).items():
+            if isinstance(value, (list, tuple)):
+                req["settings"][key] = {"value": [str(v) for v in value]}
+            else:
+                req["settings"][key] = {"value": [str(value)]}
+        resp = await self._call("TraceSetting", req, headers, client_timeout)
+        return {k: v.get("value", []) for k, v in resp.get("settings", {}).items()}
+
+    async def get_trace_settings(self, model_name=None, headers=None, client_timeout=None):
+        req = {"model_name": model_name} if model_name else {}
+        resp = await self._call("TraceSetting", req, headers, client_timeout)
+        return {k: v.get("value", []) for k, v in resp.get("settings", {}).items()}
+
+    # -- inference ---------------------------------------------------------
+    async def infer(
+        self,
+        model_name: str,
+        inputs: Sequence[InferInput],
+        model_version: str = "",
+        outputs: Optional[Sequence[InferRequestedOutput]] = None,
+        request_id: str = "",
+        sequence_id: int = 0,
+        sequence_start: bool = False,
+        sequence_end: bool = False,
+        priority: int = 0,
+        timeout: Optional[int] = None,
+        client_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+        parameters: Optional[Dict[str, Any]] = None,
+    ) -> InferResult:
+        request = build_infer_request(
+            model_name, inputs, model_version, outputs, request_id,
+            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+        )
+        response = await self._call("ModelInfer", request, headers, client_timeout)
+        return InferResult(response)
+
+    async def stream_infer(
+        self,
+        inputs_iterator: AsyncIterator[Dict[str, Any]],
+        stream_timeout: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> AsyncIterator:
+        """Bi-di streaming: consume request dicts, yield (result, error) pairs.
+
+        Each item from ``inputs_iterator`` is a kwargs dict for
+        ``build_infer_request`` (model_name, inputs, sequence_id, ...).
+        The returned async iterator has a ``cancel()`` via the underlying
+        call (raises asyncio.CancelledError in the consumer).
+        """
+
+        async def request_gen():
+            async for kwargs in inputs_iterator:
+                enable_final = kwargs.pop("enable_empty_final_response", False)
+                req = build_infer_request(**kwargs)
+                if enable_final:
+                    req.setdefault("parameters", {})[
+                        "triton_enable_empty_final_response"
+                    ] = {"bool_param": True}
+                yield req
+
+        call = self._callable("ModelStreamInfer", streaming=True)(
+            request_gen(), metadata=self._metadata(headers), timeout=stream_timeout
+        )
+
+        class _ResponseIterator:
+            """Async iterator of (result, error) pairs with ``cancel()``."""
+
+            def __init__(self, rpc_call):
+                self._call = rpc_call
+
+            def cancel(self) -> bool:
+                return self._call.cancel()
+
+            def __aiter__(self):
+                return self
+
+            async def __anext__(self):
+                try:
+                    response = await self._call.read()
+                except grpc.aio.AioRpcError as e:
+                    if e.code() == grpc.StatusCode.CANCELLED:
+                        raise StopAsyncIteration
+                    raise _to_exception(e) from e
+                if response is grpc.aio.EOF:
+                    raise StopAsyncIteration
+                err = response.get("error_message")
+                if err:
+                    return None, InferenceServerException(err)
+                return InferResult(response.get("infer_response", {})), None
+
+        return _ResponseIterator(call)

@@ -183,6 +183,10 @@ class InferenceServerClient(InferenceServerClientBase):
             data = resp.read(decode_content=True)
             if timers is not None:
                 timers.capture(RequestTimers.RECV_END)
+        except urllib3.exceptions.NewConnectionError as e:
+            # must precede TimeoutError: NewConnectionError subclasses
+            # ConnectTimeoutError in urllib3, but "refused" is not "timed out"
+            raise InferenceServerException(f"connection error: {e}") from e
         except urllib3.exceptions.TimeoutError as e:
             raise InferenceServerException("Deadline Exceeded", status="499") from e
         except urllib3.exceptions.HTTPError as e:

@@ -1,0 +1,406 @@
+"""Perf harness: the framework's perf_analyzer equivalent.
+
+The reference moved perf_analyzer out of repo (src/c++/perf_analyzer/README.md
+is a redirect), so this is a from-scratch load generator with the same core
+controls: concurrency sweep, infer/sec, p50/p90/p99 latency, and a
+``--shared-memory={none,system,tpu}`` data-plane switch (the reference's
+``none/system/cuda``).
+
+Usage::
+
+    python -m client_tpu.perf -m simple -u 127.0.0.1:8000 -i http \
+        --concurrency-range 1:4 --shared-memory tpu --measurement-requests 200
+
+Inputs are generated from the model's metadata (random data per datatype;
+dynamic dims default to 1, override with ``--shape NAME:d1,d2``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _percentile(sorted_values: List[float], q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(int(len(sorted_values) * q), len(sorted_values) - 1)
+    return sorted_values[idx]
+
+
+def _random_tensor(datatype: str, shape: List[int], rng) -> np.ndarray:
+    from .utils import triton_to_np_dtype
+
+    if datatype == "BYTES":
+        flat = int(np.prod(shape))
+        return np.array(
+            [str(rng.integers(0, 100)).encode() for _ in range(flat)], dtype=np.object_
+        ).reshape(shape)
+    np_dtype = np.dtype(triton_to_np_dtype(datatype))
+    if np_dtype.kind in "iu":
+        return rng.integers(0, 100, size=shape).astype(np_dtype)
+    return rng.standard_normal(shape).astype(np_dtype)
+
+
+class PerfRunner:
+    """Drives one (concurrency, shared-memory-mode) measurement."""
+
+    def __init__(
+        self,
+        url: str,
+        protocol: str = "http",
+        model_name: str = "simple",
+        shared_memory: str = "none",
+        shape_overrides: Optional[Dict[str, List[int]]] = None,
+        batch_size: int = 0,
+        seed: int = 0,
+    ):
+        self.url = url
+        self.protocol = protocol
+        self.model_name = model_name
+        self.shared_memory = shared_memory
+        self.shape_overrides = shape_overrides or {}
+        self.batch_size = batch_size
+        self.rng = np.random.default_rng(seed)
+        self._client_mod = self._import_client_mod()
+        self._metadata = self._fetch_metadata()
+        self._tensors = self._generate_tensors()
+        # shm modes place outputs in regions too; probe once over the wire
+        # to learn output byte sizes (perf_analyzer's output-shared-memory
+        # sizing, derived instead of flag-supplied)
+        self._output_sizes = self._probe_output_sizes() if shared_memory != "none" else {}
+
+    def _import_client_mod(self):
+        if self.protocol == "http":
+            import client_tpu.http as mod
+        else:
+            import client_tpu.grpc as mod
+        return mod
+
+    def _make_client(self, concurrency: int = 1):
+        if self.protocol == "http":
+            return self._client_mod.InferenceServerClient(self.url, concurrency=concurrency)
+        return self._client_mod.InferenceServerClient(self.url)
+
+    def _fetch_metadata(self) -> Dict[str, Any]:
+        client = self._make_client()
+        try:
+            md = client.get_model_metadata(self.model_name)
+        finally:
+            client.close()
+        return md
+
+    def _resolve_shape(self, name: str, shape: List[int]) -> List[int]:
+        if name in self.shape_overrides:
+            return self.shape_overrides[name]
+        resolved = [d if d != -1 else 1 for d in shape]
+        if self.batch_size:
+            resolved = [self.batch_size] + resolved
+        return resolved
+
+    def _generate_tensors(self) -> List[Tuple[str, str, List[int], np.ndarray]]:
+        tensors = []
+        for t in self._metadata["inputs"]:
+            shape = self._resolve_shape(t["name"], list(t["shape"]))
+            tensors.append(
+                (t["name"], t["datatype"], shape, _random_tensor(t["datatype"], shape, self.rng))
+            )
+        return tensors
+
+    def _probe_output_sizes(self) -> Dict[str, int]:
+        from .utils import serialized_byte_size
+
+        mod = self._client_mod
+        client = self._make_client()
+        try:
+            inputs = []
+            for name, datatype, shape, data in self._tensors:
+                inp = mod.InferInput(name, shape, datatype)
+                inp.set_data_from_numpy(data)
+                inputs.append(inp)
+            result = client.infer(self.model_name, inputs)
+            sizes = {}
+            for out in self._metadata["outputs"]:
+                arr = result.as_numpy(out["name"])
+                if arr is None:
+                    continue
+                nbytes = serialized_byte_size(arr) if arr.dtype == np.object_ else arr.nbytes
+                sizes[out["name"]] = nbytes + nbytes // 4  # slack for growth
+            return sizes
+        finally:
+            client.close()
+
+    def _make_shm_outputs(self, client, worker_id, family):
+        """Create+register output regions; returns (outputs, cleanup)."""
+        mod = self._client_mod
+        regions = []
+        outputs = []
+        if family == "system":
+            import client_tpu.utils.shared_memory as shm
+
+            for name, nbytes in self._output_sizes.items():
+                rname = f"perf_{worker_id}_out_{name}"
+                region = shm.create_shared_memory_region(rname, f"/{rname}", nbytes)
+                client.register_system_shared_memory(rname, f"/{rname}", nbytes)
+                out = mod.InferRequestedOutput(name)
+                out.set_shared_memory(rname, nbytes)
+                regions.append((rname, region, shm.destroy_shared_memory_region,
+                                client.unregister_system_shared_memory))
+                outputs.append(out)
+        else:
+            import client_tpu.utils.tpu_shared_memory as tpushm
+
+            for name, nbytes in self._output_sizes.items():
+                region = tpushm.create_shared_memory_region(
+                    f"perf_{worker_id}_out_{name}", nbytes, colocated=True
+                )
+                client.register_tpu_shared_memory(
+                    region.name, tpushm.get_raw_handle(region), 0, nbytes
+                )
+                out = mod.InferRequestedOutput(name)
+                out.set_shared_memory(region.name, nbytes)
+                regions.append((region.name, region, tpushm.destroy_shared_memory_region,
+                                client.unregister_tpu_shared_memory))
+                outputs.append(out)
+
+        def cleanup():
+            for rname, region, destroy, unregister in regions:
+                try:
+                    unregister(rname)
+                except Exception:
+                    pass
+                destroy(region)
+
+        return outputs or None, cleanup
+
+    # -- one worker --------------------------------------------------------
+    def _worker(self, client, barrier, stop, latencies, errors, counter, worker_id):
+        from .utils import serialized_byte_size
+
+        mod = self._client_mod
+        shm_ctx = None
+        setup_failed = False
+        try:
+            if self.shared_memory == "system":
+                import client_tpu.utils.shared_memory as shm
+
+                regions = []
+                inputs = []
+                for name, datatype, shape, data in self._tensors:
+                    nbytes = serialized_byte_size(data) if datatype == "BYTES" else data.nbytes
+                    rname = f"perf_{worker_id}_{name}"
+                    region = shm.create_shared_memory_region(rname, f"/{rname}", nbytes)
+                    shm.set_shared_memory_region(region, [data])
+                    client.register_system_shared_memory(rname, f"/{rname}", nbytes)
+                    inp = mod.InferInput(name, shape, datatype)
+                    inp.set_shared_memory(rname, nbytes)
+                    regions.append((rname, region))
+                    inputs.append(inp)
+
+                outputs, out_cleanup = self._make_shm_outputs(client, worker_id, "system")
+
+                def cleanup():
+                    for rname, region in regions:
+                        try:
+                            client.unregister_system_shared_memory(rname)
+                        except Exception:
+                            pass
+                        shm.destroy_shared_memory_region(region)
+                    out_cleanup()
+
+                shm_ctx = cleanup
+            elif self.shared_memory == "tpu":
+                import jax
+
+                import client_tpu.utils.tpu_shared_memory as tpushm
+
+                regions = []
+                inputs = []
+                for name, datatype, shape, data in self._tensors:
+                    if datatype == "BYTES":
+                        nbytes = serialized_byte_size(data)
+                        region = tpushm.create_shared_memory_region(
+                            f"perf_{worker_id}_{name}", nbytes
+                        )
+                        tpushm.set_shared_memory_region(region, [data])
+                    else:
+                        nbytes = data.nbytes
+                        region = tpushm.create_shared_memory_region(
+                            f"perf_{worker_id}_{name}", nbytes, colocated=True
+                        )
+                        dev = jax.device_put(data)
+                        dev.block_until_ready()
+                        tpushm.set_shared_memory_region_from_jax(region, dev)
+                    rname = region.name
+                    client.register_tpu_shared_memory(
+                        rname, tpushm.get_raw_handle(region), 0, nbytes
+                    )
+                    inp = mod.InferInput(name, shape, datatype)
+                    inp.set_shared_memory(rname, nbytes)
+                    regions.append((rname, region))
+                    inputs.append(inp)
+
+                outputs, out_cleanup = self._make_shm_outputs(client, worker_id, "tpu")
+
+                def cleanup():
+                    for rname, region in regions:
+                        try:
+                            client.unregister_tpu_shared_memory(rname)
+                        except Exception:
+                            pass
+                        tpushm.destroy_shared_memory_region(region)
+                    out_cleanup()
+
+                shm_ctx = cleanup
+            else:
+                outputs = None
+                inputs = []
+                for name, datatype, shape, data in self._tensors:
+                    inp = mod.InferInput(name, shape, datatype)
+                    inp.set_data_from_numpy(data)
+                    inputs.append(inp)
+
+        except Exception as e:
+            errors.append(f"worker setup failed: {e}")
+            setup_failed = True
+        try:
+            # the barrier must be reached even on setup failure, or run()
+            # would wait forever for this worker
+            barrier.wait(timeout=120)
+            if setup_failed:
+                stop.set()
+                return
+            lock, count, limit = counter
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    self._infer_once(client, inputs, outputs)
+                    latencies.append(time.perf_counter() - t0)
+                except Exception as e:  # measured as failure, loop continues
+                    errors.append(str(e))
+                with lock:
+                    count[0] += 1
+                    if count[0] >= limit:
+                        stop.set()
+        finally:
+            if shm_ctx is not None:
+                shm_ctx()
+
+    def _infer_once(self, client, inputs, outputs=None):
+        client.infer(self.model_name, inputs, outputs=outputs)
+
+    # -- sweep -------------------------------------------------------------
+    def run(self, concurrency: int, measurement_requests: int) -> Dict[str, Any]:
+        client = self._make_client(concurrency)
+        latencies: List[float] = []
+        errors: List[str] = []
+        stop = threading.Event()
+        barrier = threading.Barrier(concurrency + 1)
+        counter = (threading.Lock(), [0], measurement_requests)
+        workers = [
+            threading.Thread(
+                target=self._worker,
+                args=(client, barrier, stop, latencies, errors, counter, i),
+                daemon=True,
+            )
+            for i in range(concurrency)
+        ]
+        for w in workers:
+            w.start()
+        barrier.wait()
+        t_start = time.perf_counter()
+        for w in workers:
+            w.join(timeout=600)
+        elapsed = time.perf_counter() - t_start
+        client.close()
+
+        lat_sorted = sorted(latencies)
+        n = len(lat_sorted)
+        return {
+            "model": self.model_name,
+            "protocol": self.protocol,
+            "shared_memory": self.shared_memory,
+            "concurrency": concurrency,
+            "requests": n,
+            "errors": len(errors),
+            "error_sample": errors[0] if errors else None,
+            "duration_s": round(elapsed, 3),
+            "infer_per_sec": round(n / elapsed, 1) if elapsed > 0 else 0.0,
+            "latency_ms": {
+                "avg": round(1000 * sum(lat_sorted) / n, 3) if n else 0.0,
+                "p50": round(1000 * _percentile(lat_sorted, 0.50), 3),
+                "p90": round(1000 * _percentile(lat_sorted, 0.90), 3),
+                "p99": round(1000 * _percentile(lat_sorted, 0.99), 3),
+            },
+        }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="client_tpu.perf", description="KServe v2 load generator (perf_analyzer equivalent)"
+    )
+    parser.add_argument("-m", "--model-name", required=True)
+    parser.add_argument("-u", "--url", default="127.0.0.1:8000")
+    parser.add_argument("-i", "--protocol", choices=("http", "grpc"), default="http")
+    parser.add_argument(
+        "--shared-memory", choices=("none", "system", "tpu"), default="none"
+    )
+    parser.add_argument(
+        "--concurrency-range", default="1",
+        help="start[:end[:step]] concurrency sweep (e.g. 1:8:2)",
+    )
+    parser.add_argument("--measurement-requests", type=int, default=200)
+    parser.add_argument("-b", "--batch-size", type=int, default=0)
+    parser.add_argument(
+        "--shape", action="append", default=[],
+        help="override an input shape: NAME:d1,d2,...",
+    )
+    parser.add_argument("-f", "--format", choices=("table", "json"), default="table")
+    parser.add_argument("--warmup-requests", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    parts = [int(x) for x in args.concurrency_range.split(":")]
+    start = parts[0]
+    end = parts[1] if len(parts) > 1 else start
+    step = parts[2] if len(parts) > 2 else 1
+    shape_overrides = {}
+    for s in args.shape:
+        name, _, dims = s.partition(":")
+        shape_overrides[name] = [int(d) for d in dims.split(",")]
+
+    runner = PerfRunner(
+        args.url, args.protocol, args.model_name, args.shared_memory,
+        shape_overrides, args.batch_size,
+    )
+    if args.warmup_requests:
+        runner.run(1, args.warmup_requests)
+
+    results = []
+    for concurrency in range(start, end + 1, step):
+        results.append(runner.run(concurrency, args.measurement_requests))
+
+    if args.format == "json":
+        print(json.dumps(results))
+    else:
+        print(
+            f"model={args.model_name} protocol={args.protocol} "
+            f"shared_memory={args.shared_memory}"
+        )
+        print(f"{'conc':>5} {'infer/s':>9} {'avg ms':>8} {'p50 ms':>8} {'p90 ms':>8} {'p99 ms':>8} {'err':>4}")
+        for r in results:
+            lm = r["latency_ms"]
+            print(
+                f"{r['concurrency']:>5} {r['infer_per_sec']:>9} {lm['avg']:>8} "
+                f"{lm['p50']:>8} {lm['p90']:>8} {lm['p99']:>8} {r['errors']:>4}"
+            )
+    return 1 if any(r["errors"] and not r["requests"] for r in results) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Standalone server CLI: serve the model zoo over HTTP + GRPC.
+
+The framework's tritonserver stand-in for examples, the perf harness, and
+development::
+
+    python -m client_tpu.serve --http-port 8000 --grpc-port 8001 [--vision]
+
+Ctrl-C stops it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="client_tpu.serve")
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument("--grpc-port", type=int, default=8001)
+    parser.add_argument("--no-http", action="store_true")
+    parser.add_argument("--no-grpc", action="store_true")
+    parser.add_argument(
+        "--vision", action="store_true",
+        help="also serve the densenet_onnx vision model (first request compiles)",
+    )
+    parser.add_argument("--identity-fp32", action="store_true",
+                        help="also serve a dynamic-shape FP32 identity model")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    from .models import default_model_zoo
+    from .models.simple import IdentityModel
+    from .server import GrpcInferenceServer, HttpInferenceServer, ServerCore
+
+    models = default_model_zoo()
+    if args.identity_fp32:
+        models.append(IdentityModel("identity_fp32", "FP32"))
+    if args.vision:
+        from .models.vision import DenseNetModel
+
+        models.append(DenseNetModel())
+    core = ServerCore(models)
+
+    servers = []
+    if not args.no_http:
+        http = HttpInferenceServer(core, port=args.http_port, verbose=args.verbose)
+        http.start()
+        servers.append(http)
+        print(f"HTTP  server listening on {http.url}")
+    if not args.no_grpc:
+        grpc_srv = GrpcInferenceServer(core, port=args.grpc_port, verbose=args.verbose)
+        grpc_srv.start()
+        servers.append(grpc_srv)
+        print(f"GRPC  server listening on {grpc_srv.url}")
+    print(f"models: {', '.join(m.name for m in models)}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for s in servers:
+            s.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

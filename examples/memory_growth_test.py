@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Leak harness: repeat inferences and report RSS growth.
+
+Equivalent of the reference's memory_growth_test.py (:28-60): drive N
+repetitions, sample resident set size before/after, fail on runaway growth.
+"""
+
+import argparse
+import gc
+import resource
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def _rss_kb() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-r", "--repetitions", type=int, default=500)
+    parser.add_argument("--max-growth-mb", type=float, default=64.0)
+    args = parser.parse_args()
+
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+
+    with httpclient.InferenceServerClient(args.url, concurrency=2) as client:
+        # warm up allocators before baselining
+        for _ in range(50):
+            inputs = [
+                httpclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a),
+                httpclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b),
+            ]
+            client.infer("simple", inputs)
+        gc.collect()
+        before_kb = _rss_kb()
+        for i in range(args.repetitions):
+            inputs = [
+                httpclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a),
+                httpclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b),
+            ]
+            result = client.infer("simple", inputs)
+            assert result.as_numpy("OUTPUT0") is not None
+        gc.collect()
+        after_kb = _rss_kb()
+
+    growth_mb = (after_kb - before_kb) / 1024.0
+    print(f"RSS growth over {args.repetitions} inferences: {growth_mb:.1f} MB")
+    if growth_mb > args.max_growth_mb:
+        sys.exit(f"FAILED: RSS grew {growth_mb:.1f} MB (limit {args.max_growth_mb} MB)")
+    print("PASS: memory growth within bounds")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Reusing InferInput/InferRequestedOutput objects across requests and
+protocols (equivalent of reuse_infer_objects_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--http-url", default="localhost:8000")
+    parser.add_argument("-g", "--grpc-url", default="localhost:8001")
+    args = parser.parse_args()
+
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    # the value model is shared between protocols: build once, reuse everywhere
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b),
+    ]
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0"),
+        httpclient.InferRequestedOutput("OUTPUT1"),
+    ]
+
+    with httpclient.InferenceServerClient(args.http_url) as http_client:
+        for _ in range(3):
+            result = http_client.infer("simple", inputs, outputs=outputs)
+            if not (result.as_numpy("OUTPUT0") == a + b).all():
+                sys.exit("reuse error over http")
+
+    with grpcclient.InferenceServerClient(args.grpc_url) as grpc_client:
+        for _ in range(3):
+            result = grpc_client.infer("simple", inputs, outputs=outputs)
+            if not (result.as_numpy("OUTPUT1") == a - b).all():
+                sys.exit("reuse error over grpc")
+
+    # mutate in place and reuse again
+    inputs[0].set_data_from_numpy(a * 2)
+    with httpclient.InferenceServerClient(args.http_url) as http_client:
+        result = http_client.infer("simple", inputs, outputs=outputs)
+        if not (result.as_numpy("OUTPUT0") == a * 2 + b).all():
+            sys.exit("reuse error after mutation")
+    print("PASS: object reuse across 7 requests and 2 protocols")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Callback-style async GRPC inference.
+
+Equivalent of the reference's simple_grpc_async_infer_client.py.
+"""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    request_count = 8
+    results = queue.Queue()
+    with grpcclient.InferenceServerClient(args.url) as client:
+        input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1_data = np.ones((1, 16), dtype=np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0_data)
+        inputs[1].set_data_from_numpy(input1_data)
+
+        for _ in range(request_count):
+            client.async_infer(
+                "simple", inputs, callback=lambda r, e: results.put((r, e))
+            )
+        for _ in range(request_count):
+            result, error = results.get(timeout=30)
+            if error is not None:
+                sys.exit(f"async infer error: {error}")
+            if not (result.as_numpy("OUTPUT1") == input0_data - input1_data).all():
+                sys.exit("async infer error: incorrect difference")
+        print(f"PASS: {request_count} async requests")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Decoupled-model streaming: N responses per request.
+
+Equivalent of the reference's simple_grpc_custom_repeat.py against the
+``repeat_int32`` fixture.
+"""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-r", "--repeat-count", type=int, default=5)
+    args = parser.parse_args()
+
+    values = np.arange(args.repeat_count, dtype=np.int32)
+    results = queue.Queue()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.start_stream(callback=lambda r, e: results.put((r, e)))
+        inputs = [
+            grpcclient.InferInput("IN", [args.repeat_count], "INT32"),
+            grpcclient.InferInput("DELAY", [args.repeat_count], "UINT32"),
+            grpcclient.InferInput("WAIT", [1], "UINT32"),
+        ]
+        inputs[0].set_data_from_numpy(values)
+        inputs[1].set_data_from_numpy(np.zeros(args.repeat_count, dtype=np.uint32))
+        inputs[2].set_data_from_numpy(np.array([0], dtype=np.uint32))
+        client.async_stream_infer(
+            "repeat_int32", inputs, enable_empty_final_response=True
+        )
+        seen = []
+        while True:
+            result, error = results.get(timeout=30)
+            if error is not None:
+                sys.exit(f"stream error: {error}")
+            if result.is_null_response():
+                break
+            seen.append(int(result.as_numpy("OUT")[0]))
+        client.stop_stream()
+
+    if seen != values.tolist():
+        sys.exit(f"repeat error: {seen} != {values.tolist()}")
+    print(f"PASS: decoupled repeat ({len(seen)} responses + final)")
+
+
+if __name__ == "__main__":
+    main()

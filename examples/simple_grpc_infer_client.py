@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Basic sync GRPC inference against the ``simple`` sum/diff model.
+
+Equivalent of the reference's simple_grpc_infer_client.py.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url, verbose=args.verbose) as client:
+        input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1_data = np.ones((1, 16), dtype=np.int32)
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0_data)
+        inputs[1].set_data_from_numpy(input1_data)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        result = client.infer("simple", inputs, outputs=outputs)
+        output0 = result.as_numpy("OUTPUT0")
+        output1 = result.as_numpy("OUTPUT1")
+        if not ((output0 == input0_data + input1_data).all()
+                and (output1 == input0_data - input1_data).all()):
+            sys.exit("grpc infer error: incorrect results")
+        print("PASS: infer")
+
+
+if __name__ == "__main__":
+    main()

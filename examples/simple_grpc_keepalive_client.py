@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Custom GRPC keepalive options (equivalent of simple_grpc_keepalive_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    keepalive = grpcclient.KeepAliveOptions(
+        keepalive_time_ms=10000,
+        keepalive_timeout_ms=5000,
+        keepalive_permit_without_calls=True,
+        http2_max_pings_without_data=0,
+    )
+    with grpcclient.InferenceServerClient(args.url, keepalive_options=keepalive) as client:
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b),
+        ]
+        result = client.infer("simple", inputs)
+        if not (result.as_numpy("OUTPUT0") == a + b).all():
+            sys.exit("keepalive infer error")
+        print("PASS: keepalive client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Stateful sequences over the bidi stream.
+
+Equivalent of the reference's simple_grpc_sequence_stream_infer_client.py
+(:59-81): two interleaved sequences, per-request sequence_id + start/end
+flags, responses correlated through the stream callback.
+"""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    values = [11, 7, 5, 3, 2, 0, 1]
+    results = queue.Queue()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.start_stream(callback=lambda r, e: results.put((r, e)))
+        # two interleaved sequences: one accumulates +v, one -v (via sign)
+        for seq_id, sign in ((1001, 1), (1002, -1)):
+            for i, v in enumerate(values):
+                inp = grpcclient.InferInput("INPUT", [1, 1], "INT32")
+                inp.set_data_from_numpy(np.array([[sign * v]], dtype=np.int32))
+                client.async_stream_infer(
+                    "simple_sequence",
+                    [inp],
+                    sequence_id=seq_id,
+                    sequence_start=(i == 0),
+                    sequence_end=(i == len(values) - 1),
+                )
+        received = []
+        for _ in range(2 * len(values)):
+            result, error = results.get(timeout=30)
+            if error is not None:
+                sys.exit(f"stream error: {error}")
+            received.append(int(result.as_numpy("OUTPUT")[0, 0]))
+        client.stop_stream()
+
+    expected = sum(values)
+    # responses arrive in request order: seq 1001's partials then seq 1002's
+    if received[len(values) - 1] != expected or received[-1] != -expected:
+        sys.exit(f"sequence error: totals {received[len(values)-1]}, {received[-1]}")
+    print(f"PASS: sequence streaming (totals +/-{expected})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""System shared-memory inference over GRPC: the full zero-copy lifecycle.
+
+Equivalent of the reference's simple_grpc_shm_client.py:90-183 —
+create -> register -> set -> infer(shm in/out) -> read from region ->
+unregister -> destroy.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        # clean slate (mirrors the reference's initial unregister)
+        client.unregister_system_shared_memory()
+
+        input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1_data = np.ones((1, 16), dtype=np.int32)
+        input_byte_size = input0_data.nbytes
+        output_byte_size = input_byte_size
+
+        shm_op = shm.create_shared_memory_region(
+            "output_data", "/output_simple", output_byte_size * 2
+        )
+        client.register_system_shared_memory(
+            "output_data", "/output_simple", output_byte_size * 2
+        )
+        shm_ip = shm.create_shared_memory_region(
+            "input_data", "/input_simple", input_byte_size * 2
+        )
+        shm.set_shared_memory_region(shm_ip, [input0_data, input1_data])
+        client.register_system_shared_memory(
+            "input_data", "/input_simple", input_byte_size * 2
+        )
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("input_data", input_byte_size)
+        inputs[1].set_shared_memory("input_data", input_byte_size, offset=input_byte_size)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("output_data", output_byte_size)
+        outputs[1].set_shared_memory("output_data", output_byte_size, offset=output_byte_size)
+
+        client.infer("simple", inputs, outputs=outputs)
+
+        output0 = shm.get_contents_as_numpy(shm_op, np.int32, [1, 16])
+        output1 = shm.get_contents_as_numpy(
+            shm_op, np.int32, [1, 16], offset=output_byte_size
+        )
+        for i in range(16):
+            if output0[0][i] != input0_data[0][i] + input1_data[0][i]:
+                sys.exit("shm infer error: incorrect sum")
+            if output1[0][i] != input0_data[0][i] - input1_data[0][i]:
+                sys.exit("shm infer error: incorrect difference")
+
+        print(client.get_system_shared_memory_status())
+        client.unregister_system_shared_memory()
+        shm.destroy_shared_memory_region(shm_ip)
+        shm.destroy_shared_memory_region(shm_op)
+        print("PASS: system shared memory")
+
+
+if __name__ == "__main__":
+    main()

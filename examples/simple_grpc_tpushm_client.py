@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""TPU shared-memory inference over GRPC — the cudashm example, TPU-native.
+
+Equivalent of the reference's simple_grpc_cudashm_client.py with the CUDA IPC
+region replaced by a tpu_shared_memory region: inputs are bound as live
+jax.Arrays, outputs are read back through the device path.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.tpu_shared_memory as tpushm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.unregister_tpu_shared_memory()
+
+        input0_data = jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
+        input1_data = jnp.ones((1, 16), jnp.int32)
+        nbytes = 64
+
+        shm_ip = tpushm.create_shared_memory_region("input_data", nbytes * 2)
+        tpushm.set_shared_memory_region_from_jax(shm_ip, input0_data)
+        tpushm.set_shared_memory_region_from_jax(shm_ip, input1_data, offset=nbytes)
+        client.register_tpu_shared_memory(
+            "input_data", tpushm.get_raw_handle(shm_ip), 0, nbytes * 2
+        )
+        shm_op = tpushm.create_shared_memory_region("output_data", nbytes * 2)
+        client.register_tpu_shared_memory(
+            "output_data", tpushm.get_raw_handle(shm_op), 0, nbytes * 2
+        )
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("input_data", nbytes)
+        inputs[1].set_shared_memory("input_data", nbytes, offset=nbytes)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("output_data", nbytes)
+        outputs[1].set_shared_memory("output_data", nbytes, offset=nbytes)
+
+        client.infer("simple", inputs, outputs=outputs)
+
+        # device-path read: jax.Array without a wire hop
+        output0 = np.asarray(tpushm.get_contents_as_jax(shm_op, "INT32", [1, 16]))
+        output1 = tpushm.get_contents_as_numpy(shm_op, "INT32", [1, 16], offset=nbytes)
+        expected0 = np.asarray(input0_data + input1_data)
+        expected1 = np.asarray(input0_data - input1_data)
+        if not ((output0 == expected0).all() and (output1 == expected1).all()):
+            sys.exit("tpu shm infer error: incorrect results")
+
+        print(client.get_tpu_shared_memory_status())
+        client.unregister_tpu_shared_memory()
+        tpushm.destroy_shared_memory_region(shm_ip)
+        tpushm.destroy_shared_memory_region(shm_op)
+        print("PASS: tpu shared memory")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""asyncio HTTP inference (equivalent of simple_http_aio_infer_client.py)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import client_tpu.http.aio as httpclient
+
+
+async def run(url):
+    async with httpclient.InferenceServerClient(url) as client:
+        input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1_data = np.ones((1, 16), dtype=np.int32)
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0_data)
+        inputs[1].set_data_from_numpy(input1_data)
+        results = await asyncio.gather(
+            *[client.infer("simple", inputs) for _ in range(4)]
+        )
+        for result in results:
+            if not (result.as_numpy("OUTPUT0") == input0_data + input1_data).all():
+                sys.exit("aio infer error: incorrect sum")
+        print("PASS: aio infer")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+    asyncio.run(run(args.url))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Callback-style async HTTP inference (thread-pool futures).
+
+Equivalent of the reference's simple_http_async_infer_client.py.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-c", "--concurrency", type=int, default=4)
+    args = parser.parse_args()
+
+    request_count = 8
+    with httpclient.InferenceServerClient(args.url, concurrency=args.concurrency) as client:
+        input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1_data = np.ones((1, 16), dtype=np.int32)
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0_data)
+        inputs[1].set_data_from_numpy(input1_data)
+
+        handles = [client.async_infer("simple", inputs) for _ in range(request_count)]
+        for handle in handles:
+            result = handle.get_result()
+            if not (result.as_numpy("OUTPUT0") == input0_data + input1_data).all():
+                sys.exit("async infer error: incorrect sum")
+        print(f"PASS: {request_count} async requests")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Health + metadata surface walkthrough (equivalent of
+simple_http_health_metadata.py)."""
+
+import argparse
+import sys
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url) as client:
+        if not client.is_server_live():
+            sys.exit("FAILED: server not live")
+        if not client.is_server_ready():
+            sys.exit("FAILED: server not ready")
+        if not client.is_model_ready("simple"):
+            sys.exit("FAILED: model 'simple' not ready")
+        server_md = client.get_server_metadata()
+        print("server:", server_md["name"], server_md["version"])
+        print("extensions:", ", ".join(server_md["extensions"]))
+        model_md = client.get_model_metadata("simple")
+        print("model inputs:", [t["name"] for t in model_md["inputs"]])
+        config = client.get_model_config("simple")
+        print("backend:", config["backend"])
+        stats = client.get_inference_statistics("simple")
+        print("stats:", stats["model_stats"][0]["inference_count"], "inferences")
+        print("PASS: health/metadata")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Basic sync HTTP inference against the ``simple`` sum/diff model.
+
+Equivalent of the reference's src/python/examples/simple_http_infer_client.py.
+Start a server first: ``python -m client_tpu.serve``.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url, verbose=args.verbose) as client:
+        input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1_data = np.ones((1, 16), dtype=np.int32)
+
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0_data)
+        inputs[1].set_data_from_numpy(input1_data, binary_data=False)
+
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0"),
+            httpclient.InferRequestedOutput("OUTPUT1", binary_data=False),
+        ]
+        result = client.infer("simple", inputs, outputs=outputs)
+
+        output0 = result.as_numpy("OUTPUT0")
+        output1 = result.as_numpy("OUTPUT1")
+        for i in range(16):
+            print(f"{input0_data[0][i]} + {input1_data[0][i]} = {output0[0][i]}")
+            print(f"{input0_data[0][i]} - {input1_data[0][i]} = {output1[0][i]}")
+            if output0[0][i] != input0_data[0][i] + input1_data[0][i]:
+                sys.exit("sync infer error: incorrect sum")
+            if output1[0][i] != input0_data[0][i] - input1_data[0][i]:
+                sys.exit("sync infer error: incorrect difference")
+        print("PASS: infer")
+
+
+if __name__ == "__main__":
+    main()

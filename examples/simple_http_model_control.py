@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Model repository control: index/unload/load (equivalent of
+simple_http_model_control.py)."""
+
+import argparse
+import sys
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url) as client:
+        index = client.get_model_repository_index()
+        print("repository:", [(m["name"], m["state"]) for m in index])
+        client.unload_model("simple_string")
+        if client.is_model_ready("simple_string"):
+            sys.exit("FAILED: model still ready after unload")
+        client.load_model("simple_string")
+        if not client.is_model_ready("simple_string"):
+            sys.exit("FAILED: model not ready after load")
+        print("PASS: model control")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""BYTES/string tensors over HTTP (equivalent of simple_http_string_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url) as client:
+        in0 = np.array([[str(i) for i in range(16)]], dtype=np.object_)
+        in1 = np.array([["1"] * 16], dtype=np.object_)
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "BYTES"),
+            httpclient.InferInput("INPUT1", [1, 16], "BYTES"),
+        ]
+        inputs[0].set_data_from_numpy(in0)
+        inputs[1].set_data_from_numpy(in1, binary_data=False)
+        result = client.infer("simple_string", inputs)
+        output0 = result.as_numpy("OUTPUT0")
+        output1 = result.as_numpy("OUTPUT1")
+        for i in range(16):
+            if int(output0[0][i]) != i + 1 or int(output1[0][i]) != i - 1:
+                sys.exit("string infer error: incorrect result")
+        print("PASS: string infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Asyncio client e2e tests (HTTP aio + GRPC aio, incl. stream_infer)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from client_tpu.models import default_model_zoo
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer, ServerCore
+
+
+@pytest.fixture(scope="module")
+def servers():
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as h, GrpcInferenceServer(core) as g:
+        yield h, g
+
+
+def _simple_inputs(mod):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = mod.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+    in1 = mod.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+    return a, b, [in0, in1]
+
+
+def test_http_aio_surface(servers):
+    http_server, _ = servers
+    import client_tpu.http.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(http_server.url) as client:
+            assert await client.is_server_live()
+            assert await client.is_model_ready("simple")
+            md = await client.get_server_metadata()
+            assert "tpu_shared_memory" in md["extensions"]
+            a, b, inputs = _simple_inputs(aioclient)
+            result = await client.infer("simple", inputs, request_id="aio1")
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+            # concurrent fan-out on one session
+            results = await asyncio.gather(
+                *[client.infer("simple", inputs) for _ in range(8)]
+            )
+            for r in results:
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT1"), a - b)
+            stats = await client.get_inference_statistics("simple")
+            assert stats["model_stats"][0]["inference_count"] >= 9
+            index = await client.get_model_repository_index()
+            assert any(m["name"] == "simple" for m in index)
+
+    asyncio.run(run())
+
+
+def test_grpc_aio_surface(servers):
+    _, grpc_server = servers
+    import client_tpu.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as client:
+            assert await client.is_server_live()
+            assert await client.is_model_ready("simple")
+            a, b, inputs = _simple_inputs(aioclient)
+            result = await client.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+            results = await asyncio.gather(
+                *[client.infer("simple", inputs) for _ in range(8)]
+            )
+            for r in results:
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT1"), a - b)
+            cfg = await client.get_model_config("simple")
+            assert cfg["config"]["backend"] == "jax"
+
+    asyncio.run(run())
+
+
+def test_grpc_aio_stream_sequence(servers):
+    _, grpc_server = servers
+    import client_tpu.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as client:
+            async def requests():
+                for i, (start, end) in enumerate([(True, False), (False, True)]):
+                    inp = aioclient.InferInput("INPUT", [1, 1], "INT32")
+                    inp.set_data_from_numpy(np.array([[3]], dtype=np.int32))
+                    yield {
+                        "model_name": "simple_sequence",
+                        "inputs": [inp],
+                        "sequence_id": 31,
+                        "sequence_start": start,
+                        "sequence_end": end,
+                    }
+
+            stream = await client.stream_infer(requests())
+            totals = []
+            async for result, error in stream:
+                assert error is None
+                totals.append(int(result.as_numpy("OUTPUT")[0, 0]))
+            assert totals == [3, 6]
+
+    asyncio.run(run())
+
+
+def test_grpc_aio_stream_decoupled(servers):
+    _, grpc_server = servers
+    import client_tpu.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as client:
+            async def requests():
+                inp = aioclient.InferInput("IN", [2], "INT32")
+                inp.set_data_from_numpy(np.array([7, 8], dtype=np.int32))
+                yield {
+                    "model_name": "repeat_int32",
+                    "inputs": [inp],
+                    "enable_empty_final_response": True,
+                }
+
+            stream = await client.stream_infer(requests())
+            seen = []
+            async for result, error in stream:
+                assert error is None
+                if result.is_null_response():
+                    break
+                seen.append(int(result.as_numpy("OUT")[0]))
+            assert seen == [7, 8]
+
+    asyncio.run(run())

@@ -1,0 +1,89 @@
+"""Examples-as-smoke-tests (reference test tier 3, SURVEY §4): every example
+exits non-zero on wrong results, so run them against live servers."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from client_tpu.models import default_model_zoo
+from client_tpu.models.vision import DenseNetModel
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer, ServerCore
+
+REPO = Path(__file__).resolve().parent.parent
+EXAMPLES = REPO / "examples"
+
+
+@pytest.fixture(scope="module")
+def servers():
+    zoo = default_model_zoo() + [DenseNetModel(num_classes=16, width=8)]
+    core = ServerCore(zoo)
+    with HttpInferenceServer(core) as h, GrpcInferenceServer(core) as g:
+        yield h, g
+
+
+def _run(script, args, timeout=180):
+    env = dict(os.environ)
+    # skip the TPU sitecustomize: examples must smoke-test on CPU jax
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed (rc={proc.returncode})\nstdout: {proc.stdout}\nstderr: {proc.stderr}"
+    )
+    assert "PASS" in proc.stdout, f"{script} did not report PASS: {proc.stdout}"
+
+
+HTTP_EXAMPLES = [
+    "simple_http_infer_client.py",
+    "simple_http_async_infer_client.py",
+    "simple_http_aio_infer_client.py",
+    "simple_http_string_infer_client.py",
+    "simple_http_health_metadata.py",
+    "simple_http_model_control.py",
+]
+
+GRPC_EXAMPLES = [
+    "simple_grpc_infer_client.py",
+    "simple_grpc_async_infer_client.py",
+    "simple_grpc_aio_infer_client.py",
+    "simple_grpc_shm_client.py",
+    "simple_grpc_tpushm_client.py",
+    "simple_grpc_sequence_stream_infer_client.py",
+    "simple_grpc_custom_repeat.py",
+    "simple_grpc_keepalive_client.py",
+]
+
+
+@pytest.mark.parametrize("script", HTTP_EXAMPLES)
+def test_http_example(servers, script):
+    http_server, _ = servers
+    _run(script, ["-u", http_server.url])
+
+
+@pytest.mark.parametrize("script", GRPC_EXAMPLES)
+def test_grpc_example(servers, script):
+    _, grpc_server = servers
+    _run(script, ["-u", grpc_server.url])
+
+
+def test_reuse_objects_example(servers):
+    http_server, grpc_server = servers
+    _run("reuse_infer_objects_client.py", ["-u", http_server.url, "-g", grpc_server.url])
+
+
+def test_memory_growth_example(servers):
+    http_server, _ = servers
+    _run("memory_growth_test.py", ["-u", http_server.url, "-r", "200"])
+
+
+def test_image_client_example(servers):
+    http_server, _ = servers
+    _run("image_client.py", ["-u", http_server.url, "-c", "3"])
+    _, grpc_server = servers
+    _run("image_client.py", ["-u", grpc_server.url, "-i", "grpc", "-s", "NONE"])
