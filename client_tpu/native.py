@@ -1,0 +1,211 @@
+"""ctypes binding to the native C++ client (``native/``).
+
+The image has no pybind11, so the native library exposes a flat C API
+(native/src/c_api.cc) bound here with ctypes. Build it first::
+
+    cmake -S native -B native/build -G Ninja && ninja -C native/build
+
+``load()`` returns a NativeClient factory or raises if the library is not
+built; ``available()`` probes quietly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .utils import InferenceServerException, np_to_triton_dtype
+
+_LIB_PATHS = (
+    os.path.join(os.path.dirname(__file__), "..", "native", "build", "libclient_tpu_http.so"),
+    "libclient_tpu_http.so",
+)
+
+_lib = None
+
+
+def _bind(lib):
+    lib.ctpu_last_error.restype = ctypes.c_char_p
+    lib.ctpu_client_create.restype = ctypes.c_void_p
+    lib.ctpu_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ctpu_client_destroy.argtypes = [ctypes.c_void_p]
+    lib.ctpu_server_live.argtypes = [ctypes.c_void_p]
+    lib.ctpu_model_ready.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ctpu_infer_raw.restype = ctypes.c_longlong
+    lib.ctpu_infer_raw.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_ulonglong,
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_ulonglong,
+    ]
+    lib.ctpu_shm_create.restype = ctypes.c_void_p
+    lib.ctpu_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_ulonglong, ctypes.c_int]
+    lib.ctpu_shm_attach.restype = ctypes.c_void_p
+    lib.ctpu_shm_attach.argtypes = [ctypes.c_char_p]
+    lib.ctpu_shm_destroy.argtypes = [ctypes.c_void_p]
+    lib.ctpu_shm_raw_handle.restype = ctypes.c_char_p
+    lib.ctpu_shm_raw_handle.argtypes = [ctypes.c_void_p]
+    lib.ctpu_shm_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_ulonglong
+    ]
+    lib.ctpu_shm_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_ulonglong
+    ]
+    lib.ctpu_register_tpu_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_ulonglong,
+    ]
+    lib.ctpu_unregister_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
+    ]
+    return lib
+
+
+def load():
+    """Load (and cache) the native library; raises when unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    last = None
+    for path in _LIB_PATHS:
+        try:
+            _lib = _bind(ctypes.CDLL(os.path.abspath(path) if os.sep in path else path))
+            return _lib
+        except OSError as e:
+            last = e
+    raise InferenceServerException(
+        f"native library not built (run cmake/ninja in native/): {last}"
+    )
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except InferenceServerException:
+        return False
+
+
+def _err(lib) -> str:
+    return lib.ctpu_last_error().decode("utf-8", errors="replace")
+
+
+class NativeClient:
+    """Thin Python handle over the native HTTP client."""
+
+    def __init__(self, url: str, verbose: bool = False):
+        self._lib = load()
+        self._handle = self._lib.ctpu_client_create(url.encode(), int(verbose))
+        if not self._handle:
+            raise InferenceServerException(f"native client create failed: {_err(self._lib)}")
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ctpu_client_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def is_server_live(self) -> bool:
+        rc = self._lib.ctpu_server_live(self._handle)
+        if rc < 0:
+            raise InferenceServerException(_err(self._lib))
+        return bool(rc)
+
+    def is_model_ready(self, model_name: str) -> bool:
+        rc = self._lib.ctpu_model_ready(self._handle, model_name.encode())
+        if rc < 0:
+            raise InferenceServerException(_err(self._lib))
+        return bool(rc)
+
+    def infer_raw(
+        self,
+        model_name: str,
+        input_name: str,
+        tensor: np.ndarray,
+        output_name: str,
+        output_dtype=None,
+        output_capacity: Optional[int] = None,
+    ) -> np.ndarray:
+        """Single-tensor inference through the native data path."""
+        datatype = np_to_triton_dtype(tensor.dtype)
+        tensor = np.ascontiguousarray(tensor)
+        shape = (ctypes.c_longlong * tensor.ndim)(*tensor.shape)
+        capacity = output_capacity or max(tensor.nbytes * 2, 1 << 16)
+        out = np.empty(capacity, dtype=np.uint8)
+        nbytes = self._lib.ctpu_infer_raw(
+            self._handle, model_name.encode(), input_name.encode(),
+            datatype.encode(), shape, tensor.ndim,
+            tensor.ctypes.data_as(ctypes.c_void_p), tensor.nbytes,
+            output_name.encode(), out.ctypes.data_as(ctypes.c_void_p), capacity,
+        )
+        if nbytes < 0:
+            raise InferenceServerException(_err(self._lib))
+        np_dtype = np.dtype(output_dtype or tensor.dtype)
+        return out[:nbytes].view(np_dtype)
+
+    def register_tpu_shared_memory(
+        self, name: str, raw_handle: str, device_id: int, byte_size: int
+    ) -> None:
+        if self._lib.ctpu_register_tpu_shm(
+            self._handle, name.encode(), raw_handle.encode(), device_id, byte_size
+        ) != 0:
+            raise InferenceServerException(_err(self._lib))
+
+    def unregister_shared_memory(self, family: str = "tpu", name: str = "") -> None:
+        if self._lib.ctpu_unregister_shm(
+            self._handle, family.encode(), name.encode()
+        ) != 0:
+            raise InferenceServerException(_err(self._lib))
+
+
+class NativeTpuShmRegion:
+    """Native tpu shared-memory region, handle-compatible with the Python module."""
+
+    def __init__(self, name: str, byte_size: int, device_id: int = 0, _handle=None):
+        self._lib = load()
+        self.byte_size = byte_size
+        if _handle is not None:
+            self._handle = _handle
+        else:
+            self._handle = self._lib.ctpu_shm_create(name.encode(), byte_size, device_id)
+        if not self._handle:
+            raise InferenceServerException(f"shm create failed: {_err(self._lib)}")
+
+    @classmethod
+    def attach(cls, raw_handle: str, byte_size: int) -> "NativeTpuShmRegion":
+        lib = load()
+        handle = lib.ctpu_shm_attach(raw_handle.encode())
+        if not handle:
+            raise InferenceServerException(f"shm attach failed: {_err(lib)}")
+        return cls("", byte_size, _handle=handle)
+
+    def raw_handle(self) -> str:
+        return self._lib.ctpu_shm_raw_handle(self._handle).decode()
+
+    def write(self, arr: np.ndarray, offset: int = 0) -> None:
+        arr = np.ascontiguousarray(arr)
+        if self._lib.ctpu_shm_write(
+            self._handle, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, offset
+        ) != 0:
+            raise InferenceServerException(_err(self._lib))
+
+    def read(self, dtype, shape, offset: int = 0) -> np.ndarray:
+        out = np.empty(shape, dtype=dtype)
+        if self._lib.ctpu_shm_read(
+            self._handle, out.ctypes.data_as(ctypes.c_void_p), out.nbytes, offset
+        ) != 0:
+            raise InferenceServerException(_err(self._lib))
+        return out
+
+    def destroy(self) -> None:
+        if self._handle:
+            self._lib.ctpu_shm_destroy(self._handle)
+            self._handle = None

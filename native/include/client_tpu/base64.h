@@ -1,0 +1,17 @@
+// base64 codec — the role of the reference's vendored libb64 (cencode.h):
+// encoding raw shared-memory handles and model-file payloads for HTTP JSON.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace client_tpu {
+
+std::string Base64Encode(const uint8_t* data, size_t size);
+inline std::string Base64Encode(const std::string& s) {
+  return Base64Encode(reinterpret_cast<const uint8_t*>(s.data()), s.size());
+}
+bool Base64Decode(const std::string& encoded, std::vector<uint8_t>* out);
+
+}  // namespace client_tpu

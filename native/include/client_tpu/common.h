@@ -1,0 +1,229 @@
+// Shared value types for the native client library.
+// Role parity with the reference's src/c++/library/common.h: Error (:61-83),
+// InferOptions (:164-231), InferInput (:237-394), InferRequestedOutput
+// (:400-482), InferResult (:488-563), RequestTimers (:568-648),
+// InferStat (:93-114) — re-designed around the v2 protocol rather than
+// translated.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace client_tpu {
+
+class Error {
+ public:
+  Error() = default;
+  explicit Error(const std::string& msg) : ok_(false), msg_(msg) {}
+  static Error Success() { return Error(); }
+
+  bool IsOk() const { return ok_; }
+  const std::string& Message() const { return msg_; }
+  explicit operator bool() const { return !ok_; }  // true when error
+
+ private:
+  bool ok_ = true;
+  std::string msg_;
+};
+
+struct InferOptions {
+  explicit InferOptions(const std::string& model_name_in)
+      : model_name(model_name_in) {}
+
+  std::string model_name;
+  std::string model_version;
+  std::string request_id;
+  uint64_t sequence_id = 0;
+  std::string sequence_id_str;  // string-form correlation id
+  bool sequence_start = false;
+  bool sequence_end = false;
+  uint64_t priority = 0;
+  uint64_t server_timeout_us = 0;
+  uint64_t client_timeout_us = 0;
+  bool enable_empty_final_response = false;
+  std::map<std::string, std::string> request_parameters;
+};
+
+// An input tensor: metadata plus either scatter-gather host buffers or a
+// shared-memory placement.
+class InferInput {
+ public:
+  static Error Create(
+      InferInput** result, const std::string& name,
+      const std::vector<int64_t>& shape, const std::string& datatype);
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  const std::vector<int64_t>& Shape() const { return shape_; }
+  Error SetShape(const std::vector<int64_t>& shape) {
+    shape_ = shape;
+    return Error::Success();
+  }
+
+  // Appends a raw chunk (no copy; caller keeps it alive until the request
+  // completes). Multiple appends form a scatter-gather list.
+  Error AppendRaw(const uint8_t* input, size_t input_byte_size);
+  Error AppendRaw(const std::vector<uint8_t>& input) {
+    return AppendRaw(input.data(), input.size());
+  }
+  // Appends BYTES elements from strings (serialized with 4B LE prefixes).
+  Error AppendFromString(const std::vector<std::string>& input);
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0);
+  Error Reset();
+
+  // encoder-facing
+  bool InSharedMemory() const { return !shm_region_.empty(); }
+  const std::string& SharedMemoryRegion() const { return shm_region_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+  uint64_t ByteSize() const { return total_byte_size_; }
+  const std::vector<std::pair<const uint8_t*, size_t>>& Buffers() const {
+    return buffers_;
+  }
+
+ private:
+  InferInput(
+      const std::string& name, const std::vector<int64_t>& shape,
+      const std::string& datatype)
+      : name_(name), shape_(shape), datatype_(datatype) {}
+
+  std::string name_;
+  std::vector<int64_t> shape_;
+  std::string datatype_;
+  std::vector<std::pair<const uint8_t*, size_t>> buffers_;
+  // deque: buffers_ records pointers into these strings, so elements must
+  // never move on growth (a vector would dangle them on reallocation)
+  std::deque<std::string> owned_;
+  uint64_t total_byte_size_ = 0;
+  std::string shm_region_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+class InferRequestedOutput {
+ public:
+  static Error Create(
+      InferRequestedOutput** result, const std::string& name,
+      size_t class_count = 0);
+
+  const std::string& Name() const { return name_; }
+  size_t ClassCount() const { return class_count_; }
+  bool BinaryData() const { return binary_data_; }
+  void SetBinaryData(bool b) { binary_data_ = b; }
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0);
+  Error UnsetSharedMemory();
+
+  bool InSharedMemory() const { return !shm_region_.empty(); }
+  const std::string& SharedMemoryRegion() const { return shm_region_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+ private:
+  InferRequestedOutput(const std::string& name, size_t class_count)
+      : name_(name), class_count_(class_count) {}
+
+  std::string name_;
+  size_t class_count_;
+  bool binary_data_ = true;
+  std::string shm_region_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+// The result of an inference: decoded response metadata + zero-copy views
+// into the response body for binary outputs.
+class InferResult {
+ public:
+  virtual ~InferResult() = default;
+
+  virtual Error ModelName(std::string* name) const = 0;
+  virtual Error ModelVersion(std::string* version) const = 0;
+  virtual Error Id(std::string* id) const = 0;
+  virtual Error Shape(
+      const std::string& output_name, std::vector<int64_t>* shape) const = 0;
+  virtual Error Datatype(
+      const std::string& output_name, std::string* datatype) const = 0;
+  // Zero-copy view into the response buffer; valid while the result lives.
+  virtual Error RawData(
+      const std::string& output_name, const uint8_t** buf,
+      size_t* byte_size) const = 0;
+  virtual Error StringData(
+      const std::string& output_name,
+      std::vector<std::string>* string_result) const = 0;
+  virtual Error IsFinalResponse(bool* is_final) const = 0;
+  virtual Error IsNullResponse(bool* is_null) const = 0;
+  virtual std::string DebugString() const = 0;
+  virtual Error RequestStatus() const = 0;
+};
+
+// Monotonic nanosecond capture points per request; kinds extend the
+// reference's six with TPU device-transfer points.
+class RequestTimers {
+ public:
+  enum class Kind {
+    REQUEST_START,
+    REQUEST_END,
+    SEND_START,
+    SEND_END,
+    RECV_START,
+    RECV_END,
+    H2D_START,
+    H2D_END,
+    D2H_START,
+    D2H_END,
+    COUNT_,
+  };
+
+  void Capture(Kind kind) {
+    ts_[static_cast<size_t>(kind)] =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count();
+  }
+  uint64_t DurationNs(Kind start, Kind end) const {
+    uint64_t s = ts_[static_cast<size_t>(start)];
+    uint64_t e = ts_[static_cast<size_t>(end)];
+    return (s == 0 || e < s) ? 0 : e - s;
+  }
+
+ private:
+  uint64_t ts_[static_cast<size_t>(Kind::COUNT_)] = {};
+};
+
+struct InferStat {
+  uint64_t completed_request_count = 0;
+  uint64_t cumulative_total_request_time_ns = 0;
+  uint64_t cumulative_send_time_ns = 0;
+  uint64_t cumulative_receive_time_ns = 0;
+
+  void Update(const RequestTimers& timers) {
+    completed_request_count++;
+    cumulative_total_request_time_ns += timers.DurationNs(
+        RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);
+    cumulative_send_time_ns += timers.DurationNs(
+        RequestTimers::Kind::SEND_START, RequestTimers::Kind::SEND_END);
+    cumulative_receive_time_ns += timers.DurationNs(
+        RequestTimers::Kind::RECV_START, RequestTimers::Kind::RECV_END);
+  }
+};
+
+using OnCompleteFn = void (*)(InferResult* result, void* userp);
+
+// BYTES wire helpers (4-byte LE length prefix per element).
+void SerializeStrings(
+    const std::vector<std::string>& input, std::string* output);
+Error DeserializeStrings(
+    const uint8_t* buf, size_t byte_size, std::vector<std::string>* output);
+
+}  // namespace client_tpu

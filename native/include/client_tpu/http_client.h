@@ -1,0 +1,125 @@
+// KServe v2 HTTP/REST client over libcurl.
+// Role parity with the reference's src/c++/library/http_client.h:105 —
+// sync Infer (curl easy), AsyncInfer (curl multi + worker thread), the full
+// admin surface, two-part binary bodies with Inference-Header-Content-Length,
+// and shared-memory registration including the tpusharedmemory family.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+#include "client_tpu/common.h"
+#include "client_tpu/json.h"
+
+using CURL = void;
+using CURLM = void;
+
+namespace client_tpu {
+
+class InferenceServerHttpClient {
+ public:
+  using OnComplete = std::function<void(InferResult*)>;
+
+  static Error Create(
+      std::unique_ptr<InferenceServerHttpClient>* client,
+      const std::string& server_url, bool verbose = false);
+  ~InferenceServerHttpClient();
+
+  Error IsServerLive(bool* live);
+  Error IsServerReady(bool* ready);
+  Error IsModelReady(
+      bool* ready, const std::string& model_name,
+      const std::string& model_version = "");
+
+  Error ServerMetadata(Json* metadata);
+  Error ModelMetadata(
+      Json* metadata, const std::string& model_name,
+      const std::string& model_version = "");
+  Error ModelConfig(
+      Json* config, const std::string& model_name,
+      const std::string& model_version = "");
+  Error ModelRepositoryIndex(Json* index);
+  Error LoadModel(
+      const std::string& model_name, const std::string& config = "",
+      const std::map<std::string, std::vector<char>>& files = {});
+  Error UnloadModel(const std::string& model_name);
+  Error ModelInferenceStatistics(
+      Json* stats, const std::string& model_name = "",
+      const std::string& model_version = "");
+  Error UpdateTraceSettings(
+      Json* response, const std::string& model_name = "",
+      const Json& settings = Json::Object());
+  Error GetTraceSettings(Json* settings, const std::string& model_name = "");
+  Error UpdateLogSettings(Json* response, const Json& settings);
+  Error GetLogSettings(Json* settings);
+
+  Error SystemSharedMemoryStatus(Json* status, const std::string& name = "");
+  Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset = 0);
+  Error UnregisterSystemSharedMemory(const std::string& name = "");
+  Error TpuSharedMemoryStatus(Json* status, const std::string& name = "");
+  Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle_b64,
+      int device_id, size_t byte_size);
+  Error UnregisterTpuSharedMemory(const std::string& name = "");
+  Error CudaSharedMemoryStatus(Json* status, const std::string& name = "");
+  Error RegisterCudaSharedMemory(
+      const std::string& name, const std::string& raw_handle_b64,
+      int device_id, size_t byte_size);
+  Error UnregisterCudaSharedMemory(const std::string& name = "");
+
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {});
+  Error AsyncInfer(
+      OnComplete callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  InferStat ClientInferStat();
+
+ private:
+  InferenceServerHttpClient(const std::string& url, bool verbose);
+
+  Error Perform(
+      const std::string& path, const std::string* body, long* http_code,
+      std::string* response);
+  Error Get(const std::string& path, long* http_code, std::string* response);
+  Error Post(
+      const std::string& path, const std::string& body, long* http_code,
+      std::string* response);
+  Error GetJson(const std::string& path, Json* out);
+  Error PostJson(const std::string& path, const std::string& body, Json* out);
+  Error ShmStatus(const std::string& family, const std::string& name, Json* out);
+  Error ShmRegisterHandle(
+      const std::string& family, const std::string& name,
+      const std::string& raw_handle_b64, int device_id, size_t byte_size);
+  Error ShmUnregister(const std::string& family, const std::string& name);
+
+  struct AsyncRequest;
+  void AsyncTransfer();
+
+  std::string url_;
+  bool verbose_;
+  CURL* easy_ = nullptr;  // shared handle for sync calls
+  std::mutex easy_mutex_;
+
+  CURLM* multi_ = nullptr;
+  std::thread worker_;
+  std::mutex multi_mutex_;
+  std::condition_variable multi_cv_;
+  std::deque<AsyncRequest*> pending_;
+  std::atomic<bool> exiting_{false};
+
+  std::mutex stat_mutex_;
+  InferStat infer_stat_;
+};
+
+}  // namespace client_tpu

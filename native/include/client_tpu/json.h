@@ -1,0 +1,82 @@
+// Minimal JSON value/parser/writer for the KServe v2 protocol layer.
+// Role of the reference's TritonJson glue (src/c++/library/json_utils.h),
+// self-contained instead of depending on a vendored rapidjson.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace client_tpu {
+
+class Json {
+ public:
+  enum class Type { kNull, kBool, kInt, kDouble, kString, kArray, kObject };
+
+  Json() : type_(Type::kNull) {}
+  explicit Json(bool b) : type_(Type::kBool), bool_(b) {}
+  explicit Json(int64_t i) : type_(Type::kInt), int_(i) {}
+  explicit Json(double d) : type_(Type::kDouble), double_(d) {}
+  explicit Json(const std::string& s) : type_(Type::kString), string_(s) {}
+  explicit Json(const char* s) : type_(Type::kString), string_(s) {}
+
+  static Json Array() {
+    Json j;
+    j.type_ = Type::kArray;
+    return j;
+  }
+  static Json Object() {
+    Json j;
+    j.type_ = Type::kObject;
+    return j;
+  }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::kNull; }
+  bool is_object() const { return type_ == Type::kObject; }
+  bool is_array() const { return type_ == Type::kArray; }
+
+  bool AsBool() const { return type_ == Type::kBool ? bool_ : false; }
+  int64_t AsInt() const {
+    if (type_ == Type::kInt) return int_;
+    if (type_ == Type::kDouble) return static_cast<int64_t>(double_);
+    return 0;
+  }
+  double AsDouble() const {
+    if (type_ == Type::kDouble) return double_;
+    if (type_ == Type::kInt) return static_cast<double>(int_);
+    return 0.0;
+  }
+  const std::string& AsString() const { return string_; }
+
+  // object access
+  bool Has(const std::string& key) const { return object_.count(key) > 0; }
+  const Json& At(const std::string& key) const;  // null json if absent
+  Json& Set(const std::string& key, Json value) {
+    return object_[key] = std::move(value);
+  }
+  const std::map<std::string, Json>& items() const { return object_; }
+
+  // array access
+  size_t size() const { return array_.size(); }
+  const Json& operator[](size_t i) const { return array_[i]; }
+  void Append(Json value) { array_.push_back(std::move(value)); }
+
+  std::string Dump() const;
+
+  // Parses `text`; on success returns true and fills `out`.
+  static bool Parse(const std::string& text, Json* out, std::string* error);
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string string_;
+  std::vector<Json> array_;
+  std::map<std::string, Json> object_;
+};
+
+}  // namespace client_tpu

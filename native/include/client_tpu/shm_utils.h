@@ -1,0 +1,24 @@
+// POSIX shared-memory helpers (role of the reference's shm_utils.h:
+// CreateSharedMemoryRegion/Map/Close/Unlink/Unmap, shm_utils.cc:39-106).
+#pragma once
+
+#include <cstddef>
+#include <string>
+
+#include "client_tpu/common.h"
+
+namespace client_tpu {
+
+// shm_open(O_CREAT)+ftruncate; returns the fd in `shm_fd`.
+Error CreateSharedMemoryRegion(
+    const std::string& shm_key, size_t byte_size, int* shm_fd);
+// Opens an existing region read/write.
+Error OpenSharedMemoryRegion(const std::string& shm_key, int* shm_fd);
+// mmap of [offset, offset+byte_size).
+Error MapSharedMemory(
+    int shm_fd, size_t offset, size_t byte_size, void** shm_addr);
+Error CloseSharedMemory(int shm_fd);
+Error UnlinkSharedMemoryRegion(const std::string& shm_key);
+Error UnmapSharedMemory(void* shm_addr, size_t byte_size);
+
+}  // namespace client_tpu

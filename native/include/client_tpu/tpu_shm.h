@@ -1,0 +1,52 @@
+// TPU shared-memory shim: the native side of utils.tpu_shared_memory.
+// Role of the reference's ipc.h/cuda path (cudaMalloc+cudaIpcGetMemHandle):
+// a region is a POSIX host window whose serialized handle is a base64 JSON
+// descriptor interoperable with the Python module (same "tpu_shared_memory"
+// kind, shm_key, byte_size, device_id fields), so a C++ producer can feed a
+// Python consumer and vice versa. Device binding happens at the XLA layer
+// in-process; cross-process transport is the host window.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "client_tpu/common.h"
+
+namespace client_tpu {
+
+class TpuShmRegion {
+ public:
+  // Allocates a fresh region (shm key auto-generated when empty).
+  static Error Create(
+      TpuShmRegion** region, const std::string& name, size_t byte_size,
+      int device_id = 0, const std::string& shm_key = "");
+  // Attaches from a serialized raw handle (base64 JSON descriptor).
+  static Error Attach(TpuShmRegion** region, const std::string& raw_handle);
+
+  ~TpuShmRegion();
+
+  const std::string& Name() const { return name_; }
+  const std::string& ShmKey() const { return shm_key_; }
+  size_t ByteSize() const { return byte_size_; }
+  int DeviceId() const { return device_id_; }
+  uint8_t* Data() const { return static_cast<uint8_t*>(addr_); }
+
+  // Serialized descriptor for register_tpu_shared_memory.
+  std::string RawHandle() const;
+
+  Error Write(const void* src, size_t byte_size, size_t offset = 0);
+  Error Read(void* dst, size_t byte_size, size_t offset = 0) const;
+
+ private:
+  TpuShmRegion() = default;
+
+  std::string name_;
+  std::string shm_key_;
+  size_t byte_size_ = 0;
+  int device_id_ = 0;
+  bool owned_ = false;  // created (unlink on destroy) vs attached
+  int fd_ = -1;
+  void* addr_ = nullptr;
+};
+
+}  // namespace client_tpu

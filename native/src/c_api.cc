@@ -1,0 +1,168 @@
+// Flat C API over the native client for ctypes/cffi binding (this image has
+// no pybind11; see client_tpu/native.py for the Python side).
+#include <cstring>
+#include <memory>
+#include <vector>
+
+#include "client_tpu/common.h"
+#include "client_tpu/http_client.h"
+#include "client_tpu/tpu_shm.h"
+
+using client_tpu::Error;
+using client_tpu::InferenceServerHttpClient;
+using client_tpu::InferInput;
+using client_tpu::InferOptions;
+using client_tpu::InferRequestedOutput;
+using client_tpu::InferResult;
+using client_tpu::Json;
+using client_tpu::TpuShmRegion;
+
+namespace {
+thread_local std::string g_last_error;
+
+int SetError(const Error& err) {
+  if (err.IsOk()) return 0;
+  g_last_error = err.Message();
+  return -1;
+}
+}  // namespace
+
+extern "C" {
+
+const char* ctpu_last_error() { return g_last_error.c_str(); }
+
+// -- client -----------------------------------------------------------------
+
+void* ctpu_client_create(const char* url, int verbose) {
+  std::unique_ptr<InferenceServerHttpClient> client;
+  Error err = InferenceServerHttpClient::Create(&client, url, verbose != 0);
+  if (SetError(err) != 0) return nullptr;
+  return client.release();
+}
+
+void ctpu_client_destroy(void* client) {
+  delete static_cast<InferenceServerHttpClient*>(client);
+}
+
+int ctpu_server_live(void* client) {
+  bool live = false;
+  Error err =
+      static_cast<InferenceServerHttpClient*>(client)->IsServerLive(&live);
+  if (SetError(err) != 0) return -1;
+  return live ? 1 : 0;
+}
+
+int ctpu_model_ready(void* client, const char* model_name) {
+  bool ready = false;
+  Error err = static_cast<InferenceServerHttpClient*>(client)->IsModelReady(
+      &ready, model_name);
+  if (SetError(err) != 0) return -1;
+  return ready ? 1 : 0;
+}
+
+// Single-input single-buffer inference helper: sends `input` and copies the
+// named output back into `output` (up to output_capacity bytes). Returns the
+// output byte size, or -1.
+long long ctpu_infer_raw(
+    void* client_ptr, const char* model_name, const char* input_name,
+    const char* datatype, const long long* shape, int ndim,
+    const void* input, unsigned long long input_byte_size,
+    const char* output_name, void* output,
+    unsigned long long output_capacity) {
+  auto* client = static_cast<InferenceServerHttpClient*>(client_ptr);
+  std::vector<int64_t> dims(shape, shape + ndim);
+  InferInput* infer_input = nullptr;
+  InferInput::Create(&infer_input, input_name, dims, datatype);
+  std::unique_ptr<InferInput> input_guard(infer_input);
+  infer_input->AppendRaw(
+      static_cast<const uint8_t*>(input), input_byte_size);
+
+  InferOptions options(model_name);
+  InferResult* result = nullptr;
+  Error err = client->Infer(&result, options, {infer_input});
+  std::unique_ptr<InferResult> result_guard(result);
+  if (SetError(err) != 0) return -1;
+
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  err = result->RawData(output_name, &buf, &byte_size);
+  if (SetError(err) != 0) return -1;
+  if (byte_size > output_capacity) {
+    g_last_error = "output buffer too small";
+    return -1;
+  }
+  std::memcpy(output, buf, byte_size);
+  return static_cast<long long>(byte_size);
+}
+
+int ctpu_register_system_shm(
+    void* client, const char* name, const char* key,
+    unsigned long long byte_size, unsigned long long offset) {
+  return SetError(
+      static_cast<InferenceServerHttpClient*>(client)
+          ->RegisterSystemSharedMemory(name, key, byte_size, offset));
+}
+
+int ctpu_register_tpu_shm(
+    void* client, const char* name, const char* raw_handle_b64, int device_id,
+    unsigned long long byte_size) {
+  return SetError(
+      static_cast<InferenceServerHttpClient*>(client)->RegisterTpuSharedMemory(
+          name, raw_handle_b64, device_id, byte_size));
+}
+
+int ctpu_unregister_shm(void* client, const char* family, const char* name) {
+  auto* c = static_cast<InferenceServerHttpClient*>(client);
+  std::string fam(family);
+  if (fam == "system") return SetError(c->UnregisterSystemSharedMemory(name));
+  if (fam == "tpu") return SetError(c->UnregisterTpuSharedMemory(name));
+  if (fam == "cuda") return SetError(c->UnregisterCudaSharedMemory(name));
+  g_last_error = "unknown shared-memory family";
+  return -1;
+}
+
+// -- tpu shm regions ---------------------------------------------------------
+
+void* ctpu_shm_create(const char* name, unsigned long long byte_size, int device_id) {
+  TpuShmRegion* region = nullptr;
+  Error err = TpuShmRegion::Create(&region, name, byte_size, device_id);
+  if (SetError(err) != 0) return nullptr;
+  return region;
+}
+
+void* ctpu_shm_attach(const char* raw_handle) {
+  TpuShmRegion* region = nullptr;
+  Error err = TpuShmRegion::Attach(&region, raw_handle);
+  if (SetError(err) != 0) return nullptr;
+  return region;
+}
+
+void ctpu_shm_destroy(void* region) {
+  delete static_cast<TpuShmRegion*>(region);
+}
+
+const char* ctpu_shm_raw_handle(void* region) {
+  thread_local std::string handle;
+  handle = static_cast<TpuShmRegion*>(region)->RawHandle();
+  return handle.c_str();
+}
+
+void* ctpu_shm_data(void* region) {
+  return static_cast<TpuShmRegion*>(region)->Data();
+}
+
+int ctpu_shm_write(
+    void* region, const void* src, unsigned long long byte_size,
+    unsigned long long offset) {
+  return SetError(
+      static_cast<TpuShmRegion*>(region)->Write(src, byte_size, offset));
+}
+
+int ctpu_shm_read(
+    void* region, void* dst, unsigned long long byte_size,
+    unsigned long long offset) {
+  return SetError(
+      static_cast<TpuShmRegion*>(region)->Read(dst, byte_size, offset));
+}
+
+}  // extern "C"

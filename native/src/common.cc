@@ -1,0 +1,105 @@
+#include "client_tpu/common.h"
+
+namespace client_tpu {
+
+Error InferInput::Create(
+    InferInput** result, const std::string& name,
+    const std::vector<int64_t>& shape, const std::string& datatype) {
+  *result = new InferInput(name, shape, datatype);
+  return Error::Success();
+}
+
+Error InferInput::AppendRaw(const uint8_t* input, size_t input_byte_size) {
+  if (InSharedMemory()) {
+    return Error("cannot append raw data to an input placed in shared memory");
+  }
+  buffers_.emplace_back(input, input_byte_size);
+  total_byte_size_ += input_byte_size;
+  return Error::Success();
+}
+
+Error InferInput::AppendFromString(const std::vector<std::string>& input) {
+  std::string serialized;
+  SerializeStrings(input, &serialized);
+  owned_.push_back(std::move(serialized));
+  const std::string& stored = owned_.back();
+  return AppendRaw(
+      reinterpret_cast<const uint8_t*>(stored.data()), stored.size());
+}
+
+Error InferInput::SetSharedMemory(
+    const std::string& region_name, size_t byte_size, size_t offset) {
+  buffers_.clear();
+  owned_.clear();
+  total_byte_size_ = 0;
+  shm_region_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success();
+}
+
+Error InferInput::Reset() {
+  buffers_.clear();
+  owned_.clear();
+  total_byte_size_ = 0;
+  shm_region_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success();
+}
+
+Error InferRequestedOutput::Create(
+    InferRequestedOutput** result, const std::string& name,
+    size_t class_count) {
+  *result = new InferRequestedOutput(name, class_count);
+  return Error::Success();
+}
+
+Error InferRequestedOutput::SetSharedMemory(
+    const std::string& region_name, size_t byte_size, size_t offset) {
+  shm_region_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success();
+}
+
+Error InferRequestedOutput::UnsetSharedMemory() {
+  shm_region_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success();
+}
+
+void SerializeStrings(
+    const std::vector<std::string>& input, std::string* output) {
+  size_t total = 0;
+  for (const auto& s : input) total += 4 + s.size();
+  output->clear();
+  output->reserve(total);
+  for (const auto& s : input) {
+    uint32_t len = static_cast<uint32_t>(s.size());
+    output->append(reinterpret_cast<const char*>(&len), 4);  // little-endian
+    output->append(s);
+  }
+}
+
+Error DeserializeStrings(
+    const uint8_t* buf, size_t byte_size, std::vector<std::string>* output) {
+  size_t offset = 0;
+  while (offset < byte_size) {
+    if (offset + 4 > byte_size) {
+      return Error("malformed BYTES tensor: truncated length prefix");
+    }
+    uint32_t len;
+    std::memcpy(&len, buf + offset, 4);
+    offset += 4;
+    if (offset + len > byte_size) {
+      return Error("malformed BYTES tensor: truncated element");
+    }
+    output->emplace_back(reinterpret_cast<const char*>(buf + offset), len);
+    offset += len;
+  }
+  return Error::Success();
+}
+
+}  // namespace client_tpu

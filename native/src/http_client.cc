@@ -1,0 +1,858 @@
+#include "client_tpu/http_client.h"
+
+#include <curl/curl.h>
+
+#include <cstring>
+#include <sstream>
+
+#include "client_tpu/base64.h"
+
+namespace client_tpu {
+
+namespace {
+
+// Process-wide curl lifecycle (reference http_client.cc:71-101).
+struct CurlGlobal {
+  CurlGlobal() { curl_global_init(CURL_GLOBAL_ALL); }
+  ~CurlGlobal() { curl_global_cleanup(); }
+};
+static CurlGlobal curl_global;
+
+size_t WriteBody(char* ptr, size_t size, size_t nmemb, void* userdata) {
+  auto* out = static_cast<std::string*>(userdata);
+  out->append(ptr, size * nmemb);
+  return size * nmemb;
+}
+
+struct HeaderCapture {
+  long header_length = -1;
+};
+
+size_t WriteHeader(char* ptr, size_t size, size_t nmemb, void* userdata) {
+  auto* capture = static_cast<HeaderCapture*>(userdata);
+  std::string line(ptr, size * nmemb);
+  const std::string key = "Inference-Header-Content-Length:";
+  if (line.size() > key.size() &&
+      strncasecmp(line.c_str(), key.c_str(), key.size()) == 0) {
+    capture->header_length = strtol(line.c_str() + key.size(), nullptr, 10);
+  }
+  return size * nmemb;
+}
+
+Error ErrorFromResponse(long http_code, const std::string& body) {
+  if (http_code < 400) return Error::Success();
+  Json parsed;
+  std::string perr;
+  if (Json::Parse(body, &parsed, &perr) && parsed.Has("error")) {
+    return Error(
+        "[" + std::to_string(http_code) + "] " + parsed.At("error").AsString());
+  }
+  return Error("[" + std::to_string(http_code) + "] " + body);
+}
+
+void AppendShmParams(
+    Json* params, const std::string& region, size_t byte_size, size_t offset) {
+  params->Set("shared_memory_region", Json(region));
+  params->Set(
+      "shared_memory_byte_size", Json(static_cast<int64_t>(byte_size)));
+  if (offset != 0) {
+    params->Set("shared_memory_offset", Json(static_cast<int64_t>(offset)));
+  }
+}
+
+// Builds the two-part body; returns the JSON header length.
+size_t BuildInferBody(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    std::string* body) {
+  Json header = Json::Object();
+  if (!options.request_id.empty()) {
+    header.Set("id", Json(options.request_id));
+  }
+  Json params = Json::Object();
+  if (options.sequence_id != 0 || !options.sequence_id_str.empty()) {
+    if (!options.sequence_id_str.empty()) {
+      params.Set("sequence_id", Json(options.sequence_id_str));
+    } else {
+      params.Set(
+          "sequence_id", Json(static_cast<int64_t>(options.sequence_id)));
+    }
+    params.Set("sequence_start", Json(options.sequence_start));
+    params.Set("sequence_end", Json(options.sequence_end));
+  }
+  if (options.priority != 0) {
+    params.Set("priority", Json(static_cast<int64_t>(options.priority)));
+  }
+  if (options.server_timeout_us != 0) {
+    params.Set(
+        "timeout", Json(static_cast<int64_t>(options.server_timeout_us)));
+  }
+  for (const auto& kv : options.request_parameters) {
+    params.Set(kv.first, Json(kv.second));
+  }
+  if (outputs.empty()) {
+    params.Set("binary_data_output", Json(true));
+  }
+  if (!params.items().empty()) {
+    header.Set("parameters", std::move(params));
+  }
+
+  Json inputs_json = Json::Array();
+  for (const auto* input : inputs) {
+    Json tensor = Json::Object();
+    tensor.Set("name", Json(input->Name()));
+    tensor.Set("datatype", Json(input->Datatype()));
+    Json shape = Json::Array();
+    for (int64_t d : input->Shape()) {
+      shape.Append(Json(static_cast<int64_t>(d)));
+    }
+    tensor.Set("shape", std::move(shape));
+    Json tparams = Json::Object();
+    if (input->InSharedMemory()) {
+      AppendShmParams(
+          &tparams, input->SharedMemoryRegion(), input->SharedMemoryByteSize(),
+          input->SharedMemoryOffset());
+    } else {
+      tparams.Set(
+          "binary_data_size", Json(static_cast<int64_t>(input->ByteSize())));
+    }
+    tensor.Set("parameters", std::move(tparams));
+    inputs_json.Append(std::move(tensor));
+  }
+  header.Set("inputs", std::move(inputs_json));
+
+  if (!outputs.empty()) {
+    Json outputs_json = Json::Array();
+    for (const auto* output : outputs) {
+      Json tensor = Json::Object();
+      tensor.Set("name", Json(output->Name()));
+      Json oparams = Json::Object();
+      if (output->InSharedMemory()) {
+        AppendShmParams(
+            &oparams, output->SharedMemoryRegion(),
+            output->SharedMemoryByteSize(), output->SharedMemoryOffset());
+      } else {
+        oparams.Set("binary_data", Json(output->BinaryData()));
+      }
+      if (output->ClassCount() != 0) {
+        oparams.Set(
+            "classification",
+            Json(static_cast<int64_t>(output->ClassCount())));
+      }
+      tensor.Set("parameters", std::move(oparams));
+      outputs_json.Append(std::move(tensor));
+    }
+    header.Set("outputs", std::move(outputs_json));
+  }
+
+  std::string header_text = header.Dump();
+  size_t header_length = header_text.size();
+  size_t total = header_length;
+  for (const auto* input : inputs) total += input->ByteSize();
+  body->clear();
+  body->reserve(total);
+  body->append(header_text);
+  for (const auto* input : inputs) {
+    for (const auto& buf : input->Buffers()) {
+      body->append(reinterpret_cast<const char*>(buf.first), buf.second);
+    }
+  }
+  return header_length;
+}
+
+// Decodes a JSON "data" array into the little-endian wire representation so
+// non-binary outputs are readable through the same RawData accessor.
+bool DecodeJsonData(
+    const Json& data, const std::string& datatype, std::string* buf) {
+  auto append = [&](const void* p, size_t n) {
+    buf->append(static_cast<const char*>(p), n);
+  };
+  if (datatype == "BYTES") {
+    std::vector<std::string> strings;
+    for (size_t i = 0; i < data.size(); ++i) {
+      strings.push_back(data[i].AsString());
+    }
+    SerializeStrings(strings, buf);
+    return true;
+  }
+  for (size_t i = 0; i < data.size(); ++i) {
+    const Json& v = data[i];
+    if (datatype == "BOOL") {
+      uint8_t b = v.AsBool() ? 1 : 0;
+      append(&b, 1);
+    } else if (datatype == "INT8") {
+      int8_t x = static_cast<int8_t>(v.AsInt());
+      append(&x, 1);
+    } else if (datatype == "INT16") {
+      int16_t x = static_cast<int16_t>(v.AsInt());
+      append(&x, 2);
+    } else if (datatype == "INT32") {
+      int32_t x = static_cast<int32_t>(v.AsInt());
+      append(&x, 4);
+    } else if (datatype == "INT64") {
+      int64_t x = v.AsInt();
+      append(&x, 8);
+    } else if (datatype == "UINT8") {
+      uint8_t x = static_cast<uint8_t>(v.AsInt());
+      append(&x, 1);
+    } else if (datatype == "UINT16") {
+      uint16_t x = static_cast<uint16_t>(v.AsInt());
+      append(&x, 2);
+    } else if (datatype == "UINT32") {
+      uint32_t x = static_cast<uint32_t>(v.AsInt());
+      append(&x, 4);
+    } else if (datatype == "UINT64") {
+      uint64_t x = static_cast<uint64_t>(v.AsInt());
+      append(&x, 8);
+    } else if (datatype == "FP32") {
+      float x = static_cast<float>(v.AsDouble());
+      append(&x, 4);
+    } else if (datatype == "FP64") {
+      double x = v.AsDouble();
+      append(&x, 8);
+    } else {
+      return false;  // FP16/BF16 have no JSON representation
+    }
+  }
+  return true;
+}
+
+class InferResultHttp : public InferResult {
+ public:
+  static Error Create(
+      InferResult** result, std::string&& body, long header_length,
+      long http_code) {
+    auto* r = new InferResultHttp();
+    r->body_ = std::move(body);
+    r->status_ = ErrorFromResponse(http_code, r->body_);
+    if (!r->status_) {
+      size_t json_size =
+          header_length >= 0 ? static_cast<size_t>(header_length)
+                             : r->body_.size();
+      std::string perr;
+      if (!Json::Parse(r->body_.substr(0, json_size), &r->header_, &perr)) {
+        r->status_ = Error("failed to parse inference response: " + perr);
+      } else {
+        size_t cursor = json_size;
+        const Json& outs = r->header_.At("outputs");
+        for (size_t i = 0; i < outs.size(); ++i) {
+          const Json& out = outs[i];
+          const Json& params = out.At("parameters");
+          const std::string name = out.At("name").AsString();
+          if (params.Has("binary_data_size")) {
+            size_t size =
+                static_cast<size_t>(params.At("binary_data_size").AsInt());
+            r->offsets_[name] = {cursor, size};
+            cursor += size;
+          } else if (out.Has("data")) {
+            // JSON-mode output: decode into an owned buffer so RawData works
+            std::string decoded;
+            if (DecodeJsonData(
+                    out.At("data"), out.At("datatype").AsString(), &decoded)) {
+              r->json_buffers_[name] = std::move(decoded);
+            }
+          }
+        }
+      }
+    }
+    *result = r;
+    return Error::Success();
+  }
+
+  Error ModelName(std::string* name) const override {
+    *name = header_.At("model_name").AsString();
+    return Error::Success();
+  }
+  Error ModelVersion(std::string* version) const override {
+    *version = header_.At("model_version").AsString();
+    return Error::Success();
+  }
+  Error Id(std::string* id) const override {
+    *id = header_.At("id").AsString();
+    return Error::Success();
+  }
+
+  const Json* FindOutput(const std::string& name) const {
+    const Json& outs = header_.At("outputs");
+    for (size_t i = 0; i < outs.size(); ++i) {
+      if (outs[i].At("name").AsString() == name) return &outs[i];
+    }
+    return nullptr;
+  }
+
+  Error Shape(
+      const std::string& output_name,
+      std::vector<int64_t>* shape) const override {
+    const Json* out = FindOutput(output_name);
+    if (out == nullptr) return Error("output '" + output_name + "' not found");
+    const Json& dims = out->At("shape");
+    shape->clear();
+    for (size_t i = 0; i < dims.size(); ++i) shape->push_back(dims[i].AsInt());
+    return Error::Success();
+  }
+
+  Error Datatype(
+      const std::string& output_name, std::string* datatype) const override {
+    const Json* out = FindOutput(output_name);
+    if (out == nullptr) return Error("output '" + output_name + "' not found");
+    *datatype = out->At("datatype").AsString();
+    return Error::Success();
+  }
+
+  Error RawData(
+      const std::string& output_name, const uint8_t** buf,
+      size_t* byte_size) const override {
+    auto it = offsets_.find(output_name);
+    if (it != offsets_.end()) {
+      *buf = reinterpret_cast<const uint8_t*>(body_.data()) + it->second.first;
+      *byte_size = it->second.second;
+      return Error::Success();
+    }
+    auto jit = json_buffers_.find(output_name);
+    if (jit != json_buffers_.end()) {
+      *buf = reinterpret_cast<const uint8_t*>(jit->second.data());
+      *byte_size = jit->second.size();
+      return Error::Success();
+    }
+    return Error(
+        "output '" + output_name + "' has no data in the response");
+  }
+
+  Error StringData(
+      const std::string& output_name,
+      std::vector<std::string>* string_result) const override {
+    const uint8_t* buf;
+    size_t byte_size;
+    Error err = RawData(output_name, &buf, &byte_size);
+    if (err) return err;
+    return DeserializeStrings(buf, byte_size, string_result);
+  }
+
+  Error IsFinalResponse(bool* is_final) const override {
+    *is_final =
+        header_.At("parameters").At("triton_final_response").AsBool();
+    return Error::Success();
+  }
+  Error IsNullResponse(bool* is_null) const override {
+    bool is_final = false;
+    IsFinalResponse(&is_final);
+    *is_null = is_final && header_.At("outputs").size() == 0;
+    return Error::Success();
+  }
+  std::string DebugString() const override { return header_.Dump(); }
+  Error RequestStatus() const override { return status_; }
+
+ private:
+  std::string body_;
+  Json header_;
+  Error status_;
+  std::map<std::string, std::pair<size_t, size_t>> offsets_;
+  std::map<std::string, std::string> json_buffers_;  // decoded JSON-mode data
+};
+
+}  // namespace
+
+struct InferenceServerHttpClient::AsyncRequest {
+  CURL* easy = nullptr;
+  struct curl_slist* headers = nullptr;
+  std::string body;
+  std::string response;
+  HeaderCapture capture;
+  OnComplete callback;
+  RequestTimers timers;
+};
+
+Error InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client,
+    const std::string& server_url, bool verbose) {
+  client->reset(new InferenceServerHttpClient(server_url, verbose));
+  return Error::Success();
+}
+
+InferenceServerHttpClient::InferenceServerHttpClient(
+    const std::string& url, bool verbose)
+    : url_(url), verbose_(verbose) {
+  easy_ = curl_easy_init();
+}
+
+InferenceServerHttpClient::~InferenceServerHttpClient() {
+  exiting_ = true;
+  multi_cv_.notify_all();
+  if (worker_.joinable()) worker_.join();
+  if (multi_ != nullptr) curl_multi_cleanup(multi_);
+  if (easy_ != nullptr) curl_easy_cleanup(easy_);
+}
+
+// ---------------------------------------------------------------------------
+// transport
+// ---------------------------------------------------------------------------
+
+namespace {
+void SetCommonOptions(
+    CURL* easy, const std::string& url, const std::string* body,
+    std::string* response, HeaderCapture* capture, uint64_t timeout_us) {
+  curl_easy_setopt(easy, CURLOPT_URL, url.c_str());
+  curl_easy_setopt(easy, CURLOPT_TCP_NODELAY, 1L);
+  curl_easy_setopt(easy, CURLOPT_NOSIGNAL, 1L);
+  curl_easy_setopt(easy, CURLOPT_WRITEFUNCTION, WriteBody);
+  curl_easy_setopt(easy, CURLOPT_WRITEDATA, response);
+  curl_easy_setopt(easy, CURLOPT_HEADERFUNCTION, WriteHeader);
+  curl_easy_setopt(easy, CURLOPT_HEADERDATA, capture);
+  if (body != nullptr) {
+    curl_easy_setopt(easy, CURLOPT_POST, 1L);
+    curl_easy_setopt(easy, CURLOPT_POSTFIELDS, body->data());
+    curl_easy_setopt(
+        easy, CURLOPT_POSTFIELDSIZE_LARGE,
+        static_cast<curl_off_t>(body->size()));
+  } else {
+    curl_easy_setopt(easy, CURLOPT_HTTPGET, 1L);
+  }
+  if (timeout_us != 0) {
+    curl_easy_setopt(
+        easy, CURLOPT_TIMEOUT_MS, static_cast<long>(timeout_us / 1000));
+  }
+}
+}  // namespace
+
+Error InferenceServerHttpClient::Perform(
+    const std::string& path, const std::string* body, long* http_code,
+    std::string* response) {
+  std::lock_guard<std::mutex> lock(easy_mutex_);
+  curl_easy_reset(easy_);
+  HeaderCapture capture;
+  SetCommonOptions(easy_, url_ + "/" + path, body, response, &capture, 0);
+  CURLcode code = curl_easy_perform(easy_);
+  if (code != CURLE_OK) {
+    return Error(std::string("HTTP request failed: ") + curl_easy_strerror(code));
+  }
+  curl_easy_getinfo(easy_, CURLINFO_RESPONSE_CODE, http_code);
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::Get(
+    const std::string& path, long* http_code, std::string* response) {
+  return Perform(path, nullptr, http_code, response);
+}
+
+Error InferenceServerHttpClient::Post(
+    const std::string& path, const std::string& body, long* http_code,
+    std::string* response) {
+  return Perform(path, &body, http_code, response);
+}
+
+Error InferenceServerHttpClient::GetJson(const std::string& path, Json* out) {
+  long http_code = 0;
+  std::string response;
+  Error err = Get(path, &http_code, &response);
+  if (err) return err;
+  err = ErrorFromResponse(http_code, response);
+  if (err) return err;
+  if (response.empty()) {
+    *out = Json::Object();
+    return Error::Success();
+  }
+  std::string perr;
+  if (!Json::Parse(response, out, &perr)) {
+    return Error("failed to parse response: " + perr);
+  }
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::PostJson(
+    const std::string& path, const std::string& body, Json* out) {
+  long http_code = 0;
+  std::string response;
+  Error err = Post(path, body, &http_code, &response);
+  if (err) return err;
+  err = ErrorFromResponse(http_code, response);
+  if (err) return err;
+  if (out != nullptr && !response.empty()) {
+    std::string perr;
+    if (!Json::Parse(response, out, &perr)) {
+      return Error("failed to parse response: " + perr);
+    }
+  } else if (out != nullptr) {
+    *out = Json::Object();
+  }
+  return Error::Success();
+}
+
+// ---------------------------------------------------------------------------
+// admin surface
+// ---------------------------------------------------------------------------
+
+Error InferenceServerHttpClient::IsServerLive(bool* live) {
+  long http_code = 0;
+  std::string response;
+  Error err = Get("v2/health/live", &http_code, &response);
+  *live = err.IsOk() && http_code == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::IsServerReady(bool* ready) {
+  long http_code = 0;
+  std::string response;
+  Error err = Get("v2/health/ready", &http_code, &response);
+  *ready = err.IsOk() && http_code == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  long http_code = 0;
+  std::string response;
+  Error err = Get(path + "/ready", &http_code, &response);
+  *ready = err.IsOk() && http_code == 200;
+  return err;
+}
+
+Error InferenceServerHttpClient::ServerMetadata(Json* metadata) {
+  return GetJson("v2", metadata);
+}
+
+Error InferenceServerHttpClient::ModelMetadata(
+    Json* metadata, const std::string& model_name,
+    const std::string& model_version) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  return GetJson(path, metadata);
+}
+
+Error InferenceServerHttpClient::ModelConfig(
+    Json* config, const std::string& model_name,
+    const std::string& model_version) {
+  std::string path = "v2/models/" + model_name;
+  if (!model_version.empty()) path += "/versions/" + model_version;
+  return GetJson(path + "/config", config);
+}
+
+Error InferenceServerHttpClient::ModelRepositoryIndex(Json* index) {
+  return PostJson("v2/repository/index", "", index);
+}
+
+Error InferenceServerHttpClient::LoadModel(
+    const std::string& model_name, const std::string& config,
+    const std::map<std::string, std::vector<char>>& files) {
+  Json body = Json::Object();
+  Json params = Json::Object();
+  if (!config.empty()) params.Set("config", Json(config));
+  for (const auto& kv : files) {
+    params.Set(
+        kv.first, Json(Base64Encode(
+                      reinterpret_cast<const uint8_t*>(kv.second.data()),
+                      kv.second.size())));
+  }
+  if (!params.items().empty()) body.Set("parameters", std::move(params));
+  return PostJson(
+      "v2/repository/models/" + model_name + "/load", body.Dump(), nullptr);
+}
+
+Error InferenceServerHttpClient::UnloadModel(const std::string& model_name) {
+  return PostJson(
+      "v2/repository/models/" + model_name + "/unload", "{}", nullptr);
+}
+
+Error InferenceServerHttpClient::ModelInferenceStatistics(
+    Json* stats, const std::string& model_name,
+    const std::string& model_version) {
+  std::string path;
+  if (!model_name.empty()) {
+    path = "v2/models/" + model_name;
+    if (!model_version.empty()) path += "/versions/" + model_version;
+    path += "/stats";
+  } else {
+    path = "v2/models/stats";
+  }
+  return GetJson(path, stats);
+}
+
+Error InferenceServerHttpClient::UpdateTraceSettings(
+    Json* response, const std::string& model_name, const Json& settings) {
+  std::string path = model_name.empty()
+                         ? "v2/trace/setting"
+                         : "v2/models/" + model_name + "/trace/setting";
+  return PostJson(path, settings.Dump(), response);
+}
+
+Error InferenceServerHttpClient::GetTraceSettings(
+    Json* settings, const std::string& model_name) {
+  std::string path = model_name.empty()
+                         ? "v2/trace/setting"
+                         : "v2/models/" + model_name + "/trace/setting";
+  return GetJson(path, settings);
+}
+
+Error InferenceServerHttpClient::UpdateLogSettings(
+    Json* response, const Json& settings) {
+  return PostJson("v2/logging", settings.Dump(), response);
+}
+
+Error InferenceServerHttpClient::GetLogSettings(Json* settings) {
+  return GetJson("v2/logging", settings);
+}
+
+Error InferenceServerHttpClient::ShmStatus(
+    const std::string& family, const std::string& name, Json* out) {
+  std::string path = "v2/" + family;
+  if (!name.empty()) path += "/region/" + name;
+  return GetJson(path + "/status", out);
+}
+
+Error InferenceServerHttpClient::ShmRegisterHandle(
+    const std::string& family, const std::string& name,
+    const std::string& raw_handle_b64, int device_id, size_t byte_size) {
+  Json body = Json::Object();
+  Json handle = Json::Object();
+  handle.Set("b64", Json(raw_handle_b64));
+  body.Set("raw_handle", std::move(handle));
+  body.Set("device_id", Json(static_cast<int64_t>(device_id)));
+  body.Set("byte_size", Json(static_cast<int64_t>(byte_size)));
+  return PostJson(
+      "v2/" + family + "/region/" + name + "/register", body.Dump(), nullptr);
+}
+
+Error InferenceServerHttpClient::ShmUnregister(
+    const std::string& family, const std::string& name) {
+  std::string path = "v2/" + family;
+  if (!name.empty()) path += "/region/" + name;
+  return PostJson(path + "/unregister", "", nullptr);
+}
+
+Error InferenceServerHttpClient::SystemSharedMemoryStatus(
+    Json* status, const std::string& name) {
+  return ShmStatus("systemsharedmemory", name, status);
+}
+
+Error InferenceServerHttpClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset) {
+  Json body = Json::Object();
+  body.Set("key", Json(key));
+  body.Set("offset", Json(static_cast<int64_t>(offset)));
+  body.Set("byte_size", Json(static_cast<int64_t>(byte_size)));
+  return PostJson(
+      "v2/systemsharedmemory/region/" + name + "/register", body.Dump(),
+      nullptr);
+}
+
+Error InferenceServerHttpClient::UnregisterSystemSharedMemory(
+    const std::string& name) {
+  return ShmUnregister("systemsharedmemory", name);
+}
+
+Error InferenceServerHttpClient::TpuSharedMemoryStatus(
+    Json* status, const std::string& name) {
+  return ShmStatus("tpusharedmemory", name, status);
+}
+
+Error InferenceServerHttpClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle_b64, int device_id,
+    size_t byte_size) {
+  return ShmRegisterHandle(
+      "tpusharedmemory", name, raw_handle_b64, device_id, byte_size);
+}
+
+Error InferenceServerHttpClient::UnregisterTpuSharedMemory(
+    const std::string& name) {
+  return ShmUnregister("tpusharedmemory", name);
+}
+
+Error InferenceServerHttpClient::CudaSharedMemoryStatus(
+    Json* status, const std::string& name) {
+  return ShmStatus("cudasharedmemory", name, status);
+}
+
+Error InferenceServerHttpClient::RegisterCudaSharedMemory(
+    const std::string& name, const std::string& raw_handle_b64, int device_id,
+    size_t byte_size) {
+  return ShmRegisterHandle(
+      "cudasharedmemory", name, raw_handle_b64, device_id, byte_size);
+}
+
+Error InferenceServerHttpClient::UnregisterCudaSharedMemory(
+    const std::string& name) {
+  return ShmUnregister("cudasharedmemory", name);
+}
+
+// ---------------------------------------------------------------------------
+// inference
+// ---------------------------------------------------------------------------
+
+Error InferenceServerHttpClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
+
+  std::string body;
+  size_t header_length = BuildInferBody(options, inputs, outputs, &body);
+  std::string uri = url_ + "/v2/models/" + options.model_name;
+  if (!options.model_version.empty()) {
+    uri += "/versions/" + options.model_version;
+  }
+  uri += "/infer";
+
+  std::string response;
+  HeaderCapture capture;
+  long http_code = 0;
+  {
+    std::lock_guard<std::mutex> lock(easy_mutex_);
+    curl_easy_reset(easy_);
+    SetCommonOptions(
+        easy_, uri, &body, &response, &capture, options.client_timeout_us);
+    struct curl_slist* headers = nullptr;
+    std::string hlen =
+        "Inference-Header-Content-Length: " + std::to_string(header_length);
+    headers = curl_slist_append(headers, hlen.c_str());
+    headers =
+        curl_slist_append(headers, "Content-Type: application/octet-stream");
+    headers = curl_slist_append(headers, "Expect:");
+    curl_easy_setopt(easy_, CURLOPT_HTTPHEADER, headers);
+
+    timers.Capture(RequestTimers::Kind::SEND_START);
+    CURLcode code = curl_easy_perform(easy_);
+    timers.Capture(RequestTimers::Kind::SEND_END);
+    curl_slist_free_all(headers);
+    if (code == CURLE_OPERATION_TIMEDOUT) {
+      return Error("Deadline Exceeded");
+    }
+    if (code != CURLE_OK) {
+      return Error(
+          std::string("HTTP request failed: ") + curl_easy_strerror(code));
+    }
+    curl_easy_getinfo(easy_, CURLINFO_RESPONSE_CODE, &http_code);
+  }
+
+  timers.Capture(RequestTimers::Kind::RECV_START);
+  Error err = InferResultHttp::Create(
+      result, std::move(response), capture.header_length, http_code);
+  timers.Capture(RequestTimers::Kind::RECV_END);
+  timers.Capture(RequestTimers::Kind::REQUEST_END);
+  {
+    std::lock_guard<std::mutex> lock(stat_mutex_);
+    infer_stat_.Update(timers);
+  }
+  if (err) return err;
+  return (*result)->RequestStatus();
+}
+
+Error InferenceServerHttpClient::AsyncInfer(
+    OnComplete callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  {
+    // guarded lazy start: two first-AsyncInfer threads must not both init
+    std::lock_guard<std::mutex> lock(multi_mutex_);
+    if (multi_ == nullptr) {
+      multi_ = curl_multi_init();
+      worker_ = std::thread(&InferenceServerHttpClient::AsyncTransfer, this);
+    }
+  }
+
+  auto* request = new AsyncRequest();
+  request->callback = std::move(callback);
+  request->timers.Capture(RequestTimers::Kind::REQUEST_START);
+  size_t header_length =
+      BuildInferBody(options, inputs, outputs, &request->body);
+
+  std::string uri = url_ + "/v2/models/" + options.model_name;
+  if (!options.model_version.empty()) {
+    uri += "/versions/" + options.model_version;
+  }
+  uri += "/infer";
+
+  request->easy = curl_easy_init();
+  SetCommonOptions(
+      request->easy, uri, &request->body, &request->response,
+      &request->capture, options.client_timeout_us);
+  std::string hlen =
+      "Inference-Header-Content-Length: " + std::to_string(header_length);
+  request->headers = curl_slist_append(nullptr, hlen.c_str());
+  request->headers = curl_slist_append(
+      request->headers, "Content-Type: application/octet-stream");
+  request->headers = curl_slist_append(request->headers, "Expect:");
+  curl_easy_setopt(request->easy, CURLOPT_HTTPHEADER, request->headers);
+  curl_easy_setopt(request->easy, CURLOPT_PRIVATE, request);
+
+  {
+    std::lock_guard<std::mutex> lock(multi_mutex_);
+    pending_.push_back(request);
+  }
+  multi_cv_.notify_one();
+  return Error::Success();
+}
+
+void InferenceServerHttpClient::AsyncTransfer() {
+  int in_flight = 0;
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lock(multi_mutex_);
+      if (pending_.empty() && in_flight == 0) {
+        // drain fully before exiting: queued-but-unadded requests must
+        // still run their callbacks
+        if (exiting_) break;
+        multi_cv_.wait_for(lock, std::chrono::milliseconds(100));
+        if (exiting_ && pending_.empty()) break;
+      }
+      while (!pending_.empty()) {
+        AsyncRequest* request = pending_.front();
+        pending_.pop_front();
+        request->timers.Capture(RequestTimers::Kind::SEND_START);
+        curl_multi_add_handle(multi_, request->easy);
+        ++in_flight;
+      }
+    }
+    int running = 0;
+    curl_multi_perform(multi_, &running);
+    int msgs = 0;
+    while (CURLMsg* msg = curl_multi_info_read(multi_, &msgs)) {
+      if (msg->msg != CURLMSG_DONE) continue;
+      AsyncRequest* request = nullptr;
+      curl_easy_getinfo(
+          msg->easy_handle, CURLINFO_PRIVATE,
+          reinterpret_cast<char**>(&request));
+      long http_code = 0;
+      curl_easy_getinfo(msg->easy_handle, CURLINFO_RESPONSE_CODE, &http_code);
+      request->timers.Capture(RequestTimers::Kind::SEND_END);
+      request->timers.Capture(RequestTimers::Kind::RECV_START);
+      InferResult* result = nullptr;
+      if (msg->data.result == CURLE_OPERATION_TIMEDOUT) {
+        http_code = 499;
+        request->response = "{\"error\":\"Deadline Exceeded\"}";
+      } else if (msg->data.result != CURLE_OK) {
+        request->response = std::string("{\"error\":\"") +
+                            curl_easy_strerror(msg->data.result) + "\"}";
+        http_code = http_code >= 400 ? http_code : 500;
+      }
+      InferResultHttp::Create(
+          &result, std::move(request->response),
+          request->capture.header_length, http_code);
+      request->timers.Capture(RequestTimers::Kind::RECV_END);
+      request->timers.Capture(RequestTimers::Kind::REQUEST_END);
+      {
+        std::lock_guard<std::mutex> lock(stat_mutex_);
+        infer_stat_.Update(request->timers);
+      }
+      curl_multi_remove_handle(multi_, msg->easy_handle);
+      curl_easy_cleanup(request->easy);
+      curl_slist_free_all(request->headers);
+      request->callback(result);
+      delete request;
+      --in_flight;
+    }
+    if (running > 0) {
+      curl_multi_wait(multi_, nullptr, 0, 50, nullptr);
+    }
+  }
+}
+
+InferStat InferenceServerHttpClient::ClientInferStat() {
+  std::lock_guard<std::mutex> lock(stat_mutex_);
+  return infer_stat_;
+}
+
+}  // namespace client_tpu

@@ -1,0 +1,294 @@
+#include "client_tpu/json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace client_tpu {
+
+namespace {
+const Json kNullJson;
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::string error;
+
+  void SkipWs() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+
+  bool Fail(const std::string& msg) {
+    error = msg;
+    return false;
+  }
+
+  bool ParseValue(Json* out) {
+    SkipWs();
+    if (p >= end) return Fail("unexpected end of input");
+    switch (*p) {
+      case '{':
+        return ParseObject(out);
+      case '[':
+        return ParseArray(out);
+      case '"': {
+        std::string s;
+        if (!ParseString(&s)) return false;
+        *out = Json(s);
+        return true;
+      }
+      case 't':
+        if (end - p >= 4 && strncmp(p, "true", 4) == 0) {
+          p += 4;
+          *out = Json(true);
+          return true;
+        }
+        return Fail("bad literal");
+      case 'f':
+        if (end - p >= 5 && strncmp(p, "false", 5) == 0) {
+          p += 5;
+          *out = Json(false);
+          return true;
+        }
+        return Fail("bad literal");
+      case 'n':
+        if (end - p >= 4 && strncmp(p, "null", 4) == 0) {
+          p += 4;
+          *out = Json();
+          return true;
+        }
+        return Fail("bad literal");
+      default:
+        return ParseNumber(out);
+    }
+  }
+
+  bool ParseString(std::string* out) {
+    if (*p != '"') return Fail("expected string");
+    ++p;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= end) return Fail("bad escape");
+        switch (*p) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            if (end - p < 5) return Fail("bad \\u escape");
+            unsigned int code = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char c = p[i];
+              code <<= 4;
+              if (c >= '0' && c <= '9') code |= c - '0';
+              else if (c >= 'a' && c <= 'f') code |= c - 'a' + 10;
+              else if (c >= 'A' && c <= 'F') code |= c - 'A' + 10;
+              else return Fail("bad \\u escape");
+            }
+            p += 4;
+            // UTF-8 encode the BMP code point (surrogate pairs unsupported)
+            if (code < 0x80) {
+              out->push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return Fail("bad escape");
+        }
+        ++p;
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= end) return Fail("unterminated string");
+    ++p;  // closing quote
+    return true;
+  }
+
+  bool ParseNumber(Json* out) {
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    bool is_double = false;
+    while (p < end && (isdigit(*p) || *p == '.' || *p == 'e' || *p == 'E' ||
+                       *p == '-' || *p == '+')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') is_double = true;
+      ++p;
+    }
+    if (p == start) return Fail("expected number");
+    std::string num(start, p - start);
+    if (is_double) {
+      *out = Json(strtod(num.c_str(), nullptr));
+    } else {
+      *out = Json(static_cast<int64_t>(strtoll(num.c_str(), nullptr, 10)));
+    }
+    return true;
+  }
+
+  bool ParseObject(Json* out) {
+    *out = Json::Object();
+    ++p;  // '{'
+    SkipWs();
+    if (p < end && *p == '}') {
+      ++p;
+      return true;
+    }
+    while (p < end) {
+      SkipWs();
+      std::string key;
+      if (!ParseString(&key)) return false;
+      SkipWs();
+      if (p >= end || *p != ':') return Fail("expected ':'");
+      ++p;
+      Json value;
+      if (!ParseValue(&value)) return false;
+      out->Set(key, std::move(value));
+      SkipWs();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == '}') {
+        ++p;
+        return true;
+      }
+      return Fail("expected ',' or '}'");
+    }
+    return Fail("unterminated object");
+  }
+
+  bool ParseArray(Json* out) {
+    *out = Json::Array();
+    ++p;  // '['
+    SkipWs();
+    if (p < end && *p == ']') {
+      ++p;
+      return true;
+    }
+    while (p < end) {
+      Json value;
+      if (!ParseValue(&value)) return false;
+      out->Append(std::move(value));
+      SkipWs();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        ++p;
+        return true;
+      }
+      return Fail("expected ',' or ']'");
+    }
+    return Fail("unterminated array");
+  }
+};
+
+void DumpString(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(c);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void DumpValue(const Json& j, std::string* out) {
+  switch (j.type()) {
+    case Json::Type::kNull:
+      *out += "null";
+      break;
+    case Json::Type::kBool:
+      *out += j.AsBool() ? "true" : "false";
+      break;
+    case Json::Type::kInt: {
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(j.AsInt()));
+      *out += buf;
+      break;
+    }
+    case Json::Type::kDouble: {
+      char buf[64];
+      snprintf(buf, sizeof(buf), "%.17g", j.AsDouble());
+      *out += buf;
+      break;
+    }
+    case Json::Type::kString:
+      DumpString(j.AsString(), out);
+      break;
+    case Json::Type::kArray: {
+      out->push_back('[');
+      for (size_t i = 0; i < j.size(); ++i) {
+        if (i) out->push_back(',');
+        DumpValue(j[i], out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Json::Type::kObject: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& kv : j.items()) {
+        if (!first) out->push_back(',');
+        first = false;
+        DumpString(kv.first, out);
+        out->push_back(':');
+        DumpValue(kv.second, out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+const Json& Json::At(const std::string& key) const {
+  auto it = object_.find(key);
+  return it == object_.end() ? kNullJson : it->second;
+}
+
+std::string Json::Dump() const {
+  std::string out;
+  DumpValue(*this, &out);
+  return out;
+}
+
+bool Json::Parse(const std::string& text, Json* out, std::string* error) {
+  Parser parser{text.data(), text.data() + text.size()};
+  if (!parser.ParseValue(out)) {
+    if (error) *error = parser.error;
+    return false;
+  }
+  return true;
+}
+
+}  // namespace client_tpu

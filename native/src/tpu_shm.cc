@@ -1,0 +1,118 @@
+#include "client_tpu/tpu_shm.h"
+
+#include <unistd.h>
+
+#include <cstring>
+#include <random>
+
+#include "client_tpu/base64.h"
+#include "client_tpu/json.h"
+#include "client_tpu/shm_utils.h"
+
+namespace client_tpu {
+
+namespace {
+std::string RandomKey() {
+  static const char hex[] = "0123456789abcdef";
+  std::random_device rd;
+  std::string key = "tpushm_";
+  for (int i = 0; i < 12; ++i) key.push_back(hex[rd() % 16]);
+  return key;
+}
+}  // namespace
+
+Error TpuShmRegion::Create(
+    TpuShmRegion** region, const std::string& name, size_t byte_size,
+    int device_id, const std::string& shm_key) {
+  auto* r = new TpuShmRegion();
+  r->name_ = name;
+  r->shm_key_ = shm_key.empty() ? RandomKey() : shm_key;
+  r->byte_size_ = byte_size;
+  r->device_id_ = device_id;
+  r->owned_ = true;
+  // multiprocessing.shared_memory uses "/<name>" POSIX keys; match it
+  std::string posix_key = "/" + r->shm_key_;
+  Error err = CreateSharedMemoryRegion(posix_key, byte_size, &r->fd_);
+  if (err) {
+    delete r;
+    return err;
+  }
+  err = MapSharedMemory(r->fd_, 0, byte_size, &r->addr_);
+  if (err) {
+    CloseSharedMemory(r->fd_);
+    UnlinkSharedMemoryRegion(posix_key);
+    delete r;
+    return err;
+  }
+  *region = r;
+  return Error::Success();
+}
+
+Error TpuShmRegion::Attach(TpuShmRegion** region, const std::string& raw_handle) {
+  std::vector<uint8_t> decoded;
+  if (!Base64Decode(raw_handle, &decoded)) {
+    return Error("invalid tpu shared-memory raw handle: not base64");
+  }
+  Json desc;
+  std::string parse_error;
+  if (!Json::Parse(
+          std::string(decoded.begin(), decoded.end()), &desc, &parse_error)) {
+    return Error("invalid tpu shared-memory raw handle: " + parse_error);
+  }
+  auto* r = new TpuShmRegion();
+  r->shm_key_ = desc.At("shm_key").AsString();
+  r->name_ = desc.Has("name") ? desc.At("name").AsString() : r->shm_key_;
+  r->byte_size_ = static_cast<size_t>(desc.At("byte_size").AsInt());
+  r->device_id_ = static_cast<int>(desc.At("device_id").AsInt());
+  r->owned_ = false;
+  std::string posix_key = "/" + r->shm_key_;
+  Error err = OpenSharedMemoryRegion(posix_key, &r->fd_);
+  if (err) {
+    delete r;
+    return err;
+  }
+  err = MapSharedMemory(r->fd_, 0, r->byte_size_, &r->addr_);
+  if (err) {
+    CloseSharedMemory(r->fd_);
+    delete r;
+    return err;
+  }
+  *region = r;
+  return Error::Success();
+}
+
+TpuShmRegion::~TpuShmRegion() {
+  if (addr_ != nullptr) UnmapSharedMemory(addr_, byte_size_);
+  if (fd_ != -1) CloseSharedMemory(fd_);
+  if (owned_) UnlinkSharedMemoryRegion("/" + shm_key_);
+}
+
+std::string TpuShmRegion::RawHandle() const {
+  Json desc = Json::Object();
+  desc.Set("kind", Json("tpu_shared_memory"));
+  desc.Set("shm_key", Json(shm_key_));
+  desc.Set("byte_size", Json(static_cast<int64_t>(byte_size_)));
+  desc.Set("device_id", Json(static_cast<int64_t>(device_id_)));
+  desc.Set("colocated", Json(false));
+  std::string text = desc.Dump();
+  return Base64Encode(text);
+}
+
+Error TpuShmRegion::Write(const void* src, size_t byte_size, size_t offset) {
+  // overflow-safe: offset + byte_size could wrap for hostile offsets
+  if (offset > byte_size_ || byte_size > byte_size_ - offset) {
+    return Error("tpu shared-memory write exceeds region size");
+  }
+  std::memcpy(Data() + offset, src, byte_size);
+  return Error::Success();
+}
+
+Error TpuShmRegion::Read(void* dst, size_t byte_size, size_t offset) const {
+  if (offset > byte_size_ || byte_size > byte_size_ - offset) {
+    return Error("tpu shared-memory read exceeds region size");
+  }
+  std::memcpy(dst, Data() + offset, byte_size);
+  return Error::Success();
+}
+
+}  // namespace client_tpu

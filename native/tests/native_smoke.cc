@@ -1,0 +1,298 @@
+// Offline + online smoke tests for the native library.
+// Offline: json/base64/BYTES-serialization/shm/tpu-shm round trips.
+// Online (CLIENT_TPU_TEST_URL set, e.g. 127.0.0.1:8000): full client flow
+// against a live v2 server — health, metadata, sync Infer, AsyncInfer,
+// system + tpu shared-memory inference.
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "client_tpu/base64.h"
+#include "client_tpu/common.h"
+#include "client_tpu/http_client.h"
+#include "client_tpu/json.h"
+#include "client_tpu/shm_utils.h"
+#include "client_tpu/tpu_shm.h"
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAILED at %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+#define CHECK_OK(expr)                                                  \
+  do {                                                                  \
+    client_tpu::Error err_ = (expr);                                    \
+    if (err_) {                                                         \
+      fprintf(stderr, "FAILED at %s:%d: %s\n", __FILE__, __LINE__,      \
+              err_.Message().c_str());                                  \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+using namespace client_tpu;
+
+void TestJson() {
+  Json parsed;
+  std::string error;
+  CHECK(Json::Parse(
+      R"({"a": 1, "b": [1.5, "x\n", true, null], "c": {"d": -3}})", &parsed,
+      &error));
+  CHECK(parsed.At("a").AsInt() == 1);
+  CHECK(parsed.At("b").size() == 4);
+  CHECK(parsed.At("b")[0].AsDouble() == 1.5);
+  CHECK(parsed.At("b")[1].AsString() == "x\n");
+  CHECK(parsed.At("b")[2].AsBool());
+  CHECK(parsed.At("b")[3].is_null());
+  CHECK(parsed.At("c").At("d").AsInt() == -3);
+  // round trip
+  Json again;
+  CHECK(Json::Parse(parsed.Dump(), &again, &error));
+  CHECK(again.At("c").At("d").AsInt() == -3);
+  CHECK(!Json::Parse("{bad", &again, &error));
+  printf("ok json\n");
+}
+
+void TestBase64() {
+  const uint8_t data[] = {0x00, 0x01, 0xFE, 0xFF, 0x7F, 0x80, 0x41};
+  std::string encoded = Base64Encode(data, sizeof(data));
+  std::vector<uint8_t> decoded;
+  CHECK(Base64Decode(encoded, &decoded));
+  CHECK(decoded.size() == sizeof(data));
+  CHECK(memcmp(decoded.data(), data, sizeof(data)) == 0);
+  CHECK(Base64Encode(reinterpret_cast<const uint8_t*>("ab"), 2) == "YWI=");
+  printf("ok base64\n");
+}
+
+void TestStringsSerialization() {
+  std::vector<std::string> input = {"hello", "", std::string("\x00\x01", 2)};
+  std::string serialized;
+  SerializeStrings(input, &serialized);
+  CHECK(serialized.size() == 4 * 3 + 5 + 0 + 2);
+  std::vector<std::string> output;
+  CHECK_OK(DeserializeStrings(
+      reinterpret_cast<const uint8_t*>(serialized.data()), serialized.size(),
+      &output));
+  CHECK(output == input);
+  std::vector<std::string> bad;
+  CHECK(DeserializeStrings(
+      reinterpret_cast<const uint8_t*>("\x05\x00\x00\x00"), 4, &bad));
+  printf("ok strings\n");
+}
+
+void TestShm() {
+  const char* key = "/ctpu_native_smoke";
+  int fd = -1;
+  CHECK_OK(CreateSharedMemoryRegion(key, 256, &fd));
+  void* addr = nullptr;
+  CHECK_OK(MapSharedMemory(fd, 0, 256, &addr));
+  memcpy(addr, "native", 6);
+
+  int fd2 = -1;
+  CHECK_OK(OpenSharedMemoryRegion(key, &fd2));
+  void* addr2 = nullptr;
+  CHECK_OK(MapSharedMemory(fd2, 0, 256, &addr2));
+  CHECK(memcmp(addr2, "native", 6) == 0);
+
+  CHECK_OK(UnmapSharedMemory(addr, 256));
+  CHECK_OK(UnmapSharedMemory(addr2, 256));
+  CHECK_OK(CloseSharedMemory(fd));
+  CHECK_OK(CloseSharedMemory(fd2));
+  CHECK_OK(UnlinkSharedMemoryRegion(key));
+  printf("ok shm\n");
+}
+
+void TestTpuShm() {
+  TpuShmRegion* region = nullptr;
+  CHECK_OK(TpuShmRegion::Create(&region, "native_region", 128));
+  int32_t values[4] = {1, 2, 3, 4};
+  CHECK_OK(region->Write(values, sizeof(values)));
+  // attach through the serialized handle (the cross-process path)
+  std::string handle = region->RawHandle();
+  TpuShmRegion* attached = nullptr;
+  CHECK_OK(TpuShmRegion::Attach(&attached, handle));
+  int32_t readback[4] = {};
+  CHECK_OK(attached->Read(readback, sizeof(readback)));
+  CHECK(memcmp(values, readback, sizeof(values)) == 0);
+  CHECK(attached->ByteSize() == 128);
+  // bounds
+  CHECK(region->Write(values, sizeof(values), 126));
+  delete attached;
+  delete region;
+  printf("ok tpu_shm\n");
+}
+
+void TestOnline(const std::string& url) {
+  std::unique_ptr<InferenceServerHttpClient> client;
+  CHECK_OK(InferenceServerHttpClient::Create(&client, url));
+
+  bool live = false;
+  CHECK_OK(client->IsServerLive(&live));
+  CHECK(live);
+  bool ready = false;
+  CHECK_OK(client->IsModelReady(&ready, "simple"));
+  CHECK(ready);
+
+  Json metadata;
+  CHECK_OK(client->ServerMetadata(&metadata));
+  CHECK(!metadata.At("name").AsString().empty());
+  Json model_md;
+  CHECK_OK(client->ModelMetadata(&model_md, "simple"));
+  CHECK(model_md.At("inputs").size() == 2);
+
+  // sync infer: INT32 sum/diff
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; ++i) {
+    input0[i] = i;
+    input1[i] = 1;
+  }
+  InferInput* in0;
+  InferInput* in1;
+  InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32");
+  InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32");
+  CHECK_OK(in0->AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0)));
+  CHECK_OK(in1->AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1)));
+
+  InferOptions options("simple");
+  options.request_id = "native-1";
+  InferResult* result = nullptr;
+  CHECK_OK(client->Infer(&result, options, {in0, in1}));
+  const uint8_t* buf;
+  size_t byte_size;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &byte_size));
+  CHECK(byte_size == sizeof(input0));
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK(sums[i] == input0[i] + input1[i]);
+  std::string id;
+  CHECK_OK(result->Id(&id));
+  CHECK(id == "native-1");
+  delete result;
+  printf("ok online sync infer\n");
+
+  // async infer
+  std::mutex mu;
+  std::condition_variable cv;
+  int remaining = 4;
+  bool all_ok = true;
+  for (int r = 0; r < 4; ++r) {
+    CHECK_OK(client->AsyncInfer(
+        [&](InferResult* async_result) {
+          const uint8_t* abuf;
+          size_t asize;
+          bool ok = async_result->RequestStatus().IsOk() &&
+                    async_result->RawData("OUTPUT1", &abuf, &asize).IsOk();
+          if (ok) {
+            const int32_t* diffs = reinterpret_cast<const int32_t*>(abuf);
+            for (int i = 0; i < 16; ++i) {
+              ok = ok && diffs[i] == input0[i] - input1[i];
+            }
+          }
+          delete async_result;
+          std::lock_guard<std::mutex> lock(mu);
+          all_ok = all_ok && ok;
+          if (--remaining == 0) cv.notify_one();
+        },
+        options, {in0, in1}));
+  }
+  {
+    std::unique_lock<std::mutex> lock(mu);
+    CHECK(cv.wait_for(lock, std::chrono::seconds(30), [&] {
+      return remaining == 0;
+    }));
+  }
+  CHECK(all_ok);
+  printf("ok online async infer\n");
+
+  // JSON-mode output (binary_data=false): readable through the same accessor
+  InferRequestedOutput* json_out;
+  InferRequestedOutput::Create(&json_out, "OUTPUT0");
+  json_out->SetBinaryData(false);
+  InferResult* json_result = nullptr;
+  CHECK_OK(client->Infer(&json_result, options, {in0, in1}, {json_out}));
+  const uint8_t* jbuf;
+  size_t jsize;
+  CHECK_OK(json_result->RawData("OUTPUT0", &jbuf, &jsize));
+  CHECK(jsize == sizeof(input0));
+  const int32_t* jsums = reinterpret_cast<const int32_t*>(jbuf);
+  for (int i = 0; i < 16; ++i) CHECK(jsums[i] == input0[i] + input1[i]);
+  delete json_result;
+  delete json_out;
+  printf("ok online json-mode output\n");
+
+  // tpu shared-memory inference: inputs and outputs via regions
+  TpuShmRegion* rin = nullptr;
+  TpuShmRegion* rout = nullptr;
+  CHECK_OK(TpuShmRegion::Create(&rin, "native_in", 128));
+  CHECK_OK(TpuShmRegion::Create(&rout, "native_out", 128));
+  CHECK_OK(rin->Write(input0, 64, 0));
+  CHECK_OK(rin->Write(input1, 64, 64));
+  CHECK_OK(client->RegisterTpuSharedMemory("native_in", rin->RawHandle(), 0, 128));
+  CHECK_OK(
+      client->RegisterTpuSharedMemory("native_out", rout->RawHandle(), 0, 128));
+
+  in0->SetSharedMemory("native_in", 64, 0);
+  in1->SetSharedMemory("native_in", 64, 64);
+  InferRequestedOutput* out0;
+  InferRequestedOutput* out1;
+  InferRequestedOutput::Create(&out0, "OUTPUT0");
+  InferRequestedOutput::Create(&out1, "OUTPUT1");
+  out0->SetSharedMemory("native_out", 64, 0);
+  out1->SetSharedMemory("native_out", 64, 64);
+
+  InferResult* shm_result = nullptr;
+  CHECK_OK(client->Infer(&shm_result, options, {in0, in1}, {out0, out1}));
+  delete shm_result;
+  int32_t shm_sums[16], shm_diffs[16];
+  CHECK_OK(rout->Read(shm_sums, 64, 0));
+  CHECK_OK(rout->Read(shm_diffs, 64, 64));
+  for (int i = 0; i < 16; ++i) {
+    CHECK(shm_sums[i] == input0[i] + input1[i]);
+    CHECK(shm_diffs[i] == input0[i] - input1[i]);
+  }
+  Json status;
+  CHECK_OK(client->TpuSharedMemoryStatus(&status));
+  CHECK(status.size() == 2);
+  CHECK_OK(client->UnregisterTpuSharedMemory(""));
+  delete rin;
+  delete rout;
+  printf("ok online tpu shm infer\n");
+
+  // stats reflect the traffic
+  InferStat stat = client->ClientInferStat();
+  CHECK(stat.completed_request_count >= 6);
+  Json server_stats;
+  CHECK_OK(client->ModelInferenceStatistics(&server_stats, "simple"));
+  CHECK(server_stats.At("model_stats").size() == 1);
+
+  delete in0;
+  delete in1;
+  delete out0;
+  delete out1;
+  printf("ok online stats\n");
+}
+
+int main() {
+  TestJson();
+  TestBase64();
+  TestStringsSerialization();
+  TestShm();
+  TestTpuShm();
+  const char* url = getenv("CLIENT_TPU_TEST_URL");
+  if (url != nullptr && url[0] != '\0') {
+    TestOnline(url);
+  } else {
+    printf("skip online tests (CLIENT_TPU_TEST_URL unset)\n");
+  }
+  printf("PASS\n");
+  return 0;
+}
