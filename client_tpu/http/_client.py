@@ -493,6 +493,7 @@ class InferenceServerClient(InferenceServerClientBase):
         result = InferResult.from_response_body(
             resp.data, int(header_length) if header_length is not None else None
         )
+        result._response_headers = dict(resp.headers)  # e.g. endpoint-load-metrics
         timers.capture(RequestTimers.REQUEST_END)
         self._infer_stat.update(timers)
         if self._verbose:

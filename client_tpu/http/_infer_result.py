@@ -52,6 +52,16 @@ class InferResult:
     def get_response(self) -> Dict[str, Any]:
         return self._response
 
+    def get_response_header(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        """A transport response header (e.g. ORCA's ``endpoint-load-metrics``)."""
+        headers = getattr(self, "_response_headers", None)
+        if not headers:
+            return default
+        for key, value in headers.items():
+            if key.lower() == name.lower():
+                return value
+        return default
+
     def get_output(self, name: str) -> Optional[Dict[str, Any]]:
         for output in self._response.get("outputs", []):
             if output["name"] == name:

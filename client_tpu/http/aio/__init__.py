@@ -275,6 +275,7 @@ class InferenceServerClient(InferenceServerClientBase):
         result = InferResult.from_response_body(
             data, int(header_length) if header_length is not None else None
         )
+        result._response_headers = resp_headers  # e.g. endpoint-load-metrics
         if self._verbose:
             print(result.get_response())
         return result

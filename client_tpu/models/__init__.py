@@ -9,6 +9,7 @@ TPU VM: the same wire contracts, but the compute runs on XLA.
 """
 
 from .base import Model, TensorSpec
+from .ensemble import EnsembleModel, EnsembleStep, build_image_ensemble
 from .simple import (
     AddSubModel,
     IdentityModel,
@@ -20,11 +21,14 @@ from .simple import (
 
 __all__ = [
     "AddSubModel",
+    "EnsembleModel",
+    "EnsembleStep",
     "IdentityModel",
     "Model",
     "RepeatModel",
     "SequenceAccumulatorModel",
     "StringAddSubModel",
     "TensorSpec",
+    "build_image_ensemble",
     "default_model_zoo",
 ]

@@ -70,6 +70,37 @@ def _build_flax_model(num_classes: int, width: int = 32):
     return DenseNetish(num_classes=num_classes, width=width)
 
 
+class ImagePreprocessModel(Model):
+    """``preprocess``: raw UINT8 HWC image -> normalized FP32 CHW [3,224,224].
+
+    The ensemble front stage (reference: the DALI/preprocess member of
+    ensemble_image_client's pipeline): nearest-neighbor resize + INCEPTION
+    scaling fused on-device via the Pallas normalize kernel.
+    """
+
+    name = "preprocess"
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("raw_image", "UINT8", [-1, -1, 3])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [TensorSpec("preprocessed", "FP32", [3, 224, 224])]
+
+    def execute(self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]):
+        from ..ops import normalize_image
+
+        img = np.asarray(inputs["raw_image"]).astype(np.float32)
+        h, w = img.shape[0], img.shape[1]
+        if (h, w) != (224, 224):
+            ys = np.linspace(0, h - 1, 224).astype(int)
+            xs = np.linspace(0, w - 1, 224).astype(int)
+            img = img[ys][:, xs]
+        arr = np.asarray(
+            normalize_image(img, scale=2.0 / 255.0, shift=-1.0, out_dtype=np.float32)
+        )
+        return {"preprocessed": np.ascontiguousarray(np.transpose(arr, (2, 0, 1)))}
+
+
 class DenseNetModel(Model):
     """Server-side vision model with the densenet_onnx wire contract."""
 

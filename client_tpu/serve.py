@@ -39,9 +39,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.identity_fp32:
         models.append(IdentityModel("identity_fp32", "FP32"))
     if args.vision:
-        from .models.vision import DenseNetModel
+        from .models.ensemble import build_image_ensemble
 
-        models.append(DenseNetModel())
+        models.extend(build_image_ensemble())
     core = ServerCore(models)
 
     servers = []

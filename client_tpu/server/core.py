@@ -251,6 +251,8 @@ class ServerCore:
         with self._lock:
             self._models[model.name] = model
             self._stats.setdefault(model.name, _ModelStats())
+        if hasattr(model, "bind"):  # ensembles resolve members at execute time
+            model.bind(self.model)
 
     def model(self, name: str, version: str = "") -> Model:
         m = self._models.get(name)
@@ -316,6 +318,25 @@ class ServerCore:
             m = self.model(n)
             out.append(self._stats[n].as_dict(n, version or m.versions[-1]))
         return {"model_stats": out}
+
+    def orca_report(self, fmt: str, model_name: str = "") -> str:
+        """Per-response load metrics in ORCA json or text form."""
+        stats = self._stats.get(model_name)
+        count = infer_ns = 0
+        if stats is not None:
+            with stats.lock:
+                count = stats.inference_count
+                infer_ns = (
+                    stats.compute_infer[1] // max(stats.compute_infer[0], 1)
+                )
+        metrics = {
+            "inference_count": count,
+            "avg_compute_infer_us": infer_ns // 1000,
+            "active_models": len(self._models),
+        }
+        if fmt == "json":
+            return json.dumps({"named_metrics": metrics}, separators=(",", ":"))
+        return ", ".join(f"named_metrics.{k}={v}" for k, v in metrics.items())
 
     # -- shared memory -----------------------------------------------------
     def register_system_region(self, name: str, key: str, offset: int, byte_size: int) -> None:

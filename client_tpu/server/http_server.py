@@ -349,6 +349,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "Content-Type": "application/octet-stream",
                 "Inference-Header-Content-Length": str(json_size),
             }
+        # ORCA per-response load metrics (reference README.md:354-369): the
+        # client opts in via the endpoint-load-metrics-format request header
+        orca_format = self.headers.get("endpoint-load-metrics-format")
+        if orca_format in ("json", "text"):
+            headers["endpoint-load-metrics"] = self.core.orca_report(
+                orca_format, model_name
+            )
         self._send(200, body_out, headers)
 
 

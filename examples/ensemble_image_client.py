@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Ensemble pipeline client: raw image in, classification out.
+
+Equivalent of the reference's ensemble_image_client.py — the server-side
+ensemble (`ensemble_image`: preprocess -> densenet_onnx) takes the raw UINT8
+HWC image; no client-side preprocessing at all.
+Requires: ``python -m client_tpu.serve --vision``.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("image", nargs="?", default=None, help=".npy HWC uint8 image")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-i", "--protocol", choices=("http", "grpc"), default="http")
+    parser.add_argument("-c", "--classes", type=int, default=3)
+    args = parser.parse_args()
+
+    if args.protocol == "http":
+        import client_tpu.http as clientmod
+    else:
+        import client_tpu.grpc as clientmod
+
+    if args.image:
+        img = np.load(args.image).astype(np.uint8)
+    else:
+        print("no image supplied; classifying random noise")
+        img = np.random.default_rng(0).integers(0, 256, (300, 400, 3)).astype(np.uint8)
+
+    with clientmod.InferenceServerClient(args.url) as client:
+        if not client.is_model_ready("ensemble_image"):
+            sys.exit("model 'ensemble_image' not ready (serve with --vision)")
+        inp = clientmod.InferInput("IMAGE", list(img.shape), "UINT8")
+        inp.set_data_from_numpy(img)
+        outputs = [
+            clientmod.InferRequestedOutput("CLASSIFICATION", class_count=args.classes)
+        ]
+        result = client.infer("ensemble_image", [inp], outputs=outputs)
+        entries = result.as_numpy("CLASSIFICATION")
+        if entries is None or entries.size != args.classes:
+            sys.exit("ensemble error: no classification output")
+        print(f"Top {args.classes} classes (server-side preprocess + classify):")
+        for entry in entries.reshape(-1):
+            value, idx, *label = entry.decode().split(":")
+            print(f"    {float(value):.6f} ({idx}) = {label[0] if label else idx}")
+        print("PASS: ensemble_image_client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""asyncio bidi sequence streaming (equivalent of
+simple_grpc_aio_sequence_stream_infer_client.py)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import client_tpu.grpc.aio as grpcclient
+
+
+async def run(url):
+    values = [10, 20, 30]
+    async with grpcclient.InferenceServerClient(url) as client:
+        async def requests():
+            for i, v in enumerate(values):
+                inp = grpcclient.InferInput("INPUT", [1, 1], "INT32")
+                inp.set_data_from_numpy(np.array([[v]], dtype=np.int32))
+                yield {
+                    "model_name": "simple_sequence",
+                    "inputs": [inp],
+                    "sequence_id": 4001,
+                    "sequence_start": i == 0,
+                    "sequence_end": i == len(values) - 1,
+                }
+
+        stream = await client.stream_infer(requests())
+        running = []
+        async for result, error in stream:
+            if error is not None:
+                sys.exit(f"stream error: {error}")
+            running.append(int(result.as_numpy("OUTPUT")[0, 0]))
+        expected = list(np.cumsum(values))
+        if running != expected:
+            sys.exit(f"aio sequence error: {running} != {expected}")
+    print(f"PASS: aio sequence stream (partials {running})")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+    asyncio.run(run(args.url))
+
+
+if __name__ == "__main__":
+    main()

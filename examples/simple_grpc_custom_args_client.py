@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Fully custom GRPC channel args (equivalent of simple_grpc_custom_args_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    # channel_args fully replaces the defaults (reference behavior)
+    channel_args = [
+        ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+        ("grpc.primary_user_agent", "client_tpu_custom_args_example"),
+    ]
+    with grpcclient.InferenceServerClient(args.url, channel_args=channel_args) as client:
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b),
+        ]
+        result = client.infer("simple", inputs)
+        if not (result.as_numpy("OUTPUT0") == a + b).all():
+            sys.exit("custom args infer error")
+        print("PASS: custom channel args")
+
+
+if __name__ == "__main__":
+    main()

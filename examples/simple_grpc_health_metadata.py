@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Health + metadata over GRPC (equivalent of simple_grpc_health_metadata.py)."""
+
+import argparse
+import sys
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        if not (client.is_server_live() and client.is_server_ready()):
+            sys.exit("FAILED: server not live/ready")
+        if not client.is_model_ready("simple"):
+            sys.exit("FAILED: model not ready")
+        md = client.get_server_metadata()
+        print("server:", md.get("name"), md.get("version"))
+        model_md = client.get_model_metadata("simple")
+        print("model inputs:", [t["name"] for t in model_md["inputs"]])
+        cfg = client.get_model_config("simple")["config"]
+        print("backend:", cfg["backend"])
+        stats = client.get_inference_statistics("simple")
+        print("executions:", stats["model_stats"][0].get("execution_count", 0))
+        print("PASS: grpc health/metadata")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Model repository control over GRPC (equivalent of simple_grpc_model_control.py)."""
+
+import argparse
+import sys
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        index = client.get_model_repository_index()
+        print("repository:", [(m["name"], m.get("state", "")) for m in index])
+        client.unload_model("simple_string")
+        if client.is_model_ready("simple_string"):
+            sys.exit("FAILED: still ready after unload")
+        client.load_model("simple_string")
+        if not client.is_model_ready("simple_string"):
+            sys.exit("FAILED: not ready after load")
+        print("PASS: grpc model control")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""BYTES/string tensors over GRPC (equivalent of simple_grpc_string_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        in0 = np.array([[str(i) for i in range(16)]], dtype=np.object_)
+        in1 = np.array([["2"] * 16], dtype=np.object_)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "BYTES").set_data_from_numpy(in0),
+            grpcclient.InferInput("INPUT1", [1, 16], "BYTES").set_data_from_numpy(in1),
+        ]
+        result = client.infer("simple_string", inputs)
+        out0 = result.as_numpy("OUTPUT0")
+        out1 = result.as_numpy("OUTPUT1")
+        for i in range(16):
+            if int(out0[0][i]) != i + 2 or int(out1[0][i]) != i - 2:
+                sys.exit("grpc string infer error")
+        print("PASS: grpc string infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Stateful sequences over plain sync HTTP infer (no stream).
+
+Equivalent of the reference's simple_http_sequence_sync_infer_client.py:
+per-request sequence_id + start/end flags carried in request parameters.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    values = [4, 3, 2, 1]
+    with httpclient.InferenceServerClient(args.url) as client:
+        totals = {}
+        for seq_id in (2001, 2002):
+            total = 0
+            for i, v in enumerate(values):
+                inp = httpclient.InferInput("INPUT", [1, 1], "INT32")
+                inp.set_data_from_numpy(np.array([[v]], dtype=np.int32))
+                result = client.infer(
+                    "simple_sequence",
+                    [inp],
+                    sequence_id=seq_id,
+                    sequence_start=(i == 0),
+                    sequence_end=(i == len(values) - 1),
+                )
+                total = int(result.as_numpy("OUTPUT")[0, 0])
+            totals[seq_id] = total
+    if totals != {2001: sum(values), 2002: sum(values)}:
+        sys.exit(f"sequence sync error: {totals}")
+    print(f"PASS: sequence sync (totals {totals})")
+
+
+if __name__ == "__main__":
+    main()

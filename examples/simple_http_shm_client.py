@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""System shared-memory inference over HTTP (equivalent of
+simple_http_shm_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.http as httpclient
+import client_tpu.utils.shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    with httpclient.InferenceServerClient(args.url) as client:
+        client.unregister_system_shared_memory()
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        nbytes = a.nbytes
+
+        shm_ip = shm.create_shared_memory_region("input_data", "/http_shm_in", 2 * nbytes)
+        shm_op = shm.create_shared_memory_region("output_data", "/http_shm_out", 2 * nbytes)
+        shm.set_shared_memory_region(shm_ip, [a, b])
+        client.register_system_shared_memory("input_data", "/http_shm_in", 2 * nbytes)
+        client.register_system_shared_memory("output_data", "/http_shm_out", 2 * nbytes)
+
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("input_data", nbytes)
+        inputs[1].set_shared_memory("input_data", nbytes, offset=nbytes)
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0"),
+            httpclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("output_data", nbytes)
+        outputs[1].set_shared_memory("output_data", nbytes, offset=nbytes)
+
+        client.infer("simple", inputs, outputs=outputs)
+        out0 = shm.get_contents_as_numpy(shm_op, np.int32, [1, 16])
+        out1 = shm.get_contents_as_numpy(shm_op, np.int32, [1, 16], offset=nbytes)
+        ok = (out0 == a + b).all() and (out1 == a - b).all()
+
+        client.unregister_system_shared_memory()
+        shm.destroy_shared_memory_region(shm_ip)
+        shm.destroy_shared_memory_region(shm_op)
+        if not ok:
+            sys.exit("http shm error: incorrect results")
+        print("PASS: http system shared memory")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,8 +8,7 @@ from pathlib import Path
 
 import pytest
 
-from client_tpu.models import default_model_zoo
-from client_tpu.models.vision import DenseNetModel
+from client_tpu.models import build_image_ensemble, default_model_zoo
 from client_tpu.server import GrpcInferenceServer, HttpInferenceServer, ServerCore
 
 REPO = Path(__file__).resolve().parent.parent
@@ -18,7 +17,7 @@ EXAMPLES = REPO / "examples"
 
 @pytest.fixture(scope="module")
 def servers():
-    zoo = default_model_zoo() + [DenseNetModel(num_classes=16, width=8)]
+    zoo = default_model_zoo() + build_image_ensemble(num_classes=16, width=8)
     core = ServerCore(zoo)
     with HttpInferenceServer(core) as h, GrpcInferenceServer(core) as g:
         yield h, g
@@ -46,17 +45,27 @@ HTTP_EXAMPLES = [
     "simple_http_string_infer_client.py",
     "simple_http_health_metadata.py",
     "simple_http_model_control.py",
+    "simple_http_sequence_sync_infer_client.py",
+    "simple_http_shm_client.py",
+    "simple_http_tpushm_client.py",
+    "ensemble_image_client.py",
 ]
 
 GRPC_EXAMPLES = [
     "simple_grpc_infer_client.py",
     "simple_grpc_async_infer_client.py",
     "simple_grpc_aio_infer_client.py",
+    "simple_grpc_string_infer_client.py",
     "simple_grpc_shm_client.py",
     "simple_grpc_tpushm_client.py",
     "simple_grpc_sequence_stream_infer_client.py",
+    "simple_grpc_aio_sequence_stream_infer_client.py",
     "simple_grpc_custom_repeat.py",
     "simple_grpc_keepalive_client.py",
+    "simple_grpc_custom_args_client.py",
+    "simple_grpc_health_metadata.py",
+    "simple_grpc_model_control.py",
+    "grpc_raw_wire_client.py",
 ]
 
 

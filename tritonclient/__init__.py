@@ -1,0 +1,15 @@
+"""tritonclient compatibility namespace.
+
+Drop-in import paths for code written against the reference
+``tritonclient`` wheel: the submodules re-export this framework's
+implementations (``client_tpu``), so
+
+    import tritonclient.http as httpclient
+    import tritonclient.grpc as grpcclient
+    from tritonclient.utils import np_to_triton_dtype, InferenceServerException
+    import tritonclient.utils.shared_memory as shm
+    import tritonclient.utils.tpu_shared_memory as tpushm
+
+work unchanged. ``tritonclient.utils.cuda_shared_memory`` raises with a
+pointer at the TPU data plane (there is no CUDA on this stack).
+"""

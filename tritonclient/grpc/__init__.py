@@ -1,0 +1,12 @@
+"""Compatibility re-export of :mod:`client_tpu.grpc`."""
+
+from client_tpu.grpc import *  # noqa: F401,F403
+from client_tpu.grpc import (  # noqa: F401
+    CallContext,
+    InferInput,
+    InferRequestedOutput,
+    InferResult,
+    InferenceServerClient,
+    InferenceServerException,
+    KeepAliveOptions,
+)

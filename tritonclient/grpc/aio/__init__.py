@@ -1,0 +1,4 @@
+"""Compatibility re-export of :mod:`client_tpu.grpc.aio`."""
+
+from client_tpu.grpc.aio import *  # noqa: F401,F403
+from client_tpu.grpc.aio import InferenceServerClient  # noqa: F401

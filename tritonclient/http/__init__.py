@@ -1,0 +1,11 @@
+"""Compatibility re-export of :mod:`client_tpu.http`."""
+
+from client_tpu.http import *  # noqa: F401,F403
+from client_tpu.http import (  # noqa: F401
+    InferAsyncRequest,
+    InferInput,
+    InferRequestedOutput,
+    InferResult,
+    InferenceServerClient,
+    InferenceServerException,
+)

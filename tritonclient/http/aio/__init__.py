@@ -1,0 +1,4 @@
+"""Compatibility re-export of :mod:`client_tpu.http.aio`."""
+
+from client_tpu.http.aio import *  # noqa: F401,F403
+from client_tpu.http.aio import InferenceServerClient  # noqa: F401

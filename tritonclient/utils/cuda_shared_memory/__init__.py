@@ -1,0 +1,15 @@
+"""There is no CUDA on this stack — use tpu_shared_memory.
+
+Importing this module is the one reference surface that cannot be satisfied
+on a TPU VM; it fails loudly with migration guidance instead of silently
+degrading.
+"""
+
+raise ImportError(
+    "tritonclient.utils.cuda_shared_memory is unavailable on the TPU stack: "
+    "there is no CUDA here. Use tritonclient.utils.tpu_shared_memory — the "
+    "API mirrors cuda_shared_memory function-for-function "
+    "(create_shared_memory_region/get_raw_handle/set_shared_memory_region"
+    "[_from_dlpack]/get_contents_as_numpy/destroy_shared_memory_region), with "
+    "jax.Array bindings replacing device pointers."
+)

@@ -1,0 +1,17 @@
+"""Compatibility re-export of :mod:`client_tpu.utils.tpu_shared_memory`."""
+
+from client_tpu.utils.tpu_shared_memory import *  # noqa: F401,F403
+from client_tpu.utils.tpu_shared_memory import (  # noqa: F401
+    TpuSharedMemoryRegion,
+    allocated_shared_memory_regions,
+    as_shared_memory_tensor,
+    attach_from_raw_handle,
+    create_shared_memory_region,
+    destroy_shared_memory_region,
+    get_contents_as_jax,
+    get_contents_as_numpy,
+    get_raw_handle,
+    set_shared_memory_region,
+    set_shared_memory_region_from_dlpack,
+    set_shared_memory_region_from_jax,
+)
