@@ -116,14 +116,36 @@ def bench_shm(client, httpclient, x_np, family):
         cleanup()
 
 
+def _probe_accelerator() -> bool:
+    """True if jax device init works within a timeout (the TPU tunnel can
+    wedge hard enough to hang any jax compute; probe in a subprocess)."""
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=120, capture_output=True,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     import numpy as np
+
+    import jax
+
+    if not _probe_accelerator():
+        print(
+            '{"note": "accelerator init timed out; falling back to cpu backend"}',
+            file=sys.stderr,
+        )
+        jax.config.update("jax_platforms", "cpu")
 
     import client_tpu.http as httpclient
     from client_tpu.models.simple import IdentityModel
     from client_tpu.server import HttpInferenceServer, ServerCore
-
-    import jax
 
     platform = jax.default_backend()
     core = ServerCore(

@@ -43,6 +43,9 @@ class Model:
 
     def __init__(self):
         self._ready = True
+        # load-time config override (reference: LoadModel config param,
+        # http_client.cc:1496-1540) — merged over config() output
+        self.config_override: Dict[str, Any] = {}
 
     # -- registry-facing ---------------------------------------------------
     @property
@@ -71,7 +74,7 @@ class Model:
         }
 
     def config(self) -> Dict[str, Any]:
-        return {
+        cfg = {
             "name": self.name,
             "platform": self.platform,
             "backend": "jax",
@@ -86,10 +89,17 @@ class Model:
             ],
             "model_transaction_policy": {"decoupled": self.decoupled},
         }
+        cfg.update(self.config_override)
+        return cfg
 
     def labels(self) -> Optional[List[str]]:
         """Classification labels (for the classification extension); None if n/a."""
         return None
+
+    def effective_max_batch_size(self) -> int:
+        """max_batch_size honoring any load-time config override — the value
+        behavior must use (config() reports the same one)."""
+        return int(self.config_override.get("max_batch_size", self.max_batch_size))
 
     # -- execution ---------------------------------------------------------
     def execute(

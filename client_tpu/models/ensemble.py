@@ -118,7 +118,7 @@ class EnsembleModel(Model):
 
 
 def build_image_ensemble(
-    num_classes: int = 1000, width: int = 32
+    num_classes: int = 1000, width: int = 32, tensor_parallel: int = 1
 ) -> List[Model]:
     """The ensemble_image pipeline: [preprocess, densenet_onnx, ensemble].
 
@@ -128,7 +128,9 @@ def build_image_ensemble(
     from .vision import DenseNetModel, ImagePreprocessModel
 
     preprocess = ImagePreprocessModel()
-    densenet = DenseNetModel(num_classes=num_classes, width=width)
+    densenet = DenseNetModel(
+        num_classes=num_classes, width=width, tensor_parallel=tensor_parallel
+    )
     ensemble = EnsembleModel(
         "ensemble_image",
         steps=[

@@ -108,11 +108,21 @@ class DenseNetModel(Model):
     platform = "jax_flax"
     max_batch_size = 0  # fixture contract: one CHW image per request
 
-    def __init__(self, num_classes: int = 1000, width: int = 32, seed: int = 0):
+    def __init__(
+        self,
+        num_classes: int = 1000,
+        width: int = 32,
+        seed: int = 0,
+        tensor_parallel: int = 1,
+    ):
+        """``tensor_parallel > 1`` shards parameter output-feature axes over a
+        (1, tp) device mesh; XLA inserts the collectives (serving-side scale,
+        no client change)."""
         super().__init__()
         self._num_classes = num_classes
         self._width = width
         self._seed = seed
+        self._tensor_parallel = tensor_parallel
         self._lock = threading.Lock()
         self._module = None
         self._params = None
@@ -140,6 +150,21 @@ class DenseNetModel(Model):
             rng = jax.random.PRNGKey(self._seed)
             dummy = jnp.zeros((1, 224, 224, 3), jnp.bfloat16)
             self._params = self._module.init(rng, dummy)
+
+            if self._tensor_parallel > 1:
+                from jax.sharding import Mesh
+                import numpy as onp
+
+                from ..parallel import shard_params
+
+                devices = jax.devices()
+                tp = min(self._tensor_parallel, len(devices))
+                # (1, tp): serve-time batch stays whole, weights shard on
+                # 'model' (make_mesh's dp-leaning factorization fits training)
+                mesh = Mesh(
+                    onp.array(devices[:tp]).reshape(1, tp), ("data", "model")
+                )
+                self._params = shard_params(self._params, mesh)
 
             @jax.jit
             def forward(params, chw_batch):

@@ -26,6 +26,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--vision", action="store_true",
         help="also serve the densenet_onnx vision model (first request compiles)",
     )
+    parser.add_argument(
+        "--tensor-parallel", type=int, default=1,
+        help="shard vision-model weights over N devices (serving-side tp)",
+    )
     parser.add_argument("--identity-fp32", action="store_true",
                         help="also serve a dynamic-shape FP32 identity model")
     parser.add_argument("-v", "--verbose", action="store_true")
@@ -41,7 +45,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.vision:
         from .models.ensemble import build_image_ensemble
 
-        models.extend(build_image_ensemble())
+        models.extend(build_image_ensemble(tensor_parallel=args.tensor_parallel))
     core = ServerCore(models)
 
     servers = []

@@ -304,8 +304,24 @@ class ServerCore:
                 for m in self._models.values()
             ]
 
-    def load_model(self, name: str) -> None:
-        self.model(name).load()
+    def load_model(self, name: str, config: Optional[str] = None) -> None:
+        model = self.model(name)
+        if config:
+            try:
+                override = json.loads(config)
+            except Exception as e:
+                raise InferError(f"invalid config override: {e}", 400)
+            if not isinstance(override, dict):
+                raise InferError("config override must be a JSON object", 400)
+            if override.get("name", name) != name:
+                raise InferError(
+                    "config override cannot rename the model", 400
+                )
+        else:
+            # Triton semantics: a plain load reverts to the repository config
+            override = {}
+        model.config_override = override
+        model.load()
 
     def unload_model(self, name: str) -> None:
         self.model(name).unload()
@@ -456,7 +472,7 @@ class ServerCore:
                 self._build_response(model, model_version, request, raw)
             )
         batch = 1
-        if responses and model.max_batch_size:
+        if responses and model.effective_max_batch_size():
             first = next(iter(raw_responses[0].values()))
             batch = int(first.shape[0]) if first.ndim else 1
         self._stats[model_name].record(True, time.perf_counter_ns() - t0, infer_ns, batch)
@@ -531,7 +547,7 @@ class ServerCore:
             if class_count:
                 arr = _classification(
                     np.asarray(arr), class_count, model.labels(),
-                    batched=model.max_batch_size > 0,
+                    batched=model.effective_max_batch_size() > 0,
                 )
                 datatype = "BYTES"
             else:

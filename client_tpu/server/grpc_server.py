@@ -250,6 +250,13 @@ class _Handlers(grpc.GenericRpcHandler):
             self._abort(context, e)
 
     def _model_stream_infer(self, request_iterator, context):
+        # triton_grpc_error mode (reference README.md:569-590): when the
+        # client sets this metadata key, stream errors surface as true grpc
+        # statuses (terminating the stream) instead of in-band messages
+        grpc_error_mode = any(
+            key == "triton_grpc_error" and str(value).lower() == "true"
+            for key, value in (context.invocation_metadata() or ())
+        )
         for request in request_iterator:
             model_name = request.get("model_name", "")
             try:
@@ -274,8 +281,15 @@ class _Handlers(grpc.GenericRpcHandler):
                     if request.get("id"):
                         empty["id"] = request["id"]
                     yield {"infer_response": _encode_core_response(empty, final=True)}
-            except Exception as e:  # in-band stream errors (Triton semantics)
-                yield {"error_message": str(e)}
+            except Exception as e:
+                if grpc_error_mode:
+                    code = (
+                        _STATUS_OF_HTTP.get(e.status, grpc.StatusCode.INVALID_ARGUMENT)
+                        if isinstance(e, InferError)
+                        else grpc.StatusCode.INTERNAL
+                    )
+                    context.abort(code, str(e))
+                yield {"error_message": str(e)}  # in-band (default semantics)
 
     # -- repository ----------------------------------------------------------
     def _repository_index(self, request, context):
@@ -283,7 +297,9 @@ class _Handlers(grpc.GenericRpcHandler):
 
     def _repository_model_load(self, request, context):
         try:
-            self._core.load_model(request.get("model_name", ""))
+            params = request.get("parameters", {})
+            config = params.get("config", {}).get("string_param")
+            self._core.load_model(request.get("model_name", ""), config=config)
         except InferError as e:
             self._abort(context, e)
         return {}

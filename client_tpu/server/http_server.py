@@ -278,7 +278,11 @@ class _Handler(BaseHTTPRequestHandler):
             m = re.match(r"^/v2/repository/models/([^/]+)/(load|unload)$", path)
             if m:
                 if m.group(2) == "load":
-                    core.load_model(unquote(m.group(1)))
+                    payload = json.loads(body) if body else {}
+                    if not isinstance(payload, dict):
+                        raise InferError("load request body must be a JSON object", 400)
+                    config = payload.get("parameters", {}).get("config")
+                    core.load_model(unquote(m.group(1)), config=config)
                 else:
                     core.unload_model(unquote(m.group(1)))
                 return self._send_json({})

@@ -106,3 +106,15 @@ def test_graft_entry_forward():
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
     assert out.shape == (4, 1000)
+
+
+def test_vision_tensor_parallel_matches_single_device():
+    """tp=4 sharded serving produces the same logits as tp=1 (same seed)."""
+    from client_tpu.models.vision import DenseNetModel
+
+    image = np.random.default_rng(3).standard_normal((3, 224, 224)).astype(np.float32)
+    single = DenseNetModel(num_classes=16, width=8, seed=7)
+    sharded = DenseNetModel(num_classes=16, width=8, seed=7, tensor_parallel=4)
+    out_single = np.asarray(single.execute({"data_0": image}, {})["fc6_1"])
+    out_sharded = np.asarray(sharded.execute({"data_0": image}, {})["fc6_1"])
+    np.testing.assert_allclose(out_single, out_sharded, atol=2e-2)

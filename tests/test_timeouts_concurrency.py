@@ -227,3 +227,47 @@ def test_ensemble_model_direct(servers):
             cfg = client.get_model_config("ensemble_image")
             assert cfg["platform"] == "ensemble"
             assert len(cfg["ensemble_scheduling"]["step"]) == 2
+
+
+def test_load_model_config_override(servers):
+    """LoadModel with a config override (reference: LoadWithConfigOverride,
+    cc_client_test.cc:1202-1349)."""
+    import client_tpu.http as httpclient
+
+    http_server, _ = servers
+    with httpclient.InferenceServerClient(http_server.url) as client:
+        client.load_model(
+            "simple_string", config='{"max_batch_size": 8, "custom_field": "x"}'
+        )
+        cfg = client.get_model_config("simple_string")
+        assert cfg["max_batch_size"] == 8
+        assert cfg["custom_field"] == "x"
+        with pytest.raises(InferenceServerException, match="rename"):
+            client.load_model("simple_string", config='{"name": "other"}')
+        # Triton semantics: a plain load reverts to the repository config
+        client.load_model("simple_string")
+        assert client.get_model_config("simple_string")["max_batch_size"] == 0
+
+
+def test_triton_grpc_error_stream_mode(servers):
+    """triton_grpc_error metadata: stream errors become true grpc statuses
+    (reference README.md:569-590)."""
+    import queue
+
+    import client_tpu.grpc as grpcclient
+
+    _, grpc_server = servers
+    results = queue.Queue()
+    with grpcclient.InferenceServerClient(grpc_server.url) as client:
+        client.start_stream(
+            callback=lambda r, e: results.put((r, e)),
+            headers={"triton_grpc_error": "true"},
+        )
+        inp = grpcclient.InferInput("INPUT", [1, 1], "INT32")
+        inp.set_data_from_numpy(np.array([[1]], dtype=np.int32))
+        client.async_stream_infer("simple_sequence", [inp])  # no sequence_id
+        result, error = results.get(timeout=10)
+        assert result is None
+        # a true grpc status, not an in-band error_message
+        assert error.status() is not None and "INVALID_ARGUMENT" in error.status()
+        client.stop_stream()
