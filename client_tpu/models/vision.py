@@ -153,7 +153,6 @@ class DenseNetModel(Model):
 
             if self._tensor_parallel > 1:
                 from jax.sharding import Mesh
-                import numpy as onp
 
                 from ..parallel import shard_params
 
@@ -162,7 +161,7 @@ class DenseNetModel(Model):
                 # (1, tp): serve-time batch stays whole, weights shard on
                 # 'model' (make_mesh's dp-leaning factorization fits training)
                 mesh = Mesh(
-                    onp.array(devices[:tp]).reshape(1, tp), ("data", "model")
+                    np.array(devices[:tp]).reshape(1, tp), ("data", "model")
                 )
                 self._params = shard_params(self._params, mesh)
 

@@ -11,4 +11,18 @@ from .core import ServerCore
 from .grpc_server import GrpcInferenceServer
 from .http_server import HttpInferenceServer
 
-__all__ = ["ServerCore", "GrpcInferenceServer", "HttpInferenceServer"]
+__all__ = [
+    "AioHttpInferenceServer",
+    "GrpcInferenceServer",
+    "HttpInferenceServer",
+    "ServerCore",
+]
+
+
+def __getattr__(name):
+    # lazy: the aio frontend needs aiohttp, which is an optional extra
+    if name == "AioHttpInferenceServer":
+        from .http_server_aio import AioHttpInferenceServer
+
+        return AioHttpInferenceServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

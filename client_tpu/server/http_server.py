@@ -378,7 +378,12 @@ class HttpInferenceServer:
     def __init__(self, core: ServerCore, port: int = 0, verbose: bool = False):
         self.core = core
         handler = type("BoundHandler", (_Handler,), {"core": core})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        # stdlib default listen backlog is 5; bursts of concurrent
+        # connections get RST'd without this (subclass: no global mutation)
+        server_cls = type(
+            "BoundHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 128}
+        )
+        self._httpd = server_cls(("127.0.0.1", port), handler)
         self._httpd.verbose = verbose
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None

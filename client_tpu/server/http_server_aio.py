@@ -1,0 +1,270 @@
+"""aiohttp frontend for ServerCore: same v2 surface, event-loop concurrency.
+
+A drop-in alternative to the threaded stdlib frontend (``http_server.py``)
+for higher request rates: one event loop, blocking model execution offloaded
+to a worker pool. Shares the request/response marshaling with the threaded
+frontend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from .core import InferError, ServerCore
+from .http_server import _FAMILY, encode_infer_response, parse_infer_request
+
+
+def _json_response(obj: Any, status: int = 200) -> web.Response:
+    return web.Response(
+        body=json.dumps(obj, separators=(",", ":")).encode("utf-8"),
+        status=status,
+        content_type="application/json",
+    )
+
+
+def _error_response(e: Exception) -> web.Response:
+    if isinstance(e, InferError):
+        status = e.status
+    elif isinstance(e, (json.JSONDecodeError, KeyError, ValueError, TypeError)):
+        status = 400  # malformed payload, matching the threaded frontend
+        return _json_response({"error": f"failed to parse request: {e}"}, status)
+    else:
+        status = 500
+    return _json_response({"error": str(e)}, status)
+
+
+class AioHttpInferenceServer:
+    """An in-process v2 HTTP server on an asyncio event loop."""
+
+    def __init__(self, core: ServerCore, port: int = 0, workers: int = 8):
+        self.core = core
+        self._port = port
+        self._bound_port: Optional[int] = None
+        self._executor = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="client_tpu_aio_server"
+        )
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- routes ------------------------------------------------------------
+    def _app(self) -> web.Application:
+        app = web.Application(client_max_size=2**31)
+        core = self.core
+        r = app.router
+
+        async def live(request):
+            return web.Response(status=200 if core.live else 503)
+
+        r.add_get("/v2/health/live", live)
+        r.add_get("/v2/health/ready", live)
+        r.add_get("/v2", lambda request: _json_response(core.server_metadata()))
+        r.add_get(
+            "/v2/models/stats", lambda request: _json_response(core.statistics())
+        )
+
+        async def model_route(request):
+            name = request.match_info["name"]
+            version = request.match_info.get("version", "")
+            tail = request.match_info.get("tail", "")
+            try:
+                if tail == "ready":
+                    return web.Response(
+                        status=200 if core.model_ready(name, version) else 400
+                    )
+                if tail == "config":
+                    return _json_response(core.model(name, version).config())
+                if tail == "stats":
+                    return _json_response(core.statistics(name, version))
+                if tail == "":
+                    return _json_response(core.model(name, version).metadata())
+                return _json_response({"error": f"unknown route {tail}"}, 404)
+            except Exception as e:
+                return _error_response(e)
+
+        async def infer_route(request):
+            name = request.match_info["name"]
+            version = request.match_info.get("version", "")
+            try:
+                body = await request.read()
+                header_length = request.headers.get("Inference-Header-Content-Length")
+                parsed = parse_infer_request(
+                    body, int(header_length) if header_length is not None else None
+                )
+                requested = parsed.get("outputs")
+                binary_default = bool(
+                    parsed.get("binary_default")
+                    or parsed.get("parameters", {}).get("binary_data_output", False)
+                )
+                loop = asyncio.get_running_loop()
+                responses = await loop.run_in_executor(
+                    self._executor, core.infer, name, version, parsed
+                )
+                body_out, json_size = encode_infer_response(
+                    responses[0], requested, binary_default
+                )
+                headers = {}
+                if json_size is not None:
+                    headers["Inference-Header-Content-Length"] = str(json_size)
+                    content_type = "application/octet-stream"
+                else:
+                    content_type = "application/json"
+                orca = request.headers.get("endpoint-load-metrics-format")
+                if orca in ("json", "text"):
+                    headers["endpoint-load-metrics"] = core.orca_report(orca, name)
+                return web.Response(
+                    body=body_out, headers=headers, content_type=content_type
+                )
+            except InferError as e:
+                return _error_response(e)
+            except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+                return _json_response({"error": f"failed to parse request: {e}"}, 400)
+            except Exception as e:
+                return _json_response({"error": f"internal error: {e}"}, 500)
+
+        r.add_get("/v2/models/{name}", model_route)
+        r.add_get("/v2/models/{name}/{tail:config|ready|stats}", model_route)
+        r.add_get("/v2/models/{name}/versions/{version}", model_route)
+        r.add_get(
+            "/v2/models/{name}/versions/{version}/{tail:config|ready|stats}",
+            model_route,
+        )
+        r.add_post("/v2/models/{name}/infer", infer_route)
+        r.add_post("/v2/models/{name}/versions/{version}/infer", infer_route)
+
+        async def repo_index(request):
+            return _json_response(core.repository_index())
+
+        async def repo_action(request):
+            name = request.match_info["name"]
+            action = request.match_info["action"]
+            try:
+                body = await request.read()
+                if action == "load":
+                    payload = json.loads(body) if body else {}
+                    if not isinstance(payload, dict):
+                        raise InferError("load request body must be a JSON object", 400)
+                    core.load_model(
+                        name, config=payload.get("parameters", {}).get("config")
+                    )
+                else:
+                    core.unload_model(name)
+                return _json_response({})
+            except Exception as e:
+                return _error_response(e)
+
+        r.add_post("/v2/repository/index", repo_index)
+        r.add_post("/v2/repository/models/{name}/{action:load|unload}", repo_action)
+
+        async def shm_route(request):
+            family = _FAMILY[request.match_info["family"]]
+            # status GETs carry no {action} group in their route patterns
+            action = request.match_info.get(
+                "action", "status" if request.method == "GET" else ""
+            )
+            region = request.match_info.get("region", "")
+            try:
+                if action == "status":
+                    return _json_response(core.region_status(family, region))
+                body = await request.read()
+                payload = json.loads(body) if body else {}
+                if action == "register":
+                    if family == "system":
+                        core.register_system_region(
+                            region, payload["key"], payload.get("offset", 0),
+                            payload["byte_size"],
+                        )
+                    else:
+                        core.register_handle_region(
+                            family, region, payload["raw_handle"]["b64"],
+                            payload.get("device_id", 0), payload["byte_size"],
+                        )
+                else:  # unregister
+                    core.unregister_region(region or "", None if region else family)
+                return _json_response({})
+            except Exception as e:
+                return _error_response(e)
+
+        fam = "{family:systemsharedmemory|cudasharedmemory|tpusharedmemory}"
+        r.add_get(f"/v2/{fam}/status", shm_route)
+        r.add_get(f"/v2/{fam}/region/{{region}}/status", shm_route)
+        for action in ("register", "unregister"):
+            r.add_post(f"/v2/{fam}/region/{{region}}/{{action:{action}}}", shm_route)
+        r.add_post(f"/v2/{fam}/{{action:unregister}}", shm_route)
+
+        async def trace_route(request):
+            if request.method == "POST":
+                settings = json.loads(await request.read() or b"{}")
+                core.trace_settings.update(settings)
+            return _json_response(core.trace_settings)
+
+        async def log_route(request):
+            if request.method == "POST":
+                settings = json.loads(await request.read() or b"{}")
+                core.log_settings.update(settings)
+            return _json_response(core.log_settings)
+
+        r.add_get("/v2/trace/setting", trace_route)
+        r.add_post("/v2/trace/setting", trace_route)
+        r.add_get("/v2/models/{name}/trace/setting", trace_route)
+        r.add_post("/v2/models/{name}/trace/setting", trace_route)
+        r.add_get("/v2/logging", log_route)
+        r.add_post("/v2/logging", log_route)
+        return app
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._bound_port or self._port
+
+    @property
+    def url(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "AioHttpInferenceServer":
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def bring_up():
+                self._runner = web.AppRunner(self._app(), access_log=None)
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, "127.0.0.1", self._port)
+                await site.start()
+                self._bound_port = site._server.sockets[0].getsockname()[1]
+                self._started.set()
+
+            loop.run_until_complete(bring_up())
+            loop.run_forever()
+            loop.run_until_complete(self._runner.cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(
+            target=run, name="client_tpu_aio_http_server", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("aio http server failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._executor.shutdown(wait=False)
+
+    def __enter__(self) -> "AioHttpInferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -24,6 +24,7 @@ namespace client_tpu {
 class InferenceServerHttpClient {
  public:
   using OnComplete = std::function<void(InferResult*)>;
+  using OnMultiComplete = std::function<void(std::vector<InferResult*>)>;
 
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
@@ -82,6 +83,19 @@ class InferenceServerHttpClient {
       OnComplete callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  // Batch variants with option/output broadcasting (reference
+  // cc_client_test.cc:300-1200): a single options/outputs entry applies to
+  // every request; otherwise sizes must match the request count.
+  Error InferMulti(
+      std::vector<InferResult*>* results,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs = {});
+  Error AsyncInferMulti(
+      OnMultiComplete callback, const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs = {});
 
   InferStat ClientInferStat();
 

@@ -850,6 +850,84 @@ void InferenceServerHttpClient::AsyncTransfer() {
   }
 }
 
+namespace {
+Error ValidateMultiSizes(
+    size_t request_count, size_t options_count, size_t outputs_count) {
+  if (request_count == 0) return Error("empty request list");
+  if (options_count != 1 && options_count != request_count) {
+    return Error(
+        "options size must be 1 (broadcast) or match the request count");
+  }
+  if (outputs_count > 1 && outputs_count != request_count) {
+    return Error(
+        "outputs size must be 0, 1 (broadcast), or match the request count");
+  }
+  return Error::Success();
+}
+}  // namespace
+
+Error InferenceServerHttpClient::InferMulti(
+    std::vector<InferResult*>* results,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
+  Error err = ValidateMultiSizes(inputs.size(), options.size(), outputs.size());
+  if (err) return err;
+  results->clear();
+  static const std::vector<const InferRequestedOutput*> kNoOutputs;
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs =
+        outputs.empty() ? kNoOutputs
+                        : (outputs.size() == 1 ? outputs[0] : outputs[i]);
+    InferResult* result = nullptr;
+    err = Infer(&result, opt, inputs[i], outs);
+    results->push_back(result);
+    if (err) return err;
+  }
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::AsyncInferMulti(
+    OnMultiComplete callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs) {
+  Error err = ValidateMultiSizes(inputs.size(), options.size(), outputs.size());
+  if (err) return err;
+  // fan out every request; fire the callback once all land (reference's
+  // atomic response counter, grpc_client.cc:1254-1320)
+  struct MultiState {
+    std::mutex mu;
+    std::vector<InferResult*> results;
+    size_t remaining;
+    OnMultiComplete callback;
+  };
+  auto state = std::make_shared<MultiState>();
+  state->results.resize(inputs.size(), nullptr);
+  state->remaining = inputs.size();
+  state->callback = std::move(callback);
+  static const std::vector<const InferRequestedOutput*> kNoOutputs;
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs =
+        outputs.empty() ? kNoOutputs
+                        : (outputs.size() == 1 ? outputs[0] : outputs[i]);
+    err = AsyncInfer(
+        [state, i](InferResult* result) {
+          bool done = false;
+          {
+            std::lock_guard<std::mutex> lock(state->mu);
+            state->results[i] = result;
+            done = (--state->remaining == 0);
+          }
+          if (done) state->callback(state->results);
+        },
+        opt, inputs[i], outs);
+    if (err) return err;
+  }
+  return Error::Success();
+}
+
 InferStat InferenceServerHttpClient::ClientInferStat() {
   std::lock_guard<std::mutex> lock(stat_mutex_);
   return infer_stat_;

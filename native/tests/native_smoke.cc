@@ -267,6 +267,46 @@ void TestOnline(const std::string& url) {
   delete rout;
   printf("ok online tpu shm infer\n");
 
+  // InferMulti / AsyncInferMulti with option broadcasting
+  in0->Reset();
+  in1->Reset();
+  in0->AppendRaw(reinterpret_cast<uint8_t*>(input0), sizeof(input0));
+  in1->AppendRaw(reinterpret_cast<uint8_t*>(input1), sizeof(input1));
+  std::vector<InferResult*> multi_results;
+  CHECK_OK(client->InferMulti(
+      &multi_results, {options}, {{in0, in1}, {in0, in1}, {in0, in1}}));
+  CHECK(multi_results.size() == 3);
+  for (auto* r : multi_results) {
+    const uint8_t* mbuf;
+    size_t msize;
+    CHECK_OK(r->RawData("OUTPUT0", &mbuf, &msize));
+    CHECK(reinterpret_cast<const int32_t*>(mbuf)[3] ==
+          input0[3] + input1[3]);
+    delete r;
+  }
+  {
+    std::mutex mmu;
+    std::condition_variable mcv;
+    bool multi_done = false;
+    CHECK_OK(client->AsyncInferMulti(
+        [&](std::vector<InferResult*> async_results) {
+          bool ok = async_results.size() == 2;
+          for (auto* r : async_results) {
+            ok = ok && r->RequestStatus().IsOk();
+            delete r;
+          }
+          std::lock_guard<std::mutex> lock(mmu);
+          multi_done = ok;
+          mcv.notify_one();
+        },
+        {options}, {{in0, in1}, {in0, in1}}));
+    std::unique_lock<std::mutex> lock(mmu);
+    CHECK(mcv.wait_for(lock, std::chrono::seconds(30), [&] {
+      return multi_done;
+    }));
+  }
+  printf("ok online infer multi\n");
+
   // stats reflect the traffic
   InferStat stat = client->ClientInferStat();
   CHECK(stat.completed_request_count >= 6);

@@ -221,3 +221,50 @@ def test_basic_auth_plugin(server):
         assert req.headers["authorization"] == expected
         c.unregister_plugin()
         assert c.plugin() is None
+
+
+# ---------------------------------------------------------------------------
+# aiohttp frontend: the same client tests against the event-loop server
+# ---------------------------------------------------------------------------
+
+
+def test_aio_frontend_full_flow():
+    import client_tpu.utils.shared_memory as shm
+    from client_tpu.server.http_server_aio import AioHttpInferenceServer
+
+    core = ServerCore(default_model_zoo())
+    with AioHttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            assert client.is_server_live()
+            assert client.is_model_ready("simple")
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            b = np.ones((1, 16), dtype=np.int32)
+            in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+            in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+            result = client.infer("simple", [in0, in1])
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
+            # admin surface
+            assert client.get_model_config("simple")["backend"] == "jax"
+            index = client.get_model_repository_index()
+            assert any(m["name"] == "simple" for m in index)
+            stats = client.get_inference_statistics("simple")
+            assert stats["model_stats"][0]["inference_count"] >= 1
+            assert client.get_trace_settings()["trace_level"] == ["OFF"]
+            # shm negotiation
+            region = shm.create_shared_memory_region("aiofr", "/aio_frontend", 128)
+            try:
+                shm.set_shared_memory_region(region, [a, b])
+                client.register_system_shared_memory("aiofr", "/aio_frontend", 128)
+                i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32").set_shared_memory("aiofr", 64)
+                i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32").set_shared_memory("aiofr", 64, offset=64)
+                r = client.infer("simple", [i0, i1])
+                np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), a + b)
+                # status GETs exercise the action-less shm routes
+                assert client.get_system_shared_memory_status()[0]["name"] == "aiofr"
+                assert client.get_tpu_shared_memory_status() == []
+                client.unregister_system_shared_memory()
+            finally:
+                shm.destroy_shared_memory_region(region)
+            # errors still map correctly
+            with pytest.raises(InferenceServerException, match="unknown model"):
+                client.infer("missing", [in0, in1])
