@@ -1,0 +1,104 @@
+"""Ring attention: context parallelism for long sequences.
+
+Long-context serving shards the sequence axis across the mesh; attention
+then needs every query block to see every key/value block. Ring attention
+keeps Q resident per device and rotates K/V one hop around the ring each
+step (``lax.ppermute`` — rides ICI on real hardware), accumulating the
+softmax online (log-sum-exp streaming), so no device ever materializes the
+full [seq, seq] score matrix and per-device memory is O(seq/n · seq/n).
+
+This is the TPU-native answer to the template's long-context mandate: the
+client framework's server side can host sequence lengths that exceed a
+single chip's HBM. Exact (matches full attention to numerical tolerance).
+"""
+
+from __future__ import annotations
+
+
+def full_attention(q, k, v):
+    """Reference dense attention. q,k,v: [batch, seq, heads, dim]."""
+    import jax.numpy as jnp
+
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    probs = jnp.exp(scores - scores.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(q, k, v, mesh, axis: str = "data"):
+    """Exact attention with the sequence axis sharded over ``axis``.
+
+    q, k, v: [batch, seq, heads, dim]; seq must divide by the axis size.
+    Returns [batch, seq, heads, dim] with the same sharding.
+    """
+    import jax.numpy as jnp
+    from jax import lax, shard_map  # requires the jax that also has lax.pvary
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(f"seq {q.shape[1]} must divide by mesh axis size {n}")
+    scale = q.shape[-1] ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block(q_blk, k_blk, v_blk):
+        # q_blk/k_blk/v_blk: the local [batch, seq/n, heads, dim] shards
+        batch, sq, heads, dim = q_blk.shape
+
+        def scores_of(k_cur):
+            return jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_cur) * scale
+
+        def step(carry, i):
+            k_cur, v_cur, acc, m, l = carry
+            # rotate at the top of iterations 1..n-1: the ring sends exactly
+            # 2(n-1) collectives, none wasted on a discarded final hop
+            k_cur, v_cur = lax.cond(
+                i > 0,
+                lambda kv: (
+                    lax.ppermute(kv[0], axis, perm),
+                    lax.ppermute(kv[1], axis, perm),
+                ),
+                lambda kv: kv,
+                (k_cur, v_cur),
+            )
+            s = scores_of(k_cur)  # [b, h, sq, sk]
+            m_new = jnp.maximum(m, s.max(-1))
+            p = jnp.exp(s - m_new[..., None])
+            correction = jnp.exp(m - m_new)
+            l_new = l * correction + p.sum(-1)
+            acc_new = (
+                acc * correction[..., None]
+                + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur)
+            )
+            return (k_cur, v_cur, acc_new, m_new, l_new), None
+
+        # pvary: the accumulators must carry the same varying-axes type as
+        # the per-shard data or lax.scan rejects the carry
+        acc0 = lax.pvary(jnp.zeros((batch, heads, sq, dim), jnp.float32), (axis,))
+        m0 = lax.pvary(jnp.full((batch, heads, sq), -jnp.inf, jnp.float32), (axis,))
+        l0 = lax.pvary(jnp.zeros((batch, heads, sq), jnp.float32), (axis,))
+        (k_fin, v_fin, acc, m, l), _ = lax.scan(
+            step,
+            (k_blk.astype(jnp.float32), v_blk.astype(jnp.float32), acc0, m0, l0),
+            jnp.arange(n),
+        )
+        del k_fin, v_fin
+        out = acc / l[..., None]
+        return jnp.transpose(out, (0, 2, 1, 3)).astype(q_blk.dtype)
+
+    spec = P(None, axis, None, None)
+    return shard_map(
+        block, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+
+
+def place_sharded(arr, mesh, axis: str = "data"):
+    """Shard [batch, seq, ...] on the sequence dim over ``axis``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ndim = arr.ndim
+    spec = [None] * ndim
+    spec[1] = axis
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
