@@ -17,7 +17,6 @@ the wire path is the measured baseline, exactly what `perf_analyzer
 
 import json
 import os
-import statistics
 import sys
 import time
 

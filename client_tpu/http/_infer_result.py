@@ -25,12 +25,26 @@ class InferResult:
 
     def __init__(self, response_body: bytes, header_length: Optional[int] = None):
         self._buffer = memoryview(response_body)
-        if header_length is None:
-            self._response: Dict[str, Any] = json.loads(response_body)
-            self._binary_start = len(response_body)
-        else:
-            self._response = json.loads(bytes(self._buffer[:header_length]))
-            self._binary_start = header_length
+        if header_length is not None and header_length > len(response_body):
+            raise InferenceServerException(
+                f"malformed inference response: Inference-Header-Content-Length "
+                f"{header_length} exceeds the {len(response_body)}-byte body"
+            )
+        try:
+            if header_length is None:
+                self._response: Dict[str, Any] = json.loads(response_body)
+                self._binary_start = len(response_body)
+            else:
+                self._response = json.loads(bytes(self._buffer[:header_length]))
+                self._binary_start = header_length
+        except json.JSONDecodeError as e:
+            raise InferenceServerException(
+                f"malformed inference response: {e}"
+            ) from e
+        if not isinstance(self._response, dict):
+            raise InferenceServerException(
+                "malformed inference response: header is not a JSON object"
+            )
         # Map output name -> (start, end) into the binary tail, walked in
         # output order using each output's binary_data_size parameter.
         self._offsets: Dict[str, Tuple[int, int]] = {}
@@ -39,6 +53,18 @@ class InferResult:
             params = output.get("parameters", {})
             size = params.get("binary_data_size")
             if size is not None:
+                if not isinstance(size, int) or isinstance(size, bool) or size < 0:
+                    raise InferenceServerException(
+                        f"malformed inference response: output "
+                        f"'{output.get('name')}' has invalid binary_data_size "
+                        f"{size!r}"
+                    )
+                if cursor + size > len(response_body):
+                    raise InferenceServerException(
+                        f"malformed inference response: output "
+                        f"'{output.get('name')}' declares {size} binary bytes "
+                        "beyond the body"
+                    )
                 self._offsets[output["name"]] = (cursor, cursor + size)
                 cursor += size
 

@@ -12,7 +12,7 @@ import base64
 import json
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -243,6 +243,12 @@ class ServerCore:
             "log_format": "default",
         }
         self.live = True
+        # rolling per-request trace records, populated when trace_level
+        # includes TIMESTAMPS (Triton writes these to trace_file; we keep a
+        # ring buffer and mirror to trace_file when one is configured)
+        self._traces: List[Dict[str, Any]] = []
+        self._trace_seq = 0
+        self._trace_candidates = 0
         for m in models or []:
             self.add_model(m)
 
@@ -334,6 +340,47 @@ class ServerCore:
             m = self.model(n)
             out.append(self._stats[n].as_dict(n, version or m.versions[-1]))
         return {"model_stats": out}
+
+    def _trace_enabled(self) -> bool:
+        """Honors trace_level plus the trace_rate (sample 1-in-N) and
+        trace_count (stop after N, -1 = unlimited) settings."""
+        level = self.trace_settings.get("trace_level", [])
+        if "TIMESTAMPS" not in level and "TENSORS" not in level:
+            return False
+        with self._lock:
+            try:
+                rate = max(int(self.trace_settings.get("trace_rate", 1) or 1), 1)
+                count = int(self.trace_settings.get("trace_count", -1))
+            except (TypeError, ValueError):
+                rate, count = 1, -1
+            if count >= 0 and self._trace_seq >= count:
+                return False
+            self._trace_candidates += 1
+            return (self._trace_candidates - 1) % rate == 0
+
+    def _record_trace(self, model_name: str, request_id: str, timestamps: Dict[str, int]) -> None:
+        with self._lock:
+            self._trace_seq += 1
+            record = {
+                "id": self._trace_seq,
+                "model_name": model_name,
+                "request_id": request_id,
+                "timestamps": timestamps,
+            }
+            self._traces.append(record)
+            if len(self._traces) > 1024:
+                del self._traces[: len(self._traces) - 1024]
+            trace_file = self.trace_settings.get("trace_file")
+        if trace_file:
+            try:
+                with open(trace_file, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+            except OSError:
+                pass
+
+    def recent_traces(self, count: int = 100) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._traces[-count:])
 
     def orca_report(self, fmt: str, model_name: str = "") -> str:
         """Per-response load metrics in ORCA json or text form."""
@@ -470,6 +517,18 @@ class ServerCore:
         for raw in raw_responses:
             responses.append(
                 self._build_response(model, model_version, request, raw)
+            )
+        if self._trace_enabled():
+            end_ns = time.perf_counter_ns()
+            self._record_trace(
+                model_name,
+                request.get("id", ""),
+                {
+                    "request_start_ns": t0,
+                    "compute_start_ns": t_infer,
+                    "compute_end_ns": t_infer + infer_ns,
+                    "request_end_ns": end_ns,
+                },
             )
         batch = 1
         if responses and model.effective_max_batch_size():

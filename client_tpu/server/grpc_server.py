@@ -8,7 +8,6 @@ method handlers bound to the schema-driven wire codec — the server twin of
 
 from __future__ import annotations
 
-import threading
 from concurrent import futures
 from typing import Any, Dict, List, Optional
 

@@ -271,3 +271,65 @@ def test_triton_grpc_error_stream_mode(servers):
         # a true grpc status, not an in-band error_message
         assert error.status() is not None and "INVALID_ARGUMENT" in error.status()
         client.stop_stream()
+
+
+def test_server_side_trace_capture(servers, tmp_path):
+    """TIMESTAMPS trace level records per-request traces and mirrors them to
+    trace_file (reference: trace-settings surface, SURVEY §5)."""
+    import json as jsonlib
+
+    import client_tpu.http as httpclient
+
+    http_server, _ = servers
+    trace_file = tmp_path / "trace.jsonl"
+    with httpclient.InferenceServerClient(http_server.url) as client:
+        client.update_trace_settings(
+            settings={"trace_level": ["TIMESTAMPS"], "trace_file": str(trace_file)}
+        )
+        try:
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            b = np.ones((1, 16), dtype=np.int32)
+            i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+            i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+            client.infer("simple", [i0, i1], request_id="traced-1")
+        finally:
+            client.update_trace_settings(
+                settings={"trace_level": ["OFF"], "trace_file": ""}
+            )
+    core = http_server.core
+    traces = core.recent_traces()
+    assert traces, "no traces recorded"
+    last = traces[-1]
+    assert last["request_id"] == "traced-1"
+    ts = last["timestamps"]
+    assert ts["request_start_ns"] <= ts["compute_start_ns"] <= ts["compute_end_ns"] <= ts["request_end_ns"]
+    lines = trace_file.read_text().strip().splitlines()
+    assert jsonlib.loads(lines[-1])["request_id"] == "traced-1"
+
+
+def test_trace_rate_and_count():
+    """trace_rate samples 1-in-N; trace_count stops tracing after N (counted
+    on a dedicated server: the limits are server-global)."""
+    import client_tpu.http as httpclient
+
+    core = ServerCore(default_model_zoo())
+    http_server = HttpInferenceServer(core).start()
+    with httpclient.InferenceServerClient(http_server.url) as client:
+        client.update_trace_settings(
+            settings={"trace_level": ["TIMESTAMPS"], "trace_rate": "2",
+                      "trace_count": "3", "trace_file": ""}
+        )
+        try:
+            before = len(core.recent_traces(1000))
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            b = np.ones((1, 16), dtype=np.int32)
+            for _ in range(10):
+                i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+                i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+                client.infer("simple", [i0, i1])
+            traced = len(core.recent_traces(1000)) - before
+            # rate=2 over 10 requests caps at 5, count=3 caps at 3
+            assert traced == 3, traced
+        finally:
+            client.update_trace_settings(settings={"trace_level": ["OFF"]})
+    http_server.stop()
