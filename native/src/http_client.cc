@@ -394,6 +394,10 @@ void SetCommonOptions(
   curl_easy_setopt(easy, CURLOPT_URL, url.c_str());
   curl_easy_setopt(easy, CURLOPT_TCP_NODELAY, 1L);
   curl_easy_setopt(easy, CURLOPT_NOSIGNAL, 1L);
+  // large tensor bodies: default 64KB/16KB transfer buffers throttle the
+  // loopback path (reference uses 16MB both ways, http_client.cc:2099)
+  curl_easy_setopt(easy, CURLOPT_UPLOAD_BUFFERSIZE, 16L * 1024 * 1024);
+  curl_easy_setopt(easy, CURLOPT_BUFFERSIZE, 16L * 1024 * 1024);
   curl_easy_setopt(easy, CURLOPT_WRITEFUNCTION, WriteBody);
   curl_easy_setopt(easy, CURLOPT_WRITEDATA, response);
   curl_easy_setopt(easy, CURLOPT_HEADERFUNCTION, WriteHeader);

@@ -1,0 +1,127 @@
+// Native-client latency bench: the tpu-shm control-message hot path.
+// Usage: CLIENT_TPU_TEST_URL=host:port native_bench [n_elems] [iters]
+// Prints one JSON line with p50/p99 for wire vs tpu-shm data planes.
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "client_tpu/common.h"
+#include "client_tpu/http_client.h"
+#include "client_tpu/tpu_shm.h"
+
+using namespace client_tpu;
+
+static double Percentile(std::vector<double>& v, double q) {
+  std::sort(v.begin(), v.end());
+  size_t idx = std::min(
+      static_cast<size_t>(v.size() * q), v.size() - 1);
+  return v[idx];
+}
+
+int main(int argc, char** argv) {
+  const char* url = getenv("CLIENT_TPU_TEST_URL");
+  if (url == nullptr || url[0] == '\0') {
+    fprintf(stderr, "CLIENT_TPU_TEST_URL unset\n");
+    return 2;
+  }
+  size_t n_elems = argc > 1 ? strtoull(argv[1], nullptr, 10) : (1u << 20);
+  int iters = argc > 2 ? atoi(argv[2]) : 50;
+  size_t nbytes = n_elems * sizeof(float);
+
+  std::unique_ptr<InferenceServerHttpClient> client;
+  if (InferenceServerHttpClient::Create(&client, url)) return 1;
+
+  std::vector<float> data(n_elems);
+  for (size_t i = 0; i < n_elems; ++i) data[i] = static_cast<float>(i % 977);
+
+  InferOptions options("identity_fp32");
+  auto run = [&](bool shm, std::vector<double>* times) -> Error {
+    InferInput* input = nullptr;
+    InferInput::Create(
+        &input, "INPUT0", {1, static_cast<int64_t>(n_elems)}, "FP32");
+    std::unique_ptr<InferInput> input_guard(input);
+    TpuShmRegion* rin = nullptr;
+    TpuShmRegion* rout = nullptr;
+    InferRequestedOutput* out0 = nullptr;
+    InferRequestedOutput::Create(&out0, "OUTPUT0");
+    std::unique_ptr<InferRequestedOutput> out_guard(out0);
+    std::vector<const InferRequestedOutput*> outputs;
+    if (shm) {
+      Error err = TpuShmRegion::Create(&rin, "nb_in", nbytes);
+      if (err) return err;
+      err = TpuShmRegion::Create(&rout, "nb_out", nbytes);
+      if (err) return err;
+      if ((err = client->RegisterTpuSharedMemory(
+               "nb_in", rin->RawHandle(), 0, nbytes)))
+        return err;
+      if ((err = client->RegisterTpuSharedMemory(
+               "nb_out", rout->RawHandle(), 0, nbytes)))
+        return err;
+      input->SetSharedMemory("nb_in", nbytes);
+      out0->SetSharedMemory("nb_out", nbytes);
+      outputs.push_back(out0);
+    }
+    std::vector<float> readback(n_elems);
+    for (int i = 0; i < iters + 5; ++i) {
+      auto t0 = std::chrono::steady_clock::now();
+      Error err;
+      if (shm) {
+        rin->Write(data.data(), nbytes);
+        InferResult* result = nullptr;
+        err = client->Infer(&result, options, {input}, outputs);
+        delete result;
+        if (!err) rout->Read(readback.data(), nbytes);
+      } else {
+        input->Reset();
+        input->AppendRaw(
+            reinterpret_cast<const uint8_t*>(data.data()), nbytes);
+        InferResult* result = nullptr;
+        err = client->Infer(&result, options, {input});
+        if (!err) {
+          const uint8_t* buf;
+          size_t size;
+          result->RawData("OUTPUT0", &buf, &size);
+          memcpy(readback.data(), buf, std::min(size, nbytes));
+        }
+        delete result;
+      }
+      if (err) {
+        fprintf(stderr, "infer failed: %s\n", err.Message().c_str());
+        return err;
+      }
+      if (readback[1] != data[1]) {
+        fprintf(stderr, "wrong results\n");
+        return Error("wrong results");
+      }
+      auto dt = std::chrono::duration<double, std::milli>(
+                    std::chrono::steady_clock::now() - t0)
+                    .count();
+      if (i >= 5) times->push_back(dt);
+    }
+    if (shm) {
+      client->UnregisterTpuSharedMemory("");
+      delete rin;
+      delete rout;
+    }
+    return Error::Success();
+  };
+
+  std::vector<double> wire_times, shm_times;
+  if (run(false, &wire_times)) return 1;
+  if (run(true, &shm_times)) return 1;
+
+  printf(
+      "{\"metric\": \"native C++ client identity %.1fMiB p50\", "
+      "\"wire_p50_ms\": %.3f, \"wire_p99_ms\": %.3f, "
+      "\"tpu_shm_p50_ms\": %.3f, \"tpu_shm_p99_ms\": %.3f, "
+      "\"speedup\": %.2f}\n",
+      nbytes / 1048576.0, Percentile(wire_times, 0.5),
+      Percentile(wire_times, 0.99), Percentile(shm_times, 0.5),
+      Percentile(shm_times, 0.99),
+      Percentile(wire_times, 0.5) / Percentile(shm_times, 0.5));
+  return 0;
+}
