@@ -75,6 +75,15 @@ class InferenceServerHttpClient {
       int device_id, size_t byte_size);
   Error UnregisterCudaSharedMemory(const std::string& name = "");
 
+  // Offline marshaling (reference http_client.h:121-137): build/parse v2
+  // infer payloads without a network round trip.
+  static Error GenerateRequestBody(
+      std::string* request_body, size_t* header_length,
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {});
+  static Error ParseResponseBody(
+      InferResult** result, std::string&& response_body, size_t header_length);
+
   Error Infer(
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,

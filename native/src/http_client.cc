@@ -685,6 +685,20 @@ Error InferenceServerHttpClient::UnregisterCudaSharedMemory(
 // inference
 // ---------------------------------------------------------------------------
 
+Error InferenceServerHttpClient::GenerateRequestBody(
+    std::string* request_body, size_t* header_length,
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs) {
+  *header_length = BuildInferBody(options, inputs, outputs, request_body);
+  return Error::Success();
+}
+
+Error InferenceServerHttpClient::ParseResponseBody(
+    InferResult** result, std::string&& response_body, size_t header_length) {
+  return InferResultHttp::Create(
+      result, std::move(response_body), static_cast<long>(header_length), 200);
+}
+
 Error InferenceServerHttpClient::Infer(
     InferResult** result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,

@@ -321,12 +321,59 @@ void TestOnline(const std::string& url) {
   printf("ok online stats\n");
 }
 
+void TestOfflineMarshaling() {
+  // GenerateRequestBody/ParseResponseBody round trip with no server
+  int32_t values[4] = {5, 6, 7, 8};
+  InferInput* input = nullptr;
+  InferInput::Create(&input, "IN", {4}, "INT32");
+  input->AppendRaw(reinterpret_cast<uint8_t*>(values), sizeof(values));
+  InferOptions options("m");
+  std::string body;
+  size_t header_length = 0;
+  CHECK_OK(InferenceServerHttpClient::GenerateRequestBody(
+      &body, &header_length, options, {input}));
+  CHECK(header_length > 0 && body.size() == header_length + sizeof(values));
+  Json header;
+  std::string perr;
+  CHECK(Json::Parse(body.substr(0, header_length), &header, &perr));
+  CHECK(header.At("inputs")[0].At("name").AsString() == "IN");
+  delete input;
+
+  // a response body built by hand parses back through the public API
+  Json resp = Json::Object();
+  Json out = Json::Object();
+  out.Set("name", Json("OUT"));
+  out.Set("datatype", Json("INT32"));
+  Json shape = Json::Array();
+  shape.Append(Json(static_cast<int64_t>(4)));
+  out.Set("shape", std::move(shape));
+  Json params = Json::Object();
+  params.Set("binary_data_size", Json(static_cast<int64_t>(16)));
+  out.Set("parameters", std::move(params));
+  Json outs = Json::Array();
+  outs.Append(std::move(out));
+  resp.Set("outputs", std::move(outs));
+  std::string resp_header = resp.Dump();
+  std::string resp_body = resp_header;
+  resp_body.append(reinterpret_cast<char*>(values), sizeof(values));
+  InferResult* result = nullptr;
+  CHECK_OK(InferenceServerHttpClient::ParseResponseBody(
+      &result, std::move(resp_body), resp_header.size()));
+  const uint8_t* buf;
+  size_t size;
+  CHECK_OK(result->RawData("OUT", &buf, &size));
+  CHECK(size == 16 && memcmp(buf, values, 16) == 0);
+  delete result;
+  printf("ok offline marshaling\n");
+}
+
 int main() {
   TestJson();
   TestBase64();
   TestStringsSerialization();
   TestShm();
   TestTpuShm();
+  TestOfflineMarshaling();
   const char* url = getenv("CLIENT_TPU_TEST_URL");
   if (url != nullptr && url[0] != '\0') {
     TestOnline(url);
