@@ -441,10 +441,12 @@ class ServerCore:
     def _register(self, region: _Region) -> None:
         with self._lock:
             existing = self._regions.get(region.name)
-            if existing is not None and existing.family != region.family:
+            if existing is not None:
+                # Triton semantics: an active name must be unregistered first
+                region.close()
                 raise InferError(
-                    f"shared memory region '{region.name}' already registered "
-                    f"as {existing.family}", 400,
+                    f"shared memory region '{region.name}' already in manager",
+                    400,
                 )
             self._regions[region.name] = region
 

@@ -229,6 +229,12 @@ class InferResultHttp : public InferResult {
       size_t json_size =
           header_length >= 0 ? static_cast<size_t>(header_length)
                              : r->body_.size();
+      if (json_size > r->body_.size()) {
+        r->status_ = Error(
+            "malformed inference response: header length exceeds the body");
+        *result = r;
+        return Error::Success();
+      }
       std::string perr;
       if (!Json::Parse(r->body_.substr(0, json_size), &r->header_, &perr)) {
         r->status_ = Error("failed to parse inference response: " + perr);
@@ -240,8 +246,15 @@ class InferResultHttp : public InferResult {
           const Json& params = out.At("parameters");
           const std::string name = out.At("name").AsString();
           if (params.Has("binary_data_size")) {
-            size_t size =
-                static_cast<size_t>(params.At("binary_data_size").AsInt());
+            int64_t declared = params.At("binary_data_size").AsInt();
+            if (declared < 0 ||
+                cursor + static_cast<size_t>(declared) > r->body_.size()) {
+              r->status_ = Error(
+                  "malformed inference response: output '" + name +
+                  "' declares binary bytes beyond the body");
+              break;
+            }
+            size_t size = static_cast<size_t>(declared);
             r->offsets_[name] = {cursor, size};
             cursor += size;
           } else if (out.Has("data")) {
@@ -695,8 +708,10 @@ Error InferenceServerHttpClient::GenerateRequestBody(
 
 Error InferenceServerHttpClient::ParseResponseBody(
     InferResult** result, std::string&& response_body, size_t header_length) {
-  return InferResultHttp::Create(
-      result, std::move(response_body), static_cast<long>(header_length), 200);
+  // reference convention (http_client.h:121-137): 0 means the whole body is
+  // the JSON header (no binary tail)
+  long length = header_length == 0 ? -1 : static_cast<long>(header_length);
+  return InferResultHttp::Create(result, std::move(response_body), length, 200);
 }
 
 Error InferenceServerHttpClient::Infer(

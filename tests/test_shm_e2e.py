@@ -169,3 +169,18 @@ def test_shm_status_register_unregister_families(client):
         assert client.get_cuda_shared_memory_status() == []
     finally:
         tpushm.destroy_shared_memory_region(region)
+
+
+def test_duplicate_registration_rejected(client):
+    """Triton semantics: re-registering an active name is an error."""
+    region = shm.create_shared_memory_region("dupreg", "/dupreg_key", 64)
+    try:
+        client.register_system_shared_memory("dupreg", "/dupreg_key", 64)
+        with pytest.raises(InferenceServerException, match="already in manager"):
+            client.register_system_shared_memory("dupreg", "/dupreg_key", 64)
+        client.unregister_system_shared_memory("dupreg")
+        # after unregister the name is free again
+        client.register_system_shared_memory("dupreg", "/dupreg_key", 64)
+        client.unregister_system_shared_memory("dupreg")
+    finally:
+        shm.destroy_shared_memory_region(region)

@@ -1,0 +1,79 @@
+"""Soak tier: resource stability under churn (the reference's
+memory_leak_test.cc role, extended with fd tracking to catch attachment
+leaks like a server that never closes unregistered regions)."""
+
+import gc
+import os
+import resource
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+import client_tpu.utils.tpu_shared_memory as tpushm
+from client_tpu.models import default_model_zoo
+from client_tpu.server import HttpInferenceServer, ServerCore
+
+
+def _fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+def test_shm_register_unregister_churn_no_fd_leak():
+    """200 register/attach/unregister cycles: fd count and RSS stay flat."""
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            b = np.ones((1, 16), dtype=np.int32)
+            # warmup before baselining
+            for _ in range(10):
+                r = tpushm.create_shared_memory_region("churn", 128)
+                client.register_tpu_shared_memory("churn", tpushm.get_raw_handle(r), 0, 128)
+                client.unregister_tpu_shared_memory("churn")
+                tpushm.destroy_shared_memory_region(r)
+            gc.collect()
+            fd_before = _fd_count()
+            rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+            for i in range(200):
+                region = tpushm.create_shared_memory_region("churn", 128)
+                tpushm.set_shared_memory_region_from_jax(
+                    region, jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
+                )
+                client.register_tpu_shared_memory(
+                    "churn", tpushm.get_raw_handle(region), 0, 128
+                )
+                i0 = httpclient.InferInput("INPUT0", [1, 16], "INT32").set_shared_memory("churn", 64)
+                i1 = httpclient.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+                client.infer("simple", [i0, i1])
+                client.unregister_tpu_shared_memory("churn")
+                tpushm.destroy_shared_memory_region(region)
+
+            gc.collect()
+            fd_after = _fd_count()
+            rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    assert fd_after - fd_before <= 8, f"fd leak: {fd_before} -> {fd_after}"
+    growth_mb = (rss_after - rss_before) / 1024.0
+    assert growth_mb < 64, f"RSS grew {growth_mb:.1f} MB over 200 cycles"
+
+
+def test_wire_infer_churn_rss_bounded():
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            payload = np.random.default_rng(0).integers(0, 100, (1, 65536)).astype(np.int32)
+            for _ in range(20):
+                inp = httpclient.InferInput("INPUT0", [1, 65536], "INT32").set_data_from_numpy(payload)
+                client.infer("custom_identity_int32", [inp])
+            gc.collect()
+            rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            for _ in range(300):
+                inp = httpclient.InferInput("INPUT0", [1, 65536], "INT32").set_data_from_numpy(payload)
+                result = client.infer("custom_identity_int32", [inp])
+                assert result.as_numpy("OUTPUT0") is not None
+            gc.collect()
+            rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    growth_mb = (rss_after - rss_before) / 1024.0
+    assert growth_mb < 96, f"RSS grew {growth_mb:.1f} MB over 300 wire inferences"
