@@ -211,6 +211,9 @@ def default_model_zoo() -> List[Model]:
         StringAddSubModel(),
         IdentityModel("simple_identity", "BYTES"),
         IdentityModel("custom_identity_int32", "INT32", delay_s=0.0),
+        IdentityModel("identity_fp32", "FP32"),
+        IdentityModel("identity_bf16", "BF16"),
+        IdentityModel("identity_fp16", "FP16"),
         SequenceAccumulatorModel(),
         RepeatModel(),
     ]

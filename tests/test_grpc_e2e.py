@@ -307,3 +307,14 @@ def test_async_infer_cancellation(client):
     if not cancelled:
         result = ctx.get_result(timeout=10)
         assert result.as_numpy("OUTPUT0") is not None
+
+
+def test_bf16_identity_over_grpc(client):
+    import ml_dtypes
+
+    data = np.array([[0.5, -1.5, 2.0, -4.0]], dtype=ml_dtypes.bfloat16)
+    inp = grpcclient.InferInput("INPUT0", [1, 4], "BF16").set_data_from_numpy(data)
+    result = client.infer("identity_bf16", [inp])
+    out = result.as_numpy("OUTPUT0")
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out, data)

@@ -268,3 +268,22 @@ def test_aio_frontend_full_flow():
             # errors still map correctly
             with pytest.raises(InferenceServerException, match="unknown model"):
                 client.infer("missing", [in0, in1])
+
+
+@pytest.mark.parametrize("datatype,model", [("BF16", "identity_bf16"), ("FP16", "identity_fp16")])
+def test_half_precision_identity_roundtrip(client, datatype, model):
+    """BF16/FP16 wire round trips: native half dtypes end to end."""
+    from client_tpu.utils import triton_to_np_dtype
+
+    np_dtype = np.dtype(triton_to_np_dtype(datatype))
+    data = np.array([[1.5, -2.25, 0.125, 3.0]], dtype=np_dtype)
+    inp = httpclient.InferInput("INPUT0", [1, 4], datatype)
+    inp.set_data_from_numpy(data)
+    result = client.infer(model, [inp])
+    out = result.as_numpy("OUTPUT0")
+    assert out.dtype == np_dtype
+    np.testing.assert_array_equal(out, data)
+    # as_jax places the half-precision result on a jax device
+    jax_out = result.as_jax("OUTPUT0")
+    assert type(jax_out).__module__.startswith(("jax", "jaxlib"))
+    np.testing.assert_array_equal(np.asarray(jax_out), data)

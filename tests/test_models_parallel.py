@@ -196,3 +196,40 @@ def test_long_context_encoder_served():
             bad.set_data_from_numpy(seq[:63])
             with pytest.raises(InferenceServerException, match="divide"):
                 client.infer("long_context_encoder", [bad])
+
+
+def test_pipeline_parallel_matches_sequential():
+    """GPipe-style pipeline over 4 stages equals sequential application."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from client_tpu.parallel.pipeline import (
+        mlp_stage_params,
+        pipeline_forward,
+        sequential_mlp,
+    )
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("data", "model"))
+    w, b = mlp_stage_params(jax.random.PRNGKey(0), n_stages=4, dim=16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16), jnp.float32)
+    expected = np.asarray(sequential_mlp(w, b, x))
+    got = np.asarray(pipeline_forward(w, b, x, mesh, axis="model", n_microbatches=4))
+    np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_parallel_validates_shapes():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from client_tpu.parallel.pipeline import mlp_stage_params, pipeline_forward
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("data", "model"))
+    w, b = mlp_stage_params(jax.random.PRNGKey(0), n_stages=2, dim=8)
+    with pytest.raises(ValueError, match="stages"):
+        pipeline_forward(w, b, jnp.zeros((4, 8)), mesh)
