@@ -284,9 +284,13 @@ def _decode_scalar(f: Field, buf, pos: int, wire_type: int) -> Tuple[Any, int]:
             return bool(raw), pos
         return raw, pos
     if wire_type == 1:
+        if pos + 8 > len(buf):
+            raise ValueError("truncated fixed64 field")
         val = struct.unpack_from("<d", buf, pos)[0]
         return val, pos + 8
     if wire_type == 5:
+        if pos + 4 > len(buf):
+            raise ValueError("truncated fixed32 field")
         val = struct.unpack_from("<f", buf, pos)[0]
         return val, pos + 4
     if wire_type == 2:
@@ -347,9 +351,13 @@ def decode_message(spec: MessageSpec, buf) -> Dict[str, Any]:
                 vals = result.setdefault(f.name, [])
                 while pos < end:
                     if f.kind == "double":
+                        if pos + 8 > end:
+                            raise ValueError("truncated packed field")
                         vals.append(struct.unpack_from("<d", buf, pos)[0])
                         pos += 8
                     elif f.kind == "float":
+                        if pos + 4 > end:
+                            raise ValueError("truncated packed field")
                         vals.append(struct.unpack_from("<f", buf, pos)[0])
                         pos += 4
                     else:
@@ -382,6 +390,8 @@ def _decode_map_entry(f: Field, entry) -> Tuple[Any, Any]:
         elif num == 2:
             if vf.kind == "message":
                 length, pos = decode_varint(entry, pos)
+                if pos + length > n:
+                    raise ValueError("truncated map value")
                 value = decode_message(vf.msg, entry[pos : pos + length])
                 pos += length
             else:

@@ -1,0 +1,98 @@
+"""Wire-codec property/fuzz tests: random messages round-trip exactly and
+random bytes never crash the decoder with anything but ValueError."""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from client_tpu.grpc import _messages as M
+from client_tpu.grpc._wire import decode_message, encode_message
+
+_names = st.text(
+    alphabet=st.characters(min_codepoint=32, max_codepoint=126), max_size=12
+)
+
+
+@st.composite
+def infer_requests(draw):
+    """Random-but-valid ModelInferRequest dicts."""
+    request = {"model_name": draw(_names), "id": draw(_names)}
+    inputs = []
+    for _ in range(draw(st.integers(0, 3))):
+        tensor = {
+            "name": draw(_names),
+            "datatype": draw(st.sampled_from(["INT32", "FP32", "BYTES", "BF16"])),
+            "shape": draw(st.lists(st.integers(-1, 1 << 40), max_size=4)),
+        }
+        params = {}
+        for key in draw(st.lists(_names.filter(bool), max_size=2, unique=True)):
+            params[key] = draw(
+                st.sampled_from(
+                    [
+                        {"bool_param": draw(st.booleans())},
+                        {"int64_param": draw(st.integers(-(1 << 62), 1 << 62))},
+                        {"string_param": draw(_names)},
+                        {"double_param": draw(st.floats(allow_nan=False, width=64))},
+                    ]
+                )
+            )
+        if params:
+            tensor["parameters"] = params
+        inputs.append(tensor)
+    if inputs:
+        request["inputs"] = inputs
+    raws = draw(st.lists(st.binary(max_size=64), max_size=3))
+    if raws:
+        request["raw_input_contents"] = raws
+    return request
+
+
+@given(infer_requests())
+@settings(max_examples=150, deadline=None)
+def test_infer_request_roundtrip_property(request):
+    decoded = decode_message(
+        M.MODEL_INFER_REQUEST, encode_message(M.MODEL_INFER_REQUEST, request)
+    )
+    # proto3 semantics: default-valued non-oneof fields vanish on the wire
+    for key, value in request.items():
+        if key in ("model_name", "id"):
+            if value:
+                assert decoded[key] == value
+            else:
+                assert key not in decoded
+        elif key == "raw_input_contents":
+            assert decoded[key] == value
+        elif key == "inputs":
+            assert len(decoded[key]) == len(value)
+            for got, want in zip(decoded[key], value):
+                assert got.get("name", "") == want.get("name", "")
+                assert got.get("datatype", "") == want.get("datatype", "")
+                assert got.get("shape", []) == [int(d) for d in want.get("shape", [])]
+                if want.get("parameters"):
+                    assert "parameters" in got, "parameters dropped by codec"
+                    for pk, pv in want["parameters"].items():
+                        assert got["parameters"][pk] == pv
+
+
+@given(st.binary(max_size=200))
+@settings(max_examples=300, deadline=None)
+def test_decoder_never_crashes_on_garbage(data):
+    """Arbitrary bytes: decode either succeeds or raises ValueError — never
+    IndexError/struct.error/KeyError/segfault."""
+    for spec in (M.MODEL_INFER_REQUEST, M.MODEL_INFER_RESPONSE, M.MODEL_CONFIG):
+        try:
+            decode_message(spec, data)
+        except ValueError:
+            pass
+
+
+@given(st.binary(max_size=100), st.integers(0, 100))
+@settings(max_examples=200, deadline=None)
+def test_bytes_deserializer_never_crashes(data, count):
+    from client_tpu.utils import InferenceServerException, deserialize_bytes_tensor
+
+    try:
+        out = deserialize_bytes_tensor(data, count=count)
+        assert out.dtype == np.object_
+    except InferenceServerException:
+        pass
