@@ -61,6 +61,53 @@ def _bind(lib):
     lib.ctpu_unregister_shm.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
     ]
+    # full value-model surface
+    lib.ctpu_input_create.restype = ctypes.c_void_p
+    lib.ctpu_input_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_int,
+    ]
+    lib.ctpu_input_destroy.argtypes = [ctypes.c_void_p]
+    lib.ctpu_input_append_raw.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_ulonglong
+    ]
+    lib.ctpu_input_set_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_ulonglong, ctypes.c_ulonglong
+    ]
+    lib.ctpu_output_create.restype = ctypes.c_void_p
+    lib.ctpu_output_create.argtypes = [ctypes.c_char_p, ctypes.c_ulonglong]
+    lib.ctpu_output_destroy.argtypes = [ctypes.c_void_p]
+    lib.ctpu_output_set_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_ulonglong, ctypes.c_ulonglong
+    ]
+    lib.ctpu_options_create.restype = ctypes.c_void_p
+    lib.ctpu_options_create.argtypes = [ctypes.c_char_p]
+    lib.ctpu_options_destroy.argtypes = [ctypes.c_void_p]
+    lib.ctpu_options_set_request_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ctpu_options_set_sequence.argtypes = [
+        ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_int, ctypes.c_int
+    ]
+    lib.ctpu_infer.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.ctpu_result_destroy.argtypes = [ctypes.c_void_p]
+    lib.ctpu_result_raw.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_ulonglong),
+    ]
+    lib.ctpu_result_shape.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_int,
+    ]
+    lib.ctpu_result_shape.restype = ctypes.c_int
+    lib.ctpu_result_datatype.restype = ctypes.c_char_p
+    lib.ctpu_result_datatype.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ctpu_result_output_name.restype = ctypes.c_char_p
+    lib.ctpu_result_output_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ctpu_result_output_names.restype = ctypes.c_char_p
+    lib.ctpu_result_output_names.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -150,6 +197,134 @@ class NativeClient:
             raise InferenceServerException(_err(self._lib))
         np_dtype = np.dtype(output_dtype or tensor.dtype)
         return out[:nbytes].view(np_dtype)
+
+    def infer(self, model_name: str, inputs, outputs=None, request_id: str = "",
+              sequence=None):
+        """Full value-model inference through the native data path.
+
+        ``inputs``: list of (name, np.ndarray) and/or
+        (name, ("shm", region, byte_size, offset, datatype, shape)).
+        ``outputs``: optional list of names or (name, ("shm", ...)) tuples.
+        Returns {output_name: np.ndarray} for non-shm outputs.
+        """
+        from .utils import triton_to_np_dtype
+
+        lib = self._lib
+        in_handles = []
+        out_handles = []
+        keepalive = []
+        options = lib.ctpu_options_create(model_name.encode())
+        try:
+            if request_id:
+                lib.ctpu_options_set_request_id(options, request_id.encode())
+            if sequence is not None:
+                seq_id, start, end = sequence
+                lib.ctpu_options_set_sequence(options, seq_id, int(start), int(end))
+            out_names = []
+            for name, value in inputs:
+                if isinstance(value, tuple) and value and value[0] == "shm":
+                    _, region, nbytes, offset, datatype, shape = value
+                    dims = (ctypes.c_longlong * len(shape))(*shape)
+                    handle = lib.ctpu_input_create(
+                        name.encode(), datatype.encode(), dims, len(shape)
+                    )
+                    lib.ctpu_input_set_shm(handle, region.encode(), nbytes, offset)
+                else:
+                    arr = np.ascontiguousarray(value)
+                    datatype = np_to_triton_dtype(arr.dtype)
+                    if datatype is None:
+                        raise InferenceServerException(
+                            f"input '{name}' has unsupported dtype {arr.dtype}"
+                        )
+                    if datatype == "BYTES":
+                        from .utils import serialize_byte_tensor
+
+                        serialized = serialize_byte_tensor(arr)
+                        payload = np.frombuffer(
+                            serialized.item() if serialized.size else b"",
+                            dtype=np.uint8,
+                        )
+                    else:
+                        payload = arr
+                    keepalive.append(payload)
+                    dims = (ctypes.c_longlong * arr.ndim)(*arr.shape)
+                    handle = lib.ctpu_input_create(
+                        name.encode(), datatype.encode(), dims, arr.ndim
+                    )
+                    lib.ctpu_input_append_raw(
+                        handle,
+                        payload.ctypes.data_as(ctypes.c_void_p),
+                        payload.nbytes,
+                    )
+                if not handle:
+                    raise InferenceServerException(_err(lib))
+                in_handles.append(handle)
+            for spec in outputs or []:
+                if isinstance(spec, tuple):
+                    name, shm_spec = spec
+                    handle = lib.ctpu_output_create(name.encode(), 0)
+                    _, region, nbytes, offset = shm_spec[:4]
+                    lib.ctpu_output_set_shm(handle, region.encode(), nbytes, offset)
+                else:
+                    name = spec
+                    handle = lib.ctpu_output_create(name.encode(), 0)
+                    out_names.append(name)
+                out_handles.append(handle)
+
+            ins = (ctypes.c_void_p * len(in_handles))(*in_handles)
+            outs = (ctypes.c_void_p * len(out_handles))(*out_handles)
+            result_ptr = ctypes.c_void_p()
+            rc = lib.ctpu_infer(
+                self._handle, options, ins, len(in_handles), outs,
+                len(out_handles), ctypes.byref(result_ptr),
+            )
+            if rc != 0:
+                if result_ptr:
+                    lib.ctpu_result_destroy(result_ptr)
+                raise InferenceServerException(_err(lib))
+            try:
+                decoded = {}
+                if outputs is None:  # enumerate everything the server returned
+                    joined = lib.ctpu_result_output_names(result_ptr)
+                    names = joined.decode().split("\n") if joined else []
+                    names = [n for n in names if n]
+                else:
+                    names = out_names  # shm-placed outputs live in regions
+                for name in names:
+                    buf = ctypes.c_void_p()
+                    nbytes = ctypes.c_ulonglong()
+                    if lib.ctpu_result_raw(
+                        result_ptr, name.encode(), ctypes.byref(buf),
+                        ctypes.byref(nbytes),
+                    ) != 0:
+                        raise InferenceServerException(_err(lib))
+                    dims = (ctypes.c_longlong * 16)()
+                    ndim = lib.ctpu_result_shape(result_ptr, name.encode(), dims, 16)
+                    if ndim < 0:
+                        raise InferenceServerException(_err(lib))
+                    shape = [dims[i] for i in range(ndim)]
+                    datatype = lib.ctpu_result_datatype(result_ptr, name.encode()).decode()
+                    raw = ctypes.string_at(buf, nbytes.value)
+                    if datatype == "BYTES":
+                        from .utils import deserialize_bytes_tensor
+
+                        decoded[name] = deserialize_bytes_tensor(raw).reshape(shape)
+                        continue
+                    np_dtype = triton_to_np_dtype(datatype)
+                    if np_dtype is None:
+                        raise InferenceServerException(
+                            f"output '{name}' has unknown datatype {datatype!r}"
+                        )
+                    decoded[name] = np.frombuffer(raw, dtype=np.dtype(np_dtype)).reshape(shape)
+                return decoded
+            finally:
+                lib.ctpu_result_destroy(result_ptr)
+        finally:
+            for handle in in_handles:
+                lib.ctpu_input_destroy(handle)
+            for handle in out_handles:
+                lib.ctpu_output_destroy(handle)
+            lib.ctpu_options_destroy(options)
 
     def register_tpu_shared_memory(
         self, name: str, raw_handle: str, device_id: int, byte_size: int

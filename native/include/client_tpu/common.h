@@ -150,6 +150,7 @@ class InferResult {
   virtual Error ModelName(std::string* name) const = 0;
   virtual Error ModelVersion(std::string* version) const = 0;
   virtual Error Id(std::string* id) const = 0;
+  virtual Error OutputNames(std::vector<std::string>* names) const = 0;
   virtual Error Shape(
       const std::string& output_name, std::vector<int64_t>* shape) const = 0;
   virtual Error Datatype(

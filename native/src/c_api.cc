@@ -121,6 +121,190 @@ int ctpu_unregister_shm(void* client, const char* family, const char* name) {
   return -1;
 }
 
+// -- full value-model surface -------------------------------------------------
+// Handle-based API so any FFI language drives multi-input inference with
+// options, shared-memory placement, and result introspection.
+
+void* ctpu_input_create(
+    const char* name, const char* datatype, const long long* shape, int ndim) {
+  std::vector<int64_t> dims(shape, shape + ndim);
+  InferInput* input = nullptr;
+  Error err = InferInput::Create(&input, name, dims, datatype);
+  if (SetError(err) != 0) return nullptr;
+  return input;
+}
+
+void ctpu_input_destroy(void* input) { delete static_cast<InferInput*>(input); }
+
+// NOTE: no copy — `data` must stay valid until the infer call returns.
+int ctpu_input_append_raw(
+    void* input, const void* data, unsigned long long byte_size) {
+  return SetError(static_cast<InferInput*>(input)->AppendRaw(
+      static_cast<const uint8_t*>(data), byte_size));
+}
+
+int ctpu_input_set_shm(
+    void* input, const char* region, unsigned long long byte_size,
+    unsigned long long offset) {
+  return SetError(static_cast<InferInput*>(input)->SetSharedMemory(
+      region, byte_size, offset));
+}
+
+int ctpu_input_reset(void* input) {
+  return SetError(static_cast<InferInput*>(input)->Reset());
+}
+
+void* ctpu_output_create(const char* name, unsigned long long class_count) {
+  InferRequestedOutput* output = nullptr;
+  Error err = InferRequestedOutput::Create(&output, name, class_count);
+  if (SetError(err) != 0) return nullptr;
+  return output;
+}
+
+void ctpu_output_destroy(void* output) {
+  delete static_cast<InferRequestedOutput*>(output);
+}
+
+int ctpu_output_set_shm(
+    void* output, const char* region, unsigned long long byte_size,
+    unsigned long long offset) {
+  return SetError(static_cast<InferRequestedOutput*>(output)->SetSharedMemory(
+      region, byte_size, offset));
+}
+
+void* ctpu_options_create(const char* model_name) {
+  return new InferOptions(model_name);
+}
+
+void ctpu_options_destroy(void* options) {
+  delete static_cast<InferOptions*>(options);
+}
+
+void ctpu_options_set_request_id(void* options, const char* request_id) {
+  static_cast<InferOptions*>(options)->request_id = request_id;
+}
+
+void ctpu_options_set_sequence(
+    void* options, unsigned long long sequence_id, int sequence_start,
+    int sequence_end) {
+  auto* o = static_cast<InferOptions*>(options);
+  o->sequence_id = sequence_id;
+  o->sequence_start = sequence_start != 0;
+  o->sequence_end = sequence_end != 0;
+}
+
+void ctpu_options_set_timeouts(
+    void* options, unsigned long long client_timeout_us,
+    unsigned long long server_timeout_us) {
+  auto* o = static_cast<InferOptions*>(options);
+  o->client_timeout_us = client_timeout_us;
+  o->server_timeout_us = server_timeout_us;
+}
+
+int ctpu_infer(
+    void* client, void* options, void** inputs, int n_inputs, void** outputs,
+    int n_outputs, void** result_out) {
+  std::vector<InferInput*> ins(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) ins[i] = static_cast<InferInput*>(inputs[i]);
+  std::vector<const InferRequestedOutput*> outs(n_outputs);
+  for (int i = 0; i < n_outputs; ++i) {
+    outs[i] = static_cast<const InferRequestedOutput*>(outputs[i]);
+  }
+  InferResult* result = nullptr;
+  Error err = static_cast<InferenceServerHttpClient*>(client)->Infer(
+      &result, *static_cast<InferOptions*>(options), ins, outs);
+  *result_out = result;
+  return SetError(err);
+}
+
+// -- result accessors --------------------------------------------------------
+
+void ctpu_result_destroy(void* result) {
+  delete static_cast<InferResult*>(result);
+}
+
+// Zero-copy view into the result's buffer; valid while the result lives.
+int ctpu_result_raw(
+    void* result, const char* output_name, const void** buf,
+    unsigned long long* byte_size) {
+  const uint8_t* data = nullptr;
+  size_t size = 0;
+  Error err = static_cast<InferResult*>(result)->RawData(output_name, &data, &size);
+  *buf = data;
+  *byte_size = size;
+  return SetError(err);
+}
+
+// Fills `dims` (capacity `max_ndim`); returns ndim or -1.
+int ctpu_result_shape(
+    void* result, const char* output_name, long long* dims, int max_ndim) {
+  std::vector<int64_t> shape;
+  Error err = static_cast<InferResult*>(result)->Shape(output_name, &shape);
+  if (SetError(err) != 0) return -1;
+  if (static_cast<int>(shape.size()) > max_ndim) {
+    g_last_error = "shape buffer too small";
+    return -1;
+  }
+  for (size_t i = 0; i < shape.size(); ++i) dims[i] = shape[i];
+  return static_cast<int>(shape.size());
+}
+
+const char* ctpu_result_datatype(void* result, const char* output_name) {
+  thread_local std::string datatype;
+  Error err =
+      static_cast<InferResult*>(result)->Datatype(output_name, &datatype);
+  if (SetError(err) != 0) return nullptr;
+  return datatype.c_str();
+}
+
+// All output names, newline-joined (one call for O(n) enumeration).
+const char* ctpu_result_output_names(void* result) {
+  thread_local std::string joined;
+  std::vector<std::string> names;
+  static_cast<InferResult*>(result)->OutputNames(&names);
+  joined.clear();
+  for (size_t i = 0; i < names.size(); ++i) {
+    if (i) joined.push_back('\n');
+    joined += names[i];
+  }
+  return joined.c_str();
+}
+
+// Returns the index-th output name, or NULL past the end.
+const char* ctpu_result_output_name(void* result, int index) {
+  thread_local std::string name;
+  std::vector<std::string> names;
+  static_cast<InferResult*>(result)->OutputNames(&names);
+  if (index < 0 || static_cast<size_t>(index) >= names.size()) return nullptr;
+  name = names[index];
+  return name.c_str();
+}
+
+const char* ctpu_result_model_name(void* result) {
+  thread_local std::string name;
+  static_cast<InferResult*>(result)->ModelName(&name);
+  return name.c_str();
+}
+
+// -- async ---------------------------------------------------------------------
+
+typedef void (*ctpu_callback)(void* user, void* result);
+
+int ctpu_async_infer(
+    void* client, void* options, void** inputs, int n_inputs, void** outputs,
+    int n_outputs, ctpu_callback callback, void* user) {
+  std::vector<InferInput*> ins(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) ins[i] = static_cast<InferInput*>(inputs[i]);
+  std::vector<const InferRequestedOutput*> outs(n_outputs);
+  for (int i = 0; i < n_outputs; ++i) {
+    outs[i] = static_cast<const InferRequestedOutput*>(outputs[i]);
+  }
+  Error err = static_cast<InferenceServerHttpClient*>(client)->AsyncInfer(
+      [callback, user](InferResult* result) { callback(user, result); },
+      *static_cast<InferOptions*>(options), ins, outs);
+  return SetError(err);
+}
+
 // -- tpu shm regions ---------------------------------------------------------
 
 void* ctpu_shm_create(const char* name, unsigned long long byte_size, int device_id) {

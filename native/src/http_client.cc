@@ -285,6 +285,15 @@ class InferResultHttp : public InferResult {
     return Error::Success();
   }
 
+  Error OutputNames(std::vector<std::string>* names) const override {
+    names->clear();
+    const Json& outs = header_.At("outputs");
+    for (size_t i = 0; i < outs.size(); ++i) {
+      names->push_back(outs[i].At("name").AsString());
+    }
+    return Error::Success();
+  }
+
   const Json* FindOutput(const std::string& name) const {
     const Json& outs = header_.At("outputs");
     for (size_t i = 0; i < outs.size(); ++i) {

@@ -99,3 +99,68 @@ def test_ctypes_tpu_shm_interop(server):
         py_region.detach()
     finally:
         native_region.destroy()
+
+
+def test_ctypes_full_value_model(server):
+    """Multi-input infer with options + output enumeration via the C API."""
+    from client_tpu.native import NativeClient
+
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    with NativeClient(server.url) as client:
+        # explicit outputs
+        out = client.infer(
+            "simple", [("INPUT0", a), ("INPUT1", b)],
+            outputs=["OUTPUT0", "OUTPUT1"], request_id="capi-1",
+        )
+        np.testing.assert_array_equal(out["OUTPUT0"], a + b)
+        np.testing.assert_array_equal(out["OUTPUT1"], a - b)
+        # no explicit outputs: enumerated from the result
+        out = client.infer("simple", [("INPUT0", a), ("INPUT1", b)])
+        assert set(out) == {"OUTPUT0", "OUTPUT1"}
+        np.testing.assert_array_equal(out["OUTPUT1"], a - b)
+        # sequence options through the C API
+        for i, (start, end) in enumerate([(True, False), (False, True)]):
+            seq_out = client.infer(
+                "simple_sequence",
+                [("INPUT", np.array([[4]], dtype=np.int32))],
+                sequence=(777, start, end),
+            )
+        assert seq_out["OUTPUT"][0, 0] == 8
+        # error propagation
+        from client_tpu.utils import InferenceServerException
+
+        with pytest.raises(InferenceServerException, match="unknown model"):
+            client.infer("missing", [("INPUT0", a)])
+
+
+def test_ctypes_bytes_and_shm_outputs(server):
+    """BYTES wire format + all-shm outputs through the C API (review regressions)."""
+    import client_tpu.utils.tpu_shared_memory as tpushm
+    from client_tpu.native import NativeClient
+
+    with NativeClient(server.url) as client:
+        # BYTES inputs serialize with length prefixes; BYTES outputs decode
+        data = np.array([[str(i) for i in range(16)]], dtype=np.object_)
+        ones = np.array([["1"] * 16], dtype=np.object_)
+        out = client.infer("simple_string", [("INPUT0", data), ("INPUT1", ones)])
+        assert out["OUTPUT0"][0, 5] == b"6"
+        # outputs all placed in shm: no decode attempt, no exception
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        region = tpushm.create_shared_memory_region("capi_out", 128)
+        try:
+            client.register_tpu_shared_memory(
+                "capi_out", tpushm.get_raw_handle(region).encode().decode(), 0, 128
+            )
+            out = client.infer(
+                "simple", [("INPUT0", a), ("INPUT1", b)],
+                outputs=[("OUTPUT0", ("shm", "capi_out", 64, 0))],
+            )
+            assert out == {}
+            np.testing.assert_array_equal(
+                tpushm.get_contents_as_numpy(region, "INT32", [1, 16]), a + b
+            )
+            client.unregister_shared_memory("tpu", "capi_out")
+        finally:
+            tpushm.destroy_shared_memory_region(region)
