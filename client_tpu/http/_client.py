@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence
 from urllib.parse import quote, urlencode
@@ -81,7 +82,13 @@ class InferenceServerClient(InferenceServerClientBase):
         ssl_options: Optional[Dict[str, Any]] = None,
         ssl_context_factory: Any = None,
         insecure: bool = False,
+        max_retries: int = 0,
     ):
+        """``max_retries``: re-attempts on *connect* failures (connection
+        refused / DNS), where the request provably never reached the server —
+        the safe subset of the reference Java client's retry loop
+        (InferenceServerClient.java:293-317). In-flight failures are never
+        retried (inference is not idempotent for sequences)."""
         super().__init__()
         if "://" in url:
             raise InferenceServerException(
@@ -118,6 +125,7 @@ class InferenceServerClient(InferenceServerClientBase):
         self._executor: Optional[ThreadPoolExecutor] = None
         self._executor_lock = threading.Lock()
         self._infer_stat = InferStat()
+        self._max_retries = max(0, max_retries)
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
@@ -175,18 +183,38 @@ class InferenceServerClient(InferenceServerClientBase):
         if timeout is not None:
             kwargs["timeout"] = urllib3.Timeout(connect=timeout, read=timeout)
         resp = None
+        attempts_left = self._max_retries
+        # retry backoff must respect the caller's deadline, not just each
+        # attempt's socket timeout
+        deadline = time.monotonic() + timeout if timeout is not None else None
         try:
-            resp = self._pool.request(method, uri, **kwargs)
+            while True:
+                try:
+                    resp = self._pool.request(method, uri, **kwargs)
+                    break
+                except urllib3.exceptions.NewConnectionError as e:
+                    # must precede TimeoutError: NewConnectionError subclasses
+                    # ConnectTimeoutError in urllib3, but "refused" isn't
+                    # "timed out". Connect failures never reached the server,
+                    # so they are the one class safe to retry.
+                    backoff = min(0.05 * (self._max_retries - attempts_left + 1), 0.5)
+                    if attempts_left <= 0 or (
+                        deadline is not None
+                        and time.monotonic() + backoff >= deadline
+                    ):
+                        raise InferenceServerException(
+                            f"connection error: {e}"
+                        ) from e
+                    attempts_left -= 1
+                    if self._verbose:
+                        print(f"retrying after connect failure ({attempts_left} left)")
+                    time.sleep(backoff)
             if timers is not None:
                 timers.capture(RequestTimers.SEND_END)
                 timers.capture(RequestTimers.RECV_START)
             data = resp.read(decode_content=True)
             if timers is not None:
                 timers.capture(RequestTimers.RECV_END)
-        except urllib3.exceptions.NewConnectionError as e:
-            # must precede TimeoutError: NewConnectionError subclasses
-            # ConnectTimeoutError in urllib3, but "refused" is not "timed out"
-            raise InferenceServerException(f"connection error: {e}") from e
         except urllib3.exceptions.TimeoutError as e:
             raise InferenceServerException("Deadline Exceeded", status="499") from e
         except urllib3.exceptions.HTTPError as e:

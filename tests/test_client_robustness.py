@@ -121,3 +121,63 @@ def test_negative_binary_data_size_rejected():
     header2 = header.replace(b"-4", b'"4"')
     with pytest.raises(InferenceServerException, match="invalid binary_data_size"):
         InferResult.from_response_body(header2 + body[len(header):], len(header2))
+
+
+def test_connect_retry_recovers_when_server_appears():
+    """max_retries re-attempts connect failures; the request succeeds once
+    the server comes up (reference: Java client retry loop)."""
+    import socket
+    import threading
+    import time as timemod
+
+    import client_tpu.http as httpclient
+    from client_tpu.models import default_model_zoo
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    # reserve a port, keep it closed for a moment, then start the server on it
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    core = ServerCore(default_model_zoo())
+    server_box = {}
+
+    def bring_up():
+        timemod.sleep(0.4)
+        server_box["server"] = HttpInferenceServer(core, port=port).start()
+
+    thread = threading.Thread(target=bring_up)
+    thread.start()
+    try:
+        with httpclient.InferenceServerClient(f"127.0.0.1:{port}", max_retries=40) as c:
+            # retries bridge the gap until the server binds
+            assert c.is_server_live()
+    finally:
+        thread.join()
+        server = server_box.get("server")
+        if server is not None:
+            server.stop()
+
+
+def test_no_retry_by_default_on_refused():
+    import client_tpu.http as httpclient
+
+    with httpclient.InferenceServerClient("127.0.0.1:9", max_retries=0) as c:
+        with pytest.raises(InferenceServerException, match="connection error"):
+            c.is_server_live()
+
+
+def test_retry_respects_client_timeout():
+    """Retry backoff must not blow past an explicit per-request deadline."""
+    import time as timemod
+
+    import client_tpu.http as httpclient
+
+    with httpclient.InferenceServerClient("127.0.0.1:9", max_retries=100) as c:
+        inp = httpclient.InferInput("IN", [1], "INT32")
+        inp.set_data_from_numpy(np.array([1], dtype=np.int32))
+        t0 = timemod.monotonic()
+        with pytest.raises(InferenceServerException):
+            c.infer("m", [inp], client_timeout=0.5)
+        assert timemod.monotonic() - t0 < 2.0, "retries ignored the deadline"
