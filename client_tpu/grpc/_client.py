@@ -193,11 +193,14 @@ class InferenceServerClient(InferenceServerClientBase):
         req = {"name": model_name, "version": model_version}
         return bool(self._call("ModelReady", req, headers, client_timeout).get("ready", False))
 
-    def get_server_metadata(self, headers=None, client_timeout=None) -> Dict[str, Any]:
+    def get_server_metadata(self, headers=None, client_timeout=None, as_json=True) -> Dict[str, Any]:
+        # as_json accepted for reference-signature compat; results are always
+        # dicts here (there is no protobuf message object to return)
         return self._call("ServerMetadata", {}, headers, client_timeout)
 
     def get_model_metadata(
-        self, model_name, model_version="", headers=None, client_timeout=None
+        self, model_name, model_version="", headers=None, client_timeout=None,
+        as_json=True,
     ) -> Dict[str, Any]:
         return self._call(
             "ModelMetadata", {"name": model_name, "version": model_version},
@@ -205,7 +208,8 @@ class InferenceServerClient(InferenceServerClientBase):
         )
 
     def get_model_config(
-        self, model_name, model_version="", headers=None, client_timeout=None
+        self, model_name, model_version="", headers=None, client_timeout=None,
+        as_json=True,
     ) -> Dict[str, Any]:
         return self._call(
             "ModelConfig", {"name": model_name, "version": model_version},
@@ -242,7 +246,8 @@ class InferenceServerClient(InferenceServerClientBase):
 
     # -- statistics / trace / log ------------------------------------------
     def get_inference_statistics(
-        self, model_name="", model_version="", headers=None, client_timeout=None
+        self, model_name="", model_version="", headers=None, client_timeout=None,
+        as_json=True,
     ) -> Dict[str, Any]:
         return self._call(
             "ModelStatistics", {"name": model_name, "version": model_version},

@@ -126,3 +126,36 @@ def test_grpc_aio_stream_decoupled(servers):
             assert seen == [7, 8]
 
     asyncio.run(run())
+
+
+def test_grpc_aio_stream_error_in_band(servers):
+    """Stream errors reach the aio consumer as (None, error) pairs."""
+    _, grpc_server = servers
+    import client_tpu.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as client:
+            async def requests():
+                inp = aioclient.InferInput("INPUT", [1, 1], "INT32")
+                inp.set_data_from_numpy(np.array([[1]], dtype=np.int32))
+                yield {"model_name": "simple_sequence", "inputs": [inp]}  # no seq id
+
+            stream = await client.stream_infer(requests())
+            async for result, error in stream:
+                assert result is None
+                assert "sequence_id" in str(error)
+                break
+
+    asyncio.run(run())
+
+
+def test_grpc_as_json_compat(servers):
+    """Reference-signature compat: as_json kwarg accepted on getters."""
+    _, grpc_server = servers
+    import client_tpu.grpc as grpcclient
+
+    with grpcclient.InferenceServerClient(grpc_server.url) as client:
+        assert client.get_server_metadata(as_json=True)["name"]
+        assert client.get_model_metadata("simple", as_json=True)["name"] == "simple"
+        assert client.get_model_config("simple", as_json=True)["config"]["backend"] == "jax"
+        assert client.get_inference_statistics("simple", as_json=True)["model_stats"]
