@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""BYTES tensors through shared memory over GRPC.
+
+Equivalent of the reference's simple_grpc_shm_string_client.py: string
+tensors serialized into a system shm region (4-byte-LE length prefixes),
+outputs read back from a region with the response's reported byte size.
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+import client_tpu.utils.shared_memory as shm
+from client_tpu.utils import serialized_byte_size
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.unregister_system_shared_memory()
+
+        in0 = np.array([[str(i) for i in range(16)]], dtype=np.object_)
+        in1 = np.array([["10"] * 16], dtype=np.object_)
+        in0_size = serialized_byte_size(in0)
+        in1_size = serialized_byte_size(in1)
+        out_capacity = 4 * (in0_size + in1_size)
+
+        shm_ip = shm.create_shared_memory_region(
+            "input_data", "/str_shm_in", in0_size + in1_size
+        )
+        shm.set_shared_memory_region(shm_ip, [in0])
+        shm.set_shared_memory_region(shm_ip, [in1], offset=in0_size)
+        client.register_system_shared_memory(
+            "input_data", "/str_shm_in", in0_size + in1_size
+        )
+        shm_op = shm.create_shared_memory_region(
+            "output_data", "/str_shm_out", out_capacity
+        )
+        client.register_system_shared_memory("output_data", "/str_shm_out", out_capacity)
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "BYTES"),
+            grpcclient.InferInput("INPUT1", [1, 16], "BYTES"),
+        ]
+        inputs[0].set_shared_memory("input_data", in0_size)
+        inputs[1].set_shared_memory("input_data", in1_size, offset=in0_size)
+        outputs = [grpcclient.InferRequestedOutput("OUTPUT0")]
+        outputs[0].set_shared_memory("output_data", out_capacity)
+
+        result = client.infer("simple_string", inputs, outputs=outputs)
+        # the response reports how many bytes the output actually used
+        out_meta = result.get_output("OUTPUT0")
+        used = out_meta["parameters"]["shared_memory_byte_size"]["int64_param"]
+        sums = shm.get_contents_as_numpy(shm_op, "BYTES", [1, 16])
+        ok = all(int(sums[0][i]) == i + 10 for i in range(16)) and used > 0
+
+        client.unregister_system_shared_memory()
+        shm.destroy_shared_memory_region(shm_ip)
+        shm.destroy_shared_memory_region(shm_op)
+        if not ok:
+            sys.exit("shm string error: incorrect results")
+        print("PASS: grpc shm string")
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,7 @@ GRPC_EXAMPLES = [
     "simple_grpc_aio_infer_client.py",
     "simple_grpc_string_infer_client.py",
     "simple_grpc_shm_client.py",
+    "simple_grpc_shm_string_client.py",
     "simple_grpc_tpushm_client.py",
     "simple_grpc_sequence_stream_infer_client.py",
     "simple_grpc_aio_sequence_stream_infer_client.py",
