@@ -77,19 +77,27 @@ class PerfRunner:
         self._output_sizes = self._probe_output_sizes() if shared_memory != "none" else {}
 
     def _import_client_mod(self):
-        if self.protocol == "http":
+        if self.protocol in ("http", "native"):
             import client_tpu.http as mod
         else:
             import client_tpu.grpc as mod
         return mod
 
     def _make_client(self, concurrency: int = 1):
+        if self.protocol == "native":
+            from client_tpu.native import NativeClient
+
+            return NativeClient(self.url)
         if self.protocol == "http":
             return self._client_mod.InferenceServerClient(self.url, concurrency=concurrency)
         return self._client_mod.InferenceServerClient(self.url)
 
     def _fetch_metadata(self) -> Dict[str, Any]:
-        client = self._make_client()
+        # metadata always via the python http client (the native C API is a
+        # data-plane surface)
+        import client_tpu.http as httpmod
+
+        client = httpmod.InferenceServerClient(self.url)
         try:
             md = client.get_model_metadata(self.model_name)
         finally:
@@ -116,8 +124,11 @@ class PerfRunner:
     def _probe_output_sizes(self) -> Dict[str, int]:
         from .utils import serialized_byte_size
 
-        mod = self._client_mod
-        client = self._make_client()
+        # the probe always rides the python http client: it only needs one
+        # wire-mode inference to learn output sizes
+        import client_tpu.http as mod
+
+        client = mod.InferenceServerClient(self.url)
         try:
             inputs = []
             for name, datatype, shape, data in self._tensors:
@@ -187,7 +198,15 @@ class PerfRunner:
         shm_ctx = None
         setup_failed = False
         try:
-            if self.shared_memory == "system":
+            if self.protocol == "native":
+                if self.shared_memory == "system":
+                    raise ValueError(
+                        "native protocol supports --shared-memory none|tpu"
+                    )
+                inputs, outputs, shm_ctx = self._native_worker_setup(
+                    client, worker_id
+                )
+            elif self.shared_memory == "system":
                 import client_tpu.utils.shared_memory as shm
 
                 regions = []
@@ -293,7 +312,63 @@ class PerfRunner:
                 shm_ctx()
 
     def _infer_once(self, client, inputs, outputs=None):
+        if self.protocol == "native":
+            client.infer(self.model_name, inputs, outputs=outputs)
+            return
         client.infer(self.model_name, inputs, outputs=outputs)
+
+    def _native_worker_setup(self, client, worker_id):
+        """(inputs, outputs, cleanup) for the native protocol's worker."""
+        from .utils import serialized_byte_size
+
+        if self.shared_memory == "none":
+            inputs = [(name, data) for name, _, _, data in self._tensors]
+            return inputs, None, None
+        import jax
+
+        import client_tpu.utils.tpu_shared_memory as tpushm
+
+        regions = []
+        inputs = []
+        for name, datatype, shape, data in self._tensors:
+            nbytes = serialized_byte_size(data) if datatype == "BYTES" else data.nbytes
+            region = tpushm.create_shared_memory_region(
+                f"perfn_{worker_id}_{name}", nbytes,
+                colocated=(datatype != "BYTES"),
+            )
+            if datatype == "BYTES":
+                tpushm.set_shared_memory_region(region, [data])
+            else:
+                dev = jax.device_put(data)
+                dev.block_until_ready()
+                tpushm.set_shared_memory_region_from_jax(region, dev)
+            client.register_tpu_shared_memory(
+                region.name, tpushm.get_raw_handle(region), 0, nbytes
+            )
+            inputs.append(
+                (name, ("shm", region.name, nbytes, 0, datatype, shape))
+            )
+            regions.append(region)
+        outputs = []
+        for name, nbytes in self._output_sizes.items():
+            region = tpushm.create_shared_memory_region(
+                f"perfn_{worker_id}_out_{name}", nbytes, colocated=True
+            )
+            client.register_tpu_shared_memory(
+                region.name, tpushm.get_raw_handle(region), 0, nbytes
+            )
+            outputs.append((name, ("shm", region.name, nbytes, 0)))
+            regions.append(region)
+
+        def cleanup():
+            for region in regions:
+                try:
+                    client.unregister_shared_memory("tpu", region.name)
+                except Exception:
+                    pass
+                tpushm.destroy_shared_memory_region(region)
+
+        return inputs, outputs or None, cleanup
 
     # -- sweep -------------------------------------------------------------
     def run(self, concurrency: int, measurement_requests: int) -> Dict[str, Any]:
@@ -347,7 +422,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument("-m", "--model-name", required=True)
     parser.add_argument("-u", "--url", default="127.0.0.1:8000")
-    parser.add_argument("-i", "--protocol", choices=("http", "grpc"), default="http")
+    parser.add_argument(
+        "-i", "--protocol", choices=("http", "grpc", "native"), default="http",
+        help="native = the C++ client via its C API (HTTP transport)",
+    )
     parser.add_argument(
         "--shared-memory", choices=("none", "system", "tpu"), default="none"
     )

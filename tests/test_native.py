@@ -164,3 +164,18 @@ def test_ctypes_bytes_and_shm_outputs(server):
             client.unregister_shared_memory("tpu", "capi_out")
         finally:
             tpushm.destroy_shared_memory_region(region)
+
+
+def test_perf_runner_native_protocol(server):
+    """The perf harness drives the C++ client incl. the tpu-shm mode."""
+    from client_tpu.perf import PerfRunner
+
+    for mode in ("none", "tpu"):
+        runner = PerfRunner(
+            server.url, "native", "custom_identity_int32", shared_memory=mode,
+            shape_overrides={"INPUT0": [1, 1024]},
+        )
+        result = runner.run(concurrency=1, measurement_requests=25)
+        assert result["errors"] == 0, result["error_sample"]
+        assert result["requests"] >= 25
+        assert result["infer_per_sec"] > 0
