@@ -68,6 +68,8 @@ class PerfRunner:
         self.shape_overrides = shape_overrides or {}
         self.batch_size = batch_size
         self.rng = np.random.default_rng(seed)
+        if protocol == "native" and shared_memory == "system":
+            raise ValueError("native protocol supports --shared-memory none|tpu")
         self._client_mod = self._import_client_mod()
         self._metadata = self._fetch_metadata()
         self._tensors = self._generate_tensors()
@@ -92,12 +94,17 @@ class PerfRunner:
             return self._client_mod.InferenceServerClient(self.url, concurrency=concurrency)
         return self._client_mod.InferenceServerClient(self.url)
 
-    def _fetch_metadata(self) -> Dict[str, Any]:
-        # metadata always via the python http client (the native C API is a
-        # data-plane surface)
-        import client_tpu.http as httpmod
+    def _control_client(self):
+        """(client, module) for metadata/probing: the protocol's own python
+        client, except native (whose C API is a data-plane surface) -> http."""
+        if self.protocol == "grpc":
+            import client_tpu.grpc as mod
+        else:
+            import client_tpu.http as mod
+        return mod.InferenceServerClient(self.url), mod
 
-        client = httpmod.InferenceServerClient(self.url)
+    def _fetch_metadata(self) -> Dict[str, Any]:
+        client, _ = self._control_client()
         try:
             md = client.get_model_metadata(self.model_name)
         finally:
@@ -124,11 +131,7 @@ class PerfRunner:
     def _probe_output_sizes(self) -> Dict[str, int]:
         from .utils import serialized_byte_size
 
-        # the probe always rides the python http client: it only needs one
-        # wire-mode inference to learn output sizes
-        import client_tpu.http as mod
-
-        client = mod.InferenceServerClient(self.url)
+        client, mod = self._control_client()
         try:
             inputs = []
             for name, datatype, shape, data in self._tensors:
@@ -196,13 +199,15 @@ class PerfRunner:
 
         mod = self._client_mod
         shm_ctx = None
+        own_client = None
         setup_failed = False
         try:
             if self.protocol == "native":
-                if self.shared_memory == "system":
-                    raise ValueError(
-                        "native protocol supports --shared-memory none|tpu"
-                    )
+                # one C++ client per worker: the native Infer serializes on a
+                # mutex-guarded curl easy handle, so sharing one client would
+                # measure lock contention instead of concurrency
+                own_client = self._make_client()
+                client = own_client
                 inputs, outputs, shm_ctx = self._native_worker_setup(
                     client, worker_id
                 )
@@ -310,11 +315,10 @@ class PerfRunner:
         finally:
             if shm_ctx is not None:
                 shm_ctx()
+            if own_client is not None:
+                own_client.close()
 
     def _infer_once(self, client, inputs, outputs=None):
-        if self.protocol == "native":
-            client.infer(self.model_name, inputs, outputs=outputs)
-            return
         client.infer(self.model_name, inputs, outputs=outputs)
 
     def _native_worker_setup(self, client, worker_id):
@@ -329,36 +333,6 @@ class PerfRunner:
         import client_tpu.utils.tpu_shared_memory as tpushm
 
         regions = []
-        inputs = []
-        for name, datatype, shape, data in self._tensors:
-            nbytes = serialized_byte_size(data) if datatype == "BYTES" else data.nbytes
-            region = tpushm.create_shared_memory_region(
-                f"perfn_{worker_id}_{name}", nbytes,
-                colocated=(datatype != "BYTES"),
-            )
-            if datatype == "BYTES":
-                tpushm.set_shared_memory_region(region, [data])
-            else:
-                dev = jax.device_put(data)
-                dev.block_until_ready()
-                tpushm.set_shared_memory_region_from_jax(region, dev)
-            client.register_tpu_shared_memory(
-                region.name, tpushm.get_raw_handle(region), 0, nbytes
-            )
-            inputs.append(
-                (name, ("shm", region.name, nbytes, 0, datatype, shape))
-            )
-            regions.append(region)
-        outputs = []
-        for name, nbytes in self._output_sizes.items():
-            region = tpushm.create_shared_memory_region(
-                f"perfn_{worker_id}_out_{name}", nbytes, colocated=True
-            )
-            client.register_tpu_shared_memory(
-                region.name, tpushm.get_raw_handle(region), 0, nbytes
-            )
-            outputs.append((name, ("shm", region.name, nbytes, 0)))
-            regions.append(region)
 
         def cleanup():
             for region in regions:
@@ -367,6 +341,42 @@ class PerfRunner:
                 except Exception:
                     pass
                 tpushm.destroy_shared_memory_region(region)
+
+        inputs = []
+        try:
+            for name, datatype, shape, data in self._tensors:
+                nbytes = serialized_byte_size(data) if datatype == "BYTES" else data.nbytes
+                region = tpushm.create_shared_memory_region(
+                    f"perfn_{worker_id}_{name}", nbytes,
+                    colocated=(datatype != "BYTES"),
+                )
+                regions.append(region)
+                if datatype == "BYTES":
+                    tpushm.set_shared_memory_region(region, [data])
+                else:
+                    dev = jax.device_put(data)
+                    dev.block_until_ready()
+                    tpushm.set_shared_memory_region_from_jax(region, dev)
+                client.register_tpu_shared_memory(
+                    region.name, tpushm.get_raw_handle(region), 0, nbytes
+                )
+                inputs.append(
+                    (name, ("shm", region.name, nbytes, 0, datatype, shape))
+                )
+            outputs = []
+            for name, nbytes in self._output_sizes.items():
+                region = tpushm.create_shared_memory_region(
+                    f"perfn_{worker_id}_out_{name}", nbytes, colocated=True
+                )
+                regions.append(region)
+                client.register_tpu_shared_memory(
+                    region.name, tpushm.get_raw_handle(region), 0, nbytes
+                )
+                outputs.append((name, ("shm", region.name, nbytes, 0)))
+        except Exception:
+            # release anything created/registered so a retry can reuse names
+            cleanup()
+            raise
 
         return inputs, outputs or None, cleanup
 
