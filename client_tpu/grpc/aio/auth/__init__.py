@@ -1,0 +1,9 @@
+"""Auth plugins for the asyncio client (reference: */aio/auth subpackage).
+
+Plugins are transport-agnostic here — BasicAuth from the shared base works
+on sync and aio clients alike; this module mirrors the reference import path.
+"""
+
+from ...._base import BasicAuth, InferenceServerClientPlugin
+
+__all__ = ["BasicAuth", "InferenceServerClientPlugin"]

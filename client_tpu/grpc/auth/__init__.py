@@ -1,0 +1,9 @@
+"""Auth plugins for the sync client (reference: */auth subpackage).
+
+Plugins are transport-agnostic — BasicAuth from the shared base; this
+module mirrors the reference import path.
+"""
+
+from ..._base import BasicAuth, InferenceServerClientPlugin
+
+__all__ = ["BasicAuth", "InferenceServerClientPlugin"]

@@ -159,3 +159,48 @@ def test_grpc_as_json_compat(servers):
         assert client.get_model_metadata("simple", as_json=True)["name"] == "simple"
         assert client.get_model_config("simple", as_json=True)["config"]["backend"] == "jax"
         assert client.get_inference_statistics("simple", as_json=True)["model_stats"]
+
+
+def test_aio_auth_plugin():
+    """BasicAuth plugin headers actually arrive over the wire on aio clients
+    (captured by a recording server), and all auth import paths resolve."""
+    import base64 as b64
+    import http.server
+    import threading
+
+    import client_tpu.http.aio as aioclient
+    from client_tpu.http.aio.auth import BasicAuth
+    from client_tpu.http.auth import BasicAuth as SyncBasicAuth  # noqa: F401
+    from client_tpu.grpc.auth import BasicAuth as _g  # noqa: F401
+    from tritonclient.http.auth import BasicAuth as _c1  # noqa: F401
+    from tritonclient.grpc.aio.auth import BasicAuth as _c2  # noqa: F401
+
+    seen = {}
+
+    class Recorder(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            seen["authorization"] = self.headers.get("authorization")
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    recorder = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Recorder)
+    thread = threading.Thread(target=recorder.serve_forever, daemon=True)
+    thread.start()
+    try:
+        async def run():
+            url = f"127.0.0.1:{recorder.server_address[1]}"
+            async with aioclient.InferenceServerClient(url) as client:
+                client.register_plugin(BasicAuth("user", "pw"))
+                assert await client.is_server_live()
+        asyncio.run(run())
+        expected = "Basic " + b64.b64encode(b"user:pw").decode()
+        assert seen["authorization"] == expected
+    finally:
+        recorder.shutdown()
+        recorder.server_close()

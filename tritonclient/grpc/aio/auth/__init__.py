@@ -1,0 +1,3 @@
+"""Compatibility re-export of :mod:`client_tpu.grpc.aio.auth`."""
+
+from client_tpu.grpc.aio.auth import BasicAuth, InferenceServerClientPlugin  # noqa: F401

@@ -1,0 +1,3 @@
+"""Compatibility re-export of :mod:`client_tpu.grpc.auth`."""
+
+from client_tpu.grpc.auth import BasicAuth, InferenceServerClientPlugin  # noqa: F401

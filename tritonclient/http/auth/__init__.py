@@ -1,0 +1,3 @@
+"""Compatibility re-export of :mod:`client_tpu.http.auth`."""
+
+from client_tpu.http.auth import BasicAuth, InferenceServerClientPlugin  # noqa: F401
