@@ -230,7 +230,9 @@ class InferenceServerClient(InferenceServerClientBase):
         if model_name:
             req["model_name"] = model_name
         for key, value in (settings or {}).items():
-            if isinstance(value, (list, tuple)):
+            if value is None:
+                req["settings"][key] = {}
+            elif isinstance(value, (list, tuple)):
                 req["settings"][key] = {"value": [str(v) for v in value]}
             else:
                 req["settings"][key] = {"value": [str(value)]}

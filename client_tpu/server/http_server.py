@@ -51,6 +51,16 @@ def _decode_input(entry: Dict[str, Any], tail: memoryview, cursor: int) -> Tuple
         return out, cursor
     size = params.get("binary_data_size")
     if size is not None:
+        if isinstance(size, bool) or not isinstance(size, int) or size < 0:
+            raise InferError(
+                f"input '{entry['name']}': binary_data_size must be a "
+                f"non-negative integer, got {size!r}", 400,
+            )
+        if cursor + size > len(tail):
+            raise InferError(
+                f"input '{entry['name']}': binary_data_size {size} overruns "
+                f"the binary payload ({len(tail) - cursor} bytes remain)", 400,
+            )
         raw = bytes(tail[cursor : cursor + size])
         out["array"] = _bytes_to_array(raw, entry["datatype"], entry["shape"])
         return out, cursor + size

@@ -65,10 +65,15 @@ class AioHttpInferenceServer:
 
         r.add_get("/v2/health/live", live)
         r.add_get("/v2/health/ready", live)
-        r.add_get("/v2", lambda request: _json_response(core.server_metadata()))
-        r.add_get(
-            "/v2/models/stats", lambda request: _json_response(core.statistics())
-        )
+
+        async def server_metadata(request):
+            return _json_response(core.server_metadata())
+
+        async def server_stats(request):
+            return _json_response(core.statistics())
+
+        r.add_get("/v2", server_metadata)
+        r.add_get("/v2/models/stats", server_stats)
 
         async def model_route(request):
             name = request.match_info["name"]

@@ -204,3 +204,27 @@ def test_aio_auth_plugin():
     finally:
         recorder.shutdown()
         recorder.server_close()
+
+
+def test_grpc_aio_trace_settings_none_clears(servers):
+    """Passing ``None`` for a setting sends an empty SettingValue that clears
+    it, matching the sync client (reference grpc/_client.py clears to the
+    global default with an empty value list)."""
+    _, grpc_server = servers
+    import client_tpu.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as client:
+            await client.update_trace_settings(
+                settings={"trace_rate": 9}
+            )
+            cleared = await client.update_trace_settings(
+                settings={"trace_rate": None}
+            )
+            # an empty SettingValue, NOT the string "None"
+            assert cleared["trace_rate"] == []
+            await client.update_trace_settings(
+                settings={"trace_level": ["OFF"], "trace_rate": 1}
+            )
+
+    asyncio.run(run())

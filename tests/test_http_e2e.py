@@ -244,6 +244,10 @@ def test_aio_frontend_full_flow():
             result = client.infer("simple", [in0, in1])
             np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), a + b)
             # admin surface
+            md = client.get_server_metadata()  # /v2 (async handler, not lambda)
+            assert "tpu_shared_memory" in md["extensions"]
+            all_stats = client.get_inference_statistics()  # /v2/models/stats
+            assert any(m["name"] == "simple" for m in all_stats["model_stats"])
             assert client.get_model_config("simple")["backend"] == "jax"
             index = client.get_model_repository_index()
             assert any(m["name"] == "simple" for m in index)
@@ -287,3 +291,54 @@ def test_half_precision_identity_roundtrip(client, datatype, model):
     jax_out = result.as_jax("OUTPUT0")
     assert type(jax_out).__module__.startswith(("jax", "jaxlib"))
     np.testing.assert_array_equal(np.asarray(jax_out), data)
+
+
+def test_server_rejects_hostile_binary_data_size(server):
+    """A malformed binary_data_size in a raw request is a 400 protocol error,
+    not a 500 (the server validates before slicing the binary tail)."""
+    import http.client as hc
+    import json as _json
+
+    for bad in (-4, "4", True):
+        header = _json.dumps({
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+                 "parameters": {"binary_data_size": bad}},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+                 "parameters": {"binary_data_size": 64}},
+            ]
+        }).encode()
+        body = header + b"\x00" * 128
+        conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            conn.request(
+                "POST", "/v2/models/simple/infer", body,
+                {"Inference-Header-Content-Length": str(len(header)),
+                 "Content-Type": "application/octet-stream"},
+            )
+            resp = conn.getresponse()
+            payload = resp.read()
+            assert resp.status == 400, (bad, resp.status, payload)
+            assert b"binary_data_size" in payload
+        finally:
+            conn.close()
+    # declared size overrunning the tail is also a 400
+    header = _json.dumps({
+        "inputs": [
+            {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+             "parameters": {"binary_data_size": 1 << 20}},
+        ]
+    }).encode()
+    conn = hc.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    try:
+        conn.request(
+            "POST", "/v2/models/simple/infer", header + b"\x00" * 64,
+            {"Inference-Header-Content-Length": str(len(header)),
+             "Content-Type": "application/octet-stream"},
+        )
+        resp = conn.getresponse()
+        payload = resp.read()
+        assert resp.status == 400, (resp.status, payload)
+        assert b"overruns" in payload
+    finally:
+        conn.close()
