@@ -22,7 +22,12 @@ from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
 from ..utils import InferenceServerException
 from . import _messages as M
-from ._infer import InferResult, build_infer_request, from_infer_parameter
+from ._infer import (
+    InferResult,
+    build_infer_request,
+    from_infer_parameter,
+    to_grpc_compression,
+)
 from ._stream import _InferStream
 from ._wire import decode_message, encode_message
 
@@ -167,12 +172,16 @@ class InferenceServerClient(InferenceServerClientBase):
         request: Dict[str, Any],
         headers: Optional[Dict[str, str]] = None,
         client_timeout: Optional[float] = None,
+        compression_algorithm: Optional[str] = None,
     ) -> Dict[str, Any]:
         if self._verbose:
             print(f"{method}, metadata {headers or {}}\n{request}")
         try:
             response = self._callable(method)(
-                request, metadata=self._metadata(headers), timeout=client_timeout
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+                compression=to_grpc_compression(compression_algorithm),
             )
         except grpc.RpcError as e:
             raise _to_exception(e) from e
@@ -374,6 +383,7 @@ class InferenceServerClient(InferenceServerClientBase):
         client_timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
         parameters: Optional[Dict[str, Any]] = None,
+        compression_algorithm: Optional[str] = None,
     ) -> InferResult:
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
@@ -382,7 +392,9 @@ class InferenceServerClient(InferenceServerClientBase):
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
         timers.capture(RequestTimers.SEND_START)
-        response = self._call("ModelInfer", request, headers, client_timeout)
+        response = self._call(
+            "ModelInfer", request, headers, client_timeout, compression_algorithm
+        )
         timers.capture(RequestTimers.SEND_END)
         timers.capture(RequestTimers.RECV_START)
         result = InferResult(response)
@@ -407,6 +419,7 @@ class InferenceServerClient(InferenceServerClientBase):
         client_timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
         parameters: Optional[Dict[str, Any]] = None,
+        compression_algorithm: Optional[str] = None,
     ) -> CallContext:
         """Fire an async inference; ``callback(result, error)`` when done."""
         request = build_infer_request(
@@ -414,7 +427,10 @@ class InferenceServerClient(InferenceServerClientBase):
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
         future = self._callable("ModelInfer").future(
-            request, metadata=self._metadata(headers), timeout=client_timeout
+            request,
+            metadata=self._metadata(headers),
+            timeout=client_timeout,
+            compression=to_grpc_compression(compression_algorithm),
         )
         context = CallContext(future)
         if callback is not None:
@@ -439,6 +455,7 @@ class InferenceServerClient(InferenceServerClientBase):
         callback: Callable,
         stream_timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
     ) -> None:
         """Open the bidi stream; ``callback(result, error)`` per response."""
         with self._stream_lock:
@@ -451,6 +468,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 self._callable("ModelStreamInfer", streaming=True),
                 self._metadata(headers),
                 stream_timeout,
+                compression=to_grpc_compression(compression_algorithm),
             )
             self._stream = stream
 

@@ -21,6 +21,36 @@ from ..utils import (
     triton_to_np_dtype,
 )
 
+def to_grpc_compression(algorithm: Optional[str]):
+    """Map a ``compression_algorithm`` string to a ``grpc.Compression`` value.
+
+    Parity with reference ``grpc/_utils.py:142-153`` (``_grpc_compression_type``)
+    with one deliberate deviation: ``None`` maps to ``None`` (inherit the
+    channel's default compression) instead of ``NoCompression``, so a
+    channel constructed with ``grpc.default_compression_algorithm`` keeps
+    working when no per-call algorithm is given. ``"deflate"``/``"gzip"`` →
+    the grpc enum; any other value warns and falls back to no compression.
+    """
+    import grpc
+
+    if algorithm is None:
+        return None
+    if isinstance(algorithm, str):
+        lowered = algorithm.lower()
+        if lowered == "deflate":
+            return grpc.Compression.Deflate
+        if lowered == "gzip":
+            return grpc.Compression.Gzip
+    import warnings
+
+    warnings.warn(
+        f"unsupported client-side compression algorithm {algorithm!r}; "
+        "using no compression",
+        stacklevel=3,
+    )
+    return grpc.Compression.NoCompression
+
+
 # typed-contents field per Triton datatype (InferTensorContents)
 _CONTENTS_FIELD = {
     "BOOL": "bool_contents",

@@ -49,9 +49,10 @@ class _InferStream:
         self._active = True
         self._lock = threading.Lock()
 
-    def start(self, stream_callable, metadata, timeout) -> None:
+    def start(self, stream_callable, metadata, timeout, compression=None) -> None:
         self._call = stream_callable(
-            self._requests, metadata=metadata, timeout=timeout
+            self._requests, metadata=metadata, timeout=timeout,
+            compression=compression,
         )
         self._reader = threading.Thread(
             target=self._read_loop, name="client_tpu_grpc_stream", daemon=True
@@ -70,18 +71,27 @@ class _InferStream:
                     print(result.get_response())
                 self._callback(result, None)
         except grpc.RpcError as rpc_error:
+            # Reference grpc/_infer_stream.py:157-167: refresh the active
+            # state and deliver the true grpc status to the callback —
+            # CANCELLED included — so triton_grpc_error-mode users see real
+            # status codes (StatusCode.CANCELLED / StatusCode.UNAVAILABLE).
             with self._lock:
                 self._active = False
             code = rpc_error.code() if hasattr(rpc_error, "code") else None
-            if code == grpc.StatusCode.CANCELLED:
-                return  # local cancellation is not an error to surface
-            self._callback(
-                None,
-                InferenceServerException(
-                    f"stream closed: {rpc_error.details() if hasattr(rpc_error, 'details') else rpc_error}",
-                    status=str(code.name) if code else None,
-                ),
+            details = (
+                rpc_error.details() if hasattr(rpc_error, "details") else str(rpc_error)
             )
+            if code == grpc.StatusCode.CANCELLED:
+                error = InferenceServerException(
+                    details or "Locally cancelled by application!",
+                    status="StatusCode.CANCELLED",
+                )
+            else:
+                error = InferenceServerException(
+                    details or f"stream closed: {rpc_error}",
+                    status=f"StatusCode.{code.name}" if code else None,
+                )
+            self._callback(None, error)
         except Exception as e:  # defensive: never kill the thread silently
             with self._lock:
                 self._active = False

@@ -16,7 +16,12 @@ from ..._tensor import InferInput, InferRequestedOutput
 from ...utils import InferenceServerException
 from .. import _messages as M
 from .._client import INT32_MAX, KeepAliveOptions, _to_exception
-from .._infer import InferResult, build_infer_request, from_infer_parameter
+from .._infer import (
+    InferResult,
+    build_infer_request,
+    from_infer_parameter,
+    to_grpc_compression,
+)
 from .._wire import decode_message, encode_message
 
 __all__ = [
@@ -105,10 +110,16 @@ class InferenceServerClient(InferenceServerClientBase):
         self._call_plugin(request)
         return tuple(request.headers.items()) or None
 
-    async def _call(self, method, request, headers=None, client_timeout=None):
+    async def _call(
+        self, method, request, headers=None, client_timeout=None,
+        compression_algorithm=None,
+    ):
         try:
             return await self._callable(method)(
-                request, metadata=self._metadata(headers), timeout=client_timeout
+                request,
+                metadata=self._metadata(headers),
+                timeout=client_timeout,
+                compression=to_grpc_compression(compression_algorithm),
             )
         except grpc.aio.AioRpcError as e:
             raise _to_exception(e) from e
@@ -260,12 +271,15 @@ class InferenceServerClient(InferenceServerClientBase):
         client_timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
         parameters: Optional[Dict[str, Any]] = None,
+        compression_algorithm: Optional[str] = None,
     ) -> InferResult:
         request = build_infer_request(
             model_name, inputs, model_version, outputs, request_id,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
-        response = await self._call("ModelInfer", request, headers, client_timeout)
+        response = await self._call(
+            "ModelInfer", request, headers, client_timeout, compression_algorithm
+        )
         return InferResult(response)
 
     async def stream_infer(
@@ -273,6 +287,7 @@ class InferenceServerClient(InferenceServerClientBase):
         inputs_iterator: AsyncIterator[Dict[str, Any]],
         stream_timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
+        compression_algorithm: Optional[str] = None,
     ) -> AsyncIterator:
         """Bi-di streaming: consume request dicts, yield (result, error) pairs.
 
@@ -293,7 +308,10 @@ class InferenceServerClient(InferenceServerClientBase):
                 yield req
 
         call = self._callable("ModelStreamInfer", streaming=True)(
-            request_gen(), metadata=self._metadata(headers), timeout=stream_timeout
+            request_gen(),
+            metadata=self._metadata(headers),
+            timeout=stream_timeout,
+            compression=to_grpc_compression(compression_algorithm),
         )
 
         class _ResponseIterator:
