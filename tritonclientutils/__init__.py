@@ -1,0 +1,27 @@
+"""Deprecated alias for :mod:`tritonclient.utils`.
+
+Parity with the reference's ``tritonclientutils`` shim wheel
+(reference: src/python/library/tritonclientutils/__init__.py).
+"""
+
+import warnings
+
+warnings.simplefilter("always", DeprecationWarning)
+warnings.warn(
+    "The package `tritonclientutils` is deprecated and will be removed in a "
+    "future version. Please use instead `tritonclient.utils`",
+    DeprecationWarning,
+)
+
+from tritonclient.utils import *  # noqa: E402,F401,F403
+from tritonclient.utils import (  # noqa: E402,F401
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_triton_dtype,
+    raise_error,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    serialized_byte_size,
+    triton_to_np_dtype,
+)

@@ -1,0 +1,25 @@
+"""Deprecated alias for :mod:`tritonclient.grpc`.
+
+Parity with the reference's ``tritongrpcclient`` shim wheel
+(reference: src/python/library/tritongrpcclient/__init__.py).
+"""
+
+import warnings
+
+warnings.simplefilter("always", DeprecationWarning)
+warnings.warn(
+    "The package `tritongrpcclient` is deprecated and will be removed in a "
+    "future version. Please use instead `tritonclient.grpc`",
+    DeprecationWarning,
+)
+
+from tritonclient.grpc import *  # noqa: E402,F401,F403
+from tritonclient.grpc import (  # noqa: E402,F401
+    CallContext,
+    InferInput,
+    InferRequestedOutput,
+    InferResult,
+    InferenceServerClient,
+    InferenceServerException,
+    KeepAliveOptions,
+)

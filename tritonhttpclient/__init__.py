@@ -1,0 +1,25 @@
+"""Deprecated alias for :mod:`tritonclient.http`.
+
+Parity with the reference's ``tritonhttpclient`` shim wheel
+(reference: src/python/library/tritonhttpclient/__init__.py): importing it
+warns once per import site and re-exports the current namespace.
+"""
+
+import warnings
+
+warnings.simplefilter("always", DeprecationWarning)
+warnings.warn(
+    "The package `tritonhttpclient` is deprecated and will be removed in a "
+    "future version. Please use instead `tritonclient.http`",
+    DeprecationWarning,
+)
+
+from tritonclient.http import *  # noqa: E402,F401,F403
+from tritonclient.http import (  # noqa: E402,F401
+    InferAsyncRequest,
+    InferInput,
+    InferRequestedOutput,
+    InferResult,
+    InferenceServerClient,
+    InferenceServerException,
+)

@@ -1,0 +1,16 @@
+"""Deprecated alias package for the shared-memory utils.
+
+Parity with the reference's ``tritonshmutils`` shim wheel
+(reference: src/python/library/tritonshmutils/__init__.py): submodules
+``shared_memory`` and ``tpu_shared_memory`` re-export the live modules
+(``cuda_shared_memory`` exists but raises, as on the whole TPU stack).
+"""
+
+import warnings
+
+warnings.simplefilter("always", DeprecationWarning)
+warnings.warn(
+    "The package `tritonshmutils` is deprecated and will be removed in a "
+    "future version. Please use instead `tritonclient.utils`",
+    DeprecationWarning,
+)

@@ -1,0 +1,14 @@
+"""Deprecated alias for ``tritonclient.utils.cuda_shared_memory`` — which is
+unavailable on the TPU stack and raises with migration guidance."""
+
+import warnings
+
+warnings.simplefilter("always", DeprecationWarning)
+warnings.warn(
+    "The package `tritonshmutils.cuda_shared_memory` is deprecated and will "
+    "be removed in a future version. Please use instead "
+    "`tritonclient.utils.tpu_shared_memory`",
+    DeprecationWarning,
+)
+
+import tritonclient.utils.cuda_shared_memory  # noqa: E402,F401  (raises)

@@ -1,0 +1,20 @@
+"""Deprecated alias for :mod:`tritonclient.utils.shared_memory`."""
+
+import warnings
+
+warnings.simplefilter("always", DeprecationWarning)
+warnings.warn(
+    "The package `tritonshmutils.shared_memory` is deprecated and will be "
+    "removed in a future version. Please use instead "
+    "`tritonclient.utils.shared_memory`",
+    DeprecationWarning,
+)
+
+from tritonclient.utils.shared_memory import *  # noqa: E402,F401,F403
+from tritonclient.utils.shared_memory import (  # noqa: E402,F401
+    create_shared_memory_region,
+    destroy_shared_memory_region,
+    get_contents_as_numpy,
+    mapped_shared_memory_regions,
+    set_shared_memory_region,
+)

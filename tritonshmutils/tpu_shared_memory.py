@@ -1,0 +1,16 @@
+"""Deprecated alias for :mod:`tritonclient.utils.tpu_shared_memory`.
+
+The TPU analog of the reference's ``tritonshmutils/cuda_shared_memory.py``.
+"""
+
+import warnings
+
+warnings.simplefilter("always", DeprecationWarning)
+warnings.warn(
+    "The package `tritonshmutils.tpu_shared_memory` is deprecated and will "
+    "be removed in a future version. Please use instead "
+    "`tritonclient.utils.tpu_shared_memory`",
+    DeprecationWarning,
+)
+
+from tritonclient.utils.tpu_shared_memory import *  # noqa: E402,F401,F403
