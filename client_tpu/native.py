@@ -54,6 +54,10 @@ def _bind(lib):
     lib.ctpu_shm_read.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_ulonglong
     ]
+    lib.ctpu_register_system_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_ulonglong,
+        ctypes.c_ulonglong,
+    ]
     lib.ctpu_register_tpu_shm.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_ulonglong,
@@ -108,6 +112,28 @@ def _bind(lib):
     lib.ctpu_result_output_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.ctpu_result_output_names.restype = ctypes.c_char_p
     lib.ctpu_result_output_names.argtypes = [ctypes.c_void_p]
+    # grpc client (same value-model handles; results use ctpu_result_*)
+    lib.ctpu_grpc_client_create.restype = ctypes.c_void_p
+    lib.ctpu_grpc_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ctpu_grpc_client_destroy.argtypes = [ctypes.c_void_p]
+    lib.ctpu_grpc_server_live.argtypes = [ctypes.c_void_p]
+    lib.ctpu_grpc_model_ready.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ctpu_grpc_infer.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.ctpu_grpc_register_system_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_ulonglong,
+        ctypes.c_ulonglong,
+    ]
+    lib.ctpu_grpc_register_tpu_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_ulonglong,
+    ]
+    lib.ctpu_grpc_unregister_shm.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
+    ]
     return lib
 
 
@@ -143,15 +169,30 @@ def _err(lib) -> str:
 class NativeClient:
     """Thin Python handle over the native HTTP client."""
 
+    # C entry points; NativeGrpcClient swaps in the grpc set (results and
+    # the value-model handles are shared across both clients)
+    _FN = {
+        "create": "ctpu_client_create",
+        "destroy": "ctpu_client_destroy",
+        "live": "ctpu_server_live",
+        "ready": "ctpu_model_ready",
+        "infer": "ctpu_infer",
+        "register_system_shm": "ctpu_register_system_shm",
+        "register_tpu_shm": "ctpu_register_tpu_shm",
+        "unregister_shm": "ctpu_unregister_shm",
+    }
+
     def __init__(self, url: str, verbose: bool = False):
         self._lib = load()
-        self._handle = self._lib.ctpu_client_create(url.encode(), int(verbose))
+        self._handle = getattr(self._lib, self._FN["create"])(
+            url.encode(), int(verbose)
+        )
         if not self._handle:
             raise InferenceServerException(f"native client create failed: {_err(self._lib)}")
 
     def close(self) -> None:
         if self._handle:
-            self._lib.ctpu_client_destroy(self._handle)
+            getattr(self._lib, self._FN["destroy"])(self._handle)
             self._handle = None
 
     def __enter__(self):
@@ -161,13 +202,13 @@ class NativeClient:
         self.close()
 
     def is_server_live(self) -> bool:
-        rc = self._lib.ctpu_server_live(self._handle)
+        rc = getattr(self._lib, self._FN["live"])(self._handle)
         if rc < 0:
             raise InferenceServerException(_err(self._lib))
         return bool(rc)
 
     def is_model_ready(self, model_name: str) -> bool:
-        rc = self._lib.ctpu_model_ready(self._handle, model_name.encode())
+        rc = getattr(self._lib, self._FN["ready"])(self._handle, model_name.encode())
         if rc < 0:
             raise InferenceServerException(_err(self._lib))
         return bool(rc)
@@ -274,7 +315,7 @@ class NativeClient:
             ins = (ctypes.c_void_p * len(in_handles))(*in_handles)
             outs = (ctypes.c_void_p * len(out_handles))(*out_handles)
             result_ptr = ctypes.c_void_p()
-            rc = lib.ctpu_infer(
+            rc = getattr(lib, self._FN["infer"])(
                 self._handle, options, ins, len(in_handles), outs,
                 len(out_handles), ctypes.byref(result_ptr),
             )
@@ -326,19 +367,62 @@ class NativeClient:
                 lib.ctpu_output_destroy(handle)
             lib.ctpu_options_destroy(options)
 
+    def register_system_shared_memory(
+        self, name: str, key: str, byte_size: int, offset: int = 0
+    ) -> None:
+        if getattr(self._lib, self._FN["register_system_shm"])(
+            self._handle, name.encode(), key.encode(), byte_size, offset
+        ) != 0:
+            raise InferenceServerException(_err(self._lib))
+
     def register_tpu_shared_memory(
         self, name: str, raw_handle: str, device_id: int, byte_size: int
     ) -> None:
-        if self._lib.ctpu_register_tpu_shm(
+        if getattr(self._lib, self._FN["register_tpu_shm"])(
             self._handle, name.encode(), raw_handle.encode(), device_id, byte_size
         ) != 0:
             raise InferenceServerException(_err(self._lib))
 
     def unregister_shared_memory(self, family: str = "tpu", name: str = "") -> None:
-        if self._lib.ctpu_unregister_shm(
+        if getattr(self._lib, self._FN["unregister_shm"])(
             self._handle, family.encode(), name.encode()
         ) != 0:
             raise InferenceServerException(_err(self._lib))
+
+
+class NativeGrpcClient(NativeClient):
+    """Thin Python handle over the native GRPC client (h2c transport).
+
+    Same value-model ``infer`` surface as :class:`NativeClient`; the wire
+    underneath is hand-framed gRPC over the library's own HTTP/2
+    (native/src/grpc_client.cc, native/src/h2.cc).
+    """
+
+    _FN = {
+        "create": "ctpu_grpc_client_create",
+        "destroy": "ctpu_grpc_client_destroy",
+        "live": "ctpu_grpc_server_live",
+        "ready": "ctpu_grpc_model_ready",
+        "infer": "ctpu_grpc_infer",
+        "register_system_shm": "ctpu_grpc_register_system_shm",
+        "register_tpu_shm": "ctpu_grpc_register_tpu_shm",
+        "unregister_shm": "ctpu_grpc_unregister_shm",
+    }
+
+    def infer_raw(self, model_name, input_name, tensor, output_name,
+                  output_dtype=None, output_capacity=None):
+        """Single-tensor convenience over the full value-model path.
+
+        Matches the base class contract: a flat 1-D array of the output
+        bytes reinterpreted as ``output_dtype`` (default: the input dtype),
+        bounded by ``output_capacity`` when given.
+        """
+        result = self.infer(model_name, [(input_name, tensor)])
+        raw = np.ascontiguousarray(result[output_name]).tobytes()
+        if output_capacity is not None and len(raw) > output_capacity:
+            raise InferenceServerException("output buffer too small")
+        np_dtype = np.dtype(output_dtype or tensor.dtype)
+        return np.frombuffer(raw, dtype=np_dtype)
 
 
 class NativeTpuShmRegion:

@@ -68,8 +68,8 @@ class PerfRunner:
         self.shape_overrides = shape_overrides or {}
         self.batch_size = batch_size
         self.rng = np.random.default_rng(seed)
-        if protocol == "native" and shared_memory == "system":
-            raise ValueError("native protocol supports --shared-memory none|tpu")
+        if protocol in ("native", "native-grpc") and shared_memory == "system":
+            raise ValueError("native protocols support --shared-memory none|tpu")
         self._client_mod = self._import_client_mod()
         self._metadata = self._fetch_metadata()
         self._tensors = self._generate_tensors()
@@ -81,7 +81,7 @@ class PerfRunner:
     def _import_client_mod(self):
         if self.protocol in ("http", "native"):
             import client_tpu.http as mod
-        else:
+        else:  # grpc and native-grpc share the grpc value model
             import client_tpu.grpc as mod
         return mod
 
@@ -90,6 +90,10 @@ class PerfRunner:
             from client_tpu.native import NativeClient
 
             return NativeClient(self.url)
+        if self.protocol == "native-grpc":
+            from client_tpu.native import NativeGrpcClient
+
+            return NativeGrpcClient(self.url)
         if self.protocol == "http":
             return self._client_mod.InferenceServerClient(self.url, concurrency=concurrency)
         return self._client_mod.InferenceServerClient(self.url)
@@ -97,7 +101,7 @@ class PerfRunner:
     def _control_client(self):
         """(client, module) for metadata/probing: the protocol's own python
         client, except native (whose C API is a data-plane surface) -> http."""
-        if self.protocol == "grpc":
+        if self.protocol in ("grpc", "native-grpc"):
             import client_tpu.grpc as mod
         else:
             import client_tpu.http as mod
@@ -202,9 +206,9 @@ class PerfRunner:
         own_client = None
         setup_failed = False
         try:
-            if self.protocol == "native":
+            if self.protocol in ("native", "native-grpc"):
                 # one C++ client per worker: the native Infer serializes on a
-                # mutex-guarded curl easy handle, so sharing one client would
+                # per-client transport handle, so sharing one client would
                 # measure lock contention instead of concurrency
                 own_client = self._make_client()
                 client = own_client
@@ -433,7 +437,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("-m", "--model-name", required=True)
     parser.add_argument("-u", "--url", default="127.0.0.1:8000")
     parser.add_argument(
-        "-i", "--protocol", choices=("http", "grpc", "native"), default="http",
+        "-i", "--protocol", choices=("http", "grpc", "native", "native-grpc"),
+        default="http",
         help="native = the C++ client via its C API (HTTP transport)",
     )
     parser.add_argument(

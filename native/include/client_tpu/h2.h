@@ -1,0 +1,155 @@
+// Minimal HTTP/2 (RFC 7540) + HPACK (RFC 7541) client transport.
+//
+// Why this exists: the native GRPC client frames unary gRPC by hand and
+// needs an HTTP/2 connection it can reuse. The image's libcurl (7.88 +
+// nghttp2) wedges an h2c prior-knowledge connection after the first
+// trailered response ("Error in the HTTP2 framing layer" on every
+// subsequent request), and no grpc++/nghttp2 headers exist to link against.
+// So the framework carries its own client-side h2: connection preface,
+// SETTINGS/PING/WINDOW_UPDATE/GOAWAY handling, flow control both
+// directions, and an HPACK codec (static + dynamic table, huffman decode)
+// generated from the public RFC 7541 tables (hpack_tables.inc).
+//
+// Scope: cleartext h2c client (gRPC inside a trusted host/VPC, same as the
+// reference's default insecure channel), one concurrent request per
+// connection (callers pool connections for parallelism; streams multiplex
+// fine at the protocol level but the blocking API keeps lifetimes simple).
+// The send/recv halves of a stream are independent, which is what makes
+// bi-di gRPC streaming (ModelStreamInfer) possible on top.
+//
+// Thread model: frames are written atomically under a send lock, stream
+// state (windows, buffers) lives under a state lock, and at most one
+// thread pumps the socket at a time (recv lock) — others wanting progress
+// wait on a frame-arrival condition. This is exactly what a bi-di stream
+// needs: one application thread in StreamSend, one reader thread in
+// StreamRecv, neither corrupting the other's frames.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "client_tpu/common.h"
+
+namespace client_tpu {
+namespace h2 {
+
+using HeaderList = std::vector<std::pair<std::string, std::string>>;
+
+// HPACK decoding context (connection-wide, ordered across HEADERS frames).
+class HpackDecoder {
+ public:
+  HpackDecoder();
+  Error Decode(const uint8_t* data, size_t size, HeaderList* out);
+  void SetMaxTableSize(size_t size) { protocol_max_size_ = size; }
+
+ private:
+  Error DecodeInt(
+      const uint8_t** p, const uint8_t* end, int prefix_bits, uint64_t* out);
+  Error DecodeString(const uint8_t** p, const uint8_t* end, std::string* out);
+  Error Lookup(uint64_t index, std::string* name, std::string* value);
+  void Insert(const std::string& name, const std::string& value);
+  void EvictTo(size_t target);
+
+  std::vector<std::pair<std::string, std::string>> dynamic_;  // newest first
+  size_t dynamic_size_ = 0;
+  size_t max_size_ = 4096;
+  size_t protocol_max_size_ = 4096;
+};
+
+class Connection {
+ public:
+  struct Response {
+    int status = 0;
+    std::map<std::string, std::string> headers;   // lowercased, incl trailers
+    std::string body;
+  };
+
+  // Connects, sends the client preface, and performs the SETTINGS exchange.
+  static Error Connect(
+      std::unique_ptr<Connection>* conn, const std::string& host_port,
+      int64_t timeout_ms = 10000);
+  ~Connection();
+
+  // One blocking request/response exchange. `headers` are the non-pseudo
+  // request headers; :method POST, :scheme http, :authority and :path are
+  // synthesized. Returns transport errors; HTTP/gRPC-level status lives in
+  // `out`. Not thread-safe — guard with a mutex or pool connections.
+  Error Request(
+      const std::string& path, const HeaderList& headers,
+      const std::string& body, Response* out, int64_t timeout_ms = 0);
+
+  // -- streaming primitives (bi-di gRPC) --------------------------------
+  // Open a stream: send HEADERS (no END_STREAM). Returns the stream id.
+  Error StreamOpen(
+      const std::string& path, const HeaderList& headers, int32_t* stream_id);
+  // Send one DATA chunk on the stream; end_stream closes the send half.
+  Error StreamSend(
+      int32_t stream_id, const void* data, size_t size, bool end_stream,
+      int64_t timeout_ms = 0);
+  // Receive events on the stream until one of: `min_bytes` of new body data
+  // arrived, response headers/trailers completed, or stream closed.
+  // Appends body bytes to `body`; headers/trailers merge into `headers`.
+  // `closed` flips when the peer half-closed (END_STREAM).
+  Error StreamRecv(
+      int32_t stream_id, std::string* body,
+      std::map<std::string, std::string>* headers, bool* closed,
+      int64_t timeout_ms = 0);
+  // Abort a stream (RST_STREAM CANCEL).
+  Error StreamReset(int32_t stream_id);
+
+  bool Alive() const { return alive_.load(); }
+  const std::string& PeerDescription() const { return host_port_; }
+
+ private:
+  explicit Connection(const std::string& host_port);
+
+  Error SendAll(const void* data, size_t size, int64_t timeout_ms);
+  // Reads + dispatches exactly one frame. Caller must hold recv_mutex_.
+  Error RecvFrameLocked(int64_t timeout_ms);
+  // Makes one unit of progress: pump a frame if this thread can take the
+  // receiver role, else wait briefly for the active receiver's next frame.
+  Error PumpOne(int64_t timeout_ms);
+  Error SendFrame(
+      uint8_t type, uint8_t flags, int32_t stream_id, const void* payload,
+      size_t size, int64_t timeout_ms);
+  Error Handshake(int64_t timeout_ms);
+  Error PumpUntil(int32_t stream_id, int64_t timeout_ms);
+
+  struct StreamState {
+    std::string body;
+    std::map<std::string, std::string> headers;
+    bool headers_done = false;
+    bool closed = false;          // peer sent END_STREAM / RST
+    int64_t send_window = 65535;  // peer's flow-control budget for us
+    Error error;                  // RST_STREAM arrival
+  };
+
+  std::string host_port_;
+  int fd_ = -1;
+  std::atomic<bool> alive_{false};
+
+  std::mutex send_mutex_;   // whole-frame socket writes
+  std::mutex state_mutex_;  // streams_, windows, next_stream_id_
+  std::mutex recv_mutex_;   // at most one socket reader
+  std::condition_variable frame_cv_;  // notified (state_mutex_) per frame
+
+  int32_t next_stream_id_ = 1;
+  std::string recv_buffer_;  // recv_mutex_ holder only
+  HpackDecoder hpack_;       // recv_mutex_ holder only
+  std::map<int32_t, StreamState> streams_;
+  // peer settings (state_mutex_ past the handshake)
+  int64_t peer_max_frame_size_ = 16384;
+  int64_t peer_initial_window_ = 65535;
+  int64_t conn_send_window_ = 65535;
+  std::string goaway_debug_;
+};
+
+}  // namespace h2
+}  // namespace client_tpu

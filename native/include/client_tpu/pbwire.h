@@ -1,0 +1,221 @@
+// Minimal proto3 wire-format primitives for the GRPC client.
+// The native twin of the Python schema codec (client_tpu/grpc/_wire.py):
+// instead of generated stubs (the reference links protoc output,
+// src/c++/library/grpc_client.cc), messages are hand-framed against the
+// public KServe field numbers with a writer/reader pair. Wire rules:
+// tag = (field_number << 3) | wire_type; wire types 0 varint, 1 fixed64,
+// 2 length-delimited, 5 fixed32; proto3 scalars skip defaults; repeated
+// numerics are packed on encode and accepted in both forms on decode.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace client_tpu {
+namespace pb {
+
+// ---------------------------------------------------------------------------
+// writer
+// ---------------------------------------------------------------------------
+
+class Writer {
+ public:
+  explicit Writer(std::string* out) : out_(out) {}
+
+  void Varint(uint64_t v) {
+    while (v >= 0x80) {
+      out_->push_back(static_cast<char>((v & 0x7F) | 0x80));
+      v >>= 7;
+    }
+    out_->push_back(static_cast<char>(v));
+  }
+  void Tag(uint32_t field, uint32_t wire_type) {
+    Varint((static_cast<uint64_t>(field) << 3) | wire_type);
+  }
+
+  // proto3 default-skipping scalar emitters
+  void Uint64(uint32_t field, uint64_t v) {
+    if (v == 0) return;
+    Tag(field, 0);
+    Varint(v);
+  }
+  void Int64(uint32_t field, int64_t v) {
+    if (v == 0) return;
+    Tag(field, 0);
+    Varint(static_cast<uint64_t>(v));  // two's-complement 10-byte form
+  }
+  void Bool(uint32_t field, bool v) {
+    if (!v) return;
+    Tag(field, 0);
+    Varint(1);
+  }
+  void String(uint32_t field, const std::string& v) {
+    if (v.empty()) return;
+    Tag(field, 2);
+    Varint(v.size());
+    out_->append(v);
+  }
+  void Bytes(uint32_t field, const void* data, size_t size) {
+    Tag(field, 2);
+    Varint(size);
+    out_->append(static_cast<const char*>(data), size);
+  }
+  // length-delimited submessage from already-encoded payload
+  void Submessage(uint32_t field, const std::string& payload) {
+    Tag(field, 2);
+    Varint(payload.size());
+    out_->append(payload);
+  }
+  void PackedInt64(uint32_t field, const std::vector<int64_t>& vals) {
+    if (vals.empty()) return;
+    std::string inner;
+    Writer w(&inner);
+    for (int64_t v : vals) w.Varint(static_cast<uint64_t>(v));
+    Submessage(field, inner);
+  }
+
+ private:
+  std::string* out_;
+};
+
+// ---------------------------------------------------------------------------
+// reader
+// ---------------------------------------------------------------------------
+
+// Cursor over a serialized message. Usage:
+//   Reader r(data, size);
+//   uint32_t field, wt;
+//   while (r.Next(&field, &wt)) { switch (field) { ... default: r.Skip(wt); } }
+// All getters validate bounds and flag ok()=false on truncation.
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t size) : p_(data), end_(data + size) {}
+  Reader(const char* data, size_t size)
+      : Reader(reinterpret_cast<const uint8_t*>(data), size) {}
+
+  bool ok() const { return ok_; }
+  bool AtEnd() const { return p_ >= end_; }
+
+  bool Next(uint32_t* field, uint32_t* wire_type) {
+    if (!ok_ || AtEnd()) return false;
+    uint64_t tag = Varint();
+    if (!ok_) return false;
+    *field = static_cast<uint32_t>(tag >> 3);
+    *wire_type = static_cast<uint32_t>(tag & 0x7);
+    return true;
+  }
+
+  uint64_t Varint() {
+    uint64_t result = 0;
+    int shift = 0;
+    while (p_ < end_) {
+      uint8_t b = *p_++;
+      result |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return result;
+      shift += 7;
+      if (shift >= 70) break;
+    }
+    ok_ = false;
+    return 0;
+  }
+  int64_t SignedVarint() { return static_cast<int64_t>(Varint()); }
+  bool BoolVal() { return Varint() != 0; }
+
+  // length-delimited payload; returns a view into the buffer (no copy)
+  bool LengthDelimited(const uint8_t** data, size_t* size) {
+    uint64_t len = Varint();
+    if (!ok_ || p_ + len > end_) {
+      ok_ = false;
+      return false;
+    }
+    *data = p_;
+    *size = static_cast<size_t>(len);
+    p_ += len;
+    return true;
+  }
+  std::string StringVal() {
+    const uint8_t* d;
+    size_t n;
+    if (!LengthDelimited(&d, &n)) return "";
+    return std::string(reinterpret_cast<const char*>(d), n);
+  }
+
+  // packed-or-not repeated int64 (shape fields)
+  void RepeatedInt64(uint32_t wire_type, std::vector<int64_t>* out) {
+    if (wire_type == 2) {
+      const uint8_t* d;
+      size_t n;
+      if (!LengthDelimited(&d, &n)) return;
+      Reader inner(d, n);
+      while (!inner.AtEnd() && inner.ok()) out->push_back(inner.SignedVarint());
+      ok_ = ok_ && inner.ok();
+    } else {
+      out->push_back(SignedVarint());
+    }
+  }
+
+  void Skip(uint32_t wire_type) {
+    switch (wire_type) {
+      case 0:
+        Varint();
+        break;
+      case 1:
+        p_ += 8;
+        break;
+      case 2: {
+        const uint8_t* d;
+        size_t n;
+        LengthDelimited(&d, &n);
+        break;
+      }
+      case 5:
+        p_ += 4;
+        break;
+      default:
+        ok_ = false;
+    }
+    if (p_ > end_) ok_ = false;
+  }
+
+ private:
+  const uint8_t* p_;
+  const uint8_t* end_;
+  bool ok_ = true;
+};
+
+// ---------------------------------------------------------------------------
+// gRPC message framing (5-byte prefix: compressed flag + u32 BE length)
+// ---------------------------------------------------------------------------
+
+inline void FrameMessage(const std::string& payload, std::string* out) {
+  out->reserve(out->size() + 5 + payload.size());
+  out->push_back('\0');  // uncompressed
+  uint32_t n = static_cast<uint32_t>(payload.size());
+  out->push_back(static_cast<char>((n >> 24) & 0xFF));
+  out->push_back(static_cast<char>((n >> 16) & 0xFF));
+  out->push_back(static_cast<char>((n >> 8) & 0xFF));
+  out->push_back(static_cast<char>(n & 0xFF));
+  out->append(payload);
+}
+
+// Parses one length-prefixed message from `data`; advances *pos. Returns
+// false when fewer than 5 + len bytes remain.
+inline bool UnframeMessage(
+    const std::string& data, size_t* pos, const uint8_t** payload,
+    size_t* payload_size, bool* compressed) {
+  if (*pos + 5 > data.size()) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(data.data()) + *pos;
+  *compressed = p[0] != 0;
+  uint32_t n = (static_cast<uint32_t>(p[1]) << 24) |
+               (static_cast<uint32_t>(p[2]) << 16) |
+               (static_cast<uint32_t>(p[3]) << 8) | static_cast<uint32_t>(p[4]);
+  if (*pos + 5 + n > data.size()) return false;
+  *payload = p + 5;
+  *payload_size = n;
+  *pos += 5 + n;
+  return true;
+}
+
+}  // namespace pb
+}  // namespace client_tpu

@@ -5,10 +5,12 @@
 #include <vector>
 
 #include "client_tpu/common.h"
+#include "client_tpu/grpc_client.h"
 #include "client_tpu/http_client.h"
 #include "client_tpu/tpu_shm.h"
 
 using client_tpu::Error;
+using client_tpu::InferenceServerGrpcClient;
 using client_tpu::InferenceServerHttpClient;
 using client_tpu::InferInput;
 using client_tpu::InferOptions;
@@ -303,6 +305,96 @@ int ctpu_async_infer(
       [callback, user](InferResult* result) { callback(user, result); },
       *static_cast<InferOptions*>(options), ins, outs);
   return SetError(err);
+}
+
+// -- grpc client --------------------------------------------------------------
+// Same handle/value-model surface over InferenceServerGrpcClient; results
+// flow back through the shared ctpu_result_* accessors (InferResult is
+// polymorphic across both clients).
+
+void* ctpu_grpc_client_create(const char* url, int verbose) {
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  Error err = InferenceServerGrpcClient::Create(&client, url, verbose != 0);
+  if (SetError(err) != 0) return nullptr;
+  return client.release();
+}
+
+void ctpu_grpc_client_destroy(void* client) {
+  delete static_cast<InferenceServerGrpcClient*>(client);
+}
+
+int ctpu_grpc_server_live(void* client) {
+  bool live = false;
+  Error err =
+      static_cast<InferenceServerGrpcClient*>(client)->IsServerLive(&live);
+  if (SetError(err) != 0) return -1;
+  return live ? 1 : 0;
+}
+
+int ctpu_grpc_model_ready(void* client, const char* model_name) {
+  bool ready = false;
+  Error err = static_cast<InferenceServerGrpcClient*>(client)->IsModelReady(
+      &ready, model_name);
+  if (SetError(err) != 0) return -1;
+  return ready ? 1 : 0;
+}
+
+int ctpu_grpc_infer(
+    void* client, void* options, void** inputs, int n_inputs, void** outputs,
+    int n_outputs, void** result_out) {
+  std::vector<InferInput*> ins(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) ins[i] = static_cast<InferInput*>(inputs[i]);
+  std::vector<const InferRequestedOutput*> outs(n_outputs);
+  for (int i = 0; i < n_outputs; ++i) {
+    outs[i] = static_cast<const InferRequestedOutput*>(outputs[i]);
+  }
+  InferResult* result = nullptr;
+  Error err = static_cast<InferenceServerGrpcClient*>(client)->Infer(
+      &result, *static_cast<InferOptions*>(options), ins, outs);
+  *result_out = result;
+  return SetError(err);
+}
+
+int ctpu_grpc_async_infer(
+    void* client, void* options, void** inputs, int n_inputs, void** outputs,
+    int n_outputs, ctpu_callback callback, void* user) {
+  std::vector<InferInput*> ins(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) ins[i] = static_cast<InferInput*>(inputs[i]);
+  std::vector<const InferRequestedOutput*> outs(n_outputs);
+  for (int i = 0; i < n_outputs; ++i) {
+    outs[i] = static_cast<const InferRequestedOutput*>(outputs[i]);
+  }
+  Error err = static_cast<InferenceServerGrpcClient*>(client)->AsyncInfer(
+      [callback, user](InferResult* result) { callback(user, result); },
+      *static_cast<InferOptions*>(options), ins, outs);
+  return SetError(err);
+}
+
+int ctpu_grpc_register_system_shm(
+    void* client, const char* name, const char* key,
+    unsigned long long byte_size, unsigned long long offset) {
+  return SetError(
+      static_cast<InferenceServerGrpcClient*>(client)
+          ->RegisterSystemSharedMemory(name, key, byte_size, offset));
+}
+
+int ctpu_grpc_register_tpu_shm(
+    void* client, const char* name, const char* raw_handle, int device_id,
+    unsigned long long byte_size) {
+  return SetError(
+      static_cast<InferenceServerGrpcClient*>(client)->RegisterTpuSharedMemory(
+          name, raw_handle, device_id, byte_size));
+}
+
+int ctpu_grpc_unregister_shm(
+    void* client, const char* family, const char* name) {
+  auto* c = static_cast<InferenceServerGrpcClient*>(client);
+  std::string fam(family);
+  if (fam == "system") return SetError(c->UnregisterSystemSharedMemory(name));
+  if (fam == "tpu") return SetError(c->UnregisterTpuSharedMemory(name));
+  if (fam == "cuda") return SetError(c->UnregisterCudaSharedMemory(name));
+  g_last_error = "unknown shared-memory family";
+  return -1;
 }
 
 // -- tpu shm regions ---------------------------------------------------------

@@ -1,0 +1,829 @@
+// HTTP/2 + HPACK client transport implementation. See h2.h for scope.
+
+#include "client_tpu/h2.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+
+namespace client_tpu {
+namespace h2 {
+
+namespace {
+
+#include "hpack_tables.inc"
+
+// frame types
+constexpr uint8_t kData = 0x0;
+constexpr uint8_t kHeaders = 0x1;
+constexpr uint8_t kRstStream = 0x3;
+constexpr uint8_t kSettings = 0x4;
+constexpr uint8_t kPushPromise = 0x5;
+constexpr uint8_t kPing = 0x6;
+constexpr uint8_t kGoaway = 0x7;
+constexpr uint8_t kWindowUpdate = 0x8;
+constexpr uint8_t kContinuation = 0x9;
+
+// flags
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+// our receive windows: announce large windows once, replenish as consumed
+constexpr int64_t kRecvWindow = 1 << 28;  // 256 MiB
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+int64_t NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// huffman decoding: bit-walk a tree built once from the RFC code table
+// ---------------------------------------------------------------------------
+
+struct HuffNode {
+  int16_t child[2] = {-1, -1};
+  int16_t symbol = -1;  // 0..255 terminal, 256 EOS
+};
+
+struct HuffTree {
+  std::vector<HuffNode> nodes;
+  HuffTree() {
+    nodes.emplace_back();
+    for (int sym = 0; sym < 257; ++sym) {
+      uint32_t code = kHuffmanCodes[sym].code;
+      int bits = kHuffmanCodes[sym].bits;
+      int at = 0;
+      for (int b = bits - 1; b >= 0; --b) {
+        int bit = (code >> b) & 1;
+        if (nodes[at].child[bit] < 0) {
+          nodes[at].child[bit] = static_cast<int16_t>(nodes.size());
+          nodes.emplace_back();
+        }
+        at = nodes[at].child[bit];
+      }
+      nodes[at].symbol = static_cast<int16_t>(sym);
+    }
+  }
+};
+const HuffTree& Tree() {
+  static HuffTree tree;
+  return tree;
+}
+
+Error HuffmanDecode(const uint8_t* data, size_t size, std::string* out) {
+  const HuffTree& tree = Tree();
+  int at = 0;
+  int pending_bits = 0;  // bits consumed since the last completed symbol
+  int ones_run = 0;      // consecutive 1-bits ending at the current bit
+  for (size_t i = 0; i < size; ++i) {
+    for (int b = 7; b >= 0; --b) {
+      int bit = (data[i] >> b) & 1;
+      ones_run = bit ? ones_run + 1 : 0;
+      ++pending_bits;
+      at = tree.nodes[at].child[bit];
+      if (at < 0) return Error("hpack: invalid huffman sequence");
+      int16_t sym = tree.nodes[at].symbol;
+      if (sym >= 0) {
+        if (sym == 256) return Error("hpack: unexpected EOS symbol");
+        out->push_back(static_cast<char>(sym));
+        at = 0;
+        pending_bits = 0;
+        ones_run = 0;
+      }
+    }
+  }
+  // trailing bits must be the all-ones EOS prefix, shorter than 8 bits
+  if (pending_bits >= 8 || pending_bits != ones_run) {
+    return Error("hpack: bad huffman padding");
+  }
+  return Error::Success();
+}
+
+void PutU32(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>((v >> 24) & 0xFF));
+  out->push_back(static_cast<char>((v >> 16) & 0xFF));
+  out->push_back(static_cast<char>((v >> 8) & 0xFF));
+  out->push_back(static_cast<char>(v & 0xFF));
+}
+
+// HPACK integer encoding with N-bit prefix, high bits `pattern`
+void EncodeInt(std::string* out, uint8_t pattern, int prefix_bits, uint64_t v) {
+  uint64_t limit = (1u << prefix_bits) - 1;
+  if (v < limit) {
+    out->push_back(static_cast<char>(pattern | v));
+    return;
+  }
+  out->push_back(static_cast<char>(pattern | limit));
+  v -= limit;
+  while (v >= 128) {
+    out->push_back(static_cast<char>((v & 0x7F) | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+
+// header field as "literal without indexing, new name" — keeps the encoder
+// stateless (the decoder side still handles peers that use dynamic tables)
+void EncodeLiteralHeader(
+    std::string* out, const std::string& name, const std::string& value) {
+  out->push_back('\0');  // 0000 0000: literal without indexing, new name
+  EncodeInt(out, 0x00, 7, name.size());
+  out->append(name);
+  EncodeInt(out, 0x00, 7, value.size());
+  out->append(value);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// HpackDecoder
+// ---------------------------------------------------------------------------
+
+HpackDecoder::HpackDecoder() = default;
+
+Error HpackDecoder::DecodeInt(
+    const uint8_t** p, const uint8_t* end, int prefix_bits, uint64_t* out) {
+  if (*p >= end) return Error("hpack: truncated integer");
+  uint64_t limit = (1u << prefix_bits) - 1;
+  uint64_t v = **p & limit;
+  ++*p;
+  if (v < limit) {
+    *out = v;
+    return Error::Success();
+  }
+  int shift = 0;
+  while (*p < end) {
+    uint8_t b = **p;
+    ++*p;
+    v += static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return Error::Success();
+    }
+    shift += 7;
+    if (shift > 62) break;
+  }
+  return Error("hpack: malformed integer");
+}
+
+Error HpackDecoder::DecodeString(
+    const uint8_t** p, const uint8_t* end, std::string* out) {
+  if (*p >= end) return Error("hpack: truncated string");
+  bool huffman = (**p & 0x80) != 0;
+  uint64_t length;
+  Error err = DecodeInt(p, end, 7, &length);
+  if (err) return err;
+  if (*p + length > end) return Error("hpack: string overruns block");
+  if (huffman) {
+    err = HuffmanDecode(*p, length, out);
+    if (err) return err;
+  } else {
+    out->assign(reinterpret_cast<const char*>(*p), length);
+  }
+  *p += length;
+  return Error::Success();
+}
+
+Error HpackDecoder::Lookup(
+    uint64_t index, std::string* name, std::string* value) {
+  if (index == 0) return Error("hpack: index 0");
+  constexpr size_t kStaticCount = sizeof(kStaticTable) / sizeof(kStaticTable[0]);
+  if (index <= kStaticCount) {
+    *name = kStaticTable[index - 1].name;
+    *value = kStaticTable[index - 1].value;
+    return Error::Success();
+  }
+  size_t dyn = index - kStaticCount - 1;
+  if (dyn >= dynamic_.size()) return Error("hpack: index out of range");
+  *name = dynamic_[dyn].first;
+  *value = dynamic_[dyn].second;
+  return Error::Success();
+}
+
+void HpackDecoder::Insert(const std::string& name, const std::string& value) {
+  size_t entry = name.size() + value.size() + 32;
+  dynamic_.insert(dynamic_.begin(), {name, value});
+  dynamic_size_ += entry;
+  EvictTo(max_size_);
+}
+
+void HpackDecoder::EvictTo(size_t target) {
+  while (dynamic_size_ > target && !dynamic_.empty()) {
+    const auto& back = dynamic_.back();
+    dynamic_size_ -= back.first.size() + back.second.size() + 32;
+    dynamic_.pop_back();
+  }
+}
+
+Error HpackDecoder::Decode(
+    const uint8_t* data, size_t size, HeaderList* out) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + size;
+  while (p < end) {
+    uint8_t b = *p;
+    if (b & 0x80) {  // indexed
+      uint64_t index;
+      Error err = DecodeInt(&p, end, 7, &index);
+      if (err) return err;
+      std::string name, value;
+      err = Lookup(index, &name, &value);
+      if (err) return err;
+      out->emplace_back(std::move(name), std::move(value));
+    } else if ((b & 0xC0) == 0x40) {  // literal with incremental indexing
+      uint64_t index;
+      Error err = DecodeInt(&p, end, 6, &index);
+      if (err) return err;
+      std::string name, value, ignored;
+      if (index != 0) {
+        err = Lookup(index, &name, &ignored);
+        if (err) return err;
+      } else {
+        err = DecodeString(&p, end, &name);
+        if (err) return err;
+      }
+      err = DecodeString(&p, end, &value);
+      if (err) return err;
+      Insert(name, value);
+      out->emplace_back(std::move(name), std::move(value));
+    } else if ((b & 0xE0) == 0x20) {  // dynamic table size update
+      uint64_t new_size;
+      Error err = DecodeInt(&p, end, 5, &new_size);
+      if (err) return err;
+      if (new_size > protocol_max_size_) {
+        return Error("hpack: table size update beyond SETTINGS limit");
+      }
+      max_size_ = new_size;
+      EvictTo(max_size_);
+    } else {  // literal without indexing (0000) / never indexed (0001)
+      uint64_t index;
+      Error err = DecodeInt(&p, end, 4, &index);
+      if (err) return err;
+      std::string name, value, ignored;
+      if (index != 0) {
+        err = Lookup(index, &name, &ignored);
+        if (err) return err;
+      } else {
+        err = DecodeString(&p, end, &name);
+        if (err) return err;
+      }
+      err = DecodeString(&p, end, &value);
+      if (err) return err;
+      out->emplace_back(std::move(name), std::move(value));
+    }
+  }
+  return Error::Success();
+}
+
+// ---------------------------------------------------------------------------
+// Connection
+// ---------------------------------------------------------------------------
+
+Connection::Connection(const std::string& host_port) : host_port_(host_port) {}
+
+Connection::~Connection() {
+  if (fd_ >= 0) close(fd_);
+}
+
+Error Connection::Connect(
+    std::unique_ptr<Connection>* conn, const std::string& host_port,
+    int64_t timeout_ms) {
+  std::string host = host_port;
+  std::string port = "80";
+  size_t colon = host_port.rfind(':');
+  if (colon != std::string::npos) {
+    host = host_port.substr(0, colon);
+    port = host_port.substr(colon + 1);
+  }
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* result = nullptr;
+  int rc = getaddrinfo(host.c_str(), port.c_str(), &hints, &result);
+  if (rc != 0) {
+    return Error(
+        "failed to resolve " + host_port + ": " + gai_strerror(rc));
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = result; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    // non-blocking from the start: connect honors timeout_ms, and
+    // send/recv surface EAGAIN so per-call deadlines work
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    int rc2 = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc2 == 0) break;
+    if (errno == EINPROGRESS) {
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      int ready = poll(&pfd, 1, static_cast<int>(timeout_ms));
+      int soerr = 0;
+      socklen_t len = sizeof(soerr);
+      if (ready > 0 &&
+          getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &len) == 0 &&
+          soerr == 0) {
+        break;
+      }
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(result);
+  if (fd < 0) return Error("failed to connect to " + host_port);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  auto c = std::unique_ptr<Connection>(new Connection(host_port));
+  c->fd_ = fd;
+  Error err = c->Handshake(timeout_ms);
+  if (err) return err;
+  c->alive_ = true;
+  *conn = std::move(c);
+  return Error::Success();
+}
+
+Error Connection::Handshake(int64_t timeout_ms) {
+  // preface + SETTINGS(ENABLE_PUSH=0, INITIAL_WINDOW_SIZE=kRecvWindow,
+  // MAX_FRAME_SIZE=1MiB) + connection window bump
+  std::string out(kPreface, sizeof(kPreface) - 1);
+  std::string settings;
+  auto setting = [&settings](uint16_t id, uint32_t v) {
+    settings.push_back(static_cast<char>(id >> 8));
+    settings.push_back(static_cast<char>(id & 0xFF));
+    PutU32(&settings, v);
+  };
+  setting(0x2, 0);                                   // ENABLE_PUSH off
+  setting(0x4, static_cast<uint32_t>(kRecvWindow));  // INITIAL_WINDOW_SIZE
+  setting(0x5, 1 << 20);                             // MAX_FRAME_SIZE
+  // frame header
+  uint32_t len = static_cast<uint32_t>(settings.size());
+  out.push_back(static_cast<char>((len >> 16) & 0xFF));
+  out.push_back(static_cast<char>((len >> 8) & 0xFF));
+  out.push_back(static_cast<char>(len & 0xFF));
+  out.push_back(static_cast<char>(kSettings));
+  out.push_back(0);  // flags
+  PutU32(&out, 0);   // stream 0
+  out.append(settings);
+  // connection-level WINDOW_UPDATE to kRecvWindow
+  out.push_back(0);
+  out.push_back(0);
+  out.push_back(4);
+  out.push_back(static_cast<char>(kWindowUpdate));
+  out.push_back(0);
+  PutU32(&out, 0);
+  PutU32(&out, static_cast<uint32_t>(kRecvWindow - 65535));
+  Error err = SendAll(out.data(), out.size(), timeout_ms);
+  if (err) return err;
+  // the server's SETTINGS arrives with the first RecvFrame calls; no need
+  // to block on it here (RFC allows requests before the ACK round trip)
+  return Error::Success();
+}
+
+Error Connection::SendAll(const void* data, size_t size, int64_t timeout_ms) {
+  const char* p = static_cast<const char*>(data);
+  size_t remaining = size;
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
+  while (remaining > 0) {
+    ssize_t n = send(fd_, p, remaining, MSG_NOSIGNAL);
+    if (n > 0) {
+      p += n;
+      remaining -= static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      struct pollfd pfd = {fd_, POLLOUT, 0};
+      int wait = deadline ? static_cast<int>(deadline - NowMs()) : 1000;
+      if (deadline && wait <= 0) return Error("send timeout");
+      poll(&pfd, 1, wait);
+      continue;
+    }
+    alive_ = false;
+    return Error(
+        std::string("connection write failed: ") + strerror(errno));
+  }
+  return Error::Success();
+}
+
+Error Connection::SendFrame(
+    uint8_t type, uint8_t flags, int32_t stream_id, const void* payload,
+    size_t size, int64_t timeout_ms) {
+  // one contiguous buffer + one lock: a frame is never interleaved with
+  // another thread's bytes (the streaming reader sends WINDOW_UPDATEs
+  // concurrently with application DATA)
+  std::string frame;
+  frame.reserve(9 + size);
+  frame.push_back(static_cast<char>((size >> 16) & 0xFF));
+  frame.push_back(static_cast<char>((size >> 8) & 0xFF));
+  frame.push_back(static_cast<char>(size & 0xFF));
+  frame.push_back(static_cast<char>(type));
+  frame.push_back(static_cast<char>(flags));
+  PutU32(&frame, static_cast<uint32_t>(stream_id));
+  if (size > 0) frame.append(static_cast<const char*>(payload), size);
+  std::lock_guard<std::mutex> lock(send_mutex_);
+  return SendAll(frame.data(), frame.size(), timeout_ms);
+}
+
+// Reads exactly one frame from the socket and dispatches it into stream /
+// connection state. Caller holds recv_mutex_; state mutations take
+// state_mutex_, and every dispatched frame notifies frame_cv_ so threads
+// blocked in PumpOne can re-check their stream.
+Error Connection::RecvFrameLocked(int64_t timeout_ms) {
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
+  auto fill = [&](size_t need) -> Error {
+    while (recv_buffer_.size() < need) {
+      char buf[65536];
+      ssize_t n = recv(fd_, buf, sizeof(buf), MSG_DONTWAIT);
+      if (n > 0) {
+        recv_buffer_.append(buf, static_cast<size_t>(n));
+        continue;
+      }
+      if (n == 0) {
+        alive_ = false;
+        return Error(
+            goaway_debug_.empty()
+                ? "connection closed by peer"
+                : "connection closed by peer (GOAWAY: " + goaway_debug_ + ")");
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        struct pollfd pfd = {fd_, POLLIN, 0};
+        int wait = deadline ? static_cast<int>(deadline - NowMs()) : 1000;
+        if (deadline && wait <= 0) return Error("Deadline Exceeded");
+        poll(&pfd, 1, wait);
+        continue;
+      }
+      alive_ = false;
+      return Error(std::string("connection read failed: ") + strerror(errno));
+    }
+    return Error::Success();
+  };
+
+  Error err = fill(9);
+  if (err) return err;
+  const uint8_t* h = reinterpret_cast<const uint8_t*>(recv_buffer_.data());
+  size_t length = (static_cast<size_t>(h[0]) << 16) |
+                  (static_cast<size_t>(h[1]) << 8) | h[2];
+  uint8_t type = h[3];
+  uint8_t flags = h[4];
+  int32_t stream_id = static_cast<int32_t>(
+      ((static_cast<uint32_t>(h[5]) << 24) | (static_cast<uint32_t>(h[6]) << 16) |
+       (static_cast<uint32_t>(h[7]) << 8) | h[8]) &
+      0x7FFFFFFF);
+  err = fill(9 + length);
+  if (err) return err;
+  const uint8_t* payload =
+      reinterpret_cast<const uint8_t*>(recv_buffer_.data()) + 9;
+
+  switch (type) {
+    case kData: {
+      size_t data_len = length;
+      const uint8_t* data = payload;
+      if (flags & kFlagPadded) {
+        if (data_len < 1) return Error("h2: padded DATA too short");
+        uint8_t pad = data[0];
+        if (1u + pad > data_len) return Error("h2: DATA padding overflow");
+        data += 1;
+        data_len -= 1 + pad;
+      }
+      {
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) {
+          it->second.body.append(
+              reinterpret_cast<const char*>(data), data_len);
+          if (flags & kFlagEndStream) it->second.closed = true;
+        }
+      }
+      // replenish both windows for the full frame length (outside the
+      // state lock: SendFrame takes the send lock)
+      if (length > 0) {
+        std::string wu;
+        PutU32(&wu, static_cast<uint32_t>(length));
+        SendFrame(kWindowUpdate, 0, 0, wu.data(), wu.size(), timeout_ms);
+        if (!(flags & kFlagEndStream)) {
+          SendFrame(
+              kWindowUpdate, 0, stream_id, wu.data(), wu.size(), timeout_ms);
+        }
+      }
+      break;
+    }
+    case kHeaders: {
+      size_t block_len = length;
+      const uint8_t* block = payload;
+      if (flags & kFlagPadded) {
+        if (block_len < 1) return Error("h2: padded HEADERS too short");
+        uint8_t pad = block[0];
+        block += 1;
+        if (1u + pad > block_len) return Error("h2: HEADERS padding overflow");
+        block_len -= 1 + pad;
+      }
+      if (flags & kFlagPriority) {
+        if (block_len < 5) return Error("h2: HEADERS priority too short");
+        block += 5;
+        block_len -= 5;
+      }
+      if (!(flags & kFlagEndHeaders)) {
+        // CONTINUATION support: accumulate until END_HEADERS. Our peers'
+        // header blocks are tiny; treat fragmentation as a hard error for
+        // now rather than carrying half-finished decode state.
+        return Error("h2: fragmented header block (CONTINUATION) unsupported");
+      }
+      HeaderList decoded;
+      Error derr = hpack_.Decode(block, block_len, &decoded);
+      if (derr) return derr;
+      {
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) {
+          for (auto& kv : decoded) {
+            it->second.headers[kv.first] = kv.second;
+          }
+          it->second.headers_done = true;
+          if (flags & kFlagEndStream) it->second.closed = true;
+        }
+      }
+      break;
+    }
+    case kRstStream: {
+      if (length >= 4) {
+        uint32_t code = (static_cast<uint32_t>(payload[0]) << 24) |
+                        (static_cast<uint32_t>(payload[1]) << 16) |
+                        (static_cast<uint32_t>(payload[2]) << 8) | payload[3];
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        auto it = streams_.find(stream_id);
+        if (it != streams_.end()) {
+          it->second.closed = true;
+          it->second.error =
+              Error("stream reset by peer (code " + std::to_string(code) + ")");
+        }
+      }
+      break;
+    }
+    case kSettings: {
+      if (!(flags & kFlagAck)) {
+        for (size_t off = 0; off + 6 <= length; off += 6) {
+          uint16_t id = (static_cast<uint16_t>(payload[off]) << 8) |
+                        payload[off + 1];
+          uint32_t value = (static_cast<uint32_t>(payload[off + 2]) << 24) |
+                           (static_cast<uint32_t>(payload[off + 3]) << 16) |
+                           (static_cast<uint32_t>(payload[off + 4]) << 8) |
+                           payload[off + 5];
+          if (id == 0x1) {  // HEADER_TABLE_SIZE
+            hpack_.SetMaxTableSize(value);
+          } else if (id == 0x4) {  // INITIAL_WINDOW_SIZE
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            int64_t delta = static_cast<int64_t>(value) - peer_initial_window_;
+            peer_initial_window_ = value;
+            for (auto& s : streams_) s.second.send_window += delta;
+          } else if (id == 0x5) {  // MAX_FRAME_SIZE
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            peer_max_frame_size_ = value;
+          }
+        }
+        SendFrame(kSettings, kFlagAck, 0, nullptr, 0, timeout_ms);
+      }
+      break;
+    }
+    case kPing: {
+      if (!(flags & kFlagAck) && length == 8) {
+        SendFrame(kPing, kFlagAck, 0, payload, 8, timeout_ms);
+      }
+      break;
+    }
+    case kGoaway: {
+      if (length >= 8) {
+        goaway_debug_.assign(
+            reinterpret_cast<const char*>(payload + 8), length - 8);
+      }
+      // streams above last_stream_id will never complete; the read loop
+      // surfaces the condition when the peer closes the socket
+      break;
+    }
+    case kWindowUpdate: {
+      if (length >= 4) {
+        uint32_t inc = ((static_cast<uint32_t>(payload[0]) << 24) |
+                        (static_cast<uint32_t>(payload[1]) << 16) |
+                        (static_cast<uint32_t>(payload[2]) << 8) | payload[3]) &
+                       0x7FFFFFFF;
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        if (stream_id == 0) {
+          conn_send_window_ += inc;
+        } else {
+          auto it = streams_.find(stream_id);
+          if (it != streams_.end()) it->second.send_window += inc;
+        }
+      }
+      break;
+    }
+    case kPushPromise:
+      return Error("h2: unexpected PUSH_PROMISE (push is disabled)");
+    case kContinuation:
+      return Error("h2: unexpected CONTINUATION frame");
+    default:
+      break;  // unknown frame types are ignored (RFC 7540 §4.1)
+  }
+  recv_buffer_.erase(0, 9 + length);
+  {  // wake any thread waiting for this stream's state to change
+    std::lock_guard<std::mutex> lock(state_mutex_);
+    frame_cv_.notify_all();
+  }
+  return Error::Success();
+}
+
+// One unit of progress toward new frames: become the receiver, or wait for
+// the current receiver to dispatch something.
+Error Connection::PumpOne(int64_t timeout_ms) {
+  std::unique_lock<std::mutex> rl(recv_mutex_, std::try_to_lock);
+  if (rl.owns_lock()) {
+    return RecvFrameLocked(timeout_ms);
+  }
+  std::unique_lock<std::mutex> sl(state_mutex_);
+  frame_cv_.wait_for(
+      sl, std::chrono::milliseconds(
+              timeout_ms > 0 ? std::min<int64_t>(timeout_ms, 100) : 100));
+  if (!alive_) {
+    return Error(
+        goaway_debug_.empty()
+            ? "connection closed by peer"
+            : "connection closed by peer (GOAWAY: " + goaway_debug_ + ")");
+  }
+  return Error::Success();
+}
+
+Error Connection::StreamOpen(
+    const std::string& path, const HeaderList& headers, int32_t* stream_id) {
+  if (!alive_) return Error("connection is closed");
+  std::string block;
+  EncodeLiteralHeader(&block, ":method", "POST");
+  EncodeLiteralHeader(&block, ":scheme", "http");
+  EncodeLiteralHeader(&block, ":authority", host_port_);
+  EncodeLiteralHeader(&block, ":path", path);
+  for (const auto& kv : headers) {
+    std::string name = kv.first;
+    for (auto& c : name) c = static_cast<char>(tolower(c));
+    EncodeLiteralHeader(&block, name, kv.second);
+  }
+  if (block.size() > 16000) return Error("h2: header block too large");
+  int32_t id;
+  {
+    // register the stream before its HEADERS can be answered, and allocate
+    // ids in the same order HEADERS hit the wire (RFC: ids must increase)
+    std::lock_guard<std::mutex> lock(state_mutex_);
+    id = next_stream_id_;
+    next_stream_id_ += 2;
+    streams_[id].send_window = peer_initial_window_;
+  }
+  Error err =
+      SendFrame(kHeaders, kFlagEndHeaders, id, block.data(), block.size(), 0);
+  if (err) return err;
+  *stream_id = id;
+  return Error::Success();
+}
+
+Error Connection::StreamSend(
+    int32_t stream_id, const void* data, size_t size, bool end_stream,
+    int64_t timeout_ms) {
+  if (!alive_) return Error("connection is closed");
+  const char* p = static_cast<const char*>(data);
+  size_t remaining = size;
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
+  do {
+    size_t chunk;
+    {
+      // respect stream + connection flow control and the peer frame limit
+      std::lock_guard<std::mutex> lock(state_mutex_);
+      auto it = streams_.find(stream_id);
+      if (it == streams_.end()) return Error("h2: unknown stream");
+      if (it->second.error) return it->second.error;
+      int64_t budget = std::min(it->second.send_window, conn_send_window_);
+      if (remaining > 0 && budget <= 0) {
+        chunk = 0;
+      } else {
+        chunk = remaining;
+        if (static_cast<int64_t>(chunk) > budget) {
+          chunk = static_cast<size_t>(budget);
+        }
+        if (chunk > static_cast<size_t>(peer_max_frame_size_)) {
+          chunk = static_cast<size_t>(peer_max_frame_size_);
+        }
+        it->second.send_window -= static_cast<int64_t>(chunk);
+        conn_send_window_ -= static_cast<int64_t>(chunk);
+      }
+    }
+    if (remaining > 0 && chunk == 0) {
+      // out of window: drain frames until a WINDOW_UPDATE arrives
+      int64_t wait = deadline ? deadline - NowMs() : 1000;
+      if (deadline && wait <= 0) return Error("Deadline Exceeded");
+      Error err = PumpOne(wait);
+      if (err) return err;
+      continue;
+    }
+    bool last = (chunk == remaining) && end_stream;
+    Error err = SendFrame(
+        kData, last ? kFlagEndStream : 0, stream_id, p, chunk, timeout_ms);
+    if (err) {
+      // the window reservation is lost with the connection; no rollback
+      return err;
+    }
+    p += chunk;
+    remaining -= chunk;
+  } while (remaining > 0);
+  return Error::Success();
+}
+
+Error Connection::StreamRecv(
+    int32_t stream_id, std::string* body,
+    std::map<std::string, std::string>* headers, bool* closed,
+    int64_t timeout_ms) {
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lock(state_mutex_);
+      auto it = streams_.find(stream_id);
+      if (it == streams_.end()) return Error("h2: unknown stream");
+      if (!it->second.body.empty() || it->second.closed) {
+        if (it->second.error) return it->second.error;
+        body->append(it->second.body);
+        it->second.body.clear();
+        for (const auto& kv : it->second.headers) {
+          (*headers)[kv.first] = kv.second;
+        }
+        *closed = it->second.closed;
+        if (it->second.closed) streams_.erase(it);
+        return Error::Success();
+      }
+    }
+    int64_t wait = deadline ? deadline - NowMs() : 0;
+    if (deadline && wait <= 0) return Error("Deadline Exceeded");
+    Error err = PumpOne(wait);
+    if (err) return err;
+  }
+}
+
+Error Connection::StreamReset(int32_t stream_id) {
+  std::string payload;
+  PutU32(&payload, 0x8);  // CANCEL
+  Error err =
+      SendFrame(kRstStream, 0, stream_id, payload.data(), payload.size(), 0);
+  std::lock_guard<std::mutex> lock(state_mutex_);
+  streams_.erase(stream_id);
+  return err;
+}
+
+Error Connection::PumpUntil(int32_t stream_id, int64_t timeout_ms) {
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lock(state_mutex_);
+      auto it = streams_.find(stream_id);
+      if (it == streams_.end()) return Error("h2: stream vanished");
+      if (it->second.closed) return Error::Success();
+    }
+    int64_t wait = deadline ? deadline - NowMs() : 0;
+    if (deadline && wait <= 0) return Error("Deadline Exceeded");
+    Error err = PumpOne(wait);
+    if (err) return err;
+  }
+}
+
+Error Connection::Request(
+    const std::string& path, const HeaderList& headers,
+    const std::string& body, Response* out, int64_t timeout_ms) {
+  int32_t stream_id;
+  Error err = StreamOpen(path, headers, &stream_id);
+  if (err) return err;
+  err = StreamSend(stream_id, body.data(), body.size(), true, timeout_ms);
+  if (err) return err;
+  err = PumpUntil(stream_id, timeout_ms);
+  if (err) return err;
+  std::lock_guard<std::mutex> lock(state_mutex_);
+  auto it = streams_.find(stream_id);
+  if (it == streams_.end()) return Error("h2: stream vanished");
+  if (it->second.error) {
+    Error stream_err = it->second.error;
+    streams_.erase(it);
+    return stream_err;
+  }
+  out->headers = std::move(it->second.headers);
+  out->body = std::move(it->second.body);
+  auto status_it = out->headers.find(":status");
+  if (status_it != out->headers.end()) {
+    out->status = atoi(status_it->second.c_str());
+  }
+  streams_.erase(it);
+  return Error::Success();
+}
+
+}  // namespace h2
+}  // namespace client_tpu

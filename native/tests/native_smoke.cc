@@ -17,7 +17,9 @@
 
 #include "client_tpu/base64.h"
 #include "client_tpu/common.h"
+#include "client_tpu/grpc_client.h"
 #include "client_tpu/http_client.h"
+#include "client_tpu/pbwire.h"
 #include "client_tpu/json.h"
 #include "client_tpu/shm_utils.h"
 #include "client_tpu/tpu_shm.h"
@@ -367,6 +369,255 @@ void TestOfflineMarshaling() {
   printf("ok offline marshaling\n");
 }
 
+
+// pbwire codec round trips (offline): writer output parses back through the
+// reader, matching the Python _wire.py semantics for the same field layouts.
+void TestPbWire() {
+  std::string msg;
+  pb::Writer w(&msg);
+  w.String(1, "abc");
+  w.Int64(2, -5);
+  w.Uint64(3, 1ull << 40);
+  w.Bool(4, true);
+  w.PackedInt64(5, {1, 16, -2});
+  w.Bytes(6, "\x00\x01", 2);
+  pb::Reader r(msg.data(), msg.size());
+  uint32_t field, wt;
+  std::string s;
+  int64_t i2 = 0;
+  uint64_t u3 = 0;
+  bool b4 = false;
+  std::vector<int64_t> packed;
+  std::string bytes;
+  while (r.Next(&field, &wt)) {
+    switch (field) {
+      case 1: s = r.StringVal(); break;
+      case 2: i2 = r.SignedVarint(); break;
+      case 3: u3 = r.Varint(); break;
+      case 4: b4 = r.BoolVal(); break;
+      case 5: r.RepeatedInt64(wt, &packed); break;
+      case 6: bytes = r.StringVal(); break;
+      default: r.Skip(wt);
+    }
+  }
+  CHECK(r.ok());
+  CHECK(s == "abc");
+  CHECK(i2 == -5);
+  CHECK(u3 == (1ull << 40));
+  CHECK(b4);
+  CHECK(packed.size() == 3 && packed[0] == 1 && packed[1] == 16 && packed[2] == -2);
+  CHECK(bytes.size() == 2 && bytes[0] == 0 && bytes[1] == 1);
+  // gRPC message framing
+  std::string framed;
+  pb::FrameMessage(msg, &framed);
+  CHECK(framed.size() == msg.size() + 5);
+  size_t pos = 0;
+  const uint8_t* payload;
+  size_t n;
+  bool compressed;
+  CHECK(pb::UnframeMessage(framed, &pos, &payload, &n, &compressed));
+  CHECK(!compressed && n == msg.size());
+  CHECK(memcmp(payload, msg.data(), n) == 0);
+  printf("pbwire ok\n");
+}
+
+// Full GRPC client flow over the hand-rolled h2 transport against a live
+// GrpcInferenceServer (reference cc_client_test.cc's GRPC instantiation).
+void TestGrpcOnline(const std::string& url) {
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  CHECK_OK(InferenceServerGrpcClient::Create(&client, url));
+
+  bool flag = false;
+  CHECK_OK(client->IsServerLive(&flag));
+  CHECK(flag);
+  CHECK_OK(client->IsServerReady(&flag));
+  CHECK(flag);
+  CHECK_OK(client->IsModelReady(&flag, "simple"));
+  CHECK(flag);
+
+  Json metadata;
+  CHECK_OK(client->ServerMetadata(&metadata));
+  CHECK(!metadata.At("name").AsString().empty());
+
+  Json model_md;
+  CHECK_OK(client->ModelMetadata(&model_md, "simple"));
+  CHECK(model_md.At("name").AsString() == "simple");
+  CHECK(model_md.At("inputs").size() == 2);
+  CHECK(model_md.At("inputs")[0].At("datatype").AsString() == "INT32");
+
+  Json config;
+  CHECK_OK(client->ModelConfig(&config, "simple"));
+  CHECK(config.At("config").At("name").AsString() == "simple");
+  CHECK(config.At("config").At("backend").AsString() == "jax");
+
+  Json index;
+  CHECK_OK(client->ModelRepositoryIndex(&index));
+  CHECK(index.size() > 0);
+
+  // sync infer: simple sum/diff
+  InferInput *in0, *in1;
+  CHECK_OK(InferInput::Create(&in0, "INPUT0", {1, 16}, "INT32"));
+  CHECK_OK(InferInput::Create(&in1, "INPUT1", {1, 16}, "INT32"));
+  int32_t a[16], b[16];
+  for (int i = 0; i < 16; ++i) {
+    a[i] = i;
+    b[i] = 1;
+  }
+  CHECK_OK(in0->AppendRaw(reinterpret_cast<uint8_t*>(a), sizeof(a)));
+  CHECK_OK(in1->AppendRaw(reinterpret_cast<uint8_t*>(b), sizeof(b)));
+  InferOptions options("simple");
+  options.request_id = "grpc-smoke-1";
+  InferResult* result = nullptr;
+  CHECK_OK(client->Infer(&result, options, {in0, in1}));
+  const uint8_t* buf;
+  size_t byte_size;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &byte_size));
+  CHECK(byte_size == sizeof(a));
+  const int32_t* sums = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK(sums[i] == a[i] + b[i]);
+  CHECK_OK(result->RawData("OUTPUT1", &buf, &byte_size));
+  const int32_t* diffs = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) CHECK(diffs[i] == a[i] - b[i]);
+  std::string id;
+  CHECK_OK(result->Id(&id));
+  CHECK(id == "grpc-smoke-1");
+  delete result;
+
+  // error mapping: unknown model -> typed status string
+  InferOptions bad("definitely_missing");
+  InferResult* bad_result = nullptr;
+  Error err = client->Infer(&bad_result, bad, {in0});
+  CHECK(err);
+  CHECK(err.Message().find("StatusCode.") != std::string::npos);
+  delete bad_result;
+
+  // async infer
+  std::mutex mutex;
+  std::condition_variable cv;
+  int done = 0;
+  bool async_ok = true;
+  for (int i = 0; i < 8; ++i) {
+    CHECK_OK(client->AsyncInfer(
+        [&](InferResult* r) {
+          const uint8_t* data;
+          size_t n;
+          if (r->RequestStatus() || r->RawData("OUTPUT0", &data, &n) ||
+              n != sizeof(a)) {
+            async_ok = false;
+          }
+          delete r;
+          std::lock_guard<std::mutex> lock(mutex);
+          ++done;
+          cv.notify_one();
+        },
+        options, {in0, in1}));
+  }
+  {
+    std::unique_lock<std::mutex> lock(mutex);
+    CHECK(cv.wait_for(lock, std::chrono::seconds(30), [&] { return done == 8; }));
+  }
+  CHECK(async_ok);
+
+  // statistics reflect the calls above
+  Json stats;
+  CHECK_OK(client->ModelInferenceStatistics(&stats, "simple"));
+  CHECK(stats.At("model_stats").size() > 0);
+
+  // trace settings round trip, incl. clearing with a null value
+  Json trace_update = Json::Object();
+  Json level = Json::Array();
+  level.Append(Json("TIMESTAMPS"));
+  trace_update.Set("trace_level", std::move(level));
+  Json trace_resp;
+  CHECK_OK(client->UpdateTraceSettings(&trace_resp, "", trace_update));
+  CHECK(trace_resp.At("trace_level").size() == 1);
+  Json off = Json::Object();
+  Json off_level = Json::Array();
+  off_level.Append(Json("OFF"));
+  off.Set("trace_level", std::move(off_level));
+  CHECK_OK(client->UpdateTraceSettings(&trace_resp, "", off));
+
+  // log settings
+  Json log_settings;
+  CHECK_OK(client->GetLogSettings(&log_settings));
+  Json log_update = Json::Object();
+  log_update.Set("log_verbose_level", Json(static_cast<int64_t>(2)));
+  CHECK_OK(client->UpdateLogSettings(&log_settings, log_update));
+
+  // system shm negotiation (register/status/infer-from-region/unregister)
+  const char* shm_key = "/ct_grpc_smoke";
+  const size_t shm_size = sizeof(a);
+  void* shm_base = nullptr;
+  int shm_fd = -1;
+  CHECK_OK(CreateSharedMemoryRegion(shm_key, shm_size, &shm_fd));
+  CHECK_OK(MapSharedMemory(shm_fd, 0, shm_size, &shm_base));
+  memcpy(shm_base, a, sizeof(a));
+  CHECK_OK(client->RegisterSystemSharedMemory("grpc_smoke", shm_key, shm_size));
+  Json shm_status;
+  CHECK_OK(client->SystemSharedMemoryStatus(&shm_status));
+  CHECK(shm_status.Has("grpc_smoke"));
+  InferInput* shm_in;
+  CHECK_OK(InferInput::Create(&shm_in, "INPUT0", {1, 16}, "INT32"));
+  CHECK_OK(shm_in->SetSharedMemory("grpc_smoke", shm_size));
+  InferOptions id_options("custom_identity_int32");
+  InferResult* shm_result = nullptr;
+  CHECK_OK(client->Infer(&shm_result, id_options, {shm_in}));
+  CHECK_OK(shm_result->RawData("OUTPUT0", &buf, &byte_size));
+  CHECK(byte_size == sizeof(a));
+  CHECK(memcmp(buf, a, sizeof(a)) == 0);
+  delete shm_result;
+  CHECK_OK(client->UnregisterSystemSharedMemory("grpc_smoke"));
+  UnmapSharedMemory(shm_base, shm_size);
+  CloseSharedMemory(shm_fd);
+  UnlinkSharedMemoryRegion(shm_key);
+
+  // bi-di streaming: stateful sequence accumulates over the stream
+  std::mutex smutex;
+  std::condition_variable scv;
+  std::vector<int32_t> sums_seen;
+  CHECK_OK(client->StartStream([&](InferResult* r, const Error& stream_err) {
+    if (!stream_err && r != nullptr) {
+      const uint8_t* data;
+      size_t n;
+      if (!r->RawData("OUTPUT", &data, &n) && n == 4) {
+        std::lock_guard<std::mutex> lock(smutex);
+        sums_seen.push_back(*reinterpret_cast<const int32_t*>(data));
+        scv.notify_one();
+      }
+    }
+    delete r;
+  }));
+  InferInput* seq_in;
+  CHECK_OK(InferInput::Create(&seq_in, "INPUT", {1, 1}, "INT32"));
+  int32_t five = 5;
+  CHECK_OK(seq_in->AppendRaw(reinterpret_cast<uint8_t*>(&five), 4));
+  for (int i = 0; i < 3; ++i) {
+    InferOptions seq_options("simple_sequence");
+    seq_options.sequence_id = 4242;
+    seq_options.sequence_start = (i == 0);
+    seq_options.sequence_end = (i == 2);
+    CHECK_OK(client->AsyncStreamInfer(seq_options, {seq_in}));
+  }
+  {
+    std::unique_lock<std::mutex> lock(smutex);
+    CHECK(scv.wait_for(
+        lock, std::chrono::seconds(30), [&] { return sums_seen.size() == 3; }));
+  }
+  CHECK_OK(client->StopStream());
+  CHECK(sums_seen[0] == 5 && sums_seen[1] == 10 && sums_seen[2] == 15);
+
+  // client-side stats accumulated
+  InferStat stat = client->ClientInferStat();
+  CHECK(stat.completed_request_count >= 10);
+
+  delete in0;
+  delete in1;
+  delete shm_in;
+  delete seq_in;
+  printf("grpc online ok (%llu requests)\n",
+         static_cast<unsigned long long>(stat.completed_request_count));
+}
+
 int main() {
   TestJson();
   TestBase64();
@@ -374,11 +625,18 @@ int main() {
   TestShm();
   TestTpuShm();
   TestOfflineMarshaling();
+  TestPbWire();
   const char* url = getenv("CLIENT_TPU_TEST_URL");
   if (url != nullptr && url[0] != '\0') {
     TestOnline(url);
   } else {
     printf("skip online tests (CLIENT_TPU_TEST_URL unset)\n");
+  }
+  const char* grpc_url = getenv("CLIENT_TPU_TEST_GRPC_URL");
+  if (grpc_url != nullptr && grpc_url[0] != '\0') {
+    TestGrpcOnline(grpc_url);
+  } else {
+    printf("skip grpc online tests (CLIENT_TPU_TEST_GRPC_URL unset)\n");
   }
   printf("PASS\n");
   return 0;

@@ -179,3 +179,91 @@ def test_perf_runner_native_protocol(server):
         assert result["errors"] == 0, result["error_sample"]
         assert result["requests"] >= 25
         assert result["infer_per_sec"] > 0
+
+
+# ---------------------------------------------------------------------------
+# GRPC native client (hand-framed gRPC over the library's own h2 transport;
+# reference grpc_client.h:100 / VERDICT r1 item 2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def grpc_server():
+    from client_tpu.models import default_model_zoo
+    from client_tpu.server import GrpcInferenceServer, ServerCore
+
+    with GrpcInferenceServer(ServerCore(default_model_zoo())) as s:
+        yield s
+
+
+def test_native_smoke_grpc_online(grpc_server):
+    proc = subprocess.run(
+        [str(SMOKE)], capture_output=True, text=True, timeout=120,
+        env={
+            **os.environ,
+            "CLIENT_TPU_TEST_URL": "",
+            "CLIENT_TPU_TEST_GRPC_URL": grpc_server.url,
+        },
+    )
+    assert proc.returncode == 0, f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+    assert "grpc online ok" in proc.stdout
+
+
+def test_ctypes_grpc_client(grpc_server):
+    """The ctypes NativeGrpcClient speaks real gRPC to the grpcio server."""
+    from client_tpu.native import NativeGrpcClient
+
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    with NativeGrpcClient(grpc_server.url) as client:
+        assert client.is_server_live()
+        assert client.is_model_ready("simple")
+        assert not client.is_model_ready("missing")
+        out = client.infer(
+            "simple", [("INPUT0", a), ("INPUT1", b)],
+            outputs=["OUTPUT0", "OUTPUT1"], request_id="grpc-capi-1",
+        )
+        np.testing.assert_array_equal(out["OUTPUT0"], a + b)
+        np.testing.assert_array_equal(out["OUTPUT1"], a - b)
+        # output enumeration without explicit outputs
+        out = client.infer("simple", [("INPUT0", a), ("INPUT1", b)])
+        assert set(out) == {"OUTPUT0", "OUTPUT1"}
+        # sequences through gRPC unary with options
+        for i, (start, end) in enumerate([(True, False), (False, True)]):
+            seq_out = client.infer(
+                "simple_sequence",
+                [("INPUT", np.array([[6]], dtype=np.int32))],
+                sequence=(888, start, end),
+            )
+        assert seq_out["OUTPUT"][0, 0] == 12
+        # typed error propagation with true grpc status
+        from client_tpu.utils import InferenceServerException
+
+        with pytest.raises(InferenceServerException, match="StatusCode"):
+            client.infer("missing", [("INPUT0", a)])
+
+
+def test_ctypes_grpc_shm_flow(grpc_server):
+    """tpu-shm registration + shm-placed IO through the native grpc client."""
+    import client_tpu.utils.tpu_shared_memory as tpushm
+    from client_tpu.native import NativeGrpcClient
+
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    with NativeGrpcClient(grpc_server.url) as client:
+        region = tpushm.create_shared_memory_region("grpc_capi", 128)
+        try:
+            client.register_tpu_shared_memory(
+                "grpc_capi", tpushm.get_raw_handle(region), 0, 128
+            )
+            out = client.infer(
+                "simple", [("INPUT0", a), ("INPUT1", b)],
+                outputs=[("OUTPUT0", ("shm", "grpc_capi", 64, 0))],
+            )
+            assert out == {}
+            np.testing.assert_array_equal(
+                tpushm.get_contents_as_numpy(region, "INT32", [1, 16]), a + b
+            )
+            client.unregister_shared_memory("tpu", "grpc_capi")
+        finally:
+            tpushm.destroy_shared_memory_region(region)
