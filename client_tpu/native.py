@@ -417,7 +417,13 @@ class NativeGrpcClient(NativeClient):
         bytes reinterpreted as ``output_dtype`` (default: the input dtype),
         bounded by ``output_capacity`` when given.
         """
-        result = self.infer(model_name, [(input_name, tensor)])
+        result = self.infer(
+            model_name, [(input_name, tensor)], outputs=[output_name]
+        )
+        if output_name not in result:
+            raise InferenceServerException(
+                f"output '{output_name}' missing from response"
+            )
         raw = np.ascontiguousarray(result[output_name]).tobytes()
         if output_capacity is not None and len(raw) > output_capacity:
             raise InferenceServerException("output buffer too small")

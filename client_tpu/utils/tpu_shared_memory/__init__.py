@@ -311,7 +311,7 @@ def set_shared_memory_region(
 
 
 def set_shared_memory_region_from_jax(
-    shm_handle: TpuSharedMemoryRegion, array, offset: int = 0
+    shm_handle: TpuSharedMemoryRegion, array, offset: int = 0, timers=None
 ) -> int:
     """Bind a jax.Array into the region at ``offset``; returns the end offset.
 
@@ -319,12 +319,20 @@ def set_shared_memory_region_from_jax(
     get it back with zero copies). Unless the region is colocated, the bytes
     are also mirrored into the host window for cross-process consumers —
     one D2H DMA, the same hop cudashm pays in ``cudaMemcpyAsync``.
+
+    ``timers``: optional :class:`client_tpu._base.RequestTimers`; when the
+    host mirror actually runs, its D2H_START/D2H_END points are captured
+    (direction semantics: device HBM -> host window).
     """
     nbytes = array.dtype.itemsize * array.size
     shm_handle._check(nbytes, offset, "write")
     shm_handle._cache_device_entry(offset, array, nbytes)
     if not shm_handle.colocated or not shm_handle._cache_enabled:
+        if timers is not None:
+            timers.capture("D2H_START")
         shm_handle._host_buf()[offset : offset + nbytes] = _as_u8(np.asarray(array))[:nbytes]
+        if timers is not None:
+            timers.capture("D2H_END")
     return offset + nbytes
 
 
@@ -364,13 +372,14 @@ def get_contents_as_numpy(
 
 
 def get_contents_as_jax(
-    shm_handle: TpuSharedMemoryRegion, datatype, shape, offset: int = 0
+    shm_handle: TpuSharedMemoryRegion, datatype, shape, offset: int = 0, timers=None
 ):
     """Device view of the region contents.
 
     Cache hit (the producer was a jax.Array in this process): returns the
     pinned device array — zero copies. Otherwise: one async H2D
-    ``device_put`` from the host window.
+    ``device_put`` from the host window; with ``timers`` given, its
+    H2D_START/H2D_END points bracket that transfer (to completion).
     """
     import jax
 
@@ -387,7 +396,13 @@ def get_contents_as_jax(
     host = np.frombuffer(
         shm_handle.read_host(nbytes, offset), dtype=np_dtype, count=n_elems
     ).reshape(shape)
-    return jax.device_put(host, shm_handle.device())
+    if timers is None:
+        return jax.device_put(host, shm_handle.device())
+    timers.capture("H2D_START")
+    out = jax.device_put(host, shm_handle.device())
+    out.block_until_ready()
+    timers.capture("H2D_END")
+    return out
 
 
 def as_shared_memory_tensor(

@@ -125,7 +125,9 @@ class Reader {
   // length-delimited payload; returns a view into the buffer (no copy)
   bool LengthDelimited(const uint8_t** data, size_t* size) {
     uint64_t len = Varint();
-    if (!ok_ || p_ + len > end_) {
+    // compare against remaining bytes — `p_ + len` can wrap for hostile
+    // varint lengths and slip past the check
+    if (!ok_ || len > static_cast<uint64_t>(end_ - p_)) {
       ok_ = false;
       return false;
     }

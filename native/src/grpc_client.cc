@@ -882,7 +882,12 @@ InferenceServerGrpcClient::InferenceServerGrpcClient(
 
 InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   StopStream();
-  exiting_ = true;
+  {
+    // under the mutex: otherwise the notify can fire between the worker's
+    // predicate check and its wait, and join() blocks forever
+    std::lock_guard<std::mutex> lock(queue_mutex_);
+    exiting_ = true;
+  }
   queue_cv_.notify_all();
   if (worker_.joinable()) worker_.join();
 }
@@ -1648,11 +1653,16 @@ void InferenceServerGrpcClient::StreamReader() {
   bool closed = false;
   size_t pos = 0;
   while (ctx->active) {
+    // with no user stream timeout, poll on a short deadline so StopStream's
+    // active=false is noticed even if the server never half-closes
+    const bool polling = ctx->timeout_us == 0;
     Error err = ctx->conn->StreamRecv(
         ctx->stream_id, &buffer, &response_headers, &closed,
-        ctx->timeout_us == 0 ? 0
-                             : static_cast<int64_t>(ctx->timeout_us / 1000));
+        polling ? 500 : static_cast<int64_t>(ctx->timeout_us / 1000));
     if (err) {
+      if (polling && err.Message() == "Deadline Exceeded") {
+        continue;  // re-check ctx->active
+      }
       if (ctx->active) {
         ctx->active = false;
         ctx->callback(
@@ -1746,13 +1756,18 @@ Error InferenceServerGrpcClient::StopStream() {
   }
   if (ctx == nullptr) return Error::Success();
   // half-close the send side; the server then ends the response stream and
-  // the reader exits on END_STREAM
+  // the reader exits on END_STREAM. A wedged server cannot hang us: the
+  // reader polls on a 500 ms deadline and re-checks active, which flips
+  // below before the join.
   if (ctx->conn->Alive()) {
     std::lock_guard<std::mutex> send_lock(ctx->send_mutex);
     ctx->conn->StreamSend(ctx->stream_id, nullptr, 0, /*end_stream=*/true);
   }
-  if (ctx->reader.joinable()) ctx->reader.join();
   ctx->active = false;
+  if (ctx->reader.joinable()) ctx->reader.join();
+  if (ctx->conn->Alive()) {
+    ctx->conn->StreamReset(ctx->stream_id);  // no-op if already closed
+  }
   return Error::Success();
 }
 

@@ -187,7 +187,9 @@ Error HpackDecoder::DecodeString(
   uint64_t length;
   Error err = DecodeInt(p, end, 7, &length);
   if (err) return err;
-  if (*p + length > end) return Error("hpack: string overruns block");
+  if (length > static_cast<uint64_t>(end - *p)) {
+    return Error("hpack: string overruns block");
+  }
   if (huffman) {
     err = HuffmanDecode(*p, length, out);
     if (err) return err;
@@ -303,10 +305,22 @@ Error Connection::Connect(
     int64_t timeout_ms) {
   std::string host = host_port;
   std::string port = "80";
-  size_t colon = host_port.rfind(':');
-  if (colon != std::string::npos) {
-    host = host_port.substr(0, colon);
-    port = host_port.substr(colon + 1);
+  size_t bracket = host_port.rfind("]:");
+  if (bracket != std::string::npos && host_port.front() == '[') {
+    // [v6-literal]:port
+    host = host_port.substr(1, bracket - 1);
+    port = host_port.substr(bracket + 2);
+  } else {
+    size_t colon = host_port.rfind(':');
+    if (colon != std::string::npos &&
+        host_port.find(':') == colon) {  // exactly one ':' => host:port
+      host = host_port.substr(0, colon);
+      port = host_port.substr(colon + 1);
+    } else if (host_port.front() == '[' && host_port.back() == ']') {
+      host = host_port.substr(1, host_port.size() - 2);
+    }
+    // multiple ':' without brackets: treat the whole string as a bare v6
+    // host on the default port
   }
   struct addrinfo hints = {};
   hints.ai_family = AF_UNSPEC;
@@ -579,8 +593,10 @@ Error Connection::RecvFrameLocked(int64_t timeout_ms) {
                            (static_cast<uint32_t>(payload[off + 3]) << 16) |
                            (static_cast<uint32_t>(payload[off + 4]) << 8) |
                            payload[off + 5];
-          if (id == 0x1) {  // HEADER_TABLE_SIZE
-            hpack_.SetMaxTableSize(value);
+          if (id == 0x1) {
+            // HEADER_TABLE_SIZE governs what the PEER's decoder accepts,
+            // i.e. our (stateless) encoder — not our decoder, whose limit
+            // is what WE advertise (we never send the setting: 4096).
           } else if (id == 0x4) {  // INITIAL_WINDOW_SIZE
             std::lock_guard<std::mutex> lock(state_mutex_);
             int64_t delta = static_cast<int64_t>(value) - peer_initial_window_;

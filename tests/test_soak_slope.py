@@ -13,6 +13,7 @@ import gc
 import json
 import os
 import subprocess
+import sys
 import threading
 import time
 from pathlib import Path
@@ -24,8 +25,6 @@ import client_tpu.grpc as grpcclient
 import client_tpu.http as httpclient
 import client_tpu.utils.shared_memory as sysshm
 import client_tpu.utils.tpu_shared_memory as tpushm
-from client_tpu.models import default_model_zoo
-from client_tpu.server import GrpcInferenceServer, HttpInferenceServer, ServerCore
 
 pytestmark = pytest.mark.soak
 
@@ -49,8 +48,14 @@ def _rss_kb(pid: int = 0) -> int:
 
 
 def _slope_kb_per_min(samples):
-    """Least-squares slope over the steady-state second half."""
-    half = samples[len(samples) // 2 :]
+    """Least-squares slope over the steady-state final third.
+
+    Transport warmup is real but finite (grpc stream flow-control buffers
+    plateau after ~1 min: 59.8->63.3 MB then dead flat through 210k
+    inferences in the 2026-07 trace); the final-third window keeps short
+    smoke runs from reading that ramp as a leak while a true leak still
+    shows a positive slope at any duration."""
+    half = samples[2 * len(samples) // 3 :]
     t = np.array([s[0] for s in half])
     r = np.array([s[1] for s in half], dtype=np.float64)
     if len(half) < 3 or t[-1] - t[0] < 1.0:
@@ -89,11 +94,55 @@ def _soak(name: str, step, pid: int = 0):
     )
 
 
+_SERVER_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+from client_tpu.models import default_model_zoo
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer, ServerCore
+import time
+core = ServerCore(default_model_zoo())
+h = HttpInferenceServer(core).start()
+g = GrpcInferenceServer(core).start()
+print("PORTS", h.port, g.port, flush=True)
+time.sleep(86400)
+"""
+
+
+class _Endpoints:
+    def __init__(self, http_port, grpc_port):
+        self.http_url = f"127.0.0.1:{http_port}"
+        self.grpc_url = f"127.0.0.1:{grpc_port}"
+
+
 @pytest.fixture(scope="module")
 def servers():
-    core = ServerCore(default_model_zoo())
-    with HttpInferenceServer(core) as h, GrpcInferenceServer(core) as g:
-        yield h, g
+    """Servers live in their own process: RSS sampled here is the CLIENT's.
+
+    (Sharing the process conflated server-side arena growth with client
+    leaks — the 2026-07 diagnosis showed a perfectly flat client at 174k
+    inferences once the server moved out.)"""
+    env = dict(os.environ)
+    # the leak hunt needs a server, not an accelerator: strip the axon
+    # sitecustomize (a wedged TPU tunnel hangs any jax init it touches) and
+    # pin the cpu backend unless the caller overrides
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = os.environ.get("CLIENT_TPU_SOAK_SERVER_PLATFORM", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT.format(repo=str(REPO))],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        import select
+
+        ready, _, _ = select.select([proc.stdout], [], [], 120)
+        assert ready, "soak server subprocess did not start within 120s"
+        line = proc.stdout.readline().strip()
+        assert line.startswith("PORTS"), line
+        _, http_port, grpc_port = line.split()
+        yield _Endpoints(int(http_port), int(grpc_port))
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -118,8 +167,7 @@ _PAYLOAD = np.random.default_rng(7).integers(0, 1000, (1, 65536)).astype(np.int3
 
 
 def test_soak_http_sync_wire(servers):
-    http_server, _ = servers
-    with httpclient.InferenceServerClient(http_server.url) as client:
+    with httpclient.InferenceServerClient(servers.http_url) as client:
         def step():
             inp = httpclient.InferInput("INPUT0", [1, 65536], "INT32")
             inp.set_data_from_numpy(_PAYLOAD)
@@ -129,8 +177,7 @@ def test_soak_http_sync_wire(servers):
 
 
 def test_soak_http_async_pool(servers):
-    http_server, _ = servers
-    with httpclient.InferenceServerClient(http_server.url, concurrency=4) as client:
+    with httpclient.InferenceServerClient(servers.http_url, concurrency=4) as client:
         def step():
             reqs = []
             for _ in range(4):
@@ -143,8 +190,7 @@ def test_soak_http_async_pool(servers):
 
 
 def test_soak_grpc_sync_wire(servers):
-    _, grpc_server = servers
-    with grpcclient.InferenceServerClient(grpc_server.url) as client:
+    with grpcclient.InferenceServerClient(servers.grpc_url) as client:
         def step():
             inp = grpcclient.InferInput("INPUT0", [1, 65536], "INT32")
             inp.set_data_from_numpy(_PAYLOAD)
@@ -154,8 +200,7 @@ def test_soak_grpc_sync_wire(servers):
 
 
 def test_soak_grpc_stream(servers):
-    _, grpc_server = servers
-    with grpcclient.InferenceServerClient(grpc_server.url) as client:
+    with grpcclient.InferenceServerClient(servers.grpc_url) as client:
         got = threading.Semaphore(0)
         errors = []
 
@@ -180,9 +225,8 @@ def test_soak_grpc_stream(servers):
 
 
 def test_soak_system_shm(servers):
-    http_server, _ = servers
     nbytes = _PAYLOAD.nbytes
-    with httpclient.InferenceServerClient(http_server.url) as client:
+    with httpclient.InferenceServerClient(servers.http_url) as client:
         region = sysshm.create_shared_memory_region("soak_sys", "/soak_sys", nbytes)
         client.register_system_shared_memory("soak_sys", "/soak_sys", nbytes)
         try:
@@ -205,10 +249,9 @@ def test_soak_tpu_shm_churn(servers):
     the attachment-leak hunter, at soak duration."""
     import jax.numpy as jnp
 
-    http_server, _ = servers
     data = jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
     b = np.ones((1, 16), dtype=np.int32)
-    with httpclient.InferenceServerClient(http_server.url) as client:
+    with httpclient.InferenceServerClient(servers.http_url) as client:
         def step():
             region = tpushm.create_shared_memory_region("soak_tpu", 128)
             try:
@@ -234,10 +277,9 @@ NATIVE_BENCH = REPO / "native" / "build" / "native_bench"
 def test_soak_native_client(servers):
     """The C++ client under sustained load, RSS sampled from outside
     (reference memory_leak_test.cc's role for the native library)."""
-    http_server, _ = servers
     proc = subprocess.Popen(
         [str(NATIVE_BENCH), str(1 << 16), str(10_000_000)],
-        env={**os.environ, "CLIENT_TPU_TEST_URL": http_server.url},
+        env={**os.environ, "CLIENT_TPU_TEST_URL": servers.http_url},
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
     try:
