@@ -214,6 +214,7 @@ def default_model_zoo() -> List[Model]:
         IdentityModel("identity_fp32", "FP32"),
         IdentityModel("identity_bf16", "BF16"),
         IdentityModel("identity_fp16", "FP16"),
+        IdentityModel("identity_int8", "INT8"),
         SequenceAccumulatorModel(),
         RepeatModel(),
     ]

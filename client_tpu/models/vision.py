@@ -87,18 +87,14 @@ class ImagePreprocessModel(Model):
         return [TensorSpec("preprocessed", "FP32", [3, 224, 224])]
 
     def execute(self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]):
-        from ..ops import normalize_image
+        from ..ops import preprocess_image
 
-        img = np.asarray(inputs["raw_image"]).astype(np.float32)
-        h, w = img.shape[0], img.shape[1]
-        if (h, w) != (224, 224):
-            ys = np.linspace(0, h - 1, 224).astype(int)
-            xs = np.linspace(0, w - 1, 224).astype(int)
-            img = img[ys][:, xs]
-        arr = np.asarray(
-            normalize_image(img, scale=2.0 / 255.0, shift=-1.0, out_dtype=np.float32)
+        # resize + INCEPTION normalize + CHW layout: one compiled program
+        arr = preprocess_image(
+            np.asarray(inputs["raw_image"]), 224, 224,
+            scale=2.0 / 255.0, shift=-1.0,
         )
-        return {"preprocessed": np.ascontiguousarray(np.transpose(arr, (2, 0, 1)))}
+        return {"preprocessed": np.ascontiguousarray(arr)}
 
 
 class DenseNetModel(Model):

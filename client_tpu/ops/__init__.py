@@ -63,3 +63,125 @@ def from_bf16(x):
 def stage_to_device(host_array, device=None):
     """Async host->HBM staging (returns immediately; fence at use)."""
     return jax.device_put(host_array, device)
+
+
+# ---------------------------------------------------------------------------
+# image preprocessing (resize + normalize fused under one jit)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("out_h", "out_w"))
+def resize_nearest(img, out_h: int = 224, out_w: int = 224):
+    """Nearest-neighbor resize of an HWC image via XLA gathers.
+
+    The device-side twin of image_client's PIL resize (reference
+    image_client.py preprocess :154): two index gathers XLA fuses with
+    whatever follows.
+    """
+    h, w = img.shape[0], img.shape[1]
+    ys = jnp.clip(
+        (jnp.arange(out_h) * (h / out_h) + 0.5).astype(jnp.int32), 0, h - 1
+    )
+    xs = jnp.clip(
+        (jnp.arange(out_w) * (w / out_w) + 0.5).astype(jnp.int32), 0, w - 1
+    )
+    return img[ys][:, xs]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("out_h", "out_w", "scale", "shift", "out_dtype")
+)
+def preprocess_image(
+    img, out_h: int = 224, out_w: int = 224, scale: float = 2.0 / 255.0,
+    shift: float = -1.0, out_dtype=jnp.float32,
+):
+    """resize -> normalize -> HWC->CHW, one compiled program.
+
+    The whole ensemble front stage (ImagePreprocessModel) as a single XLA
+    computation: gathers fuse into the normalize elementwise, and the
+    transpose is a layout assignment rather than a copy.
+    """
+    x = resize_nearest(img.astype(jnp.float32), out_h, out_w)
+    x = x * scale + shift
+    return jnp.transpose(x, (2, 0, 1)).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# classification postprocess
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_classification(logits, k: int):
+    """(values, indices) of the top-k logits along the last axis.
+
+    ``jax.lax.top_k`` lowers to the TPU's sort unit; the server's
+    classification extension ranks with this instead of a host argsort.
+    """
+    return jax.lax.top_k(logits, k)
+
+
+def _softmax_kernel(x_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+@jax.jit
+def softmax_probabilities(logits):
+    """Numerically-stable softmax over the last axis as a Pallas VPU kernel
+    (max-subtract, exp, normalize fused in one pass over VMEM)."""
+    from jax.experimental import pallas as pl
+
+    shaped = logits if logits.ndim > 1 else logits[None, :]
+    out = pl.pallas_call(
+        _softmax_kernel,
+        out_shape=jax.ShapeDtypeStruct(shaped.shape, jnp.float32),
+        interpret=not _on_tpu(),
+    )(shaped)
+    return out if logits.ndim > 1 else out[0]
+
+
+# ---------------------------------------------------------------------------
+# int8 wire quantization (bandwidth-limited transports)
+# ---------------------------------------------------------------------------
+
+
+def _quantize_kernel(x_ref, o_ref, *, inv_scale):
+    x = x_ref[...].astype(jnp.float32) * inv_scale
+    o_ref[...] = jnp.clip(jnp.round(x), -127.0, 127.0).astype(jnp.int8)
+
+
+def _dequantize_kernel(q_ref, o_ref, *, scale):
+    o_ref[...] = (q_ref[...].astype(jnp.float32) * scale).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def quantize_int8(x, scale: float):
+    """Symmetric int8 quantization ``round(x/scale)`` clipped to [-127,127].
+
+    Shrinks wire tensors 4x for bandwidth-limited hops; pair with
+    ``dequantize_int8`` on the receiving side. Pallas VPU kernel on TPU.
+    """
+    from jax.experimental import pallas as pl
+
+    kernel = functools.partial(_quantize_kernel, inv_scale=1.0 / scale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.int8),
+        interpret=not _on_tpu(),
+    )(x)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "out_dtype"))
+def dequantize_int8(q, scale: float, out_dtype=jnp.float32):
+    """Inverse of :func:`quantize_int8`."""
+    from jax.experimental import pallas as pl
+
+    kernel = functools.partial(_dequantize_kernel, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, out_dtype),
+        interpret=not _on_tpu(),
+    )(q)

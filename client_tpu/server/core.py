@@ -607,7 +607,7 @@ class ServerCore:
             class_count = spec.get("classification", 0)
             if class_count:
                 arr = _classification(
-                    np.asarray(arr), class_count, model.labels(),
+                    arr, class_count, model.labels(),
                     batched=model.effective_max_batch_size() > 0,
                 )
                 datatype = "BYTES"
@@ -663,7 +663,7 @@ def _array_to_bytes(arr: np.ndarray, datatype: str) -> bytes:
 
 
 def _classification(
-    arr: np.ndarray, k: int, labels: Optional[List[str]], batched: bool = False
+    arr, k: int, labels: Optional[List[str]], batched: bool = False
 ) -> np.ndarray:
     """classification extension: top-k "value:index[:label]" strings.
 
@@ -671,18 +671,35 @@ def _classification(
     element's (flattened) remainder is its class vector; for non-batched
     models the whole (flattened) tensor is one class vector — e.g. densenet's
     [1000,1,1] output.
+
+    When the model returned a device-resident jax.Array (the XLA model zoo
+    does), ranking runs on-device via ``ops.topk_classification`` and only
+    the k winners cross to the host — instead of pulling the whole class
+    vector back for a host argsort. Device dtypes are <=32-bit under the
+    default jax config, so no precision caveat applies on that path; ties
+    break lowest-index-first there (a stable descending sort), while the
+    host path keeps its historical highest-index-first order.
     """
+    on_device = type(arr).__module__.startswith(("jax", "jaxlib"))
     if batched and arr.ndim >= 1:
         flat_batch = arr.reshape((arr.shape[0], -1))
     else:
         flat_batch = arr.reshape((1, -1))
     k = min(k, flat_batch.shape[-1])
+    if on_device:
+        from ..ops import topk_classification
+
+        values, indices = topk_classification(flat_batch, k)
+        values, indices = np.asarray(values), np.asarray(indices)
+    else:
+        flat_batch = np.asarray(flat_batch)
+        indices = np.argsort(flat_batch, axis=-1)[:, ::-1][:, :k]
+        values = np.take_along_axis(flat_batch, indices, axis=-1)
     rows = []
-    for row in flat_batch:
-        idx = np.argsort(row)[::-1][:k]
+    for row_values, row_indices in zip(values, indices):
         entries = []
-        for i in idx:
-            s = f"{row[i]:f}:{i}"
+        for value, i in zip(row_values, row_indices):
+            s = f"{value:f}:{i}"
             if labels and i < len(labels):
                 s += f":{labels[i]}"
             entries.append(s.encode("utf-8"))

@@ -1,0 +1,59 @@
+// HPACK decoder cross-validation tool.
+// stdin: lines of hex-encoded HPACK header blocks (one connection's ordered
+// sequence — the dynamic table persists across lines, as across HEADERS
+// frames). stdout: per block, "name\tvalue" lines then a blank line; on
+// decode error, "ERROR <msg>". Driven by tests/test_native.py against the
+// reference `hpack` PyPI encoder output.
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "client_tpu/h2.h"
+
+using client_tpu::Error;
+using client_tpu::h2::HeaderList;
+using client_tpu::h2::HpackDecoder;
+
+static bool HexDecode(const std::string& hex, std::string* out) {
+  if (hex.size() % 2 != 0) return false;
+  out->clear();
+  for (size_t i = 0; i < hex.size(); i += 2) {
+    char buf[3] = {hex[i], hex[i + 1], 0};
+    char* end = nullptr;
+    long v = strtol(buf, &end, 16);
+    if (end != buf + 2) return false;
+    out->push_back(static_cast<char>(v));
+  }
+  return true;
+}
+
+int main() {
+  HpackDecoder decoder;
+  std::string hex;
+  while (std::getline(std::cin, hex)) {
+    while (!hex.empty() && (hex.back() == '\n' || hex.back() == '\r')) {
+      hex.pop_back();
+    }
+    if (hex.empty()) continue;
+    std::string block;
+    if (!HexDecode(hex, &block)) {
+      printf("ERROR bad hex input\n\n");
+      continue;
+    }
+    HeaderList headers;
+    Error err = decoder.Decode(
+        reinterpret_cast<const uint8_t*>(block.data()), block.size(), &headers);
+    if (err) {
+      printf("ERROR %s\n\n", err.Message().c_str());
+      continue;
+    }
+    for (const auto& kv : headers) {
+      printf("%s\t%s\n", kv.first.c_str(), kv.second.c_str());
+    }
+    printf("\n");
+    fflush(stdout);
+  }
+  return 0;
+}

@@ -49,6 +49,7 @@ HTTP_EXAMPLES = [
     "simple_http_shm_client.py",
     "simple_http_tpushm_client.py",
     "ensemble_image_client.py",
+    "quantized_wire_client.py",
 ]
 
 GRPC_EXAMPLES = [

@@ -16,7 +16,8 @@ LIB = BUILD / "libclient_tpu_http.so"
 
 
 def _ensure_built():
-    if SMOKE.exists() and LIB.exists():
+    # hpack_tool is the newest target: its presence implies a fresh build
+    if SMOKE.exists() and LIB.exists() and (BUILD / "hpack_tool").exists():
         return True
     try:
         subprocess.run(
@@ -267,3 +268,84 @@ def test_ctypes_grpc_shm_flow(grpc_server):
             client.unregister_shared_memory("tpu", "grpc_capi")
         finally:
             tpushm.destroy_shared_memory_region(region)
+
+
+# ---------------------------------------------------------------------------
+# HPACK decoder cross-validation vs the reference `hpack` PyPI encoder
+# ---------------------------------------------------------------------------
+
+HPACK_TOOL = BUILD / "hpack_tool"
+_HPACK_PKG = "/mnt/sandboxing/model_tools_env/v1/python/install/lib/python3.11/site-packages"
+
+
+def _load_hpack_encoder():
+    import importlib
+    import sys as _sys
+
+    try:  # pip-installed hpack, any machine
+        return importlib.import_module("hpack").Encoder()
+    except ImportError:
+        pass
+    if not os.path.isdir(_HPACK_PKG):
+        pytest.skip("reference hpack package unavailable")
+    _sys.path.insert(0, _HPACK_PKG)
+    try:
+        return importlib.import_module("hpack").Encoder()
+    finally:
+        _sys.path.remove(_HPACK_PKG)
+
+
+@pytest.mark.skipif(not SMOKE.exists(), reason="native toolchain unavailable")
+def test_hpack_decoder_against_reference_encoder():
+    """Random header sequences encoded by the reference HPACK encoder
+    (dynamic table + huffman + indexed fields across blocks) must decode
+    byte-exactly in the native decoder — the headers/trailers path of the
+    hand-rolled h2 transport."""
+    import random
+    import string
+
+    encoder = _load_hpack_encoder()
+    assert HPACK_TOOL.exists()
+
+    rng = random.Random(42)
+    blocks = []
+    expected = []
+    common = [
+        (":status", "200"),
+        ("content-type", "application/grpc"),
+        ("grpc-status", "0"),
+        ("grpc-message", ""),
+        ("grpc-encoding", "identity"),
+    ]
+    for block_index in range(50):
+        headers = []
+        # repeated common headers exercise indexed + dynamic-table hits
+        for kv in common:
+            if rng.random() < 0.7:
+                headers.append(kv)
+        for _ in range(rng.randrange(0, 6)):
+            name = "".join(rng.choices(string.ascii_lowercase + "-", k=rng.randrange(1, 20))).strip("-") or "x"
+            # values include bytes that stress huffman coding
+            value = "".join(
+                rng.choices(string.ascii_letters + string.digits + " %/.=+-_:;", k=rng.randrange(0, 40))
+            )
+            headers.append((name.lower(), value))
+        if not headers:
+            headers = [(":status", "204")]
+        blocks.append(encoder.encode(headers).hex())
+        expected.append(headers)
+
+    proc = subprocess.run(
+        [str(HPACK_TOOL)], input="\n".join(blocks) + "\n",
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    decoded_blocks = proc.stdout.split("\n\n")
+    decoded_blocks = [b for b in decoded_blocks if b.strip() != ""]
+    assert len(decoded_blocks) == len(expected), (
+        len(decoded_blocks), len(expected), proc.stdout[:500],
+    )
+    for got, want in zip(decoded_blocks, expected):
+        assert not got.startswith("ERROR"), got
+        pairs = [tuple(line.split("\t", 1)) for line in got.splitlines()]
+        assert pairs == [(n, v) for n, v in want], (pairs, want)

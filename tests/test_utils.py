@@ -98,3 +98,77 @@ def test_exception_fields():
     assert e.status() == "400"
     assert e.debug_details() == {"x": 1}
     assert "[400] boom" == str(e)
+
+
+# ---------------------------------------------------------------------------
+# data-plane ops (client_tpu.ops): XLA/Pallas kernels vs numpy references
+# ---------------------------------------------------------------------------
+
+
+def test_ops_resize_and_preprocess():
+    import numpy as np
+
+    from client_tpu.ops import preprocess_image, resize_nearest
+
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 256, (100, 160, 3)).astype(np.uint8)
+    out = np.asarray(resize_nearest(img.astype(np.float32), 224, 224))
+    assert out.shape == (224, 224, 3)
+    # corners map to corners under nearest resize
+    assert out[0, 0, 0] == img[0, 0, 0]
+    # fused full pipeline: resize + INCEPTION scale + CHW
+    chw = np.asarray(preprocess_image(img, 224, 224, scale=2.0 / 255.0, shift=-1.0))
+    assert chw.shape == (3, 224, 224)
+    assert chw.min() >= -1.0 - 1e-5 and chw.max() <= 1.0 + 1e-5
+    np.testing.assert_allclose(
+        chw[:, 0, 0], img[0, 0].astype(np.float32) * 2 / 255 - 1, rtol=1e-6
+    )
+
+
+def test_ops_topk_matches_numpy():
+    import numpy as np
+
+    from client_tpu.ops import topk_classification
+
+    rng = np.random.default_rng(4)
+    logits = rng.standard_normal((5, 100)).astype(np.float32)
+    values, indices = topk_classification(logits, 7)
+    values, indices = np.asarray(values), np.asarray(indices)
+    ref_idx = np.argsort(-logits, axis=-1, kind="stable")[:, :7]
+    np.testing.assert_array_equal(indices, ref_idx)
+    np.testing.assert_allclose(values, np.take_along_axis(logits, ref_idx, -1))
+
+
+def test_ops_softmax_probabilities():
+    import numpy as np
+
+    from client_tpu.ops import softmax_probabilities
+
+    rng = np.random.default_rng(5)
+    logits = rng.standard_normal((3, 50)).astype(np.float32) * 30  # stress stability
+    probs = np.asarray(softmax_probabilities(logits))
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-5)
+    exp = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    # atol floor: XLA flushes denormal probabilities to zero (FTZ)
+    np.testing.assert_allclose(
+        probs, exp / exp.sum(axis=-1, keepdims=True), rtol=1e-5, atol=1e-30
+    )
+    # 1-D convenience
+    p1 = np.asarray(softmax_probabilities(logits[0]))
+    np.testing.assert_allclose(p1, probs[0], rtol=1e-6)
+
+
+def test_ops_int8_quantization_roundtrip():
+    import numpy as np
+
+    from client_tpu.ops import dequantize_int8, quantize_int8
+
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((64, 64)).astype(np.float32)
+    scale = float(np.abs(x).max() / 127.0)
+    q = np.asarray(quantize_int8(x, scale))
+    assert q.dtype == np.int8
+    assert np.abs(q).max() <= 127
+    back = np.asarray(dequantize_int8(q, scale))
+    # quantization error bounded by half a step
+    assert np.abs(back - x).max() <= scale * 0.5 + 1e-7
