@@ -28,12 +28,23 @@ class LongContextEncoderModel(Model):
     name = "long_context_encoder"
     platform = "jax_ring_attention"
 
-    def __init__(self, dim: int = 64, heads: int = 4, seed: int = 0, n_devices: int = 0):
+    def __init__(
+        self, dim: int = 64, heads: int = 4, seed: int = 0, n_devices: int = 0,
+        attention: str = "ring",
+    ):
+        """``attention``: "ring" (default — O(seq/n²) memory), "ulysses"
+        (all-to-all head repartition, fewer collective steps; heads must
+        divide the mesh), or "auto" (see parallel/ulysses.py)."""
         super().__init__()
+        if attention not in ("ring", "ulysses", "auto"):
+            raise ValueError(
+                f"attention must be ring|ulysses|auto, got {attention!r}"
+            )
         self._dim = dim
         self._heads = heads
         self._seed = seed
         self._n_devices = n_devices  # 0 = all available
+        self._attention = attention
         self._lock = threading.Lock()
         self._built = None
 
@@ -51,7 +62,8 @@ class LongContextEncoderModel(Model):
             import jax.numpy as jnp
             from jax.sharding import Mesh
 
-            from ..parallel.ring import place_sharded, ring_attention
+            from ..parallel.ring import place_sharded
+            from ..parallel.ulysses import sequence_parallel_attention
 
             available = len(jax.devices())
             n = self._n_devices or available
@@ -81,8 +93,9 @@ class LongContextEncoderModel(Model):
                 def project(w):
                     return (xb @ w).reshape(1, seq, heads, head_dim)
 
-                out = ring_attention(
-                    project(wq), project(wk), project(wv), mesh, axis="data"
+                out = sequence_parallel_attention(
+                    project(wq), project(wk), project(wv), mesh, axis="data",
+                    mode=self._attention,
                 )
                 return (out.reshape(1, seq, self._dim) @ wo)[0]
 

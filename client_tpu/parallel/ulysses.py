@@ -1,0 +1,88 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence repartition.
+
+The second canonical long-context scheme next to ring attention
+(``ring.py``): instead of rotating K/V around the ring, two ``all_to_all``
+collectives re-partition the tensors so each device holds the FULL sequence
+for a SLICE of the heads, runs dense attention locally, and re-partitions
+back. Trade-offs vs the ring:
+
+- collectives: 3 all-to-alls in, 1 out (O(1) steps) vs the ring's 2(n-1)
+  ppermute hops — Ulysses wins when the interconnect handles all-to-all
+  well (TPU ICI does) and sequence blocks are large;
+- memory: each device materializes its heads' full [seq, seq] score matrix,
+  so the ring remains the choice when seq² per head exceeds HBM;
+- constraint: heads must divide by the mesh axis (the ring requires seq to).
+
+Both are exact. ``sequence_parallel_attention`` picks per call.
+"""
+
+from __future__ import annotations
+
+
+def ulysses_attention(q, k, v, mesh, axis: str = "data"):
+    """Exact attention with the sequence axis sharded over ``axis``.
+
+    q, k, v: [batch, seq, heads, dim]; ``heads`` must divide by the axis
+    size (and ``seq`` by it too, as it arrives sharded). Returns the same
+    sharding as the inputs.
+    """
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    batch, seq, heads, dim = q.shape
+    if seq % n != 0:
+        raise ValueError(f"seq {seq} must divide by mesh axis size {n}")
+    if heads % n != 0:
+        raise ValueError(f"heads {heads} must divide by mesh axis size {n}")
+
+    from .ring import full_attention
+
+    def block(q_blk, k_blk, v_blk):
+        # local shards: [b, seq/n, h, d] -> all-to-all -> [b, seq, h/n, d]
+        def scatter_heads(x):
+            return lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        def gather_heads(x):
+            return lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        q_full = scatter_heads(q_blk)
+        k_full = scatter_heads(k_blk)
+        v_full = scatter_heads(v_blk)
+        out = full_attention(q_full, k_full, v_full)  # dense on h/n heads
+        return gather_heads(out)
+
+    spec = P(None, axis, None, None)
+    return shard_map(
+        block, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+
+
+def sequence_parallel_attention(q, k, v, mesh, axis: str = "data", mode: str = "auto"):
+    """Dispatch between ring and Ulysses context parallelism.
+
+    ``mode``: "ring", "ulysses", or "auto" — auto prefers Ulysses when the
+    head count divides the axis (fewer collective steps) and falls back to
+    the ring otherwise (or when the local score matrix would be huge).
+    """
+    from .ring import ring_attention
+
+    n = mesh.shape[axis]
+    if mode == "ring":
+        return ring_attention(q, k, v, mesh, axis)
+    if mode == "ulysses":
+        return ulysses_attention(q, k, v, mesh, axis)
+    if mode != "auto":
+        raise ValueError(f"unknown sequence-parallel mode {mode!r}")
+    heads_divide = q.shape[2] % n == 0
+    # per-device footprint under Ulysses: scores + probs for every local
+    # head over every batch element, 2 * batch * h/n * seq^2 floats
+    score_bytes = 2 * q.shape[0] * (q.shape[2] // max(n, 1)) * q.shape[1] ** 2 * 4
+    if heads_divide and score_bytes < (1 << 30):
+        return ulysses_attention(q, k, v, mesh, axis)
+    return ring_attention(q, k, v, mesh, axis)

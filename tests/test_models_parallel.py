@@ -233,3 +233,79 @@ def test_pipeline_parallel_validates_shapes():
     w, b = mlp_stage_params(jax.random.PRNGKey(0), n_stages=2, dim=8)
     with pytest.raises(ValueError, match="stages"):
         pipeline_forward(w, b, jnp.zeros((4, 8)), mesh)
+
+
+def test_ulysses_attention_matches_full_attention():
+    """All-to-all sequence parallelism is exact vs dense attention."""
+    import jax
+    import jax.numpy as jnp
+
+    from client_tpu.parallel import make_mesh
+    from client_tpu.parallel.ring import full_attention, place_sharded
+    from client_tpu.parallel.ulysses import ulysses_attention
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = make_mesh(8, axis_names=("data", "model"))  # data axis size 4 or 2
+    n = mesh.shape["data"]
+    rng = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(rng, 3)
+    batch, seq, heads, dim = 2, 8 * n, 2 * n, 16
+    q = jax.random.normal(kq, (batch, seq, heads, dim), jnp.float32)
+    k = jax.random.normal(kk, (batch, seq, heads, dim), jnp.float32)
+    v = jax.random.normal(kv, (batch, seq, heads, dim), jnp.float32)
+
+    expected = np.asarray(full_attention(q, k, v))
+    got = np.asarray(
+        ulysses_attention(
+            place_sharded(q, mesh), place_sharded(k, mesh), place_sharded(v, mesh),
+            mesh, axis="data",
+        )
+    )
+    np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
+
+
+def test_sequence_parallel_dispatch():
+    """auto mode picks Ulysses when heads divide, ring otherwise — both exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from client_tpu.parallel import make_mesh
+    from client_tpu.parallel.ring import full_attention, place_sharded
+    from client_tpu.parallel.ulysses import sequence_parallel_attention, ulysses_attention
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = make_mesh(8, axis_names=("data", "model"))
+    n = mesh.shape["data"]
+    rng = jax.random.PRNGKey(9)
+    # heads NOT divisible by the axis -> auto must fall back to the ring
+    batch, seq, heads, dim = 1, 8 * n, n + 1, 8
+    q = jax.random.normal(rng, (batch, seq, heads, dim), jnp.float32)
+    qs = place_sharded(q, mesh)
+    got = np.asarray(sequence_parallel_attention(qs, qs, qs, mesh, mode="auto"))
+    np.testing.assert_allclose(
+        got, np.asarray(full_attention(q, q, q)), atol=2e-5, rtol=2e-5
+    )
+    # explicit ulysses on indivisible heads raises the typed error
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(qs, qs, qs, mesh)
+
+
+def test_long_context_encoder_ulysses_mode():
+    """The served encoder under Ulysses attention matches the ring mode."""
+    import jax
+
+    from client_tpu.models.long_context import LongContextEncoderModel
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    seq, dim = 64, 32
+    x = np.random.default_rng(0).standard_normal((seq, dim)).astype(np.float32)
+    ring = LongContextEncoderModel(dim=dim, heads=8, attention="ring")
+    uly = LongContextEncoderModel(dim=dim, heads=8, attention="ulysses")
+    out_ring = ring.execute({"sequence": x}, {})["encoded"]
+    out_uly = uly.execute({"sequence": x}, {})["encoded"]
+    np.testing.assert_allclose(
+        np.asarray(out_uly), np.asarray(out_ring), atol=2e-5, rtol=2e-5
+    )
