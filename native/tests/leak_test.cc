@@ -1,0 +1,147 @@
+// Leak harness for the native clients (reference src/c++/tests/
+// memory_leak_test.cc:324 — loops inferences for external leak tooling).
+// The image has no valgrind, so this binary is built with
+// -fsanitize=address: LeakSanitizer reports anything still reachable-lost
+// at exit and fails the process. Exercises full lifecycle churn — clients,
+// inputs, results, async callbacks, streams — not just the steady state.
+//
+// env: CLIENT_TPU_TEST_URL (HTTP server), CLIENT_TPU_TEST_GRPC_URL (GRPC).
+// argv[1]: repetitions (default 100).
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "client_tpu/grpc_client.h"
+#include "client_tpu/http_client.h"
+
+using namespace client_tpu;
+
+#define CHECK_OK(expr)                                                  \
+  do {                                                                  \
+    Error err_ = (expr);                                                \
+    if (err_) {                                                         \
+      fprintf(stderr, "FAILED at %s:%d: %s\n", __FILE__, __LINE__,      \
+              err_.Message().c_str());                                  \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+static std::vector<int32_t> MakeData(size_t n) {
+  std::vector<int32_t> data(n);
+  for (size_t i = 0; i < n; ++i) data[i] = static_cast<int32_t>(i);
+  return data;
+}
+
+static void HttpChurn(const char* url, int reps) {
+  auto data = MakeData(1 << 14);
+  for (int i = 0; i < reps; ++i) {
+    std::unique_ptr<InferenceServerHttpClient> client;
+    CHECK_OK(InferenceServerHttpClient::Create(&client, url));
+    InferInput* input;
+    CHECK_OK(InferInput::Create(
+        &input, "INPUT0", {1, (int64_t)data.size()}, "INT32"));
+    CHECK_OK(input->AppendRaw(
+        reinterpret_cast<uint8_t*>(data.data()), data.size() * 4));
+    InferOptions options("custom_identity_int32");
+    InferResult* result = nullptr;
+    CHECK_OK(client->Infer(&result, options, {input}));
+    const uint8_t* buf;
+    size_t n;
+    CHECK_OK(result->RawData("OUTPUT0", &buf, &n));
+    if (n != data.size() * 4) exit(2);
+    delete result;
+    // async on the same client (worker thread spin-up/drain)
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    bool async_ok = true;
+    CHECK_OK(client->AsyncInfer(
+        [&](InferResult* r) {
+          if (r == nullptr || r->RequestStatus()) async_ok = false;
+          delete r;
+          std::lock_guard<std::mutex> lock(m);
+          done = true;
+          cv.notify_one();
+        },
+        options, {input}));
+    {
+      std::unique_lock<std::mutex> lock(m);
+      cv.wait(lock, [&] { return done; });
+    }
+    if (!async_ok) {
+      fprintf(stderr, "async infer returned an error result\n");
+      exit(3);
+    }
+    delete input;
+  }
+}
+
+static void GrpcChurn(const char* url, int reps) {
+  auto data = MakeData(1 << 14);
+  for (int i = 0; i < reps; ++i) {
+    std::unique_ptr<InferenceServerGrpcClient> client;
+    CHECK_OK(InferenceServerGrpcClient::Create(&client, url));
+    InferInput* input;
+    CHECK_OK(InferInput::Create(
+        &input, "INPUT0", {1, (int64_t)data.size()}, "INT32"));
+    CHECK_OK(input->AppendRaw(
+        reinterpret_cast<uint8_t*>(data.data()), data.size() * 4));
+    InferOptions options("custom_identity_int32");
+    InferResult* result = nullptr;
+    CHECK_OK(client->Infer(&result, options, {input}));
+    delete result;
+    // one short-lived stream per few reps: open/send/receive/close churn
+    if (i % 4 == 0) {
+      std::mutex m;
+      std::condition_variable cv;
+      int got = 0;
+      bool stream_ok = true;
+      CHECK_OK(client->StartStream([&](InferResult* r, const Error& e) {
+        if (e || r == nullptr || r->RequestStatus()) stream_ok = false;
+        delete r;
+        std::lock_guard<std::mutex> lock(m);
+        ++got;
+        cv.notify_one();
+      }));
+      CHECK_OK(client->AsyncStreamInfer(options, {input}));
+      {
+        std::unique_lock<std::mutex> lock(m);
+        cv.wait(lock, [&] { return got == 1; });
+      }
+      CHECK_OK(client->StopStream());
+      if (!stream_ok) {
+        fprintf(stderr, "stream returned an error result\n");
+        exit(4);
+      }
+    }
+    delete input;
+  }
+}
+
+int main(int argc, char** argv) {
+  int reps = argc > 1 ? atoi(argv[1]) : 100;
+  const char* http_url = getenv("CLIENT_TPU_TEST_URL");
+  const char* grpc_url = getenv("CLIENT_TPU_TEST_GRPC_URL");
+  bool any = false;
+  if (http_url != nullptr && http_url[0] != '\0') {
+    HttpChurn(http_url, reps);
+    printf("http churn ok (%d reps)\n", reps);
+    any = true;
+  }
+  if (grpc_url != nullptr && grpc_url[0] != '\0') {
+    GrpcChurn(grpc_url, reps);
+    printf("grpc churn ok (%d reps)\n", reps);
+    any = true;
+  }
+  if (!any) {
+    printf("no server urls set; nothing exercised\n");
+    return 0;
+  }
+  printf("PASS leak_test\n");
+  return 0;
+}

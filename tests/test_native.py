@@ -349,3 +349,26 @@ def test_hpack_decoder_against_reference_encoder():
         assert not got.startswith("ERROR"), got
         pairs = [tuple(line.split("\t", 1)) for line in got.splitlines()]
         assert pairs == [(n, v) for n, v in want], (pairs, want)
+
+
+LEAK_CHECK = BUILD / "leak_check"
+
+
+@pytest.mark.skipif(not SMOKE.exists(), reason="native toolchain unavailable")
+def test_native_leak_check(server, grpc_server):
+    """ASan/LSan-instrumented lifecycle churn over both native clients
+    (reference memory_leak_test.cc's role; no valgrind in this image).
+    LeakSanitizer fails the process on any leak at exit."""
+    if not LEAK_CHECK.exists():
+        pytest.skip("leak_check not built (stale build dir)")
+    proc = subprocess.run(
+        [str(LEAK_CHECK), "30"], capture_output=True, text=True, timeout=300,
+        env={
+            **os.environ,
+            "CLIENT_TPU_TEST_URL": server.url,
+            "CLIENT_TPU_TEST_GRPC_URL": grpc_server.url,
+        },
+    )
+    assert proc.returncode == 0, f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+    assert "PASS leak_test" in proc.stdout
+    assert "LeakSanitizer" not in proc.stderr, proc.stderr
