@@ -91,6 +91,9 @@ def _bind(lib):
     lib.ctpu_options_set_sequence.argtypes = [
         ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_int, ctypes.c_int
     ]
+    lib.ctpu_options_set_timeouts.argtypes = [
+        ctypes.c_void_p, ctypes.c_ulonglong, ctypes.c_ulonglong
+    ]
     lib.ctpu_infer.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
         ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
@@ -240,7 +243,7 @@ class NativeClient:
         return out[:nbytes].view(np_dtype)
 
     def infer(self, model_name: str, inputs, outputs=None, request_id: str = "",
-              sequence=None):
+              sequence=None, client_timeout_s: float = 0.0):
         """Full value-model inference through the native data path.
 
         ``inputs``: list of (name, np.ndarray) and/or
@@ -261,6 +264,14 @@ class NativeClient:
             if sequence is not None:
                 seq_id, start, end = sequence
                 lib.ctpu_options_set_sequence(options, seq_id, int(start), int(end))
+            if client_timeout_s:
+                if client_timeout_s < 0:
+                    raise InferenceServerException(
+                        "client_timeout_s must be non-negative"
+                    )
+                lib.ctpu_options_set_timeouts(
+                    options, max(1, int(round(client_timeout_s * 1e6))), 0
+                )
             out_names = []
             for name, value in inputs:
                 if isinstance(value, tuple) and value and value[0] == "shm":

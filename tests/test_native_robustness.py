@@ -1,0 +1,224 @@
+"""Hostile-server tier for the native h2/gRPC transport.
+
+The Python-client twin is tests/test_client_robustness.py; this file points
+raw byte-level TCP servers at the hand-rolled HTTP/2 client
+(native/src/h2.cc via the ctypes NativeGrpcClient) and requires typed
+errors — never hangs, crashes, or garbage results — when the peer
+misbehaves at the frame level.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from tests.test_native import _ensure_built
+
+pytestmark = pytest.mark.skipif(
+    not _ensure_built(), reason="native toolchain unavailable"
+)
+
+
+class _ByteServer:
+    """Accepts one connection and runs ``behavior(conn)`` on it."""
+
+    def __init__(self, behavior):
+        self._behavior = behavior
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(4)
+        self.port = self._listener.getsockname()[1]
+        self.url = f"127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._alive = True
+        self._thread.start()
+
+    def _loop(self):
+        while self._alive:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                self._behavior(conn)
+            except Exception:
+                # keep accepting: a behavior bug must surface as the
+                # client-side error under test, not a dead accept loop
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        self._alive = False
+        self._listener.close()
+
+
+def _frame(ftype, flags, stream_id, payload=b""):
+    return (
+        struct.pack(">I", len(payload))[1:]
+        + bytes((ftype, flags))
+        + struct.pack(">I", stream_id)
+        + payload
+    )
+
+
+def _read_preface_and_ack(conn):
+    """Consume the client preface + SETTINGS, reply with our SETTINGS+ACK."""
+    conn.settimeout(10)
+    buf = b""
+    while len(buf) < 24:
+        chunk = conn.recv(4096)
+        if not chunk:
+            raise OSError("peer closed before completing the preface")
+        buf += chunk
+    assert buf.startswith(b"PRI * HTTP/2.0")
+    conn.sendall(_frame(0x4, 0, 0))       # empty SETTINGS
+    conn.sendall(_frame(0x4, 0x1, 0))     # SETTINGS ACK
+    return buf[24:]
+
+
+def _infer(url, timeout_s=10.0):
+    from client_tpu.native import NativeGrpcClient
+
+    import numpy as np
+
+    with NativeGrpcClient(url) as client:
+        data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        return client.infer(
+            "custom_identity_int32", [("INPUT0", data)],
+            client_timeout_s=timeout_s,
+        )
+
+
+def _expect_error(url, match=None, timeout_s=10.0):
+    from client_tpu.utils import InferenceServerException
+
+    t0 = time.monotonic()
+    with pytest.raises(InferenceServerException) as exc:
+        _infer(url, timeout_s)
+    elapsed = time.monotonic() - t0
+    if match:
+        assert match in str(exc.value), str(exc.value)
+    return elapsed
+
+
+def test_immediate_close():
+    """Peer closes right after accept: UNAVAILABLE, no hang."""
+    server = _ByteServer(lambda conn: conn.close())
+    try:
+        _expect_error(server.url, "StatusCode.UNAVAILABLE")
+    finally:
+        server.close()
+
+
+def test_garbage_bytes_instead_of_h2():
+    """A non-h2 peer (e.g. an HTTP/1.1 server) produces a typed error."""
+    def behavior(conn):
+        conn.settimeout(10)
+        conn.recv(4096)
+        conn.sendall(b"HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\n\r\n")
+        time.sleep(0.5)
+
+    server = _ByteServer(behavior)
+    try:
+        _expect_error(server.url, "StatusCode.UNAVAILABLE")
+    finally:
+        server.close()
+
+
+def test_rst_stream_reset():
+    """Server RSTs the request stream: 'reset by peer' surfaces."""
+    def behavior(conn):
+        _read_preface_and_ack(conn)
+        # drain whatever the client sends, then reset stream 1
+        conn.settimeout(2)
+        try:
+            conn.recv(65536)
+        except socket.timeout:
+            pass
+        conn.sendall(_frame(0x3, 0, 1, struct.pack(">I", 0x8)))  # CANCEL
+        time.sleep(1)
+
+    server = _ByteServer(behavior)
+    try:
+        _expect_error(server.url, "reset by peer")
+    finally:
+        server.close()
+
+
+def test_silent_server_honors_timeout():
+    """Server accepts, ACKs settings, then never answers: the client
+    timeout bounds the call (DEADLINE_EXCEEDED), not a hang."""
+    def behavior(conn):
+        _read_preface_and_ack(conn)
+        time.sleep(30)
+
+    server = _ByteServer(behavior)
+    try:
+        elapsed = _expect_error(
+            server.url, "DEADLINE_EXCEEDED", timeout_s=2.0
+        )
+        assert elapsed < 10, f"timeout not honored: {elapsed:.1f}s"
+    finally:
+        server.close()
+
+
+def test_goaway_then_close():
+    """GOAWAY + close: the client reports the debug data, not garbage."""
+    def behavior(conn):
+        _read_preface_and_ack(conn)
+        conn.settimeout(2)
+        try:
+            conn.recv(65536)
+        except socket.timeout:
+            pass
+        payload = struct.pack(">II", 0, 0x0) + b"maintenance"
+        conn.sendall(_frame(0x7, 0, 0, payload))
+        conn.close()
+
+    server = _ByteServer(behavior)
+    try:
+        _expect_error(server.url, "GOAWAY: maintenance")
+    finally:
+        server.close()
+
+
+def test_truncated_grpc_frame():
+    """A well-formed h2 response whose gRPC message framing lies about its
+    length must be rejected, not mis-parsed."""
+    def behavior(conn):
+        _read_preface_and_ack(conn)
+        conn.settimeout(2)
+        try:
+            conn.recv(65536)
+        except socket.timeout:
+            pass
+        # HEADERS: :status 200 via literal-without-indexing encoding
+        def lit(name, value):
+            out = b"\x00"
+            out += bytes((len(name),)) + name
+            out += bytes((len(value),)) + value
+            return out
+
+        block = b"\x88"  # indexed :status 200 (static table 8)
+        block += lit(b"content-type", b"application/grpc")
+        conn.sendall(_frame(0x1, 0x4, 1, block))  # END_HEADERS
+        # DATA: frame header claims 100-byte message, delivers 4
+        body = b"\x00" + struct.pack(">I", 100) + b"\x00" * 4
+        conn.sendall(_frame(0x0, 0, 1, body))
+        # trailers: grpc-status 0, END_STREAM
+        trailers = lit(b"grpc-status", b"0")
+        conn.sendall(_frame(0x1, 0x5, 1, trailers))
+        time.sleep(1)
+
+    server = _ByteServer(behavior)
+    try:
+        _expect_error(server.url, "truncated gRPC response frame")
+    finally:
+        server.close()
