@@ -1,0 +1,28 @@
+package client_tpu;
+
+/** Triton/KServe v2 tensor datatypes with wire sizes (reference:
+ * src/java/.../pojo/DataType.java; sizes per the binary tensor
+ * extension — all little-endian). */
+public enum DataType {
+  BOOL(1),
+  UINT8(1),
+  UINT16(2),
+  UINT32(4),
+  UINT64(8),
+  INT8(1),
+  INT16(2),
+  INT32(4),
+  INT64(8),
+  FP16(2),
+  FP32(4),
+  FP64(8),
+  BF16(2),
+  BYTES(-1);  // 4-byte LE length prefix per element
+
+  private final int byteSize;
+
+  DataType(int byteSize) { this.byteSize = byteSize; }
+
+  /** Bytes per element; -1 for variable-size BYTES. */
+  public int byteSize() { return byteSize; }
+}

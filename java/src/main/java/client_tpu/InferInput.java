@@ -1,0 +1,135 @@
+package client_tpu;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+
+/**
+ * An input tensor: metadata + little-endian payload bytes, or a
+ * shared-memory placement (reference: src/java/.../InferInput.java and
+ * BinaryProtocol.java — re-designed around java.nio instead of manual
+ * byte shuffling).
+ */
+public class InferInput {
+  private final String name;
+  private final long[] shape;
+  private final DataType datatype;
+  private byte[] data;
+  private String sharedMemoryRegion;
+  private long sharedMemoryByteSize;
+  private long sharedMemoryOffset;
+
+  public InferInput(String name, long[] shape, DataType datatype) {
+    this.name = name;
+    this.shape = shape.clone();
+    this.datatype = datatype;
+  }
+
+  public String getName() { return name; }
+  public long[] getShape() { return shape.clone(); }
+  public DataType getDatatype() { return datatype; }
+  public byte[] getData() { return data; }
+  public boolean inSharedMemory() { return sharedMemoryRegion != null; }
+
+  private ByteBuffer alloc(int elements, int elemSize) {
+    return ByteBuffer.allocate(elements * elemSize)
+        .order(ByteOrder.LITTLE_ENDIAN);
+  }
+
+  public InferInput setData(int[] values) {
+    ByteBuffer buf = alloc(values.length, 4);
+    for (int v : values) buf.putInt(v);
+    this.data = buf.array();
+    this.sharedMemoryRegion = null;
+    return this;
+  }
+
+  public InferInput setData(long[] values) {
+    ByteBuffer buf = alloc(values.length, 8);
+    for (long v : values) buf.putLong(v);
+    this.data = buf.array();
+    this.sharedMemoryRegion = null;
+    return this;
+  }
+
+  public InferInput setData(float[] values) {
+    ByteBuffer buf = alloc(values.length, 4);
+    for (float v : values) buf.putFloat(v);
+    this.data = buf.array();
+    this.sharedMemoryRegion = null;
+    return this;
+  }
+
+  public InferInput setData(double[] values) {
+    ByteBuffer buf = alloc(values.length, 8);
+    for (double v : values) buf.putDouble(v);
+    this.data = buf.array();
+    this.sharedMemoryRegion = null;
+    return this;
+  }
+
+  public InferInput setData(byte[] rawBytes) {
+    this.data = rawBytes.clone();
+    this.sharedMemoryRegion = null;
+    return this;
+  }
+
+  public InferInput setData(boolean[] values) {
+    byte[] out = new byte[values.length];
+    for (int i = 0; i < values.length; i++) out[i] = (byte) (values[i] ? 1 : 0);
+    this.data = out;
+    this.sharedMemoryRegion = null;
+    return this;
+  }
+
+  /** BYTES elements: each string serialized with a 4-byte LE length prefix
+   * (the binary tensor extension's string wire format). */
+  public InferInput setData(String[] values) {
+    int total = 0;
+    byte[][] encoded = new byte[values.length][];
+    for (int i = 0; i < values.length; i++) {
+      encoded[i] = values[i].getBytes(StandardCharsets.UTF_8);
+      total += 4 + encoded[i].length;
+    }
+    ByteBuffer buf = ByteBuffer.allocate(total).order(ByteOrder.LITTLE_ENDIAN);
+    for (byte[] e : encoded) {
+      buf.putInt(e.length);
+      buf.put(e);
+    }
+    this.data = buf.array();
+    this.sharedMemoryRegion = null;
+    return this;
+  }
+
+  /** Place this input in a registered shared-memory region: the request
+   * then carries only the placement parameters, no tensor bytes. */
+  public InferInput setSharedMemory(String regionName, long byteSize, long offset) {
+    this.sharedMemoryRegion = regionName;
+    this.sharedMemoryByteSize = byteSize;
+    this.sharedMemoryOffset = offset;
+    this.data = null;
+    return this;
+  }
+
+  /** The JSON descriptor for the request header. */
+  Json descriptor() {
+    Json tensor = Json.object();
+    tensor.put("name", Json.of(name));
+    tensor.put("datatype", Json.of(datatype.name()));
+    Json dims = Json.array();
+    for (long d : shape) dims.append(Json.of((double) d));
+    tensor.put("shape", dims);
+    Json params = Json.object();
+    if (inSharedMemory()) {
+      params.put("shared_memory_region", Json.of(sharedMemoryRegion));
+      params.put("shared_memory_byte_size", Json.of((double) sharedMemoryByteSize));
+      if (sharedMemoryOffset != 0) {
+        params.put("shared_memory_offset", Json.of((double) sharedMemoryOffset));
+      }
+    } else {
+      params.put("binary_data_size", Json.of((double) (data == null ? 0 : data.length)));
+    }
+    tensor.put("parameters", params);
+    return tensor;
+  }
+}

@@ -1,0 +1,55 @@
+package client_tpu;
+
+/** A requested output: binary by default, optional classification top-k,
+ * optional shared-memory placement (reference:
+ * src/java/.../InferRequestedOutput.java). */
+public class InferRequestedOutput {
+  private final String name;
+  private final int classCount;
+  private boolean binaryData = true;
+  private String sharedMemoryRegion;
+  private long sharedMemoryByteSize;
+  private long sharedMemoryOffset;
+
+  public InferRequestedOutput(String name) { this(name, 0); }
+
+  public InferRequestedOutput(String name, int classCount) {
+    this.name = name;
+    this.classCount = classCount;
+  }
+
+  public String getName() { return name; }
+
+  public InferRequestedOutput setBinaryData(boolean binaryData) {
+    this.binaryData = binaryData;
+    return this;
+  }
+
+  public InferRequestedOutput setSharedMemory(
+      String regionName, long byteSize, long offset) {
+    this.sharedMemoryRegion = regionName;
+    this.sharedMemoryByteSize = byteSize;
+    this.sharedMemoryOffset = offset;
+    return this;
+  }
+
+  Json descriptor() {
+    Json out = Json.object();
+    out.put("name", Json.of(name));
+    Json params = Json.object();
+    if (sharedMemoryRegion != null) {
+      params.put("shared_memory_region", Json.of(sharedMemoryRegion));
+      params.put("shared_memory_byte_size", Json.of((double) sharedMemoryByteSize));
+      if (sharedMemoryOffset != 0) {
+        params.put("shared_memory_offset", Json.of((double) sharedMemoryOffset));
+      }
+    } else {
+      if (classCount > 0) {
+        params.put("classification", Json.of((double) classCount));
+      }
+      params.put("binary_data", Json.of(binaryData));
+    }
+    out.put("parameters", params);
+    return out;
+  }
+}

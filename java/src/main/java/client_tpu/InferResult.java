@@ -1,0 +1,152 @@
+package client_tpu;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * A decoded inference response: the JSON header plus an offset map into the
+ * binary tail (reference: src/java/.../InferResult.java). Binary outputs
+ * stay as views until a typed getter copies them out little-endian.
+ */
+public class InferResult {
+  private final Json header;
+  private final byte[] body;
+  private final Map<String, int[]> binarySpans = new LinkedHashMap<>();
+  private final Map<String, Json> outputsByName = new LinkedHashMap<>();
+
+  InferResult(byte[] responseBody, int headerLength)
+      throws InferenceServerException {
+    int jsonLength = headerLength > 0 ? headerLength : responseBody.length;
+    if (jsonLength > responseBody.length) {
+      throw new InferenceServerException(
+          "Inference-Header-Content-Length " + jsonLength
+          + " exceeds the body (" + responseBody.length + " bytes)");
+    }
+    this.body = responseBody;
+    this.header = Json.parse(
+        new String(responseBody, 0, jsonLength, StandardCharsets.UTF_8));
+    int cursor = jsonLength;
+    Json outputs = header.get("outputs");
+    for (int i = 0; i < outputs.size(); i++) {
+      Json output = outputs.get(i);
+      String name = output.get("name").asString();
+      outputsByName.put(name, output);
+      Json size = output.get("parameters").get("binary_data_size");
+      if (!size.isNull()) {
+        long n = size.asLong();
+        if (n < 0 || cursor + n > responseBody.length) {
+          throw new InferenceServerException(
+              "invalid binary_data_size " + n + " for output '" + name + "'");
+        }
+        binarySpans.put(name, new int[] {cursor, (int) n});
+        cursor += (int) n;
+      }
+    }
+  }
+
+  public String getModelName() { return header.get("model_name").asString(); }
+  public String getId() { return header.get("id").asString(); }
+
+  public List<String> getOutputNames() {
+    return new ArrayList<>(outputsByName.keySet());
+  }
+
+  public long[] getShape(String name) throws InferenceServerException {
+    Json output = require(name);
+    Json dims = output.get("shape");
+    long[] shape = new long[dims.size()];
+    for (int i = 0; i < shape.length; i++) shape[i] = dims.get(i).asLong();
+    return shape;
+  }
+
+  public DataType getDatatype(String name) throws InferenceServerException {
+    return DataType.valueOf(require(name).get("datatype").asString());
+  }
+
+  private Json require(String name) throws InferenceServerException {
+    Json output = outputsByName.get(name);
+    if (output == null) {
+      throw new InferenceServerException("unknown output '" + name + "'");
+    }
+    return output;
+  }
+
+  private ByteBuffer binary(String name) throws InferenceServerException {
+    require(name);
+    int[] span = binarySpans.get(name);
+    if (span == null) {
+      throw new InferenceServerException(
+          "output '" + name + "' has no binary data (JSON or shared memory)");
+    }
+    return ByteBuffer.wrap(body, span[0], span[1])
+        .order(ByteOrder.LITTLE_ENDIAN);
+  }
+
+  public byte[] getRaw(String name) throws InferenceServerException {
+    ByteBuffer buf = binary(name);
+    byte[] out = new byte[buf.remaining()];
+    buf.get(out);
+    return out;
+  }
+
+  public int[] getAsInt(String name) throws InferenceServerException {
+    ByteBuffer buf = binary(name);
+    int[] out = new int[buf.remaining() / 4];
+    for (int i = 0; i < out.length; i++) out[i] = buf.getInt();
+    return out;
+  }
+
+  public long[] getAsLong(String name) throws InferenceServerException {
+    ByteBuffer buf = binary(name);
+    long[] out = new long[buf.remaining() / 8];
+    for (int i = 0; i < out.length; i++) out[i] = buf.getLong();
+    return out;
+  }
+
+  public float[] getAsFloat(String name) throws InferenceServerException {
+    ByteBuffer buf = binary(name);
+    float[] out = new float[buf.remaining() / 4];
+    for (int i = 0; i < out.length; i++) out[i] = buf.getFloat();
+    return out;
+  }
+
+  public double[] getAsDouble(String name) throws InferenceServerException {
+    ByteBuffer buf = binary(name);
+    double[] out = new double[buf.remaining() / 8];
+    for (int i = 0; i < out.length; i++) out[i] = buf.getDouble();
+    return out;
+  }
+
+  /** BYTES outputs (classification labels included): 4-byte LE length
+   * prefix per element. Falls back to JSON-mode data when the server
+   * answered without binary encoding. */
+  public String[] getAsString(String name) throws InferenceServerException {
+    Json output = require(name);
+    if (binarySpans.containsKey(name)) {
+      ByteBuffer buf = binary(name);
+      List<String> out = new ArrayList<>();
+      while (buf.remaining() >= 4) {
+        int n = buf.getInt();
+        if (n < 0 || n > buf.remaining()) {
+          throw new InferenceServerException(
+              "corrupt BYTES element length " + n + " in '" + name + "'");
+        }
+        byte[] raw = new byte[n];
+        buf.get(raw);
+        out.add(new String(raw, StandardCharsets.UTF_8));
+      }
+      return out.toArray(new String[0]);
+    }
+    Json data = output.get("data");
+    String[] out = new String[data.size()];
+    for (int i = 0; i < out.length; i++) out[i] = data.get(i).asString();
+    return out;
+  }
+
+  public Json getResponseHeader() { return header; }
+}

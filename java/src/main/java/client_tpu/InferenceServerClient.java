@@ -1,0 +1,331 @@
+package client_tpu;
+
+import java.io.IOException;
+import java.net.URI;
+import java.net.URLEncoder;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.ByteBuffer;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.CompletableFuture;
+
+/**
+ * KServe v2 HTTP client on the JDK-11 standard {@code java.net.http} stack.
+ *
+ * Role parity with the reference Java client
+ * (src/java/.../InferenceServerClient.java:76-361, Apache HttpAsyncClient +
+ * fastjson + a hand-rolled retry loop) — re-designed dependency-free:
+ * java.net.http pools connections and supplies async natively
+ * ({@link #inferAsync} returns a {@link CompletableFuture} instead of the
+ * reference's callback pool), and the two-part binary body rides the same
+ * {@code Inference-Header-Content-Length} contract as every other client in
+ * this framework.
+ *
+ * STATUS: source-complete but untested in this build image (no JDK is
+ * installed — see java/README.md). The wire format it emits is the same one
+ * the Python/C++ clients emit and the in-process server round-trips in CI.
+ */
+public class InferenceServerClient implements AutoCloseable {
+  private final String baseUrl;
+  private final HttpClient http;
+  private final Duration requestTimeout;
+
+  public InferenceServerClient(String url) {
+    this(url, Duration.ofSeconds(5), Duration.ofSeconds(60));
+  }
+
+  public InferenceServerClient(
+      String url, Duration connectTimeout, Duration requestTimeout) {
+    this.baseUrl = url.startsWith("http") ? url : "http://" + url;
+    this.requestTimeout = requestTimeout;
+    this.http = HttpClient.newBuilder()
+        .version(HttpClient.Version.HTTP_1_1)
+        .connectTimeout(connectTimeout)
+        .build();
+  }
+
+  // -- health / metadata ----------------------------------------------------
+
+  public boolean isServerLive() throws InferenceServerException {
+    return getStatus("/v2/health/live") == 200;
+  }
+
+  public boolean isServerReady() throws InferenceServerException {
+    return getStatus("/v2/health/ready") == 200;
+  }
+
+  public boolean isModelReady(String modelName) throws InferenceServerException {
+    return getStatus("/v2/models/" + seg(modelName) + "/ready") == 200;
+  }
+
+  /** Percent-encode one path segment (the Python client quote()s the
+   * same way, so names with '/', ' ', '#' stay addressable). */
+  private static String seg(String name) {
+    return URLEncoder.encode(name, StandardCharsets.UTF_8)
+        .replace("+", "%20");
+  }
+
+  public Json getServerMetadata() throws InferenceServerException {
+    return getJson("/v2");
+  }
+
+  public Json getModelMetadata(String modelName) throws InferenceServerException {
+    return getJson("/v2/models/" + seg(modelName));
+  }
+
+  public Json getModelConfig(String modelName) throws InferenceServerException {
+    return getJson("/v2/models/" + seg(modelName) + "/config");
+  }
+
+  public Json getModelRepositoryIndex() throws InferenceServerException {
+    return postJson("/v2/repository/index", "{}");
+  }
+
+  public Json getInferenceStatistics(String modelName)
+      throws InferenceServerException {
+    return getJson("/v2/models/" + seg(modelName) + "/stats");
+  }
+
+  public void loadModel(String modelName) throws InferenceServerException {
+    postJson("/v2/repository/models/" + seg(modelName) + "/load", "{}");
+  }
+
+  public void unloadModel(String modelName) throws InferenceServerException {
+    postJson("/v2/repository/models/" + seg(modelName) + "/unload", "{}");
+  }
+
+  // -- shared memory --------------------------------------------------------
+
+  public void registerSystemSharedMemory(
+      String name, String key, long byteSize, long offset)
+      throws InferenceServerException {
+    Json req = Json.object()
+        .put("key", Json.of(key))
+        .put("offset", Json.of((double) offset))
+        .put("byte_size", Json.of((double) byteSize));
+    postJson(
+        "/v2/systemsharedmemory/region/" + seg(name) + "/register", req.dump());
+  }
+
+  public void unregisterSystemSharedMemory(String name)
+      throws InferenceServerException {
+    String path = name.isEmpty()
+        ? "/v2/systemsharedmemory/unregister"
+        : "/v2/systemsharedmemory/region/" + seg(name) + "/unregister";
+    postJson(path, "{}");
+  }
+
+  public Json getSystemSharedMemoryStatus() throws InferenceServerException {
+    return getJson("/v2/systemsharedmemory/status");
+  }
+
+  public void registerTpuSharedMemory(
+      String name, String rawHandleBase64, int deviceId, long byteSize)
+      throws InferenceServerException {
+    Json handle = Json.object().put("b64", Json.of(rawHandleBase64));
+    Json req = Json.object()
+        .put("raw_handle", handle)
+        .put("device_id", Json.of((double) deviceId))
+        .put("byte_size", Json.of((double) byteSize));
+    postJson("/v2/tpusharedmemory/region/" + seg(name) + "/register", req.dump());
+  }
+
+  public void unregisterTpuSharedMemory(String name)
+      throws InferenceServerException {
+    String path = name.isEmpty()
+        ? "/v2/tpusharedmemory/unregister"
+        : "/v2/tpusharedmemory/region/" + seg(name) + "/unregister";
+    postJson(path, "{}");
+  }
+
+  // -- inference ------------------------------------------------------------
+
+  public InferResult infer(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) throws InferenceServerException {
+    return infer(modelName, inputs, outputs, null);
+  }
+
+  /** Async twin of {@link #infer}; completes exceptionally with
+   * {@link InferenceServerException} on protocol errors. */
+  public CompletableFuture<InferResult> inferAsync(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) {
+    HttpRequest request;
+    try {
+      request = buildInferRequest(modelName, inputs, outputs, null);
+    } catch (InferenceServerException e) {
+      return CompletableFuture.failedFuture(e);
+    }
+    return http.sendAsync(request, HttpResponse.BodyHandlers.ofByteArray())
+        .thenApply(response -> {
+          try {
+            return decodeInferResponse(response);
+          } catch (InferenceServerException e) {
+            throw new java.util.concurrent.CompletionException(e);
+          }
+        });
+  }
+
+  public InferResult infer(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs, Map<String, String> headers)
+      throws InferenceServerException {
+    HttpRequest request = buildInferRequest(modelName, inputs, outputs, headers);
+    try {
+      HttpResponse<byte[]> response =
+          http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+      return decodeInferResponse(response);
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceServerException("infer request failed: " + e, e);
+    }
+  }
+
+  // -- internals ------------------------------------------------------------
+
+  private HttpRequest buildInferRequest(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs, Map<String, String> extraHeaders)
+      throws InferenceServerException {
+    Json header = Json.object();
+    Json inputDescriptors = Json.array();
+    long binaryBytes = 0;
+    for (InferInput input : inputs) {
+      inputDescriptors.append(input.descriptor());
+      if (!input.inSharedMemory() && input.getData() != null) {
+        binaryBytes += input.getData().length;
+      }
+    }
+    header.put("inputs", inputDescriptors);
+    if (outputs != null && !outputs.isEmpty()) {
+      Json outputDescriptors = Json.array();
+      for (InferRequestedOutput output : outputs) {
+        outputDescriptors.append(output.descriptor());
+      }
+      header.put("outputs", outputDescriptors);
+    } else {
+      header.put(
+          "parameters",
+          Json.object().put("binary_data_output", Json.of(true)));
+    }
+
+    byte[] headerBytes = header.dump().getBytes(StandardCharsets.UTF_8);
+    long totalBytes = headerBytes.length + binaryBytes;
+    if (totalBytes > Integer.MAX_VALUE) {
+      throw new InferenceServerException(
+          "request body of " + totalBytes + " bytes exceeds the 2 GiB limit;"
+          + " place large tensors in shared memory instead");
+    }
+    ByteBuffer body = ByteBuffer.allocate((int) totalBytes);
+    body.put(headerBytes);
+    for (InferInput input : inputs) {
+      if (!input.inSharedMemory() && input.getData() != null) {
+        body.put(input.getData());
+      }
+    }
+
+    HttpRequest.Builder builder = HttpRequest.newBuilder()
+        .uri(URI.create(baseUrl + "/v2/models/" + seg(modelName) + "/infer"))
+        .timeout(requestTimeout)
+        .header("Content-Type", "application/octet-stream")
+        .header(
+            "Inference-Header-Content-Length",
+            Integer.toString(headerBytes.length))
+        .POST(HttpRequest.BodyPublishers.ofByteArray(body.array()));
+    if (extraHeaders != null) {
+      for (Map.Entry<String, String> e : extraHeaders.entrySet()) {
+        builder.header(e.getKey(), e.getValue());
+      }
+    }
+    return builder.build();
+  }
+
+  private InferResult decodeInferResponse(HttpResponse<byte[]> response)
+      throws InferenceServerException {
+    if (response.statusCode() >= 400) {
+      String message = new String(response.body(), StandardCharsets.UTF_8);
+      try {
+        Json error = Json.parse(message);
+        if (error.has("error")) message = error.get("error").asString();
+      } catch (InferenceServerException ignored) {
+        // non-JSON error body: report it verbatim
+      }
+      throw new InferenceServerException(message, response.statusCode());
+    }
+    int headerLength = 0;
+    String lengthHeader = response.headers()
+        .firstValue("Inference-Header-Content-Length")
+        .orElse(null);
+    if (lengthHeader != null) {
+      try {
+        headerLength = Integer.parseInt(lengthHeader);
+      } catch (NumberFormatException e) {
+        throw new InferenceServerException(
+            "malformed Inference-Header-Content-Length: " + lengthHeader);
+      }
+    }
+    return new InferResult(response.body(), headerLength);
+  }
+
+  private int getStatus(String path) throws InferenceServerException {
+    try {
+      HttpRequest request = HttpRequest.newBuilder()
+          .uri(URI.create(baseUrl + path))
+          .timeout(requestTimeout)
+          .GET()
+          .build();
+      return http.send(request, HttpResponse.BodyHandlers.discarding())
+          .statusCode();
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceServerException("request failed: " + e, e);
+    }
+  }
+
+  private Json getJson(String path) throws InferenceServerException {
+    return exchange(path, null);
+  }
+
+  private Json postJson(String path, String body)
+      throws InferenceServerException {
+    return exchange(path, body);
+  }
+
+  private Json exchange(String path, String postBody)
+      throws InferenceServerException {
+    try {
+      HttpRequest.Builder builder = HttpRequest.newBuilder()
+          .uri(URI.create(baseUrl + path))
+          .timeout(requestTimeout);
+      HttpRequest request = (postBody == null
+          ? builder.GET()
+          : builder.header("Content-Type", "application/json")
+              .POST(HttpRequest.BodyPublishers.ofString(postBody)))
+          .build();
+      HttpResponse<String> response =
+          http.send(request, HttpResponse.BodyHandlers.ofString());
+      if (response.statusCode() >= 400) {
+        String message = response.body();
+        try {
+          Json error = Json.parse(message);
+          if (error.has("error")) message = error.get("error").asString();
+        } catch (InferenceServerException ignored) {
+          // keep the raw body
+        }
+        throw new InferenceServerException(message, response.statusCode());
+      }
+      String body = response.body();
+      return body == null || body.isEmpty() ? Json.object() : Json.parse(body);
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceServerException("request failed: " + e, e);
+    }
+  }
+
+  @Override
+  public void close() {
+    // java.net.http clients hold daemon threads; nothing to release
+  }
+}

@@ -1,0 +1,22 @@
+package client_tpu;
+
+/** Typed failure from the server or the transport (reference:
+ * src/java/.../InferenceException). Carries the HTTP status when one
+ * exists (0 for transport-level failures). */
+public class InferenceServerException extends Exception {
+  private final int status;
+
+  public InferenceServerException(String message) { this(message, 0); }
+
+  public InferenceServerException(String message, int status) {
+    super(message);
+    this.status = status;
+  }
+
+  public InferenceServerException(String message, Throwable cause) {
+    super(message, cause);
+    this.status = 0;
+  }
+
+  public int getStatus() { return status; }
+}

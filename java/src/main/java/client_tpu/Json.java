@@ -1,0 +1,323 @@
+package client_tpu;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Minimal JSON value + recursive-descent parser + writer.
+ *
+ * Dependency-free by design: the reference Java client pulls in fastjson
+ * (src/java/pom.xml); this package stays standard-library-only, the same
+ * choice the native library makes with its self-contained Json class.
+ */
+public final class Json {
+  public enum Type { NULL, BOOL, NUMBER, STRING, ARRAY, OBJECT }
+
+  private final Type type;
+  private boolean boolValue;
+  private double numberValue;
+  private String stringValue;
+  private List<Json> arrayValue;
+  private Map<String, Json> objectValue;
+
+  private Json(Type type) { this.type = type; }
+
+  public static Json ofNull() { return new Json(Type.NULL); }
+
+  public static Json of(boolean v) {
+    Json j = new Json(Type.BOOL);
+    j.boolValue = v;
+    return j;
+  }
+
+  public static Json of(double v) {
+    Json j = new Json(Type.NUMBER);
+    j.numberValue = v;
+    return j;
+  }
+
+  public static Json of(String v) {
+    Json j = new Json(Type.STRING);
+    j.stringValue = v;
+    return j;
+  }
+
+  public static Json array() {
+    Json j = new Json(Type.ARRAY);
+    j.arrayValue = new ArrayList<>();
+    return j;
+  }
+
+  public static Json object() {
+    Json j = new Json(Type.OBJECT);
+    j.objectValue = new LinkedHashMap<>();
+    return j;
+  }
+
+  public Type type() { return type; }
+  public boolean isNull() { return type == Type.NULL; }
+  public boolean asBool() { return type == Type.BOOL && boolValue; }
+  public double asDouble() { return type == Type.NUMBER ? numberValue : 0.0; }
+  public long asLong() { return (long) asDouble(); }
+  public String asString() { return type == Type.STRING ? stringValue : ""; }
+
+  public int size() { return type == Type.ARRAY ? arrayValue.size() : 0; }
+  public Json get(int index) { return arrayValue.get(index); }
+  public Json append(Json v) {
+    arrayValue.add(v);
+    return this;
+  }
+
+  public boolean has(String key) {
+    return type == Type.OBJECT && objectValue.containsKey(key);
+  }
+
+  /** Member lookup; a NULL Json when absent (never Java null). */
+  public Json get(String key) {
+    if (type == Type.OBJECT) {
+      Json v = objectValue.get(key);
+      if (v != null) return v;
+    }
+    return ofNull();
+  }
+
+  public Json put(String key, Json v) {
+    objectValue.put(key, v);
+    return this;
+  }
+
+  public Map<String, Json> members() { return objectValue; }
+
+  // -- writer --------------------------------------------------------------
+
+  public String dump() {
+    StringBuilder sb = new StringBuilder();
+    write(sb);
+    return sb.toString();
+  }
+
+  private void write(StringBuilder sb) {
+    switch (type) {
+      case NULL: sb.append("null"); break;
+      case BOOL: sb.append(boolValue); break;
+      case NUMBER:
+        if (numberValue == Math.floor(numberValue)
+            && !Double.isInfinite(numberValue)
+            && Math.abs(numberValue) < 9.007199254740992E15) {
+          sb.append((long) numberValue);
+        } else {
+          sb.append(numberValue);
+        }
+        break;
+      case STRING: writeString(sb, stringValue); break;
+      case ARRAY: {
+        sb.append('[');
+        for (int i = 0; i < arrayValue.size(); i++) {
+          if (i > 0) sb.append(',');
+          arrayValue.get(i).write(sb);
+        }
+        sb.append(']');
+        break;
+      }
+      case OBJECT: {
+        sb.append('{');
+        boolean first = true;
+        for (Map.Entry<String, Json> e : objectValue.entrySet()) {
+          if (!first) sb.append(',');
+          first = false;
+          writeString(sb, e.getKey());
+          sb.append(':');
+          e.getValue().write(sb);
+        }
+        sb.append('}');
+        break;
+      }
+    }
+  }
+
+  private static void writeString(StringBuilder sb, String s) {
+    sb.append('"');
+    for (int i = 0; i < s.length(); i++) {
+      char c = s.charAt(i);
+      switch (c) {
+        case '"': sb.append("\\\""); break;
+        case '\\': sb.append("\\\\"); break;
+        case '\n': sb.append("\\n"); break;
+        case '\r': sb.append("\\r"); break;
+        case '\t': sb.append("\\t"); break;
+        default:
+          if (c < 0x20) {
+            sb.append(String.format("\\u%04x", (int) c));
+          } else {
+            sb.append(c);
+          }
+      }
+    }
+    sb.append('"');
+  }
+
+  // -- parser --------------------------------------------------------------
+
+  public static Json parse(String text) throws InferenceServerException {
+    Parser p = new Parser(text);
+    Json value = p.parseValue();
+    p.skipWhitespace();
+    if (!p.atEnd()) {
+      throw new InferenceServerException("trailing JSON content at " + p.pos);
+    }
+    return value;
+  }
+
+  private static final class Parser {
+    private final String text;
+    private int pos = 0;
+
+    Parser(String text) { this.text = text; }
+
+    boolean atEnd() { return pos >= text.length(); }
+
+    void skipWhitespace() {
+      while (pos < text.length() && Character.isWhitespace(text.charAt(pos))) {
+        pos++;
+      }
+    }
+
+    char peek() throws InferenceServerException {
+      if (atEnd()) throw new InferenceServerException("truncated JSON");
+      return text.charAt(pos);
+    }
+
+    void expect(char c) throws InferenceServerException {
+      if (atEnd() || text.charAt(pos) != c) {
+        throw new InferenceServerException(
+            "expected '" + c + "' at offset " + pos);
+      }
+      pos++;
+    }
+
+    Json parseValue() throws InferenceServerException {
+      skipWhitespace();
+      char c = peek();
+      switch (c) {
+        case '{': return parseObject();
+        case '[': return parseArray();
+        case '"': return Json.of(parseString());
+        case 't': expectWord("true"); return Json.of(true);
+        case 'f': expectWord("false"); return Json.of(false);
+        case 'n': expectWord("null"); return Json.ofNull();
+        default: return parseNumber();
+      }
+    }
+
+    void expectWord(String word) throws InferenceServerException {
+      if (!text.startsWith(word, pos)) {
+        throw new InferenceServerException("bad JSON literal at " + pos);
+      }
+      pos += word.length();
+    }
+
+    Json parseObject() throws InferenceServerException {
+      expect('{');
+      Json obj = Json.object();
+      skipWhitespace();
+      if (peek() == '}') {
+        pos++;
+        return obj;
+      }
+      while (true) {
+        skipWhitespace();
+        String key = parseString();
+        skipWhitespace();
+        expect(':');
+        obj.put(key, parseValue());
+        skipWhitespace();
+        char c = peek();
+        pos++;
+        if (c == '}') return obj;
+        if (c != ',') {
+          throw new InferenceServerException("expected ',' or '}' at " + pos);
+        }
+      }
+    }
+
+    Json parseArray() throws InferenceServerException {
+      expect('[');
+      Json arr = Json.array();
+      skipWhitespace();
+      if (peek() == ']') {
+        pos++;
+        return arr;
+      }
+      while (true) {
+        arr.append(parseValue());
+        skipWhitespace();
+        char c = peek();
+        pos++;
+        if (c == ']') return arr;
+        if (c != ',') {
+          throw new InferenceServerException("expected ',' or ']' at " + pos);
+        }
+      }
+    }
+
+    String parseString() throws InferenceServerException {
+      expect('"');
+      StringBuilder sb = new StringBuilder();
+      while (true) {
+        char c = peek();
+        pos++;
+        if (c == '"') return sb.toString();
+        if (c == '\\') {
+          char esc = peek();
+          pos++;
+          switch (esc) {
+            case '"': sb.append('"'); break;
+            case '\\': sb.append('\\'); break;
+            case '/': sb.append('/'); break;
+            case 'b': sb.append('\b'); break;
+            case 'f': sb.append('\f'); break;
+            case 'n': sb.append('\n'); break;
+            case 'r': sb.append('\r'); break;
+            case 't': sb.append('\t'); break;
+            case 'u': {
+              if (pos + 4 > text.length()) {
+                throw new InferenceServerException("truncated \\u escape");
+              }
+              int code = 0;
+              for (int k = 0; k < 4; k++) {
+                int digit = Character.digit(text.charAt(pos + k), 16);
+                if (digit < 0) {
+                  throw new InferenceServerException(
+                      "bad \\u escape at " + pos);
+                }
+                code = (code << 4) | digit;
+              }
+              sb.append((char) code);
+              pos += 4;
+              break;
+            }
+            default:
+              throw new InferenceServerException("bad escape at " + pos);
+          }
+        } else {
+          sb.append(c);
+        }
+      }
+    }
+
+    Json parseNumber() throws InferenceServerException {
+      int start = pos;
+      while (pos < text.length()
+          && "+-0123456789.eE".indexOf(text.charAt(pos)) >= 0) {
+        pos++;
+      }
+      try {
+        return Json.of(Double.parseDouble(text.substring(start, pos)));
+      } catch (NumberFormatException e) {
+        throw new InferenceServerException("bad JSON number at " + start);
+      }
+    }
+  }
+}

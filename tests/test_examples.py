@@ -23,7 +23,7 @@ def servers():
         yield h, g
 
 
-def _run(script, args, timeout=180):
+def _run(script, args, timeout=420):  # jit compiles ride CPU contention in CI
     env = dict(os.environ)
     # skip the TPU sitecustomize: examples must smoke-test on CPU jax
     env["PYTHONPATH"] = str(REPO)
