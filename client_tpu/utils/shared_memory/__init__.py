@@ -65,11 +65,45 @@ def attach_shared_memory(key: str) -> mpshm.SharedMemory:
 
 
 # Mappings whose close() failed because zero-copy numpy views still alias
-# them; kept referenced so the views stay valid, unmapped at process exit.
+# them; kept referenced so the views stay valid, retried on later closes
+# (most views die quickly — e.g. a server response that served a zero-copy
+# read), unmapped at process exit at the latest.
 _deferred_unmaps: List[mpshm.SharedMemory] = []
+_deferred_lock = threading.Lock()
+
+
+def _sweep_deferred() -> None:
+    """Retry deferred unmaps whose aliasing views have since died.
+
+    Without this, a register/read/unregister churn leaks one mapping + fd
+    per cycle (the 2026-07 soak hit EMFILE server-side after ~500 cycles):
+    each close() raised BufferError while the response still aliased the
+    buffer, and the mapping was parked forever. The views are dead by the
+    next cycle — so each sweep closes the previous casualties and the
+    steady state is O(live views), not O(cycles)."""
+    with _deferred_lock:
+        parked, _deferred_unmaps[:] = list(_deferred_unmaps), []
+    survivors = []
+    try:
+        for old in parked:
+            try:
+                # the instance's close was neutralized when parked; go
+                # through the class so the retry actually runs
+                mpshm.SharedMemory.close(old)
+            except BufferError:
+                survivors.append(old)
+            except Exception:
+                # half-closed mapping (e.g. os.close failing): parking it
+                # again keeps the retry path alive instead of dropping the
+                # fd on the floor — and the sweep stays best-effort
+                survivors.append(old)
+    finally:
+        with _deferred_lock:
+            _deferred_unmaps.extend(survivors)
 
 
 def _safe_close(shm: mpshm.SharedMemory, unlink: bool) -> None:
+    _sweep_deferred()
     if unlink:
         try:
             shm.unlink()
@@ -79,10 +113,12 @@ def _safe_close(shm: mpshm.SharedMemory, unlink: bool) -> None:
         shm.close()
     except BufferError:
         # np.frombuffer views over the mapping are still alive; the POSIX
-        # object is already unlinked (if owned) — defer the unmap to process
-        # exit and neutralize __del__'s retry so it can't raise again.
+        # object is already unlinked (if owned) — park the mapping so the
+        # views stay valid, neutralize __del__'s retry so it can't raise,
+        # and let a later sweep (or process exit) finish the unmap.
         shm.close = lambda: None
-        _deferred_unmaps.append(shm)
+        with _deferred_lock:
+            _deferred_unmaps.append(shm)
 
 
 class SharedMemoryRegion:

@@ -169,3 +169,50 @@ def test_transfer_timers_captured():
         assert d["cumulative_h2d_time_ns"] > 0
     finally:
         tpushm.destroy_shared_memory_region(region)
+
+
+def test_attach_detach_churn_releases_fds():
+    """Server-style attach/read/detach cycles must not accumulate mappings
+    or fds (the 600s churn soak hit EMFILE before the deferred-unmap sweep
+    existed: every cycle parked one BufferError'd mapping forever)."""
+    import os
+
+    import client_tpu.utils.tpu_shared_memory as tpushm
+    from client_tpu.utils.shared_memory import _deferred_unmaps
+    from client_tpu.utils.tpu_shared_memory import _lock, _registry
+
+    def fd_count():
+        return len(os.listdir("/proc/self/fd"))
+
+    region = tpushm.create_shared_memory_region("fd_churn", 1024)
+    handle = tpushm.get_raw_handle(region)
+    tpushm.set_shared_memory_region(region, [np.arange(16, dtype=np.int32)])
+    try:
+        # hold each cycle's zero-copy view ACROSS detach — close() then
+        # raises BufferError and the mapping parks, the exact shape that
+        # used to leak the fd forever; the next cycle's sweep must free it
+        live_view = None
+
+        def cycle():
+            nonlocal live_view
+            with _lock:
+                saved = _registry.pop(region.shm_key, None)
+            att = tpushm.attach_from_raw_handle(handle)
+            view = tpushm.get_contents_as_numpy(att, "INT32", [16])
+            att.detach()  # view still alive -> BufferError -> parked
+            live_view = view  # previous cycle's view dies here
+            with _lock:
+                if saved is not None:
+                    _registry[region.shm_key] = saved
+
+        for _ in range(5):
+            cycle()
+        before = fd_count()
+        for _ in range(100):
+            cycle()
+        after = fd_count()
+        assert live_view is not None
+        assert after - before <= 4, f"fd leak: {before} -> {after}"
+        assert len(_deferred_unmaps) <= 4, len(_deferred_unmaps)
+    finally:
+        tpushm.destroy_shared_memory_region(region)
