@@ -93,6 +93,15 @@ def test_memory_growth_example(servers):
     _run("memory_growth_test.py", ["-u", http_server.url, "-r", "200"])
 
 
+def test_native_grpc_example(servers):
+    from tests.test_native import _ensure_built
+
+    if not _ensure_built():
+        pytest.skip("native toolchain unavailable")
+    _, grpc_server = servers
+    _run("simple_native_grpc_client.py", ["-u", grpc_server.url])
+
+
 def test_image_client_example(servers):
     http_server, _ = servers
     _run("image_client.py", ["-u", http_server.url, "-c", "3"])
