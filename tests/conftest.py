@@ -29,3 +29,29 @@ except Exception:  # jax absent; env vars still pin cpu
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def native_built() -> bool:
+    """Build (or locate) the native library; shared by the native test tiers
+    so no test module needs to import another test module."""
+    import subprocess
+    from pathlib import Path
+
+    build = Path(__file__).resolve().parent.parent / "native" / "build"
+    targets = [build / "native_smoke", build / "libclient_tpu_http.so",
+               build / "hpack_tool"]
+    if all(t.exists() for t in targets):
+        return True
+    native = build.parent
+    try:
+        subprocess.run(
+            ["cmake", "-S", str(native), "-B", str(build), "-G", "Ninja"],
+            check=True, capture_output=True, timeout=120,
+        )
+        subprocess.run(
+            ["ninja", "-C", str(build)], check=True, capture_output=True,
+            timeout=300,
+        )
+        return True
+    except Exception:
+        return False

@@ -94,9 +94,9 @@ def test_memory_growth_example(servers):
 
 
 def test_native_grpc_example(servers):
-    from tests.test_native import _ensure_built
+    from tests.conftest import native_built
 
-    if not _ensure_built():
+    if not native_built():
         pytest.skip("native toolchain unavailable")
     _, grpc_server = servers
     _run("simple_native_grpc_client.py", ["-u", grpc_server.url])

@@ -15,22 +15,7 @@ SMOKE = BUILD / "native_smoke"
 LIB = BUILD / "libclient_tpu_http.so"
 
 
-def _ensure_built():
-    # hpack_tool is the newest target: its presence implies a fresh build
-    if SMOKE.exists() and LIB.exists() and (BUILD / "hpack_tool").exists():
-        return True
-    try:
-        subprocess.run(
-            ["cmake", "-S", str(NATIVE), "-B", str(BUILD), "-G", "Ninja"],
-            check=True, capture_output=True, timeout=120,
-        )
-        subprocess.run(
-            ["ninja", "-C", str(BUILD)], check=True, capture_output=True, timeout=300
-        )
-        return True
-    except Exception:
-        return False
-
+from tests.conftest import native_built as _ensure_built
 
 pytestmark = pytest.mark.skipif(
     not _ensure_built(), reason="native toolchain unavailable"

@@ -14,7 +14,7 @@ import time
 
 import pytest
 
-from tests.test_native import _ensure_built
+from tests.conftest import native_built as _ensure_built
 
 pytestmark = pytest.mark.skipif(
     not _ensure_built(), reason="native toolchain unavailable"
