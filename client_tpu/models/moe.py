@@ -1,0 +1,108 @@
+"""Mixture-of-experts model served behind the v2 protocol.
+
+The expert-parallel twin of ``long_context.py`` (which serves the
+sequence-parallel families): a top-1 routed MoE FFN whose expert weights
+shard over the device mesh, with tokens dispatched over ``all_to_all``
+(``parallel/moe.py``). Fixture contract, seeded weights — exercises ep in
+serving, not a trained model.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .base import Model, TensorSpec
+
+
+class MoEFFNModel(Model):
+    """``moe_ffn``: FP32 [tokens, dim] -> routed expert outputs, same shape.
+
+    ``tokens`` must divide by the mesh axis size (the dispatch shards the
+    token dim); experts divide the axis by construction.
+    """
+
+    name = "moe_ffn"
+    platform = "jax_moe_ep"
+
+    def __init__(
+        self, dim: int = 32, hidden: int = 64, experts_per_device: int = 2,
+        seed: int = 0, n_devices: int = 0,
+    ):
+        super().__init__()
+        self._dim = dim
+        self._hidden = hidden
+        self._experts_per_device = experts_per_device
+        self._seed = seed
+        self._n_devices = n_devices
+        self._lock = threading.Lock()
+        self._built = None
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("tokens", "FP32", [-1, self._dim])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [TensorSpec("routed", "FP32", [-1, self._dim])]
+
+    def _ensure_built(self):
+        with self._lock:
+            if self._built is not None:
+                return self._built
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            from ..parallel.moe import moe_ffn
+
+            available = len(jax.devices())
+            n = self._n_devices or available
+            if n > available:
+                raise ValueError(
+                    f"requested {n} devices but only {available} available"
+                )
+            mesh = Mesh(
+                np.array(jax.devices()[:n]).reshape(1, n), ("data", "model")
+            )
+            n_experts = self._experts_per_device * n
+            rng = jax.random.PRNGKey(self._seed)
+            kg, k1, k2 = jax.random.split(rng, 3)
+            scale = self._dim**-0.5
+            gate_w = jax.random.normal(
+                kg, (self._dim, n_experts), jnp.float32) * scale
+            w1 = jax.device_put(
+                jax.random.normal(
+                    k1, (n_experts, self._dim, self._hidden), jnp.float32
+                ) * scale,
+                NamedSharding(mesh, P("model", None, None)),
+            )
+            w2 = jax.device_put(
+                jax.random.normal(
+                    k2, (n_experts, self._hidden, self._dim), jnp.float32
+                ) * scale,
+                NamedSharding(mesh, P("model", None, None)),
+            )
+
+            def run(x):  # [tokens, dim] host array
+                tokens = jnp.asarray(x, jnp.float32)
+                sharded = jax.device_put(
+                    tokens, NamedSharding(mesh, P("model", None))
+                )
+                return moe_ffn(sharded, gate_w, w1, w2, mesh, axis="model")
+
+            self._built = (mesh, run)
+            return self._built
+
+    def execute(self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]):
+        mesh, run = self._ensure_built()
+        x = inputs["tokens"]
+        n = mesh.shape["model"]
+        if x.shape[0] % n != 0:
+            from ..server.core import InferError
+
+            raise InferError(
+                f"token count {x.shape[0]} must divide by the mesh axis "
+                f"size {n}", 400,
+            )
+        return {"routed": run(x)}

@@ -19,6 +19,11 @@ import numpy as np
 
 from .utils import InferenceServerException, np_to_triton_dtype
 
+# (user, InferResult*, error message or NULL) from the native stream reader
+STREAM_CALLBACK = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p
+)
+
 _LIB_PATHS = (
     os.path.join(os.path.dirname(__file__), "..", "native", "build", "libclient_tpu_http.so"),
     "libclient_tpu_http.so",
@@ -137,6 +142,14 @@ def _bind(lib):
     lib.ctpu_grpc_unregister_shm.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
     ]
+    lib.ctpu_grpc_start_stream.argtypes = [
+        ctypes.c_void_p, STREAM_CALLBACK, ctypes.c_void_p
+    ]
+    lib.ctpu_grpc_stream_infer.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+    ]
+    lib.ctpu_grpc_stop_stream.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -167,6 +180,73 @@ def available() -> bool:
 
 def _err(lib) -> str:
     return lib.ctpu_last_error().decode("utf-8", errors="replace")
+
+
+def _decode_result(lib, result_ptr, names=None):
+    """{output: np.ndarray} from a ctpu result handle.
+
+    ``names=None`` enumerates every output the server returned. Raises
+    InferenceServerException on accessor failures (both the blocking and
+    streaming paths share these semantics).
+    """
+    from .utils import deserialize_bytes_tensor, triton_to_np_dtype
+
+    decoded = {}
+    if names is None:
+        joined = lib.ctpu_result_output_names(result_ptr)
+        names = [n for n in (joined.decode().split("\n") if joined else []) if n]
+    for name in names:
+        buf = ctypes.c_void_p()
+        nbytes = ctypes.c_ulonglong()
+        if lib.ctpu_result_raw(
+            result_ptr, name.encode(), ctypes.byref(buf), ctypes.byref(nbytes)
+        ) != 0:
+            raise InferenceServerException(_err(lib))
+        dims = (ctypes.c_longlong * 16)()
+        ndim = lib.ctpu_result_shape(result_ptr, name.encode(), dims, 16)
+        if ndim < 0:
+            raise InferenceServerException(_err(lib))
+        shape = [dims[i] for i in range(ndim)]
+        datatype = lib.ctpu_result_datatype(result_ptr, name.encode()).decode()
+        raw = ctypes.string_at(buf, nbytes.value)
+        if datatype == "BYTES":
+            decoded[name] = deserialize_bytes_tensor(raw).reshape(shape)
+            continue
+        np_dtype = triton_to_np_dtype(datatype)
+        if np_dtype is None:
+            raise InferenceServerException(
+                f"output '{name}' has unknown datatype {datatype!r}"
+            )
+        decoded[name] = np.frombuffer(raw, dtype=np.dtype(np_dtype)).reshape(shape)
+    return decoded
+
+
+def _build_array_input(lib, name, value, keepalive):
+    """A ctpu input handle for a host array, BYTES-serialized when needed."""
+    from .utils import serialize_byte_tensor
+
+    arr = np.ascontiguousarray(value)
+    datatype = np_to_triton_dtype(arr.dtype)
+    if datatype is None:
+        raise InferenceServerException(
+            f"input '{name}' has unsupported dtype {arr.dtype}"
+        )
+    if datatype == "BYTES":
+        serialized = serialize_byte_tensor(arr)
+        payload = np.frombuffer(
+            serialized.item() if serialized.size else b"", dtype=np.uint8
+        )
+    else:
+        payload = arr
+    keepalive.append(payload)
+    dims = (ctypes.c_longlong * arr.ndim)(*arr.shape)
+    handle = lib.ctpu_input_create(
+        name.encode(), datatype.encode(), dims, arr.ndim
+    )
+    lib.ctpu_input_append_raw(
+        handle, payload.ctypes.data_as(ctypes.c_void_p), payload.nbytes
+    )
+    return handle
 
 
 class NativeClient:
@@ -282,32 +362,7 @@ class NativeClient:
                     )
                     lib.ctpu_input_set_shm(handle, region.encode(), nbytes, offset)
                 else:
-                    arr = np.ascontiguousarray(value)
-                    datatype = np_to_triton_dtype(arr.dtype)
-                    if datatype is None:
-                        raise InferenceServerException(
-                            f"input '{name}' has unsupported dtype {arr.dtype}"
-                        )
-                    if datatype == "BYTES":
-                        from .utils import serialize_byte_tensor
-
-                        serialized = serialize_byte_tensor(arr)
-                        payload = np.frombuffer(
-                            serialized.item() if serialized.size else b"",
-                            dtype=np.uint8,
-                        )
-                    else:
-                        payload = arr
-                    keepalive.append(payload)
-                    dims = (ctypes.c_longlong * arr.ndim)(*arr.shape)
-                    handle = lib.ctpu_input_create(
-                        name.encode(), datatype.encode(), dims, arr.ndim
-                    )
-                    lib.ctpu_input_append_raw(
-                        handle,
-                        payload.ctypes.data_as(ctypes.c_void_p),
-                        payload.nbytes,
-                    )
+                    handle = _build_array_input(lib, name, value, keepalive)
                 if not handle:
                     raise InferenceServerException(_err(lib))
                 in_handles.append(handle)
@@ -335,40 +390,11 @@ class NativeClient:
                     lib.ctpu_result_destroy(result_ptr)
                 raise InferenceServerException(_err(lib))
             try:
-                decoded = {}
-                if outputs is None:  # enumerate everything the server returned
-                    joined = lib.ctpu_result_output_names(result_ptr)
-                    names = joined.decode().split("\n") if joined else []
-                    names = [n for n in names if n]
-                else:
-                    names = out_names  # shm-placed outputs live in regions
-                for name in names:
-                    buf = ctypes.c_void_p()
-                    nbytes = ctypes.c_ulonglong()
-                    if lib.ctpu_result_raw(
-                        result_ptr, name.encode(), ctypes.byref(buf),
-                        ctypes.byref(nbytes),
-                    ) != 0:
-                        raise InferenceServerException(_err(lib))
-                    dims = (ctypes.c_longlong * 16)()
-                    ndim = lib.ctpu_result_shape(result_ptr, name.encode(), dims, 16)
-                    if ndim < 0:
-                        raise InferenceServerException(_err(lib))
-                    shape = [dims[i] for i in range(ndim)]
-                    datatype = lib.ctpu_result_datatype(result_ptr, name.encode()).decode()
-                    raw = ctypes.string_at(buf, nbytes.value)
-                    if datatype == "BYTES":
-                        from .utils import deserialize_bytes_tensor
-
-                        decoded[name] = deserialize_bytes_tensor(raw).reshape(shape)
-                        continue
-                    np_dtype = triton_to_np_dtype(datatype)
-                    if np_dtype is None:
-                        raise InferenceServerException(
-                            f"output '{name}' has unknown datatype {datatype!r}"
-                        )
-                    decoded[name] = np.frombuffer(raw, dtype=np.dtype(np_dtype)).reshape(shape)
-                return decoded
+                # shm-placed outputs live in regions; with explicit outputs
+                # only the non-shm names decode
+                return _decode_result(
+                    lib, result_ptr, None if outputs is None else out_names
+                )
             finally:
                 lib.ctpu_result_destroy(result_ptr)
         finally:
@@ -406,7 +432,11 @@ class NativeGrpcClient(NativeClient):
 
     Same value-model ``infer`` surface as :class:`NativeClient`; the wire
     underneath is hand-framed gRPC over the library's own HTTP/2
-    (native/src/grpc_client.cc, native/src/h2.cc).
+    (native/src/grpc_client.cc, native/src/h2.cc). Bi-di streaming mirrors
+    the Python grpc client: ``start_stream(callback)`` /
+    ``stream_infer(...)`` / ``stop_stream()`` with ``callback(outputs,
+    error)`` fired from the native reader thread (outputs is a
+    ``{name: np.ndarray}`` dict, or None with an error string).
     """
 
     _FN = {
@@ -419,6 +449,82 @@ class NativeGrpcClient(NativeClient):
         "register_tpu_shm": "ctpu_grpc_register_tpu_shm",
         "unregister_shm": "ctpu_grpc_unregister_shm",
     }
+
+    # -- bi-di streaming ---------------------------------------------------
+    def start_stream(self, callback) -> None:
+        """Open the ModelStreamInfer stream; ``callback(outputs, error)``
+        per response from the native reader thread."""
+        lib = self._lib
+        if getattr(self, "_stream_cb", None) is not None:
+            # never clobber a live trampoline: the active stream's reader
+            # still holds its function pointer
+            raise InferenceServerException(
+                "cannot start a stream: one is already active; stop it first"
+            )
+
+        def on_response(_user, result_ptr, error_message):
+            try:
+                if error_message is not None:
+                    callback(None, error_message.decode("utf-8", "replace"))
+                    return
+                try:
+                    decoded = _decode_result(lib, result_ptr) if result_ptr else {}
+                except InferenceServerException as e:
+                    callback(None, str(e))
+                    return
+                callback(decoded, None)
+            finally:
+                if result_ptr:
+                    lib.ctpu_result_destroy(result_ptr)
+
+        # keep the CFUNCTYPE alive for the stream's lifetime
+        trampoline = STREAM_CALLBACK(on_response)
+        if lib.ctpu_grpc_start_stream(self._handle, trampoline, None) != 0:
+            raise InferenceServerException(_err(lib))
+        self._stream_cb = trampoline
+
+    def stream_infer(self, model_name: str, inputs, sequence=None) -> None:
+        """Send one request on the open stream. ``inputs``: list of
+        (name, np.ndarray)."""
+        lib = self._lib
+        in_handles = []
+        keepalive = []
+        options = lib.ctpu_options_create(model_name.encode())
+        try:
+            if sequence is not None:
+                seq_id, start, end = sequence
+                lib.ctpu_options_set_sequence(options, seq_id, int(start), int(end))
+            for name, value in inputs:
+                in_handles.append(
+                    _build_array_input(lib, name, value, keepalive)
+                )
+            ins = (ctypes.c_void_p * len(in_handles))(*in_handles)
+            # the native client serializes the request before returning, so
+            # the input handles (and numpy buffers) may be freed right after
+            if lib.ctpu_grpc_stream_infer(
+                self._handle, options, ins, len(in_handles), None, 0
+            ) != 0:
+                raise InferenceServerException(_err(lib))
+        finally:
+            for handle in in_handles:
+                lib.ctpu_input_destroy(handle)
+            lib.ctpu_options_destroy(options)
+
+    def stop_stream(self) -> None:
+        if getattr(self, "_stream_cb", None) is None:
+            return
+        rc = self._lib.ctpu_grpc_stop_stream(self._handle)
+        self._stream_cb = None
+        if rc != 0:
+            raise InferenceServerException(_err(self._lib))
+
+    def close(self) -> None:
+        if self._handle and getattr(self, "_stream_cb", None) is not None:
+            try:
+                self.stop_stream()
+            except InferenceServerException:
+                pass
+        super().close()
 
     def infer_raw(self, model_name, input_name, tensor, output_name,
                   output_dtype=None, output_capacity=None):

@@ -33,6 +33,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--identity-fp32", action="store_true",
                         help="also serve a dynamic-shape FP32 identity model")
     parser.add_argument(
+        "--long-context", action="store_true",
+        help="also serve the ring/ulysses long_context_encoder (sp)",
+    )
+    parser.add_argument(
+        "--moe", action="store_true",
+        help="also serve the expert-parallel moe_ffn model (ep)",
+    )
+    parser.add_argument(
         "--http-frontend", choices=("threaded", "aio"), default="threaded",
         help="threaded: best single-client latency; aio: higher sustained "
         "rate and tighter p99 at many concurrent connections",
@@ -56,6 +64,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .models.ensemble import build_image_ensemble
 
         models.extend(build_image_ensemble(tensor_parallel=args.tensor_parallel))
+    if args.long_context:
+        from .models.long_context import LongContextEncoderModel
+
+        models.append(LongContextEncoderModel())
+    if args.moe:
+        from .models.moe import MoEFFNModel
+
+        models.append(MoEFFNModel())
     core = ServerCore(models)
 
     servers = []

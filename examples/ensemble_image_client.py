@@ -32,7 +32,11 @@ def main():
         print("no image supplied; classifying random noise")
         img = np.random.default_rng(0).integers(0, 256, (300, 400, 3)).astype(np.uint8)
 
-    with clientmod.InferenceServerClient(args.url) as client:
+    # first request pays the XLA compile of both ensemble stages: give the
+    # http read timeout room (a stock tritonserver compiles at load, not
+    # request); the grpc client has no read deadline by default
+    kwargs = {"network_timeout": 300.0} if args.protocol == "http" else {}
+    with clientmod.InferenceServerClient(args.url, **kwargs) as client:
         if not client.is_model_ready("ensemble_image"):
             sys.exit("model 'ensemble_image' not ready (serve with --vision)")
         inp = clientmod.InferInput("IMAGE", list(img.shape), "UINT8")

@@ -98,7 +98,8 @@ def main():
 
         url = args.url or "localhost:8001"
 
-    with clientmod.InferenceServerClient(url) as client:
+    kwargs = {"network_timeout": 300.0} if args.protocol.lower() == "http" else {}
+    with clientmod.InferenceServerClient(url, **kwargs) as client:
         metadata = client.get_model_metadata(args.model_name)
         config = client.get_model_config(args.model_name)
         input_name, output_name, fmt, c, h, w, dtype = parse_model(metadata, config)

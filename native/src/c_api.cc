@@ -397,6 +397,41 @@ int ctpu_grpc_unregister_shm(
   return -1;
 }
 
+// grpc bi-di streaming: callback receives (user, result, error_message);
+// result may be null on stream errors and must be freed by the callee via
+// ctpu_result_destroy when non-null. error_message is valid only for the
+// duration of the call.
+typedef void (*ctpu_stream_callback)(
+    void* user, void* result, const char* error_message);
+
+int ctpu_grpc_start_stream(
+    void* client, ctpu_stream_callback callback, void* user) {
+  return SetError(
+      static_cast<InferenceServerGrpcClient*>(client)->StartStream(
+          [callback, user](InferResult* result, const Error& err) {
+            callback(user, result, err.IsOk() ? nullptr : err.Message().c_str());
+          }));
+}
+
+int ctpu_grpc_stream_infer(
+    void* client, void* options, void** inputs, int n_inputs, void** outputs,
+    int n_outputs) {
+  std::vector<InferInput*> ins(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) ins[i] = static_cast<InferInput*>(inputs[i]);
+  std::vector<const InferRequestedOutput*> outs(n_outputs);
+  for (int i = 0; i < n_outputs; ++i) {
+    outs[i] = static_cast<const InferRequestedOutput*>(outputs[i]);
+  }
+  return SetError(
+      static_cast<InferenceServerGrpcClient*>(client)->AsyncStreamInfer(
+          *static_cast<InferOptions*>(options), ins, outs));
+}
+
+int ctpu_grpc_stop_stream(void* client) {
+  return SetError(
+      static_cast<InferenceServerGrpcClient*>(client)->StopStream());
+}
+
 // -- tpu shm regions ---------------------------------------------------------
 
 void* ctpu_shm_create(const char* name, unsigned long long byte_size, int device_id) {

@@ -374,3 +374,35 @@ def test_moe_capacity_drops_are_bounded_not_wrong():
     zeros = (got == 0).all(axis=-1)
     assert (matches | zeros).all()
     assert matches.sum() > 0  # capacity=2 still serves some tokens
+
+
+def test_moe_ffn_served():
+    """Expert-parallel MoE behind the v2 protocol over the 8-device mesh."""
+    import jax
+
+    import client_tpu.http as httpclient
+    from client_tpu.models.moe import MoEFFNModel
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.utils import InferenceServerException
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    core = ServerCore([MoEFFNModel(dim=16, hidden=32)])
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            md = client.get_model_metadata("moe_ffn")
+            assert md["platform"] == "jax_moe_ep"
+            tokens = np.random.default_rng(1).standard_normal((64, 16)).astype(np.float32)
+            inp = httpclient.InferInput("tokens", [64, 16], "FP32")
+            inp.set_data_from_numpy(tokens)
+            out = client.infer("moe_ffn", [inp]).as_numpy("routed")
+            assert out.shape == (64, 16)
+            assert np.isfinite(out).all()
+            # deterministic across calls
+            out2 = client.infer("moe_ffn", [inp]).as_numpy("routed")
+            np.testing.assert_array_equal(out, out2)
+            # indivisible token counts are a 400, not a 500
+            bad = httpclient.InferInput("tokens", [63, 16], "FP32")
+            bad.set_data_from_numpy(tokens[:63])
+            with pytest.raises(InferenceServerException, match="divide"):
+                client.infer("moe_ffn", [bad])

@@ -357,3 +357,39 @@ def test_native_leak_check(server, grpc_server):
     assert proc.returncode == 0, f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
     assert "PASS leak_test" in proc.stdout
     assert "LeakSanitizer" not in proc.stderr, proc.stderr
+
+
+def test_ctypes_grpc_streaming(grpc_server):
+    """Bi-di streaming through the ctypes binding: a stateful sequence
+    accumulates across stream messages, callbacks fire from the native
+    reader thread."""
+    import queue
+
+    from client_tpu.native import NativeGrpcClient
+
+    results = queue.Queue()
+    with NativeGrpcClient(grpc_server.url) as client:
+        client.start_stream(lambda outputs, error: results.put((outputs, error)))
+        for i, (start, end) in enumerate([(True, False), (False, False), (False, True)]):
+            client.stream_infer(
+                "simple_sequence",
+                [("INPUT", np.array([[4]], dtype=np.int32))],
+                sequence=(515, start, end),
+            )
+        sums = []
+        for _ in range(3):
+            outputs, error = results.get(timeout=30)
+            assert error is None, error
+            sums.append(int(outputs["OUTPUT"][0, 0]))
+        assert sums == [4, 8, 12]
+        client.stop_stream()
+        # restartable: a second stream on the same client works
+        client.start_stream(lambda outputs, error: results.put((outputs, error)))
+        client.stream_infer(
+            "simple_sequence",
+            [("INPUT", np.array([[7]], dtype=np.int32))],
+            sequence=(516, True, True),
+        )
+        outputs, error = results.get(timeout=30)
+        assert error is None and int(outputs["OUTPUT"][0, 0]) == 7
+        client.stop_stream()
