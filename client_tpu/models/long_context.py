@@ -9,6 +9,7 @@ data plane. On a single device the ring degenerates gracefully (one hop).
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Any, Dict, List
 
@@ -36,9 +37,9 @@ class LongContextEncoderModel(Model):
         (all-to-all head repartition, fewer collective steps; heads must
         divide the mesh), or "auto" (see parallel/ulysses.py)."""
         super().__init__()
-        if attention not in ("ring", "ulysses", "auto"):
+        if attention not in ("ring", "ulysses", "auto", "flash"):
             raise ValueError(
-                f"attention must be ring|ulysses|auto, got {attention!r}"
+                f"attention must be ring|ulysses|auto|flash, got {attention!r}"
             )
         self._dim = dim
         self._heads = heads
@@ -86,6 +87,8 @@ class LongContextEncoderModel(Model):
             heads = self._heads
             head_dim = self._dim // heads
 
+            attention_mode = self._attention
+
             @jax.jit  # one compile per sequence length, then cached
             def encode(xb):  # [1, seq, dim] device array
                 seq = xb.shape[1]
@@ -93,14 +96,29 @@ class LongContextEncoderModel(Model):
                 def project(w):
                     return (xb @ w).reshape(1, seq, heads, head_dim)
 
-                out = sequence_parallel_attention(
-                    project(wq), project(wk), project(wv), mesh, axis="data",
-                    mode=self._attention,
-                )
+                if attention_mode == "flash":
+                    # single-device blocked kernel (Pallas); no mesh hop
+                    from ..ops.flash_attention import flash_attention
+
+                    block = 128 if seq % 128 == 0 else math.gcd(seq, 128)
+                    out = flash_attention(
+                        project(wq), project(wk), project(wv),
+                        block_q=block, block_k=block,
+                    )
+                else:
+                    out = sequence_parallel_attention(
+                        project(wq), project(wk), project(wv), mesh,
+                        axis="data", mode=attention_mode,
+                    )
                 return (out.reshape(1, seq, self._dim) @ wo)[0]
 
             def run(x):  # [seq, dim] host array
-                xb = place_sharded(jnp.asarray(x, jnp.float32)[None], mesh)
+                xb = jnp.asarray(x, jnp.float32)[None]
+                if attention_mode != "flash":
+                    # the mesh schemes want the sequence sharded; flash is
+                    # single-device — placing it on the mesh would just make
+                    # XLA all-gather it back per request
+                    xb = place_sharded(xb, mesh)
                 return encode(xb)
 
             self._built = (mesh, run)

@@ -185,3 +185,11 @@ def dequantize_int8(q, scale: float, out_dtype=jnp.float32):
         out_shape=jax.ShapeDtypeStruct(q.shape, out_dtype),
         interpret=not _on_tpu(),
     )(q)
+
+
+def flash_attention(q, k, v, causal: bool = False, **kwargs):
+    """Blocked online-softmax attention (Pallas kernel; see
+    ops/flash_attention.py)."""
+    from .flash_attention import flash_attention as impl
+
+    return impl(q, k, v, causal=causal, **kwargs)

@@ -1,0 +1,144 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The single-device hot-loop counterpart of the distributed schemes in
+``parallel/`` (ring rotates K/V across chips; Ulysses re-partitions heads;
+THIS kernel is what each device should run on its local blocks): blocked
+online-softmax attention that never materializes the [seq, seq] score
+matrix. VMEM holds one Q block plus running (max, sum, accumulator) state
+while K/V blocks stream through; the K-block grid axis is sequential on
+TPU ("arbitrary" dimension semantics), which is exactly what the carried
+scratch state needs.
+
+Runs in interpret mode off-TPU (CI exactness tests vs dense attention);
+compiled to Mosaic on the chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import _on_tpu
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, block_q, block_k, nk,
+):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: K blocks strictly above the diagonal contribute nothing — and
+    # with sequential K iteration the whole block body can be skipped
+    run_block = jnp.logical_or(
+        jnp.logical_not(causal), ik * block_k <= iq * block_q + block_q - 1
+    )
+
+    @pl.when(run_block)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0].astype(jnp.float32)            # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                    # [bq, bk]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_prev = m_scr[...]                          # [bq, 128] broadcast lanes
+        l_prev = l_scr[...]
+        m_cur = s.max(-1)                            # [bq]
+        m_new = jnp.maximum(m_prev, m_cur[:, None])
+        p = jnp.exp(s - m_new[:, :1])                # [bq, bk]
+        correction = jnp.exp(m_prev - m_new)         # [bq, 128]
+        l_scr[...] = l_prev * correction + p.sum(-1)[:, None]
+        acc_scr[...] = (
+            acc_scr[...] * correction[:, :1]
+            + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        m_scr[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[...][:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q, k, v, causal: bool = False, block_q: int = 128, block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Blocked attention. q,k,v: [batch, seq, heads, dim] -> same shape.
+
+    ``seq`` must divide by the block sizes (pad upstream); blocks default
+    to the MXU-native 128.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, seq, heads, dim = q.shape
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    if seq % block_q or seq % block_k:
+        raise ValueError(f"seq {seq} must divide by blocks {block_q}/{block_k}")
+    nq = seq // block_q
+    nk = seq // block_k
+    scale = dim ** -0.5
+
+    # [batch, seq, heads, dim] -> [batch*heads, seq, dim] kernel layout
+    def to_bh(t):
+        return jnp.transpose(t, (0, 2, 1, 3)).reshape(batch * heads, seq, dim)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(batch * heads, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dim), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, seq, dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (lanes bcast)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, dim), jnp.float32),  # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=not _on_tpu() if interpret is None else interpret,
+    )(qb, kb, vb)
+
+    return jnp.transpose(
+        out.reshape(batch, heads, seq, dim), (0, 2, 1, 3)
+    )

@@ -15,22 +15,30 @@ single chip's HBM. Exact (matches full attention to numerical tolerance).
 from __future__ import annotations
 
 
-def full_attention(q, k, v):
+def full_attention(q, k, v, causal: bool = False):
     """Reference dense attention. q,k,v: [batch, seq, heads, dim]."""
     import jax.numpy as jnp
 
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        seq = q.shape[1]
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
     probs = jnp.exp(scores - scores.max(-1, keepdims=True))
     probs = probs / probs.sum(-1, keepdims=True)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def ring_attention(q, k, v, mesh, axis: str = "data"):
+def ring_attention(q, k, v, mesh, axis: str = "data", causal: bool = False):
     """Exact attention with the sequence axis sharded over ``axis``.
 
     q, k, v: [batch, seq, heads, dim]; seq must divide by the axis size.
-    Returns [batch, seq, heads, dim] with the same sharding.
+    Returns [batch, seq, heads, dim] with the same sharding. ``causal``
+    masks at block granularity: a K/V block strictly after the query block
+    contributes nothing, the diagonal block applies the in-block triangle —
+    the standard causal-ring formulation (the compute for skipped blocks
+    still rotates; a production kernel would also skip the FLOPs).
     """
     import jax.numpy as jnp
     from jax import lax, shard_map  # requires the jax that also has lax.pvary
@@ -45,6 +53,7 @@ def ring_attention(q, k, v, mesh, axis: str = "data"):
     def block(q_blk, k_blk, v_blk):
         # q_blk/k_blk/v_blk: the local [batch, seq/n, heads, dim] shards
         batch, sq, heads, dim = q_blk.shape
+        my_index = lax.axis_index(axis)
 
         def scores_of(k_cur):
             return jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_cur) * scale
@@ -63,6 +72,14 @@ def ring_attention(q, k, v, mesh, axis: str = "data"):
                 (k_cur, v_cur),
             )
             s = scores_of(k_cur)  # [b, h, sq, sk]
+            if causal:
+                # after i hops this device holds the block that started at
+                # device (my_index - i) mod n
+                kv_index = (my_index - i) % n
+                q_pos = my_index * sq + jnp.arange(sq)
+                k_pos = kv_index * sq + jnp.arange(sq)
+                allowed = q_pos[:, None] >= k_pos[None, :]  # [sq, sk]
+                s = jnp.where(allowed[None, None], s, -jnp.inf)
             m_new = jnp.maximum(m, s.max(-1))
             p = jnp.exp(s - m_new[..., None])
             correction = jnp.exp(m - m_new)
@@ -84,7 +101,9 @@ def ring_attention(q, k, v, mesh, axis: str = "data"):
             jnp.arange(n),
         )
         del k_fin, v_fin
-        out = acc / l[..., None]
+        # causal first row(s) see at least the diagonal block, so l > 0 for
+        # every query; keep the guard for numerical robustness anyway
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
         return jnp.transpose(out, (0, 2, 1, 3)).astype(q_blk.dtype)
 
     spec = P(None, axis, None, None)

@@ -19,12 +19,14 @@ Both are exact. ``sequence_parallel_attention`` picks per call.
 from __future__ import annotations
 
 
-def ulysses_attention(q, k, v, mesh, axis: str = "data"):
+def ulysses_attention(q, k, v, mesh, axis: str = "data", causal: bool = False):
     """Exact attention with the sequence axis sharded over ``axis``.
 
     q, k, v: [batch, seq, heads, dim]; ``heads`` must divide by the axis
     size (and ``seq`` by it too, as it arrives sharded). Returns the same
-    sharding as the inputs.
+    sharding as the inputs. ``causal`` is free here: after the all-to-all
+    each device holds the full sequence, so the mask is the ordinary
+    lower triangle.
     """
     import jax.numpy as jnp
     from jax import lax, shard_map
@@ -54,7 +56,7 @@ def ulysses_attention(q, k, v, mesh, axis: str = "data"):
         q_full = scatter_heads(q_blk)
         k_full = scatter_heads(k_blk)
         v_full = scatter_heads(v_blk)
-        out = full_attention(q_full, k_full, v_full)  # dense on h/n heads
+        out = full_attention(q_full, k_full, v_full, causal=causal)
         return gather_heads(out)
 
     spec = P(None, axis, None, None)
@@ -63,7 +65,9 @@ def ulysses_attention(q, k, v, mesh, axis: str = "data"):
     )(q, k, v)
 
 
-def sequence_parallel_attention(q, k, v, mesh, axis: str = "data", mode: str = "auto"):
+def sequence_parallel_attention(
+    q, k, v, mesh, axis: str = "data", mode: str = "auto", causal: bool = False
+):
     """Dispatch between ring and Ulysses context parallelism.
 
     ``mode``: "ring", "ulysses", or "auto" — auto prefers Ulysses when the
@@ -74,9 +78,9 @@ def sequence_parallel_attention(q, k, v, mesh, axis: str = "data", mode: str = "
 
     n = mesh.shape[axis]
     if mode == "ring":
-        return ring_attention(q, k, v, mesh, axis)
+        return ring_attention(q, k, v, mesh, axis, causal=causal)
     if mode == "ulysses":
-        return ulysses_attention(q, k, v, mesh, axis)
+        return ulysses_attention(q, k, v, mesh, axis, causal=causal)
     if mode != "auto":
         raise ValueError(f"unknown sequence-parallel mode {mode!r}")
     heads_divide = q.shape[2] % n == 0
@@ -84,5 +88,5 @@ def sequence_parallel_attention(q, k, v, mesh, axis: str = "data", mode: str = "
     # head over every batch element, 2 * batch * h/n * seq^2 floats
     score_bytes = 2 * q.shape[0] * (q.shape[2] // max(n, 1)) * q.shape[1] ** 2 * 4
     if heads_divide and score_bytes < (1 << 30):
-        return ulysses_attention(q, k, v, mesh, axis)
-    return ring_attention(q, k, v, mesh, axis)
+        return ulysses_attention(q, k, v, mesh, axis, causal=causal)
+    return ring_attention(q, k, v, mesh, axis, causal=causal)
