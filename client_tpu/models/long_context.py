@@ -23,7 +23,8 @@ class LongContextEncoderModel(Model):
 
     One multi-head self-attention layer with fixed (seeded) projections —
     the fixture contract for exercising context parallelism, not a trained
-    model. ``seq`` must divide by the mesh's data-axis size.
+    model. ``seq`` must divide by the mesh's data-axis size (except in
+    flash mode, which handles arbitrary lengths on one device).
     """
 
     name = "long_context_encoder"
@@ -97,13 +98,12 @@ class LongContextEncoderModel(Model):
                     return (xb @ w).reshape(1, seq, heads, head_dim)
 
                 if attention_mode == "flash":
-                    # single-device blocked kernel (Pallas); no mesh hop
+                    # single-device blocked kernel (Pallas); no mesh hop.
+                    # arbitrary lengths: the kernel pads + masks internally
                     from ..ops.flash_attention import flash_attention
 
-                    block = 128 if seq % 128 == 0 else math.gcd(seq, 128)
                     out = flash_attention(
                         project(wq), project(wk), project(wv),
-                        block_q=block, block_k=block,
                     )
                 else:
                     out = sequence_parallel_attention(
@@ -128,7 +128,9 @@ class LongContextEncoderModel(Model):
         mesh, encode = self._ensure_built()
         x = np.asarray(inputs["sequence"], dtype=np.float32)
         n = mesh.shape["data"]
-        if x.shape[0] % n != 0:
+        # flash is single-device (pads + masks internally); only the mesh
+        # schemes shard the sequence and need the divisibility
+        if self._attention != "flash" and x.shape[0] % n != 0:
             raise ValueError(
                 f"sequence length {x.shape[0]} must divide by the mesh's "
                 f"data-axis size {n}"

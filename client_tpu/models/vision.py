@@ -22,7 +22,7 @@ import numpy as np
 from .base import Model, TensorSpec
 
 
-def _build_flax_model(num_classes: int, width: int = 32):
+def _build_flax_model(num_classes: int, width: int = 32, stages=(2, 2, 2)):
     import flax.linen as nn
     import jax.numpy as jnp
 
@@ -52,22 +52,23 @@ def _build_flax_model(num_classes: int, width: int = 32):
     class DenseNetish(nn.Module):
         num_classes: int
         width: int
+        stages: tuple = (2, 2, 2)
 
         @nn.compact
         def __call__(self, x):  # x: [N, H, W, C] bf16
             x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding="SAME",
                         use_bias=False, dtype=jnp.bfloat16)(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-            for i, layers in enumerate((2, 2, 2)):
-                x = DenseStage(growth=self.width * (2**i), layers=layers)(x)
+            for i, layers in enumerate(self.stages):
+                x = DenseStage(growth=self.width * (2**min(i, 2)), layers=layers)(x)
                 # transition: 1x1 squeeze + stride-2 pool
-                x = ConvBlock(self.width * (2**i))(x)
+                x = ConvBlock(self.width * (2**min(i, 2)))(x)
                 x = nn.avg_pool(x, (2, 2), strides=(2, 2))
             x = jnp.mean(x, axis=(1, 2))  # global average pool
             x = nn.Dense(self.num_classes, dtype=jnp.bfloat16)(x)
             return x.astype(jnp.float32)
 
-    return DenseNetish(num_classes=num_classes, width=width)
+    return DenseNetish(num_classes=num_classes, width=width, stages=tuple(stages))
 
 
 class ImagePreprocessModel(Model):
@@ -104,21 +105,30 @@ class DenseNetModel(Model):
     platform = "jax_flax"
     max_batch_size = 0  # fixture contract: one CHW image per request
 
+    # stage depths: "lite" is the CI/protocol-testing default; "121" is the
+    # densenet-121 layout (6/12/24/16 dense layers) for real-chip rounds
+    ARCHS = {"lite": (2, 2, 2), "121": (6, 12, 24, 16)}
+
     def __init__(
         self,
         num_classes: int = 1000,
         width: int = 32,
         seed: int = 0,
         tensor_parallel: int = 1,
+        arch: str = "lite",
     ):
         """``tensor_parallel > 1`` shards parameter output-feature axes over a
         (1, tp) device mesh; XLA inserts the collectives (serving-side scale,
-        no client change)."""
+        no client change). ``arch``: "lite" (default) or "121"
+        (densenet-121 stage depths — budget for the compile on CPU)."""
         super().__init__()
+        if arch not in self.ARCHS:
+            raise ValueError(f"arch must be one of {sorted(self.ARCHS)}")
         self._num_classes = num_classes
         self._width = width
         self._seed = seed
         self._tensor_parallel = tensor_parallel
+        self._stages = self.ARCHS[arch]
         self._lock = threading.Lock()
         self._module = None
         self._params = None
@@ -142,7 +152,9 @@ class DenseNetModel(Model):
             import jax
             import jax.numpy as jnp
 
-            self._module = _build_flax_model(self._num_classes, self._width)
+            self._module = _build_flax_model(
+                self._num_classes, self._width, self._stages
+            )
             rng = jax.random.PRNGKey(self._seed)
             dummy = jnp.zeros((1, 224, 224, 3), jnp.bfloat16)
             self._params = self._module.init(rng, dummy)

@@ -25,7 +25,7 @@ from . import _on_tpu
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, block_q, block_k, nk,
+    *, scale, causal, block_q, block_k, nk, kv_len,
 ):
     from jax.experimental import pallas as pl
 
@@ -53,12 +53,16 @@ def _flash_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                    # [bq, bk]
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        if kv_len is not None:
+            # padded tail keys (sequence rounded up to the block size)
+            # contribute nothing
+            s = jnp.where(k_pos < kv_len, s, -jnp.inf)
         if causal:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         m_prev = m_scr[...]                          # [bq, 128] broadcast lanes
@@ -93,15 +97,25 @@ def flash_attention(
 ):
     """Blocked attention. q,k,v: [batch, seq, heads, dim] -> same shape.
 
-    ``seq`` must divide by the block sizes (pad upstream); blocks default
-    to the MXU-native 128.
+    Sequences that don't divide by the block sizes are zero-padded up to
+    the next multiple and the padded keys masked in-kernel (exact results,
+    full-size blocks — never degrade the block to tiny grids). Blocks
+    default to the MXU-native 128.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    batch, seq, heads, dim = q.shape
-    block_q = min(block_q, seq)
-    block_k = min(block_k, seq)
+    batch, real_seq, heads, dim = q.shape
+    block_q = min(block_q, real_seq)
+    block_k = min(block_k, real_seq)
+    block = max(block_q, block_k)
+    seq = -(-real_seq // block) * block  # ceil to a common block multiple
+    kv_len = real_seq if seq != real_seq else None
+    if kv_len is not None:
+        pad = [(0, 0), (0, seq - real_seq), (0, 0), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
     if seq % block_q or seq % block_k:
         raise ValueError(f"seq {seq} must divide by blocks {block_q}/{block_k}")
     nq = seq // block_q
@@ -116,7 +130,7 @@ def flash_attention(
 
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, nk=nk,
+        block_q=block_q, block_k=block_k, nk=nk, kv_len=kv_len,
     )
     out = pl.pallas_call(
         kernel,
@@ -139,6 +153,5 @@ def flash_attention(
         interpret=not _on_tpu() if interpret is None else interpret,
     )(qb, kb, vb)
 
-    return jnp.transpose(
-        out.reshape(batch, heads, seq, dim), (0, 2, 1, 3)
-    )
+    result = jnp.transpose(out.reshape(batch, heads, seq, dim), (0, 2, 1, 3))
+    return result[:, :real_seq] if kv_len is not None else result

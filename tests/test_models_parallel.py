@@ -458,3 +458,42 @@ def test_long_context_encoder_flash_mode():
     out_ring = np.asarray(ring.execute({"sequence": x}, {})["encoded"])
     out_flash = np.asarray(flash.execute({"sequence": x}, {})["encoded"])
     np.testing.assert_allclose(out_flash, out_ring, atol=2e-5, rtol=2e-5)
+
+
+def test_densenet_arch_presets():
+    """Stage-depth presets: lite is the CI default, 121 the real-chip
+    densenet-121 layout; unknown archs fail at construction."""
+    from client_tpu.models.vision import DenseNetModel
+
+    m = DenseNetModel(num_classes=8, width=8)
+    out = m.execute({"data_0": np.zeros((3, 64, 64), np.float32)}, {})
+    assert out["fc6_1"].shape == (8, 1, 1)
+    assert DenseNetModel(arch="121")._stages == (6, 12, 24, 16)
+    with pytest.raises(ValueError, match="arch"):
+        DenseNetModel(arch="dense169")
+
+
+def test_flash_mode_arbitrary_sequence_lengths():
+    """Flash mode pads + masks internally: odd lengths match the dense
+    reference exactly and never shrink to degenerate blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    from client_tpu.models.long_context import LongContextEncoderModel
+    from client_tpu.ops.flash_attention import flash_attention
+    from client_tpu.parallel.ring import full_attention
+
+    # direct kernel: non-multiple lengths, causal and not
+    rng = jax.random.PRNGKey(13)
+    q = jax.random.normal(rng, (1, 100, 2, 16), jnp.float32)
+    for causal in (False, True):
+        got = np.asarray(flash_attention(q, q, q, causal=causal, block_q=64, block_k=64))
+        want = np.asarray(full_attention(q, q, q, causal=causal))
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    # served model: seq not divisible by the device count
+    flash = LongContextEncoderModel(dim=32, heads=4, attention="flash")
+    ring = LongContextEncoderModel(dim=32, heads=4, attention="ring", n_devices=1)
+    x = np.random.default_rng(5).standard_normal((100, 32)).astype(np.float32)
+    out_flash = np.asarray(flash.execute({"sequence": x}, {})["encoded"])
+    out_ring = np.asarray(ring.execute({"sequence": x}, {})["encoded"])
+    np.testing.assert_allclose(out_flash, out_ring, atol=2e-5, rtol=2e-5)
