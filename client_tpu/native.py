@@ -150,6 +150,12 @@ def _bind(lib):
         ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
     ]
     lib.ctpu_grpc_stop_stream.argtypes = [ctypes.c_void_p]
+    lib.ctpu_set_header.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
+    ]
+    lib.ctpu_grpc_set_header.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
+    ]
     return lib
 
 
@@ -263,6 +269,7 @@ class NativeClient:
         "register_system_shm": "ctpu_register_system_shm",
         "register_tpu_shm": "ctpu_register_tpu_shm",
         "unregister_shm": "ctpu_unregister_shm",
+        "set_header": "ctpu_set_header",
     }
 
     def __init__(self, url: str, verbose: bool = False):
@@ -283,6 +290,13 @@ class NativeClient:
 
     def __exit__(self, *exc):
         self.close()
+
+    def set_header(self, key: str, value: str) -> None:
+        """Attach ``key: value`` to every request (auth tokens etc. — the
+        native twin of the Python plugin hook)."""
+        getattr(self._lib, self._FN["set_header"])(
+            self._handle, key.encode(), value.encode()
+        )
 
     def is_server_live(self) -> bool:
         rc = getattr(self._lib, self._FN["live"])(self._handle)
@@ -448,6 +462,7 @@ class NativeGrpcClient(NativeClient):
         "register_system_shm": "ctpu_grpc_register_system_shm",
         "register_tpu_shm": "ctpu_grpc_register_tpu_shm",
         "unregister_shm": "ctpu_grpc_unregister_shm",
+        "set_header": "ctpu_grpc_set_header",
     }
 
     # -- bi-di streaming ---------------------------------------------------

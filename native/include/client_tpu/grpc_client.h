@@ -129,6 +129,13 @@ class InferenceServerGrpcClient {
 
   InferStat ClientInferStat();
 
+  // Headers attached to every RPC (merged under per-call headers).
+  void AddDefaultHeader(const std::string& key, const std::string& value) {
+    std::lock_guard<std::mutex> lock(default_headers_mutex_);
+    default_headers_[key] = value;
+  }
+
+
  private:
   InferenceServerGrpcClient(const std::string& url, bool verbose);
 
@@ -163,6 +170,10 @@ class InferenceServerGrpcClient {
 
   std::mutex stat_mutex_;
   InferStat infer_stat_;
+
+  std::mutex default_headers_mutex_;
+  Headers default_headers_;
+  Headers MergedHeaders(const Headers& headers);
 };
 
 }  // namespace client_tpu

@@ -18,6 +18,7 @@
 
 using CURL = void;
 using CURLM = void;
+struct curl_slist;
 
 namespace client_tpu {
 
@@ -25,6 +26,7 @@ class InferenceServerHttpClient {
  public:
   using OnComplete = std::function<void(InferResult*)>;
   using OnMultiComplete = std::function<void(std::vector<InferResult*>)>;
+  using Headers = std::map<std::string, std::string>;
 
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
@@ -108,6 +110,14 @@ class InferenceServerHttpClient {
 
   InferStat ClientInferStat();
 
+  // Headers sent with every request (auth tokens etc. — the role of the
+  // reference's per-call Headers param / the Python plugin hook).
+  void AddDefaultHeader(const std::string& key, const std::string& value) {
+    std::lock_guard<std::mutex> lock(headers_mutex_);
+    default_headers_[key] = value;
+  }
+
+
  private:
   InferenceServerHttpClient(const std::string& url, bool verbose);
 
@@ -143,6 +153,10 @@ class InferenceServerHttpClient {
 
   std::mutex stat_mutex_;
   InferStat infer_stat_;
+
+  std::mutex headers_mutex_;
+  Headers default_headers_;
+  struct curl_slist* DefaultHeaderList(struct curl_slist* list);
 };
 
 }  // namespace client_tpu

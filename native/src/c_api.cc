@@ -307,6 +307,11 @@ int ctpu_async_infer(
   return SetError(err);
 }
 
+int ctpu_set_header(void* client, const char* key, const char* value) {
+  static_cast<InferenceServerHttpClient*>(client)->AddDefaultHeader(key, value);
+  return 0;
+}
+
 // -- grpc client --------------------------------------------------------------
 // Same handle/value-model surface over InferenceServerGrpcClient; results
 // flow back through the shared ctpu_result_* accessors (InferResult is
@@ -384,6 +389,11 @@ int ctpu_grpc_register_tpu_shm(
   return SetError(
       static_cast<InferenceServerGrpcClient*>(client)->RegisterTpuSharedMemory(
           name, raw_handle, device_id, byte_size));
+}
+
+int ctpu_grpc_set_header(void* client, const char* key, const char* value) {
+  static_cast<InferenceServerGrpcClient*>(client)->AddDefaultHeader(key, value);
+  return 0;
 }
 
 int ctpu_grpc_unregister_shm(

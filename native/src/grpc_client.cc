@@ -901,6 +901,14 @@ struct InferenceServerGrpcClient::AsyncRequest {
   RequestTimers timers;
 };
 
+InferenceServerGrpcClient::Headers InferenceServerGrpcClient::MergedHeaders(
+    const Headers& headers) {
+  std::lock_guard<std::mutex> lock(default_headers_mutex_);
+  Headers merged = default_headers_;
+  for (const auto& kv : headers) merged[kv.first] = kv.second;
+  return merged;
+}
+
 std::unique_ptr<h2::Connection> InferenceServerGrpcClient::AcquireConnection(
     Error* err) {
   {
@@ -949,7 +957,7 @@ Error InferenceServerGrpcClient::Call(
   if (err) return err;
   h2::Connection::Response resp;
   err = conn->Request(
-      "/inference.GRPCInferenceService/" + method, GrpcRequestHeaders(headers),
+      "/inference.GRPCInferenceService/" + method, GrpcRequestHeaders(MergedHeaders(headers)),
       body, &resp, timeout_us == 0 ? 0 : static_cast<int64_t>(timeout_us / 1000));
   if (err) {
     // transport failure: the connection is not reusable
@@ -1477,7 +1485,7 @@ void InferenceServerGrpcClient::AsyncTransfer() {
     if (!err) {
       err = conn->Request(
           "/inference.GRPCInferenceService/" + request->method,
-          GrpcRequestHeaders(request->headers), request->body, &resp,
+          GrpcRequestHeaders(MergedHeaders(request->headers)), request->body, &resp,
           request->timeout_us == 0
               ? 0
               : static_cast<int64_t>(request->timeout_us / 1000));
@@ -1632,7 +1640,7 @@ Error InferenceServerGrpcClient::StartStream(
   if (err) return Error("[StatusCode.UNAVAILABLE] " + err.Message());
   err = ctx->conn->StreamOpen(
       "/inference.GRPCInferenceService/ModelStreamInfer",
-      GrpcRequestHeaders(headers), &ctx->stream_id);
+      GrpcRequestHeaders(MergedHeaders(headers)), &ctx->stream_id);
   if (err) return Error("[StatusCode.UNAVAILABLE] " + err.Message());
   ctx->callback = std::move(callback);
   ctx->timeout_us = stream_timeout_us;

@@ -374,6 +374,15 @@ class InferResultHttp : public InferResult {
 
 }  // namespace
 
+struct curl_slist* InferenceServerHttpClient::DefaultHeaderList(
+    struct curl_slist* list) {
+  std::lock_guard<std::mutex> lock(headers_mutex_);
+  for (const auto& kv : default_headers_) {
+    list = curl_slist_append(list, (kv.first + ": " + kv.second).c_str());
+  }
+  return list;
+}
+
 struct InferenceServerHttpClient::AsyncRequest {
   CURL* easy = nullptr;
   struct curl_slist* headers = nullptr;
@@ -447,7 +456,12 @@ Error InferenceServerHttpClient::Perform(
   curl_easy_reset(easy_);
   HeaderCapture capture;
   SetCommonOptions(easy_, url_ + "/" + path, body, response, &capture, 0);
+  struct curl_slist* headers = DefaultHeaderList(nullptr);
+  if (headers != nullptr) {
+    curl_easy_setopt(easy_, CURLOPT_HTTPHEADER, headers);
+  }
   CURLcode code = curl_easy_perform(easy_);
+  curl_slist_free_all(headers);
   if (code != CURLE_OK) {
     return Error(std::string("HTTP request failed: ") + curl_easy_strerror(code));
   }
@@ -746,7 +760,7 @@ Error InferenceServerHttpClient::Infer(
     curl_easy_reset(easy_);
     SetCommonOptions(
         easy_, uri, &body, &response, &capture, options.client_timeout_us);
-    struct curl_slist* headers = nullptr;
+    struct curl_slist* headers = DefaultHeaderList(nullptr);
     std::string hlen =
         "Inference-Header-Content-Length: " + std::to_string(header_length);
     headers = curl_slist_append(headers, hlen.c_str());
@@ -813,7 +827,8 @@ Error InferenceServerHttpClient::AsyncInfer(
       &request->capture, options.client_timeout_us);
   std::string hlen =
       "Inference-Header-Content-Length: " + std::to_string(header_length);
-  request->headers = curl_slist_append(nullptr, hlen.c_str());
+  request->headers = DefaultHeaderList(nullptr);
+  request->headers = curl_slist_append(request->headers, hlen.c_str());
   request->headers = curl_slist_append(
       request->headers, "Content-Type: application/octet-stream");
   request->headers = curl_slist_append(request->headers, "Expect:");

@@ -222,3 +222,39 @@ def test_truncated_grpc_frame():
         _expect_error(server.url, "truncated gRPC response frame")
     finally:
         server.close()
+
+
+def test_native_stream_survives_server_death():
+    """Killing the server mid-stream delivers an error callback and
+    stop_stream() returns promptly (the reader polls on a bounded deadline
+    instead of blocking forever)."""
+    import queue
+
+    import numpy as np
+
+    from client_tpu.models import default_model_zoo
+    from client_tpu.native import NativeGrpcClient
+    from client_tpu.server import GrpcInferenceServer, ServerCore
+
+    server = GrpcInferenceServer(ServerCore(default_model_zoo())).start()
+    results = queue.Queue()
+    client = NativeGrpcClient(server.url)
+    try:
+        client.start_stream(lambda outputs, error: results.put((outputs, error)))
+        client.stream_infer(
+            "simple_sequence", [("INPUT", np.array([[3]], dtype=np.int32))],
+            sequence=(777, True, False),
+        )
+        outputs, error = results.get(timeout=20)
+        assert error is None and int(outputs["OUTPUT"][0, 0]) == 3
+
+        server.stop(grace=0)
+        outputs, error = results.get(timeout=30)
+        assert outputs is None
+        assert error is not None and "UNAVAILABLE" in error, error
+
+        t0 = time.monotonic()
+        client.stop_stream()
+        assert time.monotonic() - t0 < 10, "stop_stream hung after server death"
+    finally:
+        client.close()
