@@ -228,6 +228,30 @@ def bench_densenet(http_client, grpc_client, httpclient, grpcclient):
     return out
 
 
+def bench_native(url):
+    """The C++ client's own wire-vs-tpu-shm race (native_bench), embedded
+    when the native build exists; {} otherwise."""
+    import subprocess
+
+    binary = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "native", "build",
+        "native_bench",
+    )
+    if not os.path.exists(binary):
+        return {}
+    try:
+        proc = subprocess.run(
+            # race the same payload as the Python headline (IDENTITY_SIZES[0])
+            [binary, str(IDENTITY_SIZES[0]), "50"], capture_output=True, text=True,
+            timeout=240, env={**os.environ, "CLIENT_TPU_TEST_URL": url},
+        )
+        if proc.returncode != 0:
+            return {"error": (proc.stderr or "")[-200:]}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"error": str(e)[:200]}
+
+
 # ---------------------------------------------------------------------------
 # accelerator init (hardened: retry with backoff, log the failure cause)
 # ---------------------------------------------------------------------------
@@ -324,6 +348,7 @@ def main():
                     _percentile(wire, 0.5),
                 )
         densenet = bench_densenet(client, grpc_client, httpclient, grpcclient)
+        native = bench_native(server.url)
     finally:
         client.close()
         grpc_client.close()
@@ -343,6 +368,7 @@ def main():
                 "width": DENSENET_WIDTH,
                 **densenet,
             },
+            "native_cpp_client": native,
         },
     }
     print(json.dumps(result))
