@@ -37,6 +37,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="also serve the ring/ulysses long_context_encoder (sp)",
     )
     parser.add_argument(
+        "--attention", choices=("ring", "ulysses", "auto", "flash"),
+        default="ring",
+        help="sequence-parallel scheme for --long-context (flash = the "
+        "single-device Pallas kernel)",
+    )
+    parser.add_argument(
         "--moe", action="store_true",
         help="also serve the expert-parallel moe_ffn model (ep)",
     )
@@ -67,7 +73,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.long_context:
         from .models.long_context import LongContextEncoderModel
 
-        models.append(LongContextEncoderModel())
+        models.append(LongContextEncoderModel(attention=args.attention))
     if args.moe:
         from .models.moe import MoEFFNModel
 

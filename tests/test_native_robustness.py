@@ -68,6 +68,12 @@ def _frame(ftype, flags, stream_id, payload=b""):
     )
 
 
+def _hpack_lit(name, value):
+    """Literal-without-indexing HPACK field (tiny names/values only)."""
+    return (b"\x00" + bytes((len(name),)) + name
+            + bytes((len(value),)) + value)
+
+
 def _read_preface_and_ack(conn):
     """Consume the client preface + SETTINGS, reply with our SETTINGS+ACK."""
     conn.settimeout(10)
@@ -199,21 +205,14 @@ def test_truncated_grpc_frame():
             conn.recv(65536)
         except socket.timeout:
             pass
-        # HEADERS: :status 200 via literal-without-indexing encoding
-        def lit(name, value):
-            out = b"\x00"
-            out += bytes((len(name),)) + name
-            out += bytes((len(value),)) + value
-            return out
-
-        block = b"\x88"  # indexed :status 200 (static table 8)
-        block += lit(b"content-type", b"application/grpc")
+        # HEADERS: :status 200 (static table 8) + content-type
+        block = b"\x88" + _hpack_lit(b"content-type", b"application/grpc")
         conn.sendall(_frame(0x1, 0x4, 1, block))  # END_HEADERS
         # DATA: frame header claims 100-byte message, delivers 4
         body = b"\x00" + struct.pack(">I", 100) + b"\x00" * 4
         conn.sendall(_frame(0x0, 0, 1, body))
         # trailers: grpc-status 0, END_STREAM
-        trailers = lit(b"grpc-status", b"0")
+        trailers = _hpack_lit(b"grpc-status", b"0")
         conn.sendall(_frame(0x1, 0x5, 1, trailers))
         time.sleep(1)
 
@@ -258,3 +257,54 @@ def test_native_stream_survives_server_death():
         assert time.monotonic() - t0 < 10, "stop_stream hung after server death"
     finally:
         client.close()
+
+
+def test_garbage_proto_payload_never_crashes():
+    """A well-formed h2+gRPC exchange whose protobuf payload is random
+    garbage must yield a typed error or an empty result — never a crash or
+    a hang (fuzzes InferResultGrpc::Parse end-to-end)."""
+    import random
+
+    from client_tpu.native import NativeGrpcClient
+    from client_tpu.utils import InferenceServerException
+
+    import numpy as np
+
+    rng = random.Random(1234)
+
+    def make_behavior(payload):
+        def behavior(conn):
+            _read_preface_and_ack(conn)
+            conn.settimeout(2)
+            try:
+                conn.recv(65536)
+            except socket.timeout:
+                pass
+
+            block = b"\x88" + _hpack_lit(b"content-type", b"application/grpc")
+            conn.sendall(_frame(0x1, 0x4, 1, block))
+            framed = b"\x00" + struct.pack(">I", len(payload)) + payload
+            conn.sendall(_frame(0x0, 0, 1, framed))
+            trailers = _hpack_lit(b"grpc-status", b"0")
+            conn.sendall(_frame(0x1, 0x5, 1, trailers))
+            time.sleep(0.5)
+
+        return behavior
+
+    for trial in range(8):
+        payload = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 300)))
+        server = _ByteServer(make_behavior(payload))
+        try:
+            with NativeGrpcClient(server.url) as client:
+                data = np.arange(4, dtype=np.int32).reshape(1, 4)
+                try:
+                    out = client.infer(
+                        "m", [("INPUT0", data)], client_timeout_s=10.0
+                    )
+                    # parsed "successfully": garbage decoded to an output set
+                    # (possibly empty) — acceptable, as long as nothing crashed
+                    assert isinstance(out, dict)
+                except InferenceServerException:
+                    pass  # typed rejection is the expected common case
+        finally:
+            server.close()
