@@ -17,6 +17,7 @@ import aiohttp
 from ..._base import InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
 from ...utils import InferenceServerException
+from .._client import InferenceServerClient as _SyncClient
 from .._infer_result import InferResult
 from .._utils import build_infer_body, compress_body, raise_if_error
 
@@ -230,6 +231,11 @@ class InferenceServerClient(InferenceServerClientBase):
         await self._shm_unregister("tpusharedmemory", name, headers, query_params)
 
     # -- inference ---------------------------------------------------------
+    # offline marshaling statics (same behavior as the sync client's —
+    # reference http/aio/__init__.py exposes them on the aio class too)
+    generate_request_body = staticmethod(_SyncClient.generate_request_body)
+    parse_response_body = staticmethod(_SyncClient.parse_response_body)
+
     async def infer(
         self,
         model_name: str,

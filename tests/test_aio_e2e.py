@@ -228,3 +228,28 @@ def test_grpc_aio_trace_settings_none_clears(servers):
             )
 
     asyncio.run(run())
+
+
+def test_http_aio_offline_marshaling_statics():
+    """The aio class exposes the same generate_request_body /
+    parse_response_body statics as the sync client (reference parity)."""
+    import client_tpu.http as syncclient
+    import client_tpu.http.aio as aioclient
+
+    a = np.arange(8, dtype=np.int32).reshape(1, 8)
+    inp = aioclient.InferInput("X", [1, 8], "INT32").set_data_from_numpy(a)
+    body, size = aioclient.InferenceServerClient.generate_request_body([inp])
+    body2, size2 = syncclient.InferenceServerClient.generate_request_body([inp])
+    assert bytes(body) == bytes(body2) and size == size2
+
+    from client_tpu.server.http_server import encode_infer_response
+
+    resp, json_size = encode_infer_response(
+        {"model_name": "m", "model_version": "1",
+         "outputs": [{"name": "X", "datatype": "INT32", "shape": [1, 8], "array": a}]},
+        None, True,
+    )
+    result = aioclient.InferenceServerClient.parse_response_body(
+        bytes(resp), header_length=json_size
+    )
+    np.testing.assert_array_equal(result.as_numpy("X"), a)
