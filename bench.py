@@ -253,54 +253,42 @@ def bench_native(url):
 
 
 # ---------------------------------------------------------------------------
-# accelerator init (hardened: retry with backoff, log the failure cause)
+# accelerator init (stage-split probe: names the exact stage that hung)
 # ---------------------------------------------------------------------------
 
 
-def _probe_accelerator(attempts: int = 2, timeout_s: int = 120):
-    """(ok, cause): jax device init in a subprocess, retried after a pause.
+def _probe_accelerator():
+    """Staged probe (tools/tpu_probe.py): import → devices → device_put → jit,
+    each stage marked as it completes so a wedged tunnel is attributable to
+    the stage that never finished, not a generic '>120s hang'. Returns the
+    structured result for embedding in the bench JSON."""
+    from tools.tpu_probe import probe
 
-    The TPU tunnel can wedge hard enough to hang ANY jax compute in-process
-    (axon sitecustomize pins the backend), so the probe always runs in a
-    throwaway subprocess. A wedged tunnel sometimes recovers within a minute
-    or two — hence the flat 15 s pause and second attempt rather than round
-    1's single shot (budget-capped: the driver runs this at round end).
-    """
-    import subprocess
-
-    cause = ""
-    for attempt in range(attempts):
-        try:
-            probe = subprocess.run(
-                [
-                    sys.executable, "-c",
-                    "import jax; ds = jax.devices(); "
-                    "import jax.numpy as jnp; "
-                    "(jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready(); "
-                    "print([d.platform for d in ds])",
-                ],
-                timeout=timeout_s, capture_output=True, text=True,
-            )
-            if probe.returncode == 0:
-                return True, probe.stdout.strip()
-            cause = (probe.stderr or "").strip()[-500:]
-        except subprocess.TimeoutExpired:
-            cause = f"device init + first compute hung >{timeout_s}s (attempt {attempt + 1}/{attempts})"
-        print(json.dumps({"note": f"accelerator probe attempt {attempt + 1} failed", "cause": cause}), file=sys.stderr)
-        if attempt + 1 < attempts:
-            time.sleep(15)
-    return False, cause
+    try:
+        return probe()
+    except Exception as e:  # never lose the CPU-fallback path to a probe bug
+        return {"ok": False, "error": f"probe itself raised: {e!r}"[:500]}
 
 
 def main():
     import numpy as np
 
+    # Probe BEFORE touching jax in this process: under axon sitecustomize the
+    # import already happened at interpreter start, but in a plain environment
+    # importing jax here could itself wedge before the probe ever ran.
+    probe_result = _probe_accelerator()
+
     import jax
 
-    ok, cause = _probe_accelerator()
-    if not ok:
+    if not probe_result.get("ok"):
         print(
-            json.dumps({"note": "accelerator init failed after retries; falling back to cpu backend", "cause": cause}),
+            json.dumps({
+                "note": "accelerator init failed after retries; falling back to cpu backend",
+                "hung_at": probe_result.get("hung_at"),
+                "failed_at": probe_result.get("failed_at"),
+                "cause": probe_result.get("error", ""),
+                "stderr_tail": probe_result.get("stderr_tail", ""),
+            }),
             file=sys.stderr,
         )
         jax.config.update("jax_platforms", "cpu")
@@ -363,6 +351,12 @@ def main():
         "vs_baseline": round(wire_p50 / tpu_p50, 3),
         "detail": {
             "platform": platform,
+            "accelerator_probe": {
+                k: probe_result.get(k)
+                for k in ("ok", "platform", "stages", "hung_at", "failed_at",
+                          "stderr_tail", "error", "attempt")
+                if k in probe_result
+            },
             "identity": identity,
             "densenet_onnx": {
                 "width": DENSENET_WIDTH,

@@ -1,0 +1,75 @@
+"""Stage-split accelerator probe (tools/tpu_probe.py) — attribution paths.
+
+The probe exists so BENCH_r*.json names the exact init stage that hung or
+crashed (VERDICT r2 #1) instead of a generic '>120s hang'. These tests pin
+all three outcomes: success (full stage trace), crash (failed_at + stderr
+tail), and hang (hung_at) — each driven through the real subprocess path.
+"""
+
+import sys
+
+import pytest
+
+from tools import tpu_probe
+
+
+@pytest.fixture
+def cpu_child_env(monkeypatch):
+    # The child inherits os.environ; strip the axon sitecustomize (a down
+    # tunnel hangs ANY jax backend init) and pin the cpu platform so the
+    # success path is deterministic in CI.
+    monkeypatch.setenv("PYTHONPATH", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+
+def test_success_path_reports_all_stages(cpu_child_env):
+    result = tpu_probe._run_attempt(stage_timeout_s=90, total_timeout_s=180)
+    assert result["ok"], result
+    assert result["platform"] == "cpu"
+    assert [s["stage"] for s in result["stages"]] == list(tpu_probe.STAGES)
+
+
+def test_crash_path_names_stage_and_keeps_stderr(cpu_child_env, monkeypatch):
+    monkeypatch.setattr(
+        tpu_probe, "_CHILD",
+        tpu_probe._CHILD.replace(
+            "devs = jax.devices()", "raise RuntimeError('tunnel refused')"),
+    )
+    result = tpu_probe._run_attempt(stage_timeout_s=90, total_timeout_s=180)
+    assert not result["ok"]
+    assert result["failed_at"] == "devices"
+    assert "tunnel refused" in result["stderr_tail"]
+    assert [s["stage"] for s in result["stages"]] == ["import"]
+
+
+def test_hang_path_names_stage(monkeypatch):
+    # A child that never prints any STAGE marker == jax import itself hung.
+    monkeypatch.setattr(
+        tpu_probe, "_CHILD", "import time\ntime.sleep(60)\n")
+    result = tpu_probe._run_attempt(stage_timeout_s=1, total_timeout_s=2)
+    assert not result["ok"]
+    assert result["hung_at"] == "import"
+    assert "jax import itself hung" in result["error"]
+
+
+def test_total_budget_caps_slow_stage_crawl(monkeypatch):
+    # Each fake stage completes just inside its own budget; the overall cap
+    # must stop the crawl rather than letting it run #stages x stage budget.
+    slow = (
+        "import time, json\n"
+        "for name in ('import', 'devices', 'device_put', 'jit'):\n"
+        "    time.sleep(0.8)\n"
+        "    print('STAGE ' + json.dumps({'stage': name, 'seconds': 0.8}), flush=True)\n"
+        "print('DONE ' + json.dumps({'platform': 'cpu', 'stages': []}), flush=True)\n"
+    )
+    monkeypatch.setattr(tpu_probe, "_CHILD", slow)
+    import time
+
+    t0 = time.monotonic()
+    result = tpu_probe._run_attempt(stage_timeout_s=1.0, total_timeout_s=2.0)
+    elapsed = time.monotonic() - t0
+    assert not result["ok"]
+    assert result["hung_at"] in tpu_probe.STAGES
+    # without the overall cap this crawl would run ~4 x 0.8s of stage sleeps
+    # plus interpreter startup; the cap must stop it at ~total_timeout_s
+    assert elapsed < 3.5, elapsed

@@ -1,0 +1,186 @@
+"""Stage-split TPU accelerator probe (VERDICT r2 #1).
+
+The axon PJRT tunnel has been down for two full rounds and the old probe
+("device init + first compute hung >120s") taught nothing about WHERE it
+hung. This tool splits initialization into four stages, each with its OWN
+timeout, and streams the child's progress markers live so a hang (or a
+crash) is attributed to the exact stage that never completed:
+
+  1. import    — `import jax` + PJRT plugin discovery (axon sitecustomize)
+  2. devices   — `jax.devices()` (backend init: tunnel socket + handshake)
+  3. device_put— first host->device transfer
+  4. jit       — first XLA compile + execute on the chip
+
+Run it directly for a human-readable trace, or import `probe()` for the
+structured result bench.py embeds in BENCH_r*.json.
+
+Env knobs:
+  BENCH_TPU_INIT_BUDGET_S  — PER-STAGE budget (default 120)
+  BENCH_TPU_TOTAL_BUDGET_S — per-attempt overall cap (default 2x stage budget)
+  BENCH_TPU_ATTEMPTS       — attempts with 15 s backoff (default 2)
+
+Reference parity: the reference client benches assume a live tritonserver
+on GPU; this is the tpu-native analog of "is the accelerator reachable".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import selectors
+import subprocess
+import sys
+import tempfile
+import time
+
+STAGES = ("import", "devices", "device_put", "jit")
+
+_CHILD = r"""
+import json, time, sys
+stages = []
+def mark(name, t0, **extra):
+    stages.append({"stage": name, "seconds": round(time.time() - t0, 2), **extra})
+    print("STAGE " + json.dumps(stages[-1]), flush=True)
+
+t0 = time.time()
+import jax
+mark("import", t0, version=jax.__version__)
+
+t0 = time.time()
+devs = jax.devices()
+mark("devices", t0, platform=devs[0].platform, count=len(devs))
+
+t0 = time.time()
+import jax.numpy as jnp
+x = jax.device_put(jnp.ones((256, 256), jnp.float32))
+x.block_until_ready()
+mark("device_put", t0)
+
+t0 = time.time()
+y = jax.jit(lambda a: a @ a)(x)
+y.block_until_ready()
+mark("jit", t0)
+
+print("DONE " + json.dumps({"platform": devs[0].platform, "stages": stages}), flush=True)
+"""
+
+
+def _run_attempt(stage_timeout_s: float, total_timeout_s: float) -> dict:
+    """One staged probe in a throwaway subprocess (the tunnel can wedge any
+    in-process jax compute — axon sitecustomize pins the backend).
+
+    stdout is consumed line-by-line as STAGE markers arrive, so each stage
+    gets its own `stage_timeout_s` deadline; stderr goes to a tempfile (no
+    pipe to fill) and its tail is kept on EVERY failure path — the PJRT
+    plugin's connect/retry errors are exactly the diagnostics we want.
+    """
+    stages: list[dict] = []
+    result: dict = {"ok": False, "stages": stages}
+
+    def _expected() -> str | None:
+        return STAGES[len(stages)] if len(stages) < len(STAGES) else None
+
+    total_deadline = time.monotonic() + total_timeout_s
+    with tempfile.TemporaryFile(mode="w+", errors="replace") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-c", _CHILD],
+            stdout=subprocess.PIPE, stderr=errf,
+        )
+        # Raw non-blocking fd + our own line buffer: mixing select() with a
+        # buffered readline() can strand lines in the Python-level buffer
+        # (select sees an empty fd, the stage timer expires, attribution is
+        # wrong or a buffered DONE is missed entirely).
+        fd = proc.stdout.fileno()
+        os.set_blocking(fd, False)
+        sel = selectors.DefaultSelector()
+        sel.register(fd, selectors.EVENT_READ)
+        pending = b""
+        stage_started = time.monotonic()
+        hung = False
+        eof = False
+        try:
+            while not eof:
+                budget = min(stage_started + stage_timeout_s,
+                             total_deadline) - time.monotonic()
+                if budget <= 0:
+                    hung = True
+                    break
+                if not sel.select(timeout=max(budget, 0.05)):
+                    continue
+                try:
+                    chunk = os.read(fd, 65536)
+                except BlockingIOError:
+                    continue
+                if not chunk:  # EOF: child exited (crash or done)
+                    eof = True
+                pending += chunk
+                while b"\n" in pending:
+                    raw, pending = pending.split(b"\n", 1)
+                    line = raw.decode("utf-8", "replace")
+                    if line.startswith("STAGE "):
+                        stages.append(json.loads(line[len("STAGE "):]))
+                        stage_started = time.monotonic()
+                    elif line.startswith("DONE "):
+                        done = json.loads(line[len("DONE "):])
+                        result.update(ok=True, platform=done["platform"])
+        finally:
+            sel.close()
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+            errf.seek(0)
+            stderr_tail = errf.read()[-800:].strip()
+
+        if result["ok"]:
+            return result
+        failed_at = _expected()
+        reached = stages[-1]["stage"] if stages else None
+        if hung:
+            result["hung_at"] = failed_at
+            result["error"] = (
+                f"stage '{failed_at}' did not complete within its "
+                f"{stage_timeout_s:.0f}s budget (last completed: "
+                f"{reached or 'none — jax import itself hung'})"
+            )
+        else:
+            result["failed_at"] = failed_at
+            result["error"] = (
+                f"child exited rc={proc.returncode} during stage '{failed_at}' "
+                f"(last completed: {reached or 'none'})"
+            )
+        if stderr_tail:
+            result["stderr_tail"] = stderr_tail
+        return result
+
+
+def probe(attempts: int | None = None, stage_timeout_s: float | None = None) -> dict:
+    """Staged accelerator probe. Returns a dict with ok/platform/stages and,
+    on failure, hung_at/failed_at + error naming the exact stage, plus the
+    child's stderr tail (PJRT/tunnel diagnostics)."""
+    attempts = attempts or int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
+    stage_timeout_s = stage_timeout_s or float(
+        os.environ.get("BENCH_TPU_INIT_BUDGET_S", "120"))
+    # Overall cap per attempt so a slowly-progressing tunnel can't stretch
+    # one attempt to 4x the stage budget (the old probe's total semantics).
+    total_timeout_s = float(
+        os.environ.get("BENCH_TPU_TOTAL_BUDGET_S", str(stage_timeout_s * 2)))
+    last: dict = {}
+    for attempt in range(attempts):
+        last = _run_attempt(stage_timeout_s, total_timeout_s)
+        last["attempt"] = attempt + 1
+        if last["ok"]:
+            return last
+        print(json.dumps({"note": "tpu probe attempt failed", **{k: v for k, v in last.items() if k != "stages"}}), file=sys.stderr)
+        if attempt + 1 < attempts:
+            time.sleep(15)
+    return last
+
+
+def main() -> int:
+    res = probe()
+    print(json.dumps(res))
+    return 0 if res.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
