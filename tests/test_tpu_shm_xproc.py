@@ -1,0 +1,76 @@
+"""Cross-process tpu shared-memory: raw-handle attach against a server in
+another PROCESS (not the in-process registry short-circuit).
+
+This is the deployment-realistic split the bench's identity_xproc row
+measures: the server attaches the region via its raw handle
+(``server/core.py:116-118`` -> ``attach_from_raw_handle``), sees
+``_cache_enabled=False``, and the POSIX host window is the only transport.
+Reference parity: cudashm raw handles are exactly the cross-process
+contract (cuda_shared_memory/__init__.py:107-170).
+"""
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+import client_tpu.utils.tpu_shared_memory as tpushm
+from tools.xproc_server import XprocServer
+
+
+@pytest.fixture(scope="module")
+def xproc_url():
+    with XprocServer() as server:
+        yield server.url
+
+
+def test_xproc_tpu_shm_roundtrip(xproc_url):
+    import jax
+
+    rng = np.random.default_rng(7)
+    x_np = rng.standard_normal((1, 4096), dtype=np.float32)
+    nbytes = x_np.nbytes
+    x_dev = jax.device_put(x_np)
+    x_dev.block_until_ready()
+
+    with httpclient.InferenceServerClient(xproc_url) as client:
+        rin = tpushm.create_shared_memory_region("xpt_in", nbytes, colocated=False)
+        rout = tpushm.create_shared_memory_region("xpt_out", nbytes, colocated=False)
+        client.register_tpu_shared_memory("xpt_in", tpushm.get_raw_handle(rin), 0, nbytes)
+        client.register_tpu_shared_memory("xpt_out", tpushm.get_raw_handle(rout), 0, nbytes)
+        try:
+            status = client.get_tpu_shared_memory_status()
+            names = {r["name"] for r in status}
+            assert {"xpt_in", "xpt_out"} <= names
+
+            tpushm.set_shared_memory_region_from_jax(rin, x_dev)
+            inp = httpclient.InferInput("INPUT0", [1, 4096], "FP32")
+            inp.set_shared_memory("xpt_in", nbytes)
+            o = httpclient.InferRequestedOutput("OUTPUT0")
+            o.set_shared_memory("xpt_out", nbytes)
+            client.infer("identity_fp32", [inp], outputs=[o])
+
+            # The bytes must have crossed two real process boundaries via the
+            # host window — assert both the device view and the raw window.
+            res = tpushm.get_contents_as_jax(rout, "FP32", [1, 4096])
+            np.testing.assert_array_equal(np.asarray(res), x_np)
+            window = tpushm.get_contents_as_numpy(rout, np.float32, [1, 4096])
+            np.testing.assert_array_equal(window, x_np)
+        finally:
+            client.unregister_tpu_shared_memory()
+            tpushm.destroy_shared_memory_region(rin)
+            tpushm.destroy_shared_memory_region(rout)
+
+
+def test_xproc_register_rejects_unknown_key(xproc_url):
+    import base64
+    import json
+
+    bogus = base64.b64encode(json.dumps({
+        "kind": "tpu_shared_memory", "shm_key": "tpushm_does_not_exist",
+        "byte_size": 64, "device_id": 0, "uuid": "0" * 32, "colocated": False,
+    }).encode()).decode()
+    from client_tpu.utils import InferenceServerException
+
+    with httpclient.InferenceServerClient(xproc_url) as client:
+        with pytest.raises(InferenceServerException):
+            client.register_tpu_shared_memory("xpt_bogus", bogus, 0, 64)

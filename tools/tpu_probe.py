@@ -77,8 +77,11 @@ def _run_attempt(stage_timeout_s: float, total_timeout_s: float) -> dict:
     stages: list[dict] = []
     result: dict = {"ok": False, "stages": stages}
 
-    def _expected() -> str | None:
-        return STAGES[len(stages)] if len(stages) < len(STAGES) else None
+    def _expected() -> str:
+        # "finalize": all four stages completed but the DONE line never
+        # arrived (child killed/OOM'd between 'jit' and DONE) — keep the
+        # attribution meaningful instead of reporting stage 'None'.
+        return STAGES[len(stages)] if len(stages) < len(STAGES) else "finalize"
 
     total_deadline = time.monotonic() + total_timeout_s
     with tempfile.TemporaryFile(mode="w+", errors="replace") as errf:
