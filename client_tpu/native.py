@@ -24,6 +24,10 @@ STREAM_CALLBACK = ctypes.CFUNCTYPE(
     None, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p
 )
 
+# (user, InferResult*) from the native async completion-queue worker;
+# failures arrive as a result whose ctpu_result_status is non-NULL
+ASYNC_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
+
 _LIB_PATHS = (
     os.path.join(os.path.dirname(__file__), "..", "native", "build", "libclient_tpu_http.so"),
     "libclient_tpu_http.so",
@@ -120,6 +124,16 @@ def _bind(lib):
     lib.ctpu_result_output_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.ctpu_result_output_names.restype = ctypes.c_char_p
     lib.ctpu_result_output_names.argtypes = [ctypes.c_void_p]
+    lib.ctpu_result_status.restype = ctypes.c_char_p
+    lib.ctpu_result_status.argtypes = [ctypes.c_void_p]
+    lib.ctpu_grpc_async_infer.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ASYNC_CALLBACK, ctypes.c_void_p,
+    ]
+    lib.ctpu_grpc_set_async_concurrency.argtypes = [
+        ctypes.c_void_p, ctypes.c_int
+    ]
     # grpc client (same value-model handles; results use ctpu_result_*)
     lib.ctpu_grpc_client_create.restype = ctypes.c_void_p
     lib.ctpu_grpc_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -274,6 +288,10 @@ class NativeClient:
 
     def __init__(self, url: str, verbose: bool = False):
         self._lib = load()
+        # eager, not lazy-on-first-use: concurrent async_infer calls racing
+        # a lazy init could each install a fresh dict and orphan the other's
+        # live callback trampoline (native callback into freed memory)
+        self._async_pending = {}  # id -> trampoline (CFUNCTYPE unhashable)
         self._handle = getattr(self._lib, self._FN["create"])(
             url.encode(), int(verbose)
         )
@@ -464,6 +482,82 @@ class NativeGrpcClient(NativeClient):
         "unregister_shm": "ctpu_grpc_unregister_shm",
         "set_header": "ctpu_grpc_set_header",
     }
+
+    # -- async (completion-queue worker) -----------------------------------
+    def async_infer(self, model_name: str, inputs, callback,
+                    client_timeout_s: float = 0.0) -> None:
+        """Queue one inference on the native async worker; returns at once.
+
+        ``callback(outputs, error)`` fires from the worker thread when the
+        RPC completes — ``outputs`` is ``{name: np.ndarray}``, or ``None``
+        with an error string. The worker keeps many RPCs in flight on ONE
+        multiplexed h2 connection (completion-queue model; reference
+        grpc_client.cc:1583-1626), so N queued requests against a slow model
+        overlap rather than serialize. ``inputs``: list of
+        (name, np.ndarray).
+        """
+        lib = self._lib
+        pending = self._async_pending
+        holder = []
+
+        def on_complete(_user, result_ptr):
+            try:
+                if not result_ptr:
+                    callback(None, "async infer returned no result")
+                    return
+                status = lib.ctpu_result_status(result_ptr)
+                if status is not None:
+                    callback(None, status.decode("utf-8", "replace"))
+                    return
+                try:
+                    decoded = _decode_result(lib, result_ptr)
+                except InferenceServerException as e:
+                    callback(None, str(e))
+                    return
+                callback(decoded, None)
+            finally:
+                if result_ptr:
+                    lib.ctpu_result_destroy(result_ptr)
+                pending.pop(id(holder[0]), None)
+
+        trampoline = ASYNC_CALLBACK(on_complete)
+        holder.append(trampoline)
+        in_handles = []
+        keepalive = []
+        options = lib.ctpu_options_create(model_name.encode())
+        try:
+            if client_timeout_s:
+                lib.ctpu_options_set_timeouts(
+                    options, max(1, int(round(client_timeout_s * 1e6))), 0
+                )
+            for name, value in inputs:
+                handle = _build_array_input(lib, name, value, keepalive)
+                if not handle:
+                    raise InferenceServerException(_err(lib))
+                in_handles.append(handle)
+            ins = (ctypes.c_void_p * len(in_handles))(*in_handles)
+            # the native side serializes the request before returning, so
+            # the input handles and numpy buffers may be freed on return;
+            # only the callback trampoline must outlive the RPC
+            pending[id(trampoline)] = trampoline
+            rc = lib.ctpu_grpc_async_infer(
+                self._handle, options, ins, len(in_handles), None, 0,
+                trampoline, None,
+            )
+            if rc != 0:
+                pending.pop(id(trampoline), None)
+                raise InferenceServerException(_err(lib))
+        finally:
+            for handle in in_handles:
+                lib.ctpu_input_destroy(handle)
+            lib.ctpu_options_destroy(options)
+
+    def set_async_concurrency(self, n: int) -> None:
+        """In-flight window for :meth:`async_infer` (default 16): how many
+        RPCs the native worker keeps open concurrently on its multiplexed
+        connection, clamped to the server's advertised
+        SETTINGS_MAX_CONCURRENT_STREAMS."""
+        self._lib.ctpu_grpc_set_async_concurrency(self._handle, int(n))
 
     # -- bi-di streaming ---------------------------------------------------
     def start_stream(self, callback) -> None:

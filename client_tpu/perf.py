@@ -70,6 +70,8 @@ class PerfRunner:
         self.rng = np.random.default_rng(seed)
         if protocol in ("native", "native-grpc") and shared_memory == "system":
             raise ValueError("native protocols support --shared-memory none|tpu")
+        if protocol == "native-grpc-async" and shared_memory != "none":
+            raise ValueError("native-grpc-async supports --shared-memory none")
         self._client_mod = self._import_client_mod()
         self._metadata = self._fetch_metadata()
         self._tensors = self._generate_tensors()
@@ -81,7 +83,7 @@ class PerfRunner:
     def _import_client_mod(self):
         if self.protocol in ("http", "native"):
             import client_tpu.http as mod
-        else:  # grpc and native-grpc share the grpc value model
+        else:  # grpc and native-grpc* share the grpc value model
             import client_tpu.grpc as mod
         return mod
 
@@ -90,7 +92,7 @@ class PerfRunner:
             from client_tpu.native import NativeClient
 
             return NativeClient(self.url)
-        if self.protocol == "native-grpc":
+        if self.protocol in ("native-grpc", "native-grpc-async"):
             from client_tpu.native import NativeGrpcClient
 
             return NativeGrpcClient(self.url)
@@ -101,7 +103,7 @@ class PerfRunner:
     def _control_client(self):
         """(client, module) for metadata/probing: the protocol's own python
         client, except native (whose C API is a data-plane surface) -> http."""
-        if self.protocol in ("grpc", "native-grpc"):
+        if self.protocol in ("grpc", "native-grpc", "native-grpc-async"):
             import client_tpu.grpc as mod
         else:
             import client_tpu.http as mod
@@ -206,10 +208,18 @@ class PerfRunner:
         own_client = None
         setup_failed = False
         try:
-            if self.protocol in ("native", "native-grpc"):
-                # one C++ client per worker: the native Infer serializes on a
-                # per-client transport handle, so sharing one client would
-                # measure lock contention instead of concurrency
+            if self.protocol == "native-grpc-async":
+                # ONE client shared by every worker: the async worker keeps
+                # all their RPCs in flight on a single multiplexed h2
+                # connection (completion-queue model) — this mode measures
+                # exactly what per-worker instances cannot: one instance's
+                # concurrent throughput
+                inputs = [(name, data) for name, _, _, data in self._tensors]
+                outputs = None
+            elif self.protocol in ("native", "native-grpc"):
+                # one C++ client per worker: the native sync Infer serializes
+                # on a per-client transport handle, so sharing one client
+                # would measure lock contention instead of concurrency
                 own_client = self._make_client()
                 client = own_client
                 inputs, outputs, shm_ctx = self._native_worker_setup(
@@ -323,6 +333,20 @@ class PerfRunner:
                 own_client.close()
 
     def _infer_once(self, client, inputs, outputs=None):
+        if self.protocol == "native-grpc-async":
+            done = threading.Event()
+            box = {}
+
+            def on_complete(result, error):
+                box["error"] = error
+                done.set()
+
+            client.async_infer(self.model_name, inputs, on_complete)
+            if not done.wait(timeout=120):
+                raise RuntimeError("async infer did not complete in 120s")
+            if box.get("error"):
+                raise RuntimeError(box["error"])
+            return
         client.infer(self.model_name, inputs, outputs=outputs)
 
     def _native_worker_setup(self, client, worker_id):
@@ -387,6 +411,10 @@ class PerfRunner:
     # -- sweep -------------------------------------------------------------
     def run(self, concurrency: int, measurement_requests: int) -> Dict[str, Any]:
         client = self._make_client(concurrency)
+        if self.protocol == "native-grpc-async":
+            # the shared instance must admit as many RPCs as we have
+            # workers, or the measurement clamps at the default window
+            client.set_async_concurrency(concurrency)
         latencies: List[float] = []
         errors: List[str] = []
         stop = threading.Event()
@@ -437,7 +465,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("-m", "--model-name", required=True)
     parser.add_argument("-u", "--url", default="127.0.0.1:8000")
     parser.add_argument(
-        "-i", "--protocol", choices=("http", "grpc", "native", "native-grpc"),
+        "-i", "--protocol",
+        choices=("http", "grpc", "native", "native-grpc", "native-grpc-async"),
         default="http",
         help="native = the C++ client via its C API (HTTP transport)",
     )

@@ -135,6 +135,12 @@ class InferenceServerGrpcClient {
     default_headers_[key] = value;
   }
 
+  // In-flight window for AsyncInfer: how many RPCs the worker keeps open
+  // concurrently on its multiplexed connection (completion-queue model).
+  void SetAsyncConcurrency(size_t n) {
+    std::lock_guard<std::mutex> lock(queue_mutex_);
+    max_async_inflight_ = n == 0 ? 1 : n;
+  }
 
  private:
   InferenceServerGrpcClient(const std::string& url, bool verbose);
@@ -149,6 +155,8 @@ class InferenceServerGrpcClient {
 
   struct AsyncRequest;
   void AsyncTransfer();
+  void FinishAsync(AsyncRequest* request, InferResult* result);
+  void FinishAsyncError(AsyncRequest* request, const Error& err);
   void StreamReader();
 
   std::string url_;
@@ -161,6 +169,7 @@ class InferenceServerGrpcClient {
   std::mutex queue_mutex_;
   std::condition_variable queue_cv_;
   std::deque<AsyncRequest*> pending_;
+  size_t max_async_inflight_ = 16;  // queue_mutex_
   std::atomic<bool> exiting_{false};
 
   // streaming state: dedicated connection + reader thread

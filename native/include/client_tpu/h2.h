@@ -103,9 +103,22 @@ class Connection {
       int64_t timeout_ms = 0);
   // Abort a stream (RST_STREAM CANCEL).
   Error StreamReset(int32_t stream_id);
+  // Completion-queue primitive: pump until ANY listed stream is closed or
+  // errored; *ready_id names it. Frames for non-listed streams are still
+  // dispatched while pumping (this is what lets one thread reap a window
+  // of concurrent in-flight RPCs — the multiplexed AsyncInfer model).
+  Error StreamWaitAny(
+      const std::vector<int32_t>& stream_ids, int32_t* ready_id,
+      int64_t timeout_ms = 0);
 
   bool Alive() const { return alive_.load(); }
   const std::string& PeerDescription() const { return host_port_; }
+  // Peer's advertised SETTINGS_MAX_CONCURRENT_STREAMS (RFC 7540 §6.5.2;
+  // unset = unlimited). Multiplexing callers must not open more.
+  int64_t PeerMaxConcurrentStreams() {
+    std::lock_guard<std::mutex> lock(state_mutex_);
+    return peer_max_concurrent_streams_;
+  }
 
  private:
   explicit Connection(const std::string& host_port);
@@ -147,6 +160,7 @@ class Connection {
   // peer settings (state_mutex_ past the handshake)
   int64_t peer_max_frame_size_ = 16384;
   int64_t peer_initial_window_ = 65535;
+  int64_t peer_max_concurrent_streams_ = INT64_MAX;  // unset = unlimited
   int64_t conn_send_window_ = 65535;
   std::string goaway_debug_;
 };

@@ -288,6 +288,18 @@ const char* ctpu_result_model_name(void* result) {
   return name.c_str();
 }
 
+// NULL when the request succeeded; the error message otherwise. Async
+// completions deliver failures as a result whose RequestStatus carries the
+// error (the reference callback contract), so callbacks need this to tell
+// the two apart.
+const char* ctpu_result_status(void* result) {
+  thread_local std::string message;
+  Error err = static_cast<InferResult*>(result)->RequestStatus();
+  if (!err) return nullptr;
+  message = err.Message();
+  return message.c_str();
+}
+
 // -- async ---------------------------------------------------------------------
 
 typedef void (*ctpu_callback)(void* user, void* result);
@@ -393,6 +405,13 @@ int ctpu_grpc_register_tpu_shm(
 
 int ctpu_grpc_set_header(void* client, const char* key, const char* value) {
   static_cast<InferenceServerGrpcClient*>(client)->AddDefaultHeader(key, value);
+  return 0;
+}
+
+// In-flight window for the async completion-queue worker.
+int ctpu_grpc_set_async_concurrency(void* client, int n) {
+  static_cast<InferenceServerGrpcClient*>(client)->SetAsyncConcurrency(
+      n < 1 ? 1 : static_cast<size_t>(n));
   return 0;
 }
 

@@ -342,6 +342,7 @@ class InferResultGrpc : public InferResult {
     const uint8_t* data = nullptr;
     size_t size = 0;
     std::vector<std::string> bytes_elements;  // typed contents fallback
+    std::string owned;  // 4-byte-length serialization of bytes_elements
     bool in_shm = false;
   };
 
@@ -463,11 +464,32 @@ class InferResultGrpc : public InferResult {
     if (!r.ok()) return Error("malformed ModelInferResponse");
     size_t raw_index = 0;
     for (auto& o : outputs_) {
-      if (o.in_shm || !o.bytes_elements.empty()) continue;
+      if (o.in_shm) continue;
+      if (!o.bytes_elements.empty()) {
+        // BYTES delivered via typed bytes_contents: materialize the
+        // 4-byte-length raw form so RawData() consumers (the flat C API
+        // and its ctypes binding deserialize through RawData only) see
+        // the same bytes a raw_output_contents response would carry.
+        o.owned.clear();
+        for (const auto& elem : o.bytes_elements) {
+          uint32_t len = static_cast<uint32_t>(elem.size());
+          o.owned.append(reinterpret_cast<const char*>(&len), 4);
+          o.owned.append(elem);
+        }
+        continue;
+      }
       if (raw_index < raws.size()) {
         o.data = raws[raw_index].first;
         o.size = raws[raw_index].second;
         ++raw_index;
+      }
+    }
+    // second pass: point data at the owned buffers only after outputs_ can
+    // no longer reallocate (push_back above would dangle the pointers)
+    for (auto& o : outputs_) {
+      if (!o.bytes_elements.empty()) {
+        o.data = reinterpret_cast<const uint8_t*>(o.owned.data());
+        o.size = o.owned.size();
       }
     }
     return Error::Success();
@@ -958,7 +980,9 @@ Error InferenceServerGrpcClient::Call(
   h2::Connection::Response resp;
   err = conn->Request(
       "/inference.GRPCInferenceService/" + method, GrpcRequestHeaders(MergedHeaders(headers)),
-      body, &resp, timeout_us == 0 ? 0 : static_cast<int64_t>(timeout_us / 1000));
+      body, &resp,
+      // round sub-ms timeouts UP: truncating to 0 would mean "no timeout"
+      timeout_us == 0 ? 0 : static_cast<int64_t>((timeout_us + 999) / 1000));
   if (err) {
     // transport failure: the connection is not reusable
     if (err.Message() == "Deadline Exceeded") {
@@ -1462,72 +1486,226 @@ Error InferenceServerGrpcClient::AsyncInfer(
   return Error::Success();
 }
 
-// Worker thread: drains the queue over pooled connections. Requests are
-// serialized per worker (parallel load uses multiple client instances, the
-// same scaling model the perf harness applies to the native client).
+namespace {
+int64_t NowMsMono() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+}  // namespace
+
+// Deliver a completed async request: close out timers, fold the exchange
+// into infer_stat_, fire the callback, free the request.
+void InferenceServerGrpcClient::FinishAsync(
+    AsyncRequest* request, InferResult* result) {
+  request->timers.Capture(RequestTimers::Kind::RECV_END);
+  request->timers.Capture(RequestTimers::Kind::REQUEST_END);
+  {
+    std::lock_guard<std::mutex> lock(stat_mutex_);
+    infer_stat_.Update(request->timers);
+  }
+  request->callback(result);
+  delete request;
+}
+
+void InferenceServerGrpcClient::FinishAsyncError(
+    AsyncRequest* request, const Error& err) {
+  InferResult* result = nullptr;
+  InferResultGrpc::Create(&result, std::string(), err);
+  FinishAsync(request, result);
+}
+
+// Worker thread: a completion-queue pump. Up to max_async_inflight_ RPCs
+// ride concurrent streams on ONE dedicated h2 connection (the transport
+// multiplexes; StreamWaitAny reaps whichever finishes first), matching the
+// reference's grpc completion-queue model (grpc_client.cc:1583-1626) where
+// many AsyncInfer RPCs are in flight per client and callback order is
+// unguaranteed. Round 2 serialized one RPC at a time here — the sweep's
+// native-grpc numbers only scaled by instantiating many clients.
 void InferenceServerGrpcClient::AsyncTransfer() {
+  std::unique_ptr<h2::Connection> conn;
+  struct Inflight {
+    AsyncRequest* request;
+    int64_t deadline_ms;  // CLOCK_MONOTONIC ms; 0 = no timeout
+  };
+  std::map<int32_t, Inflight> inflight;
+
+  auto fail_all_inflight = [&](const std::string& why) {
+    for (auto& kv : inflight) {
+      FinishAsyncError(
+          kv.second.request, Error("[StatusCode.UNAVAILABLE] " + why));
+    }
+    inflight.clear();
+    conn.reset();
+  };
+
   while (true) {
-    AsyncRequest* request = nullptr;
+    // -- admit queued requests into the in-flight window ------------------
+    std::vector<AsyncRequest*> to_open;
     {
       std::unique_lock<std::mutex> lock(queue_mutex_);
-      queue_cv_.wait(lock, [this] { return exiting_ || !pending_.empty(); });
-      if (pending_.empty()) {
-        if (exiting_) return;
-        continue;
+      if (pending_.empty() && inflight.empty()) {
+        queue_cv_.wait(lock, [this] { return exiting_ || !pending_.empty(); });
+        if (pending_.empty() && exiting_) return;
       }
-      request = pending_.front();
-      pending_.pop_front();
+      // bounded by our window AND the peer's SETTINGS_MAX_CONCURRENT_STREAMS
+      // (opening past the peer's cap earns RST_STREAM REFUSED_STREAM)
+      size_t window = max_async_inflight_;
+      if (conn != nullptr) {
+        int64_t peer_cap = conn->PeerMaxConcurrentStreams();
+        if (peer_cap > 0 && static_cast<int64_t>(window) > peer_cap) {
+          window = static_cast<size_t>(peer_cap);
+        }
+      }
+      while (!pending_.empty() &&
+             inflight.size() + to_open.size() < window) {
+        to_open.push_back(pending_.front());
+        pending_.pop_front();
+      }
     }
-    request->timers.Capture(RequestTimers::Kind::SEND_START);
-    Error err;
-    std::unique_ptr<h2::Connection> conn = AcquireConnection(&err);
-    h2::Connection::Response resp;
-    if (!err) {
-      err = conn->Request(
-          "/inference.GRPCInferenceService/" + request->method,
-          GrpcRequestHeaders(MergedHeaders(request->headers)), request->body, &resp,
+
+    if (!to_open.empty() && (conn == nullptr || !conn->Alive())) {
+      Error cerr;
+      std::unique_ptr<h2::Connection> fresh;
+      cerr = h2::Connection::Connect(&fresh, url_);
+      if (cerr) {
+        for (AsyncRequest* request : to_open) {
+          FinishAsyncError(
+              request, Error("[StatusCode.UNAVAILABLE] " + cerr.Message()));
+        }
+        to_open.clear();
+      } else {
+        conn = std::move(fresh);
+      }
+    }
+    if (conn != nullptr && !to_open.empty()) {
+      // re-clamp once the live connection's peer settings are known (the
+      // admit loop may have run before the connection existed)
+      int64_t peer_cap = conn->PeerMaxConcurrentStreams();
+      while (peer_cap > 0 &&
+             static_cast<int64_t>(inflight.size() + to_open.size()) >
+                 peer_cap &&
+             !to_open.empty()) {
+        std::lock_guard<std::mutex> lock(queue_mutex_);
+        pending_.push_front(to_open.back());
+        to_open.pop_back();
+      }
+    }
+    for (AsyncRequest* request : to_open) {
+      request->timers.Capture(RequestTimers::Kind::SEND_START);
+      int64_t timeout_ms =
           request->timeout_us == 0
               ? 0
-              : static_cast<int64_t>(request->timeout_us / 1000));
+              : static_cast<int64_t>((request->timeout_us + 999) / 1000);
+      int32_t sid = 0;
+      Error err = conn->StreamOpen(
+          "/inference.GRPCInferenceService/" + request->method,
+          GrpcRequestHeaders(MergedHeaders(request->headers)), &sid);
+      if (!err) {
+        err = conn->StreamSend(
+            sid, request->body.data(), request->body.size(), true, timeout_ms);
+      }
+      request->timers.Capture(RequestTimers::Kind::SEND_END);
       if (err) {
-        err = Error(
-            err.Message() == "Deadline Exceeded"
-                ? "[StatusCode.DEADLINE_EXCEEDED] Deadline Exceeded"
-                : "[StatusCode.UNAVAILABLE] " + err.Message());
-      } else {
-        ReleaseConnection(std::move(conn));
-        err = GrpcStatusToError(resp.headers);
+        if (sid != 0 && conn != nullptr && conn->Alive()) {
+          // HEADERS went out but the body failed: reset so the peer (and
+          // our streams_ map) drop the half-sent stream
+          conn->StreamReset(sid);
+        }
+        FinishAsyncError(
+            request,
+            Error(err.Message() == "Deadline Exceeded"
+                      ? "[StatusCode.DEADLINE_EXCEEDED] Deadline Exceeded"
+                      : "[StatusCode.UNAVAILABLE] " + err.Message()));
+        continue;
+      }
+      request->timers.Capture(RequestTimers::Kind::RECV_START);
+      inflight[sid] = Inflight{
+          request, timeout_ms == 0 ? 0 : NowMsMono() + timeout_ms};
+    }
+    if (inflight.empty()) continue;
+
+    // -- reap: wait for any in-flight stream to finish --------------------
+    // Bounded wait so newly queued requests are admitted promptly and
+    // per-request deadlines stay enforced even with no frame traffic.
+    // 5 ms tick: with frame traffic the wait returns immediately, so the
+    // tick only gates admission latency when the connection is quiet —
+    // a self-pipe in the socket poll would remove even that, at the cost
+    // of threading a wakeup fd through the transport.
+    int64_t wait_ms = 5;
+    int64_t now = NowMsMono();
+    for (const auto& kv : inflight) {
+      if (kv.second.deadline_ms != 0) {
+        wait_ms = std::min(wait_ms, std::max<int64_t>(kv.second.deadline_ms - now, 1));
       }
     }
-    request->timers.Capture(RequestTimers::Kind::SEND_END);
-    request->timers.Capture(RequestTimers::Kind::RECV_START);
+    std::vector<int32_t> ids;
+    ids.reserve(inflight.size());
+    for (const auto& kv : inflight) ids.push_back(kv.first);
+    int32_t ready = 0;
+    Error werr = conn->StreamWaitAny(ids, &ready, wait_ms);
+    if (werr) {
+      if (werr.Message() == "Deadline Exceeded") {
+        // poll tick: expire overdue requests, then admit/reap again
+        now = NowMsMono();
+        for (auto it = inflight.begin(); it != inflight.end();) {
+          if (it->second.deadline_ms != 0 && now >= it->second.deadline_ms) {
+            conn->StreamReset(it->first);
+            FinishAsyncError(
+                it->second.request,
+                Error("[StatusCode.DEADLINE_EXCEEDED] Deadline Exceeded"));
+            it = inflight.erase(it);
+          } else {
+            ++it;
+          }
+        }
+        continue;
+      }
+      fail_all_inflight(werr.Message());
+      continue;
+    }
+
+    auto it = inflight.find(ready);
+    if (it == inflight.end()) continue;  // already reaped/reset
+    AsyncRequest* request = it->second.request;
+    inflight.erase(it);
+    std::string body;
+    std::map<std::string, std::string> headers;
+    bool closed = false;
+    Error rerr;
+    while (!closed && !rerr) {
+      // the stream is terminal (StreamWaitAny), so this drains buffered
+      // DATA + trailers without blocking meaningfully
+      rerr = conn->StreamRecv(ready, &body, &headers, &closed, 1000);
+    }
     InferResult* result = nullptr;
-    if (err) {
-      InferResultGrpc::Create(&result, std::string(), err);
+    if (rerr) {
+      InferResultGrpc::Create(
+          &result, std::string(),
+          Error("[StatusCode.UNAVAILABLE] " + rerr.Message()));
     } else {
-      size_t pos = 0;
-      const uint8_t* payload;
-      size_t payload_size;
-      bool compressed;
-      if (pb::UnframeMessage(resp.body, &pos, &payload, &payload_size,
-                             &compressed) &&
-          !compressed) {
-        std::string message(
-            reinterpret_cast<const char*>(payload), payload_size);
-        InferResultGrpc::Create(&result, std::move(message), Error::Success());
+      Error status = GrpcStatusToError(headers);
+      if (status) {
+        InferResultGrpc::Create(&result, std::string(), status);
       } else {
-        InferResultGrpc::Create(
-            &result, std::string(), Error("truncated gRPC response frame"));
+        size_t pos = 0;
+        const uint8_t* payload;
+        size_t payload_size;
+        bool compressed;
+        if (pb::UnframeMessage(body, &pos, &payload, &payload_size,
+                               &compressed) &&
+            !compressed) {
+          std::string message(
+              reinterpret_cast<const char*>(payload), payload_size);
+          InferResultGrpc::Create(
+              &result, std::move(message), Error::Success());
+        } else {
+          InferResultGrpc::Create(
+              &result, std::string(), Error("truncated gRPC response frame"));
+        }
       }
     }
-    request->timers.Capture(RequestTimers::Kind::RECV_END);
-    request->timers.Capture(RequestTimers::Kind::REQUEST_END);
-    {
-      std::lock_guard<std::mutex> lock(stat_mutex_);
-      infer_stat_.Update(request->timers);
-    }
-    request->callback(result);
-    delete request;
+    FinishAsync(request, result);
   }
 }
 

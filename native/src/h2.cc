@@ -303,6 +303,7 @@ Connection::~Connection() {
 Error Connection::Connect(
     std::unique_ptr<Connection>* conn, const std::string& host_port,
     int64_t timeout_ms) {
+  if (host_port.empty()) return Error("h2: empty server url");
   std::string host = host_port;
   std::string port = "80";
   size_t bracket = host_port.rfind("]:");
@@ -318,9 +319,13 @@ Error Connection::Connect(
       port = host_port.substr(colon + 1);
     } else if (host_port.front() == '[' && host_port.back() == ']') {
       host = host_port.substr(1, host_port.size() - 2);
+    } else if (colon != std::string::npos) {
+      // multiple ':' without brackets is ambiguous (v6 host? host:port with
+      // a stray colon?) — require [v6]:port rather than guessing
+      return Error(
+          "h2: ambiguous url '" + host_port +
+          "' (IPv6 literals must be bracketed: [addr] or [addr]:port)");
     }
-    // multiple ':' without brackets: treat the whole string as a bare v6
-    // host on the default port
   }
   struct addrinfo hints = {};
   hints.ai_family = AF_UNSPEC;
@@ -602,6 +607,9 @@ Error Connection::RecvFrameLocked(int64_t timeout_ms) {
             int64_t delta = static_cast<int64_t>(value) - peer_initial_window_;
             peer_initial_window_ = value;
             for (auto& s : streams_) s.second.send_window += delta;
+          } else if (id == 0x3) {  // MAX_CONCURRENT_STREAMS
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            peer_max_concurrent_streams_ = value;
           } else if (id == 0x5) {  // MAX_FRAME_SIZE
             std::lock_guard<std::mutex> lock(state_mutex_);
             peer_max_frame_size_ = value;
@@ -692,17 +700,37 @@ Error Connection::StreamOpen(
   }
   if (block.size() > 16000) return Error("h2: header block too large");
   int32_t id;
+  std::string frame;
+  frame.reserve(9 + block.size());
   {
-    // register the stream before its HEADERS can be answered, and allocate
-    // ids in the same order HEADERS hit the wire (RFC: ids must increase)
-    std::lock_guard<std::mutex> lock(state_mutex_);
-    id = next_stream_id_;
-    next_stream_id_ += 2;
-    streams_[id].send_window = peer_initial_window_;
+    // send_mutex_ held across BOTH the id allocation and the HEADERS write:
+    // ids must hit the wire strictly increasing (RFC 7540 §5.1.1), and two
+    // threads opening streams concurrently could otherwise interleave
+    // allocation order with write order and tear the connection down with
+    // PROTOCOL_ERROR.
+    std::lock_guard<std::mutex> send_lock(send_mutex_);
+    {
+      // register the stream before its HEADERS can be answered
+      std::lock_guard<std::mutex> lock(state_mutex_);
+      id = next_stream_id_;
+      next_stream_id_ += 2;
+      streams_[id].send_window = peer_initial_window_;
+    }
+    size_t size = block.size();
+    frame.push_back(static_cast<char>((size >> 16) & 0xFF));
+    frame.push_back(static_cast<char>((size >> 8) & 0xFF));
+    frame.push_back(static_cast<char>(size & 0xFF));
+    frame.push_back(static_cast<char>(kHeaders));
+    frame.push_back(static_cast<char>(kFlagEndHeaders));
+    PutU32(&frame, static_cast<uint32_t>(id));
+    frame.append(block);
+    Error err = SendAll(frame.data(), frame.size(), 0);
+    if (err) {
+      std::lock_guard<std::mutex> lock(state_mutex_);
+      streams_.erase(id);
+      return err;
+    }
   }
-  Error err =
-      SendFrame(kHeaders, kFlagEndHeaders, id, block.data(), block.size(), 0);
-  if (err) return err;
   *stream_id = id;
   return Error::Success();
 }
@@ -769,7 +797,13 @@ Error Connection::StreamRecv(
       auto it = streams_.find(stream_id);
       if (it == streams_.end()) return Error("h2: unknown stream");
       if (!it->second.body.empty() || it->second.closed) {
-        if (it->second.error) return it->second.error;
+        if (it->second.error) {
+          // terminal: reap the entry, or error-heavy callers leak one map
+          // slot per failed RPC on a long-lived multiplexed connection
+          Error stream_err = it->second.error;
+          streams_.erase(it);
+          return stream_err;
+        }
         body->append(it->second.body);
         it->second.body.clear();
         for (const auto& kv : it->second.headers) {
@@ -797,6 +831,37 @@ Error Connection::StreamReset(int32_t stream_id) {
   return err;
 }
 
+Error Connection::StreamWaitAny(
+    const std::vector<int32_t>& stream_ids, int32_t* ready_id,
+    int64_t timeout_ms) {
+  // Completion-queue primitive: pump frames until ANY of the given streams
+  // is closed (or carries a stream error). Frames for every stream are
+  // dispatched as they arrive regardless of which one we return first.
+  if (stream_ids.empty()) return Error("h2: StreamWaitAny on no streams");
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lock(state_mutex_);
+      for (int32_t id : stream_ids) {
+        auto it = streams_.find(id);
+        if (it == streams_.end()) {
+          // already reaped (or reset) — surface it so the caller drops it
+          *ready_id = id;
+          return Error::Success();
+        }
+        if (it->second.closed || it->second.error) {
+          *ready_id = id;
+          return Error::Success();
+        }
+      }
+    }
+    int64_t wait = deadline ? deadline - NowMs() : 0;
+    if (deadline && wait <= 0) return Error("Deadline Exceeded");
+    Error err = PumpOne(wait);
+    if (err) return err;
+  }
+}
+
 Error Connection::PumpUntil(int32_t stream_id, int64_t timeout_ms) {
   int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
   while (true) {
@@ -816,12 +881,24 @@ Error Connection::PumpUntil(int32_t stream_id, int64_t timeout_ms) {
 Error Connection::Request(
     const std::string& path, const HeaderList& headers,
     const std::string& body, Response* out, int64_t timeout_ms) {
+  // ONE deadline across all phases: passing timeout_ms to each phase
+  // independently would let worst-case wall time run to ~2x the request.
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
+  auto remaining = [deadline]() -> int64_t {
+    if (deadline == 0) return 0;  // no timeout
+    int64_t left = deadline - NowMs();
+    return left > 0 ? left : -1;  // -1: expired (0 would mean "no timeout")
+  };
   int32_t stream_id;
   Error err = StreamOpen(path, headers, &stream_id);
   if (err) return err;
-  err = StreamSend(stream_id, body.data(), body.size(), true, timeout_ms);
+  int64_t left = remaining();
+  if (left < 0) return Error("Deadline Exceeded");
+  err = StreamSend(stream_id, body.data(), body.size(), true, left);
   if (err) return err;
-  err = PumpUntil(stream_id, timeout_ms);
+  left = remaining();
+  if (left < 0) return Error("Deadline Exceeded");
+  err = PumpUntil(stream_id, left);
   if (err) return err;
   std::lock_guard<std::mutex> lock(state_mutex_);
   auto it = streams_.find(stream_id);

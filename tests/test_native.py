@@ -395,6 +395,73 @@ def test_ctypes_grpc_streaming(grpc_server):
         client.stop_stream()
 
 
+def test_ctypes_grpc_async_infer_multiplexes(grpc_server):
+    """ONE client instance keeps many AsyncInfer RPCs in flight on its
+    multiplexed h2 connection (completion-queue model, reference
+    grpc_client.cc:1583-1626). Round 2 serialized the worker — 8 requests
+    against a 0.3 s model would have taken ~2.4 s; multiplexed they overlap
+    within the server's worker pool."""
+    import queue
+    import time as _time
+
+    from client_tpu.models.simple import IdentityModel
+    from client_tpu.native import NativeGrpcClient
+    from client_tpu.server import GrpcInferenceServer, ServerCore
+
+    delay = 0.3
+    n = 8
+    core = ServerCore(
+        [IdentityModel("identity_slow", "INT32", delay_s=delay)]
+    )
+    with GrpcInferenceServer(core) as server:
+        with NativeGrpcClient(server.url) as client:
+            results = queue.Queue()
+            payloads = [
+                np.full((1, 16), i, dtype=np.int32) for i in range(n)
+            ]
+            t0 = _time.monotonic()
+            for i in range(n):
+                client.async_infer(
+                    "identity_slow",
+                    [("INPUT0", payloads[i])],
+                    lambda outputs, error, i=i: results.put((i, outputs, error)),
+                )
+            seen = {}
+            for _ in range(n):
+                i, outputs, error = results.get(timeout=30)
+                assert error is None, error
+                seen[i] = outputs["OUTPUT0"]
+            elapsed = _time.monotonic() - t0
+        assert len(seen) == n
+        for i in range(n):
+            np.testing.assert_array_equal(seen[i], payloads[i])
+        # serialized would be >= n * delay = 2.4 s; require at least 2x
+        # overlap (amply loose for CI jitter while still impossible for a
+        # one-at-a-time worker)
+        assert elapsed < (n * delay) / 2, (
+            f"8 async infers took {elapsed:.2f}s — worker is serializing"
+        )
+
+
+def test_ctypes_grpc_async_infer_error_path(grpc_server):
+    """Async failures arrive as callback(None, error) via result status —
+    never as a worker crash or a silent drop."""
+    import queue
+
+    from client_tpu.native import NativeGrpcClient
+
+    results = queue.Queue()
+    with NativeGrpcClient(grpc_server.url) as client:
+        client.async_infer(
+            "no_such_model",
+            [("INPUT0", np.zeros((1, 4), dtype=np.int32))],
+            lambda outputs, error: results.put((outputs, error)),
+        )
+        outputs, error = results.get(timeout=30)
+        assert outputs is None
+        assert error and "no_such_model" in error
+
+
 def test_native_default_headers_on_the_wire(grpc_server):
     """set_header attaches to every request in both native clients — proven
     at the byte level (HTTP/1.1 text; h2 literal-encoded header block)."""
