@@ -134,6 +134,7 @@ def _bind(lib):
     lib.ctpu_grpc_set_async_concurrency.argtypes = [
         ctypes.c_void_p, ctypes.c_int
     ]
+    lib.ctpu_grpc_set_compression.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     # grpc client (same value-model handles; results use ctpu_result_*)
     lib.ctpu_grpc_client_create.restype = ctypes.c_void_p
     lib.ctpu_grpc_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -551,6 +552,14 @@ class NativeGrpcClient(NativeClient):
             for handle in in_handles:
                 lib.ctpu_input_destroy(handle)
             lib.ctpu_options_destroy(options)
+
+    def set_compression(self, algorithm: Optional[str]) -> None:
+        """Default message compression for infer RPCs and streams:
+        ``"gzip"``, ``"deflate"``, or ``None`` (off). The twin of the
+        Python clients' ``compression_algorithm`` argument."""
+        self._lib.ctpu_grpc_set_compression(
+            self._handle, (algorithm or "").encode()
+        )
 
     def set_async_concurrency(self, n: int) -> None:
         """In-flight window for :meth:`async_infer` (default 16): how many

@@ -412,7 +412,11 @@ def _snake(name: str) -> str:
 class GrpcInferenceServer:
     """An in-process v2 GRPC server bound to localhost."""
 
-    def __init__(self, core: ServerCore, port: int = 0, max_workers: int = 8, verbose: bool = False):
+    def __init__(self, core: ServerCore, port: int = 0, max_workers: int = 8,
+                 verbose: bool = False, compression=None):
+        """``compression``: a ``grpc.Compression`` value (e.g. ``Gzip``) to
+        compress responses for clients that advertise support — exercises
+        clients' grpc-encoding decompression paths end-to-end."""
         self.core = core
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
@@ -422,6 +426,7 @@ class GrpcInferenceServer:
                 ("grpc.max_send_message_length", 2**31 - 1),
                 ("grpc.max_receive_message_length", 2**31 - 1),
             ],
+            compression=compression,
         )
         self._server.add_generic_rpc_handlers((_Handlers(core, verbose),))
         self._port = self._server.add_insecure_port(f"127.0.0.1:{port}")

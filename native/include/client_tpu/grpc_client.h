@@ -93,16 +93,22 @@ class InferenceServerGrpcClient {
   Error UnregisterCudaSharedMemory(
       const std::string& name = "", const Headers& headers = {});
 
+  // `compression_algorithm`: "gzip" | "deflate" | "" (= the client default
+  // set via SetCompression). Reference parity: per-call
+  // grpc_compression_algorithm (grpc_client.h Infer/AsyncInfer; Python
+  // grpc/_client.py:1459-1565).
   Error Infer(
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
-      const Headers& headers = {});
+      const Headers& headers = {},
+      const std::string& compression_algorithm = "");
   Error AsyncInfer(
       OnComplete callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
-      const Headers& headers = {});
+      const Headers& headers = {},
+      const std::string& compression_algorithm = "");
   Error InferMulti(
       std::vector<InferResult*>* results,
       const std::vector<InferOptions>& options,
@@ -142,6 +148,11 @@ class InferenceServerGrpcClient {
     max_async_inflight_ = n == 0 ? 1 : n;
   }
 
+  // Default message compression for infer RPCs and streams: "gzip",
+  // "deflate", or "" (off). Per-call compression_algorithm overrides it.
+  void SetCompression(const std::string& algorithm);
+  std::string DefaultCompression();
+
  private:
   InferenceServerGrpcClient(const std::string& url, bool verbose);
 
@@ -149,7 +160,7 @@ class InferenceServerGrpcClient {
   Error Call(
       const std::string& method, const std::string& request,
       std::string* response, const Headers& headers = {},
-      uint64_t timeout_us = 0);
+      uint64_t timeout_us = 0, const std::string& compression = "");
   std::unique_ptr<h2::Connection> AcquireConnection(Error* err);
   void ReleaseConnection(std::unique_ptr<h2::Connection> conn);
 
@@ -182,6 +193,7 @@ class InferenceServerGrpcClient {
 
   std::mutex default_headers_mutex_;
   Headers default_headers_;
+  std::string default_compression_;  // default_headers_mutex_
   Headers MergedHeaders(const Headers& headers);
 };
 

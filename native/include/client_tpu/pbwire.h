@@ -190,9 +190,12 @@ class Reader {
 // gRPC message framing (5-byte prefix: compressed flag + u32 BE length)
 // ---------------------------------------------------------------------------
 
-inline void FrameMessage(const std::string& payload, std::string* out) {
+inline void FrameMessage(
+    const std::string& payload, std::string* out, bool compressed = false) {
   out->reserve(out->size() + 5 + payload.size());
-  out->push_back('\0');  // uncompressed
+  // gRPC message framing flag byte: 1 = payload is compressed with the
+  // algorithm named by the grpc-encoding header
+  out->push_back(compressed ? '\x01' : '\0');
   uint32_t n = static_cast<uint32_t>(payload.size());
   out->push_back(static_cast<char>((n >> 24) & 0xFF));
   out->push_back(static_cast<char>((n >> 16) & 0xFF));

@@ -408,6 +408,13 @@ int ctpu_grpc_set_header(void* client, const char* key, const char* value) {
   return 0;
 }
 
+// Default message compression: "gzip", "deflate", or "" (off).
+int ctpu_grpc_set_compression(void* client, const char* algorithm) {
+  static_cast<InferenceServerGrpcClient*>(client)->SetCompression(
+      algorithm == nullptr ? "" : algorithm);
+  return 0;
+}
+
 // In-flight window for the async completion-queue worker.
 int ctpu_grpc_set_async_concurrency(void* client, int n) {
   static_cast<InferenceServerGrpcClient*>(client)->SetAsyncConcurrency(

@@ -6,6 +6,8 @@
 
 #include "client_tpu/grpc_client.h"
 
+#include <zlib.h>
+
 #include <cstring>
 
 #include "client_tpu/pbwire.h"
@@ -13,6 +15,90 @@
 namespace client_tpu {
 
 namespace {
+
+// -- gRPC message compression (grpc-encoding: gzip | deflate) ---------------
+// Reference parity: grpc channel compression (Python grpc/_client.py
+// compression_algorithm; C++ grpc_client.cc channel args). "gzip" is the
+// RFC 1952 format, "deflate" the RFC 1950 zlib stream; decompression
+// auto-detects either via windowBits 15+32.
+
+Error ZCompress(const std::string& in, std::string* out, bool gzip_format) {
+  z_stream zs = {};
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED,
+                   15 + (gzip_format ? 16 : 0), 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("zlib deflateInit failed");
+  }
+  out->resize(deflateBound(&zs, in.size()));
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  zs.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
+  zs.avail_out = static_cast<uInt>(out->size());
+  int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) return Error("zlib deflate failed");
+  out->resize(zs.total_out);
+  return Error::Success();
+}
+
+// Decompression-bomb guard: the reference clients bound inbound messages
+// via max_receive_message_length (2^31-1 default); match that ceiling so a
+// hostile peer cannot amplify a small frame into unbounded allocation.
+constexpr size_t kMaxDecompressedSize = (1ull << 31) - 1;
+
+Error ZDecompress(const std::string& in, std::string* out) {
+  z_stream zs = {};
+  if (inflateInit2(&zs, 15 + 32) != Z_OK) {  // auto-detect gzip/zlib
+    return Error("zlib inflateInit failed");
+  }
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  out->clear();
+  char buf[64 * 1024];
+  int rc = Z_OK;
+  while (rc == Z_OK) {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = sizeof(buf);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return Error("corrupt compressed gRPC message");
+    }
+    out->append(buf, sizeof(buf) - zs.avail_out);
+    if (out->size() > kMaxDecompressedSize) {
+      inflateEnd(&zs);
+      return Error("compressed gRPC message decompresses beyond the 2GiB receive limit");
+    }
+  }
+  inflateEnd(&zs);
+  return Error::Success();
+}
+
+// Frame `payload`, compressing per `algorithm` ("gzip", "deflate",
+// "identity", or ""). Incompressible payloads fall back to flag-0
+// uncompressed framing (legal with grpc-encoding set, and what grpc-core
+// does) so enabling compression never enlarges the wire bytes.
+Error FrameMaybeCompressed(
+    const std::string& payload, const std::string& algorithm,
+    std::string* out) {
+  if (algorithm.empty() || algorithm == "identity") {
+    pb::FrameMessage(payload, out);
+    return Error::Success();
+  }
+  if (algorithm != "gzip" && algorithm != "deflate") {
+    return Error("unsupported compression_algorithm '" + algorithm +
+                 "' (supported: gzip, deflate, identity)");
+  }
+  std::string packed;
+  Error err = ZCompress(payload, &packed, algorithm == "gzip");
+  if (err) return err;
+  if (packed.size() >= payload.size()) {
+    pb::FrameMessage(payload, out);
+  } else {
+    pb::FrameMessage(packed, out, /*compressed=*/true);
+  }
+  return Error::Success();
+}
 
 const char* kStatusNames[] = {
     "OK", "CANCELLED", "UNKNOWN", "INVALID_ARGUMENT", "DEADLINE_EXCEEDED",
@@ -959,27 +1045,61 @@ void InferenceServerGrpcClient::ReleaseConnection(
 
 namespace {
 h2::HeaderList GrpcRequestHeaders(
-    const InferenceServerGrpcClient::Headers& extra) {
+    const InferenceServerGrpcClient::Headers& extra,
+    const std::string& compression = "") {
   h2::HeaderList headers = {
       {"content-type", "application/grpc"},
       {"te", "trailers"},
+      // always advertise: the server may compress responses either way
+      {"grpc-accept-encoding", "identity, deflate, gzip"},
   };
+  if (!compression.empty()) {
+    headers.emplace_back("grpc-encoding", compression);
+  }
   for (const auto& kv : extra) headers.emplace_back(kv.first, kv.second);
   return headers;
+}
+
+// Unframe + (if flagged) decompress one response message into *response.
+// `allow_empty`: admin RPCs legitimately answer with a zero-length body;
+// ModelInfer never does, so the async path keeps it a protocol error.
+Error UnpackResponse(
+    const std::string& body, std::string* response, bool allow_empty) {
+  size_t pos = 0;
+  const uint8_t* payload;
+  size_t payload_size;
+  bool compressed;
+  if (!pb::UnframeMessage(body, &pos, &payload, &payload_size, &compressed)) {
+    if (body.empty() && allow_empty) {
+      response->clear();
+      return Error::Success();
+    }
+    return Error("truncated gRPC response frame");
+  }
+  if (compressed) {
+    return ZDecompress(
+        std::string(reinterpret_cast<const char*>(payload), payload_size),
+        response);
+  }
+  response->assign(reinterpret_cast<const char*>(payload), payload_size);
+  return Error::Success();
 }
 }  // namespace
 
 Error InferenceServerGrpcClient::Call(
     const std::string& method, const std::string& request,
-    std::string* response, const Headers& headers, uint64_t timeout_us) {
+    std::string* response, const Headers& headers, uint64_t timeout_us,
+    const std::string& compression) {
   std::string body;
-  pb::FrameMessage(request, &body);
+  Error frame_err = FrameMaybeCompressed(request, compression, &body);
+  if (frame_err) return frame_err;
   Error err;
   std::unique_ptr<h2::Connection> conn = AcquireConnection(&err);
   if (err) return err;
   h2::Connection::Response resp;
   err = conn->Request(
-      "/inference.GRPCInferenceService/" + method, GrpcRequestHeaders(MergedHeaders(headers)),
+      "/inference.GRPCInferenceService/" + method,
+      GrpcRequestHeaders(MergedHeaders(headers), compression),
       body, &resp,
       // round sub-ms timeouts UP: truncating to 0 would mean "no timeout"
       timeout_us == 0 ? 0 : static_cast<int64_t>((timeout_us + 999) / 1000));
@@ -997,25 +1117,17 @@ Error InferenceServerGrpcClient::Call(
   }
   Error status = GrpcStatusToError(resp.headers);
   if (status) return status;
+  return UnpackResponse(resp.body, response, /*allow_empty=*/true);
+}
 
-  size_t pos = 0;
-  const uint8_t* payload;
-  size_t payload_size;
-  bool compressed;
-  if (!pb::UnframeMessage(resp.body, &pos, &payload, &payload_size,
-                          &compressed)) {
-    // Empty-response RPCs legitimately carry a zero-length message
-    if (resp.body.empty()) {
-      response->clear();
-      return Error::Success();
-    }
-    return Error("truncated gRPC response frame");
-  }
-  if (compressed) {
-    return Error("compressed gRPC responses are not supported");
-  }
-  response->assign(reinterpret_cast<const char*>(payload), payload_size);
-  return Error::Success();
+void InferenceServerGrpcClient::SetCompression(const std::string& algorithm) {
+  std::lock_guard<std::mutex> lock(default_headers_mutex_);
+  default_compression_ = algorithm;
+}
+
+std::string InferenceServerGrpcClient::DefaultCompression() {
+  std::lock_guard<std::mutex> lock(default_headers_mutex_);
+  return default_compression_;
 }
 
 // -- health / metadata ------------------------------------------------------
@@ -1434,14 +1546,16 @@ Error InferenceServerGrpcClient::Infer(
     InferResult** result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers) {
+    const Headers& headers, const std::string& compression_algorithm) {
   RequestTimers timers;
   timers.Capture(RequestTimers::Kind::REQUEST_START);
   std::string request = EncodeInferRequest(options, inputs, outputs);
   timers.Capture(RequestTimers::Kind::SEND_START);
   std::string response;
-  Error err =
-      Call("ModelInfer", request, &response, headers, options.client_timeout_us);
+  Error err = Call(
+      "ModelInfer", request, &response, headers, options.client_timeout_us,
+      compression_algorithm.empty() ? DefaultCompression()
+                                    : compression_algorithm);
   timers.Capture(RequestTimers::Kind::SEND_END);
   timers.Capture(RequestTimers::Kind::RECV_START);
   if (err) {
@@ -1462,7 +1576,7 @@ Error InferenceServerGrpcClient::AsyncInfer(
     OnComplete callback, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const Headers& headers) {
+    const Headers& headers, const std::string& compression_algorithm) {
   if (callback == nullptr) return Error("callback must not be null");
   {
     std::lock_guard<std::mutex> lock(queue_mutex_);
@@ -1477,7 +1591,17 @@ Error InferenceServerGrpcClient::AsyncInfer(
   request->callback = std::move(callback);
   request->timers.Capture(RequestTimers::Kind::REQUEST_START);
   std::string payload = EncodeInferRequest(options, inputs, outputs);
-  pb::FrameMessage(payload, &request->body);
+  const std::string compression = compression_algorithm.empty()
+                                      ? DefaultCompression()
+                                      : compression_algorithm;
+  Error frame_err = FrameMaybeCompressed(payload, compression, &request->body);
+  if (frame_err) {
+    delete request;
+    return frame_err;
+  }
+  if (!compression.empty()) {
+    request->headers["grpc-encoding"] = compression;
+  }
   {
     std::lock_guard<std::mutex> lock(queue_mutex_);
     pending_.push_back(request);
@@ -1688,20 +1812,13 @@ void InferenceServerGrpcClient::AsyncTransfer() {
       if (status) {
         InferResultGrpc::Create(&result, std::string(), status);
       } else {
-        size_t pos = 0;
-        const uint8_t* payload;
-        size_t payload_size;
-        bool compressed;
-        if (pb::UnframeMessage(body, &pos, &payload, &payload_size,
-                               &compressed) &&
-            !compressed) {
-          std::string message(
-              reinterpret_cast<const char*>(payload), payload_size);
-          InferResultGrpc::Create(
-              &result, std::move(message), Error::Success());
+        std::string message;
+        Error uerr = UnpackResponse(body, &message, /*allow_empty=*/false);
+        if (uerr) {
+          InferResultGrpc::Create(&result, std::string(), uerr);
         } else {
           InferResultGrpc::Create(
-              &result, std::string(), Error("truncated gRPC response frame"));
+              &result, std::move(message), Error::Success());
         }
       }
     }
@@ -1802,6 +1919,7 @@ struct InferenceServerGrpcClient::StreamCtx {
   std::atomic<bool> active{true};
   std::mutex send_mutex;
   uint64_t timeout_us = 0;
+  std::string compression;  // fixed at StreamOpen (grpc-encoding header)
 };
 
 Error InferenceServerGrpcClient::StartStream(
@@ -1816,9 +1934,13 @@ Error InferenceServerGrpcClient::StartStream(
   auto ctx = std::make_unique<StreamCtx>();
   Error err = h2::Connection::Connect(&ctx->conn, url_);
   if (err) return Error("[StatusCode.UNAVAILABLE] " + err.Message());
+  // stream compression is fixed at HEADERS time: the client default governs
+  // every message sent on this stream
+  ctx->compression = DefaultCompression();
   err = ctx->conn->StreamOpen(
       "/inference.GRPCInferenceService/ModelStreamInfer",
-      GrpcRequestHeaders(MergedHeaders(headers)), &ctx->stream_id);
+      GrpcRequestHeaders(MergedHeaders(headers), ctx->compression),
+      &ctx->stream_id);
   if (err) return Error("[StatusCode.UNAVAILABLE] " + err.Message());
   ctx->callback = std::move(callback);
   ctx->timeout_us = stream_timeout_us;
@@ -1860,13 +1982,20 @@ void InferenceServerGrpcClient::StreamReader() {
     const uint8_t* payload;
     size_t payload_size;
     bool compressed;
+    std::string inflated;
     while (pb::UnframeMessage(buffer, &pos, &payload, &payload_size,
                               &compressed)) {
       if (compressed) {
-        ctx->active = false;
-        ctx->callback(
-            nullptr, Error("compressed gRPC responses are not supported"));
-        return;
+        Error zerr = ZDecompress(
+            std::string(reinterpret_cast<const char*>(payload), payload_size),
+            &inflated);
+        if (zerr) {
+          ctx->active = false;
+          ctx->callback(nullptr, zerr);
+          return;
+        }
+        payload = reinterpret_cast<const uint8_t*>(inflated.data());
+        payload_size = inflated.size();
       }
       // ModelStreamInferResponse: error_message=1, infer_response=2
       pb::Reader r(payload, payload_size);
@@ -1923,7 +2052,8 @@ Error InferenceServerGrpcClient::AsyncStreamInfer(
   }
   std::string payload = EncodeInferRequest(options, inputs, outputs);
   std::string framed;
-  pb::FrameMessage(payload, &framed);
+  Error frame_err = FrameMaybeCompressed(payload, stream_->compression, &framed);
+  if (frame_err) return frame_err;
   std::lock_guard<std::mutex> send_lock(stream_->send_mutex);
   Error err = stream_->conn->StreamSend(
       stream_->stream_id, framed.data(), framed.size(), /*end_stream=*/false);
