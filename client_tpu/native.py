@@ -40,6 +40,11 @@ def _bind(lib):
     lib.ctpu_last_error.restype = ctypes.c_char_p
     lib.ctpu_client_create.restype = ctypes.c_void_p
     lib.ctpu_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ctpu_client_create_ssl.restype = ctypes.c_void_p
+    lib.ctpu_client_create_ssl.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+    ]
     lib.ctpu_client_destroy.argtypes = [ctypes.c_void_p]
     lib.ctpu_server_live.argtypes = [ctypes.c_void_p]
     lib.ctpu_model_ready.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -138,6 +143,11 @@ def _bind(lib):
     # grpc client (same value-model handles; results use ctpu_result_*)
     lib.ctpu_grpc_client_create.restype = ctypes.c_void_p
     lib.ctpu_grpc_client_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ctpu_grpc_client_create_ssl.restype = ctypes.c_void_p
+    lib.ctpu_grpc_client_create_ssl.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+    ]
     lib.ctpu_grpc_client_destroy.argtypes = [ctypes.c_void_p]
     lib.ctpu_grpc_server_live.argtypes = [ctypes.c_void_p]
     lib.ctpu_grpc_model_ready.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -277,6 +287,7 @@ class NativeClient:
     # the value-model handles are shared across both clients)
     _FN = {
         "create": "ctpu_client_create",
+        "create_ssl": "ctpu_client_create_ssl",
         "destroy": "ctpu_client_destroy",
         "live": "ctpu_server_live",
         "ready": "ctpu_model_ready",
@@ -287,15 +298,36 @@ class NativeClient:
         "set_header": "ctpu_set_header",
     }
 
-    def __init__(self, url: str, verbose: bool = False):
+    def __init__(self, url: str, verbose: bool = False, ssl: bool = False,
+                 ssl_options: Optional[dict] = None):
+        """``ssl=True`` (or an ``https://`` url) negotiates TLS.
+        ``ssl_options`` keys (all optional): ``ca_cert``, ``client_cert``,
+        ``client_key`` (PEM file paths), ``verify_peer``, ``verify_host``
+        (bools, default True) — HttpSslOptions / grpc SslOptions parity."""
         self._lib = load()
         # eager, not lazy-on-first-use: concurrent async_infer calls racing
         # a lazy init could each install a fresh dict and orphan the other's
         # live callback trampoline (native callback into freed memory)
         self._async_pending = {}  # id -> trampoline (CFUNCTYPE unhashable)
-        self._handle = getattr(self._lib, self._FN["create"])(
-            url.encode(), int(verbose)
-        )
+        if ssl or url.startswith("https://") or ssl_options:
+            if not url.startswith("https://"):
+                # ssl=True must never downgrade to cleartext: the HTTP C
+                # path's SSL options only configure verification, the scheme
+                # is what selects TLS
+                url = "https://" + url.removeprefix("http://")
+            opts = ssl_options or {}
+            self._handle = getattr(self._lib, self._FN["create_ssl"])(
+                url.encode(), int(verbose),
+                (opts.get("ca_cert") or "").encode() or None,
+                (opts.get("client_cert") or "").encode() or None,
+                (opts.get("client_key") or "").encode() or None,
+                int(opts.get("verify_peer", True)),
+                int(opts.get("verify_host", True)),
+            )
+        else:
+            self._handle = getattr(self._lib, self._FN["create"])(
+                url.encode(), int(verbose)
+            )
         if not self._handle:
             raise InferenceServerException(f"native client create failed: {_err(self._lib)}")
 
@@ -474,6 +506,7 @@ class NativeGrpcClient(NativeClient):
 
     _FN = {
         "create": "ctpu_grpc_client_create",
+        "create_ssl": "ctpu_grpc_client_create_ssl",
         "destroy": "ctpu_grpc_client_destroy",
         "live": "ctpu_grpc_server_live",
         "ready": "ctpu_grpc_model_ready",

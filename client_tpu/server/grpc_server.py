@@ -413,10 +413,12 @@ class GrpcInferenceServer:
     """An in-process v2 GRPC server bound to localhost."""
 
     def __init__(self, core: ServerCore, port: int = 0, max_workers: int = 8,
-                 verbose: bool = False, compression=None):
+                 verbose: bool = False, compression=None, credentials=None):
         """``compression``: a ``grpc.Compression`` value (e.g. ``Gzip``) to
         compress responses for clients that advertise support — exercises
-        clients' grpc-encoding decompression paths end-to-end."""
+        clients' grpc-encoding decompression paths end-to-end.
+        ``credentials``: a ``grpc.ServerCredentials`` (ssl_server_credentials)
+        to serve TLS instead of cleartext h2c."""
         self.core = core
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
@@ -429,7 +431,12 @@ class GrpcInferenceServer:
             compression=compression,
         )
         self._server.add_generic_rpc_handlers((_Handlers(core, verbose),))
-        self._port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        if credentials is not None:
+            self._port = self._server.add_secure_port(
+                f"127.0.0.1:{port}", credentials
+            )
+        else:
+            self._port = self._server.add_insecure_port(f"127.0.0.1:{port}")
 
     @property
     def port(self) -> int:

@@ -35,9 +35,14 @@ class InferenceServerGrpcClient {
   using OnStreamResponse = std::function<void(InferResult*, const Error&)>;
   using Headers = std::map<std::string, std::string>;
 
+  // `server_url`: "host:port" (cleartext h2c) or "https://host:port".
+  // `ssl_options` configures TLS (CA bundle, client cert/key, verification)
+  // and, with use_tls=true, forces TLS for scheme-less urls — the analog of
+  // the reference grpc SslOptions (grpc_client.h:43-60).
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
-      const std::string& server_url, bool verbose = false);
+      const std::string& server_url, bool verbose = false,
+      const tls::TlsOptions& ssl_options = {});
   ~InferenceServerGrpcClient();
 
   Error IsServerLive(bool* live, const Headers& headers = {});
@@ -154,7 +159,8 @@ class InferenceServerGrpcClient {
   std::string DefaultCompression();
 
  private:
-  InferenceServerGrpcClient(const std::string& url, bool verbose);
+  InferenceServerGrpcClient(
+      const std::string& url, bool verbose, const tls::TlsOptions& ssl);
 
   // One unary RPC over a pooled connection.
   Error Call(
@@ -172,6 +178,7 @@ class InferenceServerGrpcClient {
 
   std::string url_;
   bool verbose_;
+  tls::TlsOptions ssl_options_;
 
   std::mutex pool_mutex_;
   std::vector<std::unique_ptr<h2::Connection>> idle_;

@@ -36,6 +36,7 @@
 #include <vector>
 
 #include "client_tpu/common.h"
+#include "client_tpu/tls.h"
 
 namespace client_tpu {
 namespace h2 {
@@ -72,9 +73,12 @@ class Connection {
   };
 
   // Connects, sends the client preface, and performs the SETTINGS exchange.
+  // `host_port` accepts "host:port" (cleartext h2c), or an "https://" url —
+  // TLS via the system libssl runtime with ALPN "h2" (tls.h). Explicit
+  // `tls` options force/configure TLS regardless of scheme.
   static Error Connect(
       std::unique_ptr<Connection>* conn, const std::string& host_port,
-      int64_t timeout_ms = 10000);
+      int64_t timeout_ms = 10000, const tls::TlsOptions* tls_options = nullptr);
   ~Connection();
 
   // One blocking request/response exchange. `headers` are the non-pseudo
@@ -144,9 +148,16 @@ class Connection {
     Error error;                  // RST_STREAM arrival
   };
 
+  // Raw-socket-contract IO (send(2)/recv(2) semantics on a non-blocking
+  // fd), routed through the TLS session when one is active.
+  ssize_t IoSend(const void* data, size_t size);
+  ssize_t IoRecv(void* buf, size_t size);
+  short IoPollEvents(short plain) const;
+
   std::string host_port_;
   int fd_ = -1;
   std::atomic<bool> alive_{false};
+  std::unique_ptr<tls::TlsSession> tls_;
 
   std::mutex send_mutex_;   // whole-frame socket writes
   std::mutex state_mutex_;  // streams_, windows, next_stream_id_

@@ -22,15 +22,32 @@ struct curl_slist;
 
 namespace client_tpu {
 
+// TLS options for the libcurl transport — field-for-field the reference
+// HttpSslOptions (/root/reference/src/c++/library/http_client.h:45-103),
+// minus the CURLOPT_SSLVERSION pin (curl negotiates the best TLS version).
+struct HttpSslOptions {
+  bool verify_peer = true;   // CURLOPT_SSL_VERIFYPEER
+  bool verify_host = true;   // CURLOPT_SSL_VERIFYHOST (2 when on)
+  std::string ca_info;       // CURLOPT_CAINFO (PEM CA bundle path)
+  std::string cert;          // CURLOPT_SSLCERT (client certificate path)
+  std::string cert_type = "PEM";  // CURLOPT_SSLCERTTYPE: PEM | DER
+  std::string key;           // CURLOPT_SSLKEY (client key path)
+  std::string key_type = "PEM";   // CURLOPT_SSLKEYTYPE: PEM | DER
+};
+
 class InferenceServerHttpClient {
  public:
   using OnComplete = std::function<void(InferResult*)>;
   using OnMultiComplete = std::function<void(std::vector<InferResult*>)>;
   using Headers = std::map<std::string, std::string>;
 
+  // `server_url` accepts "host:port" (http) or an explicit
+  // "https://host:port"; `ssl_options` governs the TLS handshake for the
+  // latter (applies to the sync easy handle and every async multi handle).
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
-      const std::string& server_url, bool verbose = false);
+      const std::string& server_url, bool verbose = false,
+      const HttpSslOptions& ssl_options = {});
   ~InferenceServerHttpClient();
 
   Error IsServerLive(bool* live);
@@ -119,7 +136,9 @@ class InferenceServerHttpClient {
 
 
  private:
-  InferenceServerHttpClient(const std::string& url, bool verbose);
+  InferenceServerHttpClient(
+      const std::string& url, bool verbose, const HttpSslOptions& ssl);
+  void ApplySslOptions(CURL* easy);
 
   Error Perform(
       const std::string& path, const std::string* body, long* http_code,
@@ -140,6 +159,7 @@ class InferenceServerHttpClient {
   void AsyncTransfer();
 
   std::string url_;
+  HttpSslOptions ssl_options_;
   bool verbose_;
   CURL* easy_ = nullptr;  // shared handle for sync calls
   std::mutex easy_mutex_;

@@ -1,0 +1,80 @@
+// TLS socket layer for the native clients, over the SYSTEM libssl runtime.
+//
+// Why dlopen: this image ships /lib/x86_64-linux-gnu/libssl.so.3 (OpenSSL
+// 3.0, the same library libcurl links) but NO OpenSSL headers, and the
+// only headers around (a BoringSSL bundle) mismatch that runtime's ABI.
+// So the handful of stable libssl entry points used here are declared by
+// hand and resolved with dlopen/dlsym at first use — no build-time
+// dependency, same runtime curl already proved works.
+//
+// Reference parity: HttpSslOptions (http_client.h:45-103) and grpc
+// SslOptions (grpc_client.h:43-60) — CA bundle, client cert/key, peer and
+// host verification. ALPN offers "h2" so the gRPC path negotiates HTTP/2.
+#pragma once
+
+#include <sys/types.h>
+
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "client_tpu/common.h"
+
+namespace client_tpu {
+namespace tls {
+
+struct TlsOptions {
+  bool use_tls = false;
+  // Reference HttpSslOptions::verify_peer / verify_host.
+  bool verify_peer = true;
+  bool verify_host = true;
+  // PEM CA bundle (HttpSslOptions::ca_info / grpc root_certificates);
+  // empty = the system default verify paths.
+  std::string ca_cert_file;
+  // PEM client certificate chain + private key (mutual TLS).
+  std::string client_cert_file;
+  std::string client_key_file;
+};
+
+// One TLS client session over an already-connected non-blocking socket.
+// Send/Recv follow the send(2)/recv(2) contract on a non-blocking fd:
+// >0 bytes moved; 0 = orderly peer close (Recv); -1 with errno EAGAIN =
+// retry after poll(fd, poll_events()).
+class TlsSession {
+ public:
+  // Handshakes (blocking up to timeout_ms, polling the non-blocking fd).
+  // `host` feeds SNI and hostname verification.
+  static Error Create(
+      std::unique_ptr<TlsSession>* out, int fd, const std::string& host,
+      const TlsOptions& options, int64_t timeout_ms);
+  ~TlsSession();
+
+  ssize_t Send(const void* data, size_t size);
+  ssize_t Recv(void* buf, size_t size);
+  // Which poll event unblocks the last EAGAIN on each half (TLS
+  // renegotiation can want POLLIN mid-write and vice versa). Tracked
+  // separately per direction: a concurrent writer's WANT_WRITE must not
+  // redirect a blocked reader to poll for POLLOUT.
+  short SendPollEvents() const { return send_poll_events_; }
+  short RecvPollEvents() const { return recv_poll_events_; }
+  // Negotiated ALPN protocol ("h2", "http/1.1", or "" if none).
+  const std::string& Alpn() const { return alpn_; }
+
+ private:
+  TlsSession() = default;
+  void* ssl_ = nullptr;  // SSL*
+  void* ctx_ = nullptr;  // SSL_CTX*
+  // OpenSSL SSL objects are NOT safe for concurrent SSL_read/SSL_write
+  // (shared rwstate + error state); the h2 layer has independent send and
+  // recv locks, so this mutex serializes every libssl call on the session.
+  std::mutex io_mutex_;
+  short send_poll_events_ = 0x004 /*POLLOUT*/;
+  short recv_poll_events_ = 0x001 /*POLLIN*/;
+  std::string alpn_;
+};
+
+// True when the system libssl runtime loaded (TLS urls usable).
+bool Available();
+
+}  // namespace tls
+}  // namespace client_tpu

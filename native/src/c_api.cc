@@ -10,6 +10,7 @@
 #include "client_tpu/tpu_shm.h"
 
 using client_tpu::Error;
+using client_tpu::HttpSslOptions;
 using client_tpu::InferenceServerGrpcClient;
 using client_tpu::InferenceServerHttpClient;
 using client_tpu::InferInput;
@@ -38,6 +39,23 @@ const char* ctpu_last_error() { return g_last_error.c_str(); }
 void* ctpu_client_create(const char* url, int verbose) {
   std::unique_ptr<InferenceServerHttpClient> client;
   Error err = InferenceServerHttpClient::Create(&client, url, verbose != 0);
+  if (SetError(err) != 0) return nullptr;
+  return client.release();
+}
+
+// HTTPS variant: ca/cert/key are file paths (empty/NULL = unset).
+void* ctpu_client_create_ssl(
+    const char* url, int verbose, const char* ca_cert, const char* client_cert,
+    const char* client_key, int verify_peer, int verify_host) {
+  HttpSslOptions ssl;
+  ssl.verify_peer = verify_peer != 0;
+  ssl.verify_host = verify_host != 0;
+  if (ca_cert != nullptr) ssl.ca_info = ca_cert;
+  if (client_cert != nullptr) ssl.cert = client_cert;
+  if (client_key != nullptr) ssl.key = client_key;
+  std::unique_ptr<InferenceServerHttpClient> client;
+  Error err =
+      InferenceServerHttpClient::Create(&client, url, verbose != 0, ssl);
   if (SetError(err) != 0) return nullptr;
   return client.release();
 }
@@ -332,6 +350,25 @@ int ctpu_set_header(void* client, const char* key, const char* value) {
 void* ctpu_grpc_client_create(const char* url, int verbose) {
   std::unique_ptr<InferenceServerGrpcClient> client;
   Error err = InferenceServerGrpcClient::Create(&client, url, verbose != 0);
+  if (SetError(err) != 0) return nullptr;
+  return client.release();
+}
+
+// TLS variant (grpc-over-TLS on the library's own h2 via the system libssl
+// runtime). ca/cert/key are PEM file paths.
+void* ctpu_grpc_client_create_ssl(
+    const char* url, int verbose, const char* ca_cert, const char* client_cert,
+    const char* client_key, int verify_peer, int verify_host) {
+  client_tpu::tls::TlsOptions ssl;
+  ssl.use_tls = true;
+  ssl.verify_peer = verify_peer != 0;
+  ssl.verify_host = verify_host != 0;
+  if (ca_cert != nullptr) ssl.ca_cert_file = ca_cert;
+  if (client_cert != nullptr) ssl.client_cert_file = client_cert;
+  if (client_key != nullptr) ssl.client_key_file = client_key;
+  std::unique_ptr<InferenceServerGrpcClient> client;
+  Error err =
+      InferenceServerGrpcClient::Create(&client, url, verbose != 0, ssl);
   if (SetError(err) != 0) return nullptr;
   return client.release();
 }

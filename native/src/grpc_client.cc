@@ -979,14 +979,16 @@ void EncodeTraceSettings(pb::Writer* w, const Json& settings) {
 
 Error InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client,
-    const std::string& server_url, bool verbose) {
-  client->reset(new InferenceServerGrpcClient(server_url, verbose));
+    const std::string& server_url, bool verbose,
+    const tls::TlsOptions& ssl_options) {
+  client->reset(
+      new InferenceServerGrpcClient(server_url, verbose, ssl_options));
   return Error::Success();
 }
 
 InferenceServerGrpcClient::InferenceServerGrpcClient(
-    const std::string& url, bool verbose)
-    : url_(url), verbose_(verbose) {}
+    const std::string& url, bool verbose, const tls::TlsOptions& ssl)
+    : url_(url), verbose_(verbose), ssl_options_(ssl) {}
 
 InferenceServerGrpcClient::~InferenceServerGrpcClient() {
   StopStream();
@@ -1028,7 +1030,7 @@ std::unique_ptr<h2::Connection> InferenceServerGrpcClient::AcquireConnection(
     }
   }
   std::unique_ptr<h2::Connection> conn;
-  *err = h2::Connection::Connect(&conn, url_);
+  *err = h2::Connection::Connect(&conn, url_, 10000, &ssl_options_);
   if (*err) {
     *err = Error("[StatusCode.UNAVAILABLE] " + err->Message());
     return nullptr;
@@ -1691,7 +1693,7 @@ void InferenceServerGrpcClient::AsyncTransfer() {
     if (!to_open.empty() && (conn == nullptr || !conn->Alive())) {
       Error cerr;
       std::unique_ptr<h2::Connection> fresh;
-      cerr = h2::Connection::Connect(&fresh, url_);
+      cerr = h2::Connection::Connect(&fresh, url_, 10000, &ssl_options_);
       if (cerr) {
         for (AsyncRequest* request : to_open) {
           FinishAsyncError(
@@ -1932,7 +1934,7 @@ Error InferenceServerGrpcClient::StartStream(
         "cannot start a stream: one is already active; stop it first");
   }
   auto ctx = std::make_unique<StreamCtx>();
-  Error err = h2::Connection::Connect(&ctx->conn, url_);
+  Error err = h2::Connection::Connect(&ctx->conn, url_, 10000, &ssl_options_);
   if (err) return Error("[StatusCode.UNAVAILABLE] " + err.Message());
   // stream compression is fixed at HEADERS time: the client default governs
   // every message sent on this stream

@@ -301,11 +301,23 @@ Connection::~Connection() {
 }
 
 Error Connection::Connect(
-    std::unique_ptr<Connection>* conn, const std::string& host_port,
-    int64_t timeout_ms) {
+    std::unique_ptr<Connection>* conn, const std::string& url,
+    int64_t timeout_ms, const tls::TlsOptions* tls_options) {
+  if (url.empty()) return Error("h2: empty server url");
+  // scheme prefix: https:// selects TLS (explicit options can also force it)
+  std::string host_port = url;
+  bool use_tls = tls_options != nullptr && tls_options->use_tls;
+  std::string default_port = "80";
+  if (host_port.rfind("https://", 0) == 0) {
+    host_port = host_port.substr(8);
+    use_tls = true;
+    default_port = "443";
+  } else if (host_port.rfind("http://", 0) == 0) {
+    host_port = host_port.substr(7);
+  }
   if (host_port.empty()) return Error("h2: empty server url");
   std::string host = host_port;
-  std::string port = "80";
+  std::string port = default_port;
   size_t bracket = host_port.rfind("]:");
   if (bracket != std::string::npos && host_port.front() == '[') {
     // [v6-literal]:port
@@ -366,11 +378,40 @@ Error Connection::Connect(
 
   auto c = std::unique_ptr<Connection>(new Connection(host_port));
   c->fd_ = fd;
+  if (use_tls) {
+    tls::TlsOptions opts = tls_options != nullptr ? *tls_options
+                                                  : tls::TlsOptions{};
+    Error terr =
+        tls::TlsSession::Create(&c->tls_, fd, host, opts, timeout_ms);
+    if (terr) return terr;
+    if (c->tls_->Alpn() != "h2") {
+      return Error(
+          "TLS peer did not negotiate h2 (ALPN: '" + c->tls_->Alpn() +
+          "') — gRPC requires HTTP/2");
+    }
+  }
   Error err = c->Handshake(timeout_ms);
   if (err) return err;
   c->alive_ = true;
   *conn = std::move(c);
   return Error::Success();
+}
+
+ssize_t Connection::IoSend(const void* data, size_t size) {
+  if (tls_ != nullptr) return tls_->Send(data, size);
+  return send(fd_, data, size, MSG_NOSIGNAL);
+}
+
+ssize_t Connection::IoRecv(void* buf, size_t size) {
+  if (tls_ != nullptr) return tls_->Recv(buf, size);
+  return recv(fd_, buf, size, MSG_DONTWAIT);
+}
+
+short Connection::IoPollEvents(short plain) const {
+  // a TLS session mid-renegotiation can need POLLIN to finish a write and
+  // vice versa — it tracks which event unblocks each half's last EAGAIN
+  if (tls_ == nullptr) return plain;
+  return plain == POLLOUT ? tls_->SendPollEvents() : tls_->RecvPollEvents();
 }
 
 Error Connection::Handshake(int64_t timeout_ms) {
@@ -415,14 +456,14 @@ Error Connection::SendAll(const void* data, size_t size, int64_t timeout_ms) {
   size_t remaining = size;
   int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
   while (remaining > 0) {
-    ssize_t n = send(fd_, p, remaining, MSG_NOSIGNAL);
+    ssize_t n = IoSend(p, remaining);
     if (n > 0) {
       p += n;
       remaining -= static_cast<size_t>(n);
       continue;
     }
     if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
-      struct pollfd pfd = {fd_, POLLOUT, 0};
+      struct pollfd pfd = {fd_, IoPollEvents(POLLOUT), 0};
       int wait = deadline ? static_cast<int>(deadline - NowMs()) : 1000;
       if (deadline && wait <= 0) return Error("send timeout");
       poll(&pfd, 1, wait);
@@ -463,7 +504,7 @@ Error Connection::RecvFrameLocked(int64_t timeout_ms) {
   auto fill = [&](size_t need) -> Error {
     while (recv_buffer_.size() < need) {
       char buf[65536];
-      ssize_t n = recv(fd_, buf, sizeof(buf), MSG_DONTWAIT);
+      ssize_t n = IoRecv(buf, sizeof(buf));
       if (n > 0) {
         recv_buffer_.append(buf, static_cast<size_t>(n));
         continue;
@@ -476,7 +517,7 @@ Error Connection::RecvFrameLocked(int64_t timeout_ms) {
                 : "connection closed by peer (GOAWAY: " + goaway_debug_ + ")");
       }
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
-        struct pollfd pfd = {fd_, POLLIN, 0};
+        struct pollfd pfd = {fd_, IoPollEvents(POLLIN), 0};
         int wait = deadline ? static_cast<int>(deadline - NowMs()) : 1000;
         if (deadline && wait <= 0) return Error("Deadline Exceeded");
         poll(&pfd, 1, wait);
@@ -690,7 +731,7 @@ Error Connection::StreamOpen(
   if (!alive_) return Error("connection is closed");
   std::string block;
   EncodeLiteralHeader(&block, ":method", "POST");
-  EncodeLiteralHeader(&block, ":scheme", "http");
+  EncodeLiteralHeader(&block, ":scheme", tls_ != nullptr ? "https" : "http");
   EncodeLiteralHeader(&block, ":authority", host_port_);
   EncodeLiteralHeader(&block, ":path", path);
   for (const auto& kv : headers) {

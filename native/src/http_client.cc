@@ -395,15 +395,37 @@ struct InferenceServerHttpClient::AsyncRequest {
 
 Error InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client,
-    const std::string& server_url, bool verbose) {
-  client->reset(new InferenceServerHttpClient(server_url, verbose));
+    const std::string& server_url, bool verbose,
+    const HttpSslOptions& ssl_options) {
+  client->reset(
+      new InferenceServerHttpClient(server_url, verbose, ssl_options));
   return Error::Success();
 }
 
 InferenceServerHttpClient::InferenceServerHttpClient(
-    const std::string& url, bool verbose)
-    : url_(url), verbose_(verbose) {
+    const std::string& url, bool verbose, const HttpSslOptions& ssl)
+    : url_(url), verbose_(verbose), ssl_options_(ssl) {
   easy_ = curl_easy_init();
+}
+
+// Reference HttpSslOptions application (http_client.cc SetSSLCurlOptions):
+// applied after every SetCommonOptions since curl_easy_reset clears state.
+void InferenceServerHttpClient::ApplySslOptions(CURL* easy) {
+  curl_easy_setopt(
+      easy, CURLOPT_SSL_VERIFYPEER, ssl_options_.verify_peer ? 1L : 0L);
+  curl_easy_setopt(
+      easy, CURLOPT_SSL_VERIFYHOST, ssl_options_.verify_host ? 2L : 0L);
+  if (!ssl_options_.ca_info.empty()) {
+    curl_easy_setopt(easy, CURLOPT_CAINFO, ssl_options_.ca_info.c_str());
+  }
+  if (!ssl_options_.cert.empty()) {
+    curl_easy_setopt(easy, CURLOPT_SSLCERT, ssl_options_.cert.c_str());
+    curl_easy_setopt(easy, CURLOPT_SSLCERTTYPE, ssl_options_.cert_type.c_str());
+  }
+  if (!ssl_options_.key.empty()) {
+    curl_easy_setopt(easy, CURLOPT_SSLKEY, ssl_options_.key.c_str());
+    curl_easy_setopt(easy, CURLOPT_SSLKEYTYPE, ssl_options_.key_type.c_str());
+  }
 }
 
 InferenceServerHttpClient::~InferenceServerHttpClient() {
@@ -456,6 +478,7 @@ Error InferenceServerHttpClient::Perform(
   curl_easy_reset(easy_);
   HeaderCapture capture;
   SetCommonOptions(easy_, url_ + "/" + path, body, response, &capture, 0);
+  ApplySslOptions(easy_);
   struct curl_slist* headers = DefaultHeaderList(nullptr);
   if (headers != nullptr) {
     curl_easy_setopt(easy_, CURLOPT_HTTPHEADER, headers);
@@ -760,6 +783,7 @@ Error InferenceServerHttpClient::Infer(
     curl_easy_reset(easy_);
     SetCommonOptions(
         easy_, uri, &body, &response, &capture, options.client_timeout_us);
+    ApplySslOptions(easy_);
     struct curl_slist* headers = DefaultHeaderList(nullptr);
     std::string hlen =
         "Inference-Header-Content-Length: " + std::to_string(header_length);
@@ -825,6 +849,7 @@ Error InferenceServerHttpClient::AsyncInfer(
   SetCommonOptions(
       request->easy, uri, &request->body, &request->response,
       &request->capture, options.client_timeout_us);
+  ApplySslOptions(request->easy);
   std::string hlen =
       "Inference-Header-Content-Length: " + std::to_string(header_length);
   request->headers = DefaultHeaderList(nullptr);

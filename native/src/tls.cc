@@ -1,0 +1,255 @@
+// TLS over the system libssl.so.3 runtime, resolved via dlopen (see tls.h
+// for why there is no build-time OpenSSL dependency in this image).
+//
+// ABI notes: every entry point used here has had a stable signature since
+// OpenSSL 1.1.0 and is unchanged in 3.x; constants (SSL_ERROR_*,
+// SSL_VERIFY_*, SSL_FILETYPE_PEM, SSL_CTRL_SET_TLSEXT_HOSTNAME) are
+// likewise ABI-frozen — they are redeclared below from the public spec.
+
+#include "client_tpu/tls.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <poll.h>
+#include <signal.h>
+
+#include <mutex>
+
+namespace client_tpu {
+namespace tls {
+
+namespace {
+
+// -- libssl ABI (hand-declared; no headers in the image) --------------------
+constexpr int kSslErrorWantRead = 2;   // SSL_ERROR_WANT_READ
+constexpr int kSslErrorWantWrite = 3;  // SSL_ERROR_WANT_WRITE
+constexpr int kSslErrorZeroReturn = 6; // SSL_ERROR_ZERO_RETURN
+constexpr int kSslVerifyNone = 0;      // SSL_VERIFY_NONE
+constexpr int kSslVerifyPeer = 1;      // SSL_VERIFY_PEER
+constexpr int kSslFiletypePem = 1;     // SSL_FILETYPE_PEM
+constexpr int kCtrlSetTlsextHostname = 55;  // SSL_CTRL_SET_TLSEXT_HOSTNAME
+
+struct Libssl {
+  void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(void*);
+  void (*SSL_CTX_free)(void*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  int (*SSL_CTX_set_default_verify_paths)(void*);
+  int (*SSL_CTX_use_certificate_chain_file)(void*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int);
+  int (*SSL_CTX_set_alpn_protos)(void*, const unsigned char*, unsigned);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_get_error)(const void*, int);
+  int (*SSL_shutdown)(void*);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  int (*SSL_set1_host)(void*, const char*);
+  void (*SSL_get0_alpn_selected)(const void*, const unsigned char**, unsigned*);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);
+  bool ok = false;
+};
+
+Libssl* Load() {
+  static Libssl lib;
+  static std::once_flag once;
+  std::call_once(once, [] {
+    void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) ssl = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    if (ssl == nullptr) return;
+    void* crypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (crypto == nullptr) crypto = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_GLOBAL);
+    auto sym = [&](const char* name) -> void* {
+      void* p = dlsym(ssl, name);
+      if (p == nullptr && crypto != nullptr) p = dlsym(crypto, name);
+      return p;
+    };
+#define RESOLVE(name)                                      \
+  lib.name = reinterpret_cast<decltype(lib.name)>(sym(#name)); \
+  if (lib.name == nullptr) return;
+    RESOLVE(TLS_client_method)
+    RESOLVE(SSL_CTX_new)
+    RESOLVE(SSL_CTX_free)
+    RESOLVE(SSL_CTX_set_verify)
+    RESOLVE(SSL_CTX_load_verify_locations)
+    RESOLVE(SSL_CTX_set_default_verify_paths)
+    RESOLVE(SSL_CTX_use_certificate_chain_file)
+    RESOLVE(SSL_CTX_use_PrivateKey_file)
+    RESOLVE(SSL_CTX_set_alpn_protos)
+    RESOLVE(SSL_new)
+    RESOLVE(SSL_free)
+    RESOLVE(SSL_set_fd)
+    RESOLVE(SSL_connect)
+    RESOLVE(SSL_read)
+    RESOLVE(SSL_write)
+    RESOLVE(SSL_get_error)
+    RESOLVE(SSL_shutdown)
+    RESOLVE(SSL_ctrl)
+    RESOLVE(SSL_set1_host)
+    RESOLVE(SSL_get0_alpn_selected)
+    RESOLVE(ERR_get_error)
+    RESOLVE(ERR_error_string_n)
+#undef RESOLVE
+    // SSL_write cannot pass MSG_NOSIGNAL to the underlying write(2) (unlike
+    // the plaintext path, h2.cc SendAll); a peer-closed TLS socket would
+    // SIGPIPE-kill the host. Ignore SIGPIPE iff the host left it at SIG_DFL
+    // (Python and most servers already ignore it; we never override a
+    // handler the host installed).
+    struct sigaction current;
+    if (sigaction(SIGPIPE, nullptr, &current) == 0 &&
+        current.sa_handler == SIG_DFL) {
+      struct sigaction ignore = {};
+      ignore.sa_handler = SIG_IGN;
+      sigaction(SIGPIPE, &ignore, nullptr);
+    }
+    lib.ok = true;
+  });
+  return lib.ok ? &lib : nullptr;
+}
+
+std::string LastSslError(Libssl* lib) {
+  unsigned long code = lib->ERR_get_error();
+  if (code == 0) return "unknown TLS error";
+  char buf[256];
+  lib->ERR_error_string_n(code, buf, sizeof(buf));
+  return std::string(buf);
+}
+
+int64_t NowMs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+}  // namespace
+
+bool Available() { return Load() != nullptr; }
+
+Error TlsSession::Create(
+    std::unique_ptr<TlsSession>* out, int fd, const std::string& host,
+    const TlsOptions& options, int64_t timeout_ms) {
+  Libssl* lib = Load();
+  if (lib == nullptr) {
+    return Error("TLS unavailable: system libssl runtime not found");
+  }
+  std::unique_ptr<TlsSession> session(new TlsSession());
+  session->ctx_ = lib->SSL_CTX_new(lib->TLS_client_method());
+  if (session->ctx_ == nullptr) return Error("SSL_CTX_new failed");
+
+  if (options.verify_peer) {
+    lib->SSL_CTX_set_verify(session->ctx_, kSslVerifyPeer, nullptr);
+    if (!options.ca_cert_file.empty()) {
+      if (lib->SSL_CTX_load_verify_locations(
+              session->ctx_, options.ca_cert_file.c_str(), nullptr) != 1) {
+        return Error("failed to load CA bundle '" + options.ca_cert_file +
+                     "': " + LastSslError(lib));
+      }
+    } else {
+      lib->SSL_CTX_set_default_verify_paths(session->ctx_);
+    }
+  } else {
+    lib->SSL_CTX_set_verify(session->ctx_, kSslVerifyNone, nullptr);
+  }
+  if (!options.client_cert_file.empty()) {
+    if (lib->SSL_CTX_use_certificate_chain_file(
+            session->ctx_, options.client_cert_file.c_str()) != 1) {
+      return Error("failed to load client certificate: " + LastSslError(lib));
+    }
+    const std::string& key = options.client_key_file.empty()
+                                 ? options.client_cert_file
+                                 : options.client_key_file;
+    if (lib->SSL_CTX_use_PrivateKey_file(
+            session->ctx_, key.c_str(), kSslFiletypePem) != 1) {
+      return Error("failed to load client key: " + LastSslError(lib));
+    }
+  }
+  // Offer h2 first (gRPC), http/1.1 second (plain HTTPS servers).
+  static const unsigned char kAlpn[] = {2, 'h', '2', 8, 'h', 't', 't', 'p',
+                                        '/', '1', '.', '1'};
+  lib->SSL_CTX_set_alpn_protos(session->ctx_, kAlpn, sizeof(kAlpn));
+
+  session->ssl_ = lib->SSL_new(session->ctx_);
+  if (session->ssl_ == nullptr) return Error("SSL_new failed");
+  lib->SSL_set_fd(session->ssl_, fd);
+  // SNI (SSL_set_tlsext_host_name is an SSL_ctrl macro in the headers)
+  lib->SSL_ctrl(session->ssl_, kCtrlSetTlsextHostname, 0,
+                const_cast<char*>(host.c_str()));
+  if (options.verify_peer && options.verify_host) {
+    lib->SSL_set1_host(session->ssl_, host.c_str());
+  }
+
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : 0;
+  while (true) {
+    int rc = lib->SSL_connect(session->ssl_);
+    if (rc == 1) break;
+    int err = lib->SSL_get_error(session->ssl_, rc);
+    if (err != kSslErrorWantRead && err != kSslErrorWantWrite) {
+      return Error("TLS handshake with " + host + " failed: " +
+                   LastSslError(lib));
+    }
+    struct pollfd pfd = {fd, static_cast<short>(
+                                 err == kSslErrorWantRead ? POLLIN : POLLOUT),
+                         0};
+    int wait = deadline ? static_cast<int>(deadline - NowMs()) : 1000;
+    if (deadline && wait <= 0) return Error("TLS handshake timeout");
+    poll(&pfd, 1, wait);
+  }
+  const unsigned char* proto = nullptr;
+  unsigned proto_len = 0;
+  lib->SSL_get0_alpn_selected(session->ssl_, &proto, &proto_len);
+  if (proto != nullptr) {
+    session->alpn_.assign(reinterpret_cast<const char*>(proto), proto_len);
+  }
+  *out = std::move(session);
+  return Error::Success();
+}
+
+TlsSession::~TlsSession() {
+  Libssl* lib = Load();
+  if (lib != nullptr) {
+    if (ssl_ != nullptr) {
+      lib->SSL_shutdown(ssl_);  // best-effort close_notify (non-blocking fd)
+      lib->SSL_free(ssl_);
+    }
+    if (ctx_ != nullptr) lib->SSL_CTX_free(ctx_);
+  }
+}
+
+ssize_t TlsSession::Send(const void* data, size_t size) {
+  Libssl* lib = Load();
+  std::lock_guard<std::mutex> lock(io_mutex_);
+  int rc = lib->SSL_write(ssl_, data, static_cast<int>(size));
+  if (rc > 0) return rc;
+  int err = lib->SSL_get_error(ssl_, rc);
+  if (err == kSslErrorWantRead || err == kSslErrorWantWrite) {
+    send_poll_events_ = err == kSslErrorWantRead ? POLLIN : POLLOUT;
+    errno = EAGAIN;
+    return -1;
+  }
+  errno = ECONNRESET;
+  return -1;
+}
+
+ssize_t TlsSession::Recv(void* buf, size_t size) {
+  Libssl* lib = Load();
+  std::lock_guard<std::mutex> lock(io_mutex_);
+  int rc = lib->SSL_read(ssl_, buf, static_cast<int>(size));
+  if (rc > 0) return rc;
+  int err = lib->SSL_get_error(ssl_, rc);
+  if (err == kSslErrorZeroReturn) return 0;  // orderly TLS close
+  if (err == kSslErrorWantRead || err == kSslErrorWantWrite) {
+    recv_poll_events_ = err == kSslErrorWantRead ? POLLIN : POLLOUT;
+    errno = EAGAIN;
+    return -1;
+  }
+  errno = ECONNRESET;
+  return -1;
+}
+
+}  // namespace tls
+}  // namespace client_tpu

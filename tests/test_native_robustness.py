@@ -308,3 +308,165 @@ def test_garbage_proto_payload_never_crashes():
                     pass  # typed rejection is the expected common case
         finally:
             server.close()
+
+
+# ---------------------------------------------------------------------------
+# TLS (VERDICT r2 #4): https on both native clients against self-signed certs
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def self_signed_cert(tmp_path_factory):
+    """(cert_path, key_path) for CN=localhost with SAN 127.0.0.1."""
+    import subprocess
+
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", key, "-out", cert, "-days", "2", "-subj",
+            "/CN=127.0.0.1",
+            "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost",
+        ],
+        check=True, capture_output=True,
+    )
+    return cert, key
+
+
+def test_native_grpc_over_tls(self_signed_cert):
+    """grpc-over-TLS on the library's own h2 (ALPN h2, system libssl
+    runtime): round trip against a grpcio secure port, CA-pinned.
+    Reference: grpc SslOptions, grpc_client.h:43-60."""
+    import grpc as grpc_mod
+    import numpy as np
+
+    from client_tpu.models import default_model_zoo
+    from client_tpu.native import NativeGrpcClient
+    from client_tpu.server import GrpcInferenceServer, ServerCore
+
+    cert, key = self_signed_cert
+    creds = grpc_mod.ssl_server_credentials(
+        [(open(key, "rb").read(), open(cert, "rb").read())]
+    )
+    core = ServerCore(default_model_zoo())
+    with GrpcInferenceServer(core, credentials=creds) as server:
+        data = np.arange(1024, dtype=np.int32).reshape(1, 1024)
+        with NativeGrpcClient(
+            f"https://{server.url}", ssl_options={"ca_cert": cert}
+        ) as client:
+            assert client.is_server_live()
+            out = client.infer(
+                "custom_identity_int32", [("INPUT0", data)], outputs=["OUTPUT0"]
+            )
+            np.testing.assert_array_equal(out["OUTPUT0"].reshape(data.shape), data)
+
+        # bi-di streaming rides the same TLS connection plumbing
+        import queue
+
+        results = queue.Queue()
+        with NativeGrpcClient(
+            f"https://{server.url}", ssl_options={"ca_cert": cert}
+        ) as stream_client:
+            stream_client.start_stream(
+                lambda outputs, error: results.put((outputs, error))
+            )
+            stream_client.stream_infer(
+                "simple_sequence",
+                [("INPUT", np.array([[5]], dtype=np.int32))],
+                sequence=(717, True, True),
+            )
+            outputs, error = results.get(timeout=30)
+            assert error is None, error
+            assert int(outputs["OUTPUT"][0, 0]) == 5
+            stream_client.stop_stream()
+
+        # verification is real: without the CA the handshake must fail
+        with NativeGrpcClient(
+            f"https://{server.url}"
+        ) as untrusted:
+            from client_tpu.utils import InferenceServerException
+
+            with pytest.raises(InferenceServerException, match="TLS|certificate|verify"):
+                untrusted.is_server_live()
+
+        # explicit opt-out mirrors the reference's verify_peer=false
+        with NativeGrpcClient(
+            f"https://{server.url}",
+            ssl_options={"verify_peer": False, "verify_host": False},
+        ) as insecure:
+            assert insecure.is_server_live()
+
+
+def test_native_http_over_tls(self_signed_cert):
+    """https on the libcurl client (HttpSslOptions parity) through a
+    TLS-terminating proxy in front of the in-process HTTP server.
+    Reference: http_client.h:45-103."""
+    import ssl as ssl_mod
+
+    import numpy as np
+
+    from client_tpu.models import default_model_zoo
+    from client_tpu.native import NativeClient
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    cert, key = self_signed_cert
+    with HttpInferenceServer(ServerCore(default_model_zoo())) as plain:
+        ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        listener = socket.socket()
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(8)
+        tls_port = listener.getsockname()[1]
+        alive = [True]
+
+        def pump(src, dst):
+            try:
+                while True:
+                    chunk = src.recv(65536)
+                    if not chunk:
+                        break
+                    dst.sendall(chunk)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        def accept_loop():
+            while alive[0]:
+                try:
+                    conn, _ = listener.accept()
+                    tls_conn = ctx.wrap_socket(conn, server_side=True)
+                except OSError:
+                    return
+                upstream = socket.create_connection(("127.0.0.1", plain.port))
+                threading.Thread(target=pump, args=(tls_conn, upstream), daemon=True).start()
+                threading.Thread(target=pump, args=(upstream, tls_conn), daemon=True).start()
+
+        t = threading.Thread(target=accept_loop, daemon=True)
+        t.start()
+        try:
+            data = np.arange(512, dtype=np.int32).reshape(1, 512)
+            with NativeClient(
+                f"https://127.0.0.1:{tls_port}", ssl_options={"ca_cert": cert}
+            ) as client:
+                assert client.is_server_live()
+                out = client.infer_raw(
+                    "custom_identity_int32", "INPUT0", data, "OUTPUT0"
+                )
+                np.testing.assert_array_equal(out, data.reshape(-1))
+
+            # un-pinned CA must fail peer verification
+            from client_tpu.utils import InferenceServerException
+
+            with NativeClient(f"https://127.0.0.1:{tls_port}") as untrusted:
+                with pytest.raises(InferenceServerException):
+                    untrusted.is_server_live()
+        finally:
+            alive[0] = False
+            listener.close()
