@@ -117,17 +117,17 @@ public class InferInput {
     tensor.put("name", Json.of(name));
     tensor.put("datatype", Json.of(datatype.name()));
     Json dims = Json.array();
-    for (long d : shape) dims.append(Json.of((double) d));
+    for (long d : shape) dims.append(Json.of(d));
     tensor.put("shape", dims);
     Json params = Json.object();
     if (inSharedMemory()) {
       params.put("shared_memory_region", Json.of(sharedMemoryRegion));
-      params.put("shared_memory_byte_size", Json.of((double) sharedMemoryByteSize));
+      params.put("shared_memory_byte_size", Json.of(sharedMemoryByteSize));
       if (sharedMemoryOffset != 0) {
-        params.put("shared_memory_offset", Json.of((double) sharedMemoryOffset));
+        params.put("shared_memory_offset", Json.of(sharedMemoryOffset));
       }
     } else {
-      params.put("binary_data_size", Json.of((double) (data == null ? 0 : data.length)));
+      params.put("binary_data_size", Json.of((long) (data == null ? 0 : data.length)));
     }
     tensor.put("parameters", params);
     return tensor;

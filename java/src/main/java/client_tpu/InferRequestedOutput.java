@@ -39,13 +39,13 @@ public class InferRequestedOutput {
     Json params = Json.object();
     if (sharedMemoryRegion != null) {
       params.put("shared_memory_region", Json.of(sharedMemoryRegion));
-      params.put("shared_memory_byte_size", Json.of((double) sharedMemoryByteSize));
+      params.put("shared_memory_byte_size", Json.of(sharedMemoryByteSize));
       if (sharedMemoryOffset != 0) {
-        params.put("shared_memory_offset", Json.of((double) sharedMemoryOffset));
+        params.put("shared_memory_offset", Json.of(sharedMemoryOffset));
       }
     } else {
       if (classCount > 0) {
-        params.put("classification", Json.of((double) classCount));
+        params.put("classification", Json.of((long) classCount));
       }
       params.put("binary_data", Json.of(binaryData));
     }

@@ -33,6 +33,7 @@ public class InferenceServerClient implements AutoCloseable {
   private final String baseUrl;
   private final HttpClient http;
   private final Duration requestTimeout;
+  private final int retryCnt;
 
   public InferenceServerClient(String url) {
     this(url, Duration.ofSeconds(5), Duration.ofSeconds(60));
@@ -40,8 +41,22 @@ public class InferenceServerClient implements AutoCloseable {
 
   public InferenceServerClient(
       String url, Duration connectTimeout, Duration requestTimeout) {
+    this(url, connectTimeout, requestTimeout, 0);
+  }
+
+  /**
+   * @param retryCnt additional attempts after a transport failure on
+   *     {@link #infer}: the request is retried up to {@code retryCnt} times
+   *     and the LAST failure is rethrown (reference semantics,
+   *     InferenceServerClient.java:293-317 — transient network errors on an
+   *     idempotent infer POST are absorbed, protocol errors are not).
+   */
+  public InferenceServerClient(
+      String url, Duration connectTimeout, Duration requestTimeout,
+      int retryCnt) {
     this.baseUrl = url.startsWith("http") ? url : "http://" + url;
     this.requestTimeout = requestTimeout;
+    this.retryCnt = Math.max(retryCnt, 0);
     this.http = HttpClient.newBuilder()
         .version(HttpClient.Version.HTTP_1_1)
         .connectTimeout(connectTimeout)
@@ -105,8 +120,8 @@ public class InferenceServerClient implements AutoCloseable {
       throws InferenceServerException {
     Json req = Json.object()
         .put("key", Json.of(key))
-        .put("offset", Json.of((double) offset))
-        .put("byte_size", Json.of((double) byteSize));
+        .put("offset", Json.of(offset))
+        .put("byte_size", Json.of(byteSize));
     postJson(
         "/v2/systemsharedmemory/region/" + seg(name) + "/register", req.dump());
   }
@@ -129,8 +144,8 @@ public class InferenceServerClient implements AutoCloseable {
     Json handle = Json.object().put("b64", Json.of(rawHandleBase64));
     Json req = Json.object()
         .put("raw_handle", handle)
-        .put("device_id", Json.of((double) deviceId))
-        .put("byte_size", Json.of((double) byteSize));
+        .put("device_id", Json.of((long) deviceId))
+        .put("byte_size", Json.of(byteSize));
     postJson("/v2/tpusharedmemory/region/" + seg(name) + "/register", req.dump());
   }
 
@@ -176,12 +191,25 @@ public class InferenceServerClient implements AutoCloseable {
       List<InferRequestedOutput> outputs, Map<String, String> headers)
       throws InferenceServerException {
     HttpRequest request = buildInferRequest(modelName, inputs, outputs, headers);
-    try {
-      HttpResponse<byte[]> response =
-          http.send(request, HttpResponse.BodyHandlers.ofByteArray());
-      return decodeInferResponse(response);
-    } catch (IOException | InterruptedException e) {
-      throw new InferenceServerException("infer request failed: " + e, e);
+    // Transport failures retry up to retryCnt times (reference
+    // InferenceServerClient.java:293-317); server-side errors surface
+    // through decodeInferResponse without a retry — they are answers,
+    // not transient failures.
+    for (int attempt = 0; ; attempt++) {
+      try {
+        HttpResponse<byte[]> response =
+            http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+        return decodeInferResponse(response);
+      } catch (IOException e) {
+        if (attempt >= retryCnt) {
+          throw new InferenceServerException(
+              "infer request failed after " + (attempt + 1) + " attempt(s): "
+                  + e, e);
+        }
+      } catch (InterruptedException e) {
+        Thread.currentThread().interrupt();
+        throw new InferenceServerException("infer request interrupted: " + e, e);
+      }
     }
   }
 

@@ -18,6 +18,11 @@ public final class Json {
   private final Type type;
   private boolean boolValue;
   private double numberValue;
+  // int64 JSON values (sequence ids, shm byte sizes) above 2^53 lose
+  // precision through double; integral numbers keep an exact long twin
+  // (the reference's fastjson Java client preserves longs the same way)
+  private long longValue;
+  private boolean integral;
   private String stringValue;
   private List<Json> arrayValue;
   private Map<String, Json> objectValue;
@@ -35,6 +40,14 @@ public final class Json {
   public static Json of(double v) {
     Json j = new Json(Type.NUMBER);
     j.numberValue = v;
+    return j;
+  }
+
+  public static Json of(long v) {
+    Json j = new Json(Type.NUMBER);
+    j.numberValue = v;
+    j.longValue = v;
+    j.integral = true;
     return j;
   }
 
@@ -60,7 +73,10 @@ public final class Json {
   public boolean isNull() { return type == Type.NULL; }
   public boolean asBool() { return type == Type.BOOL && boolValue; }
   public double asDouble() { return type == Type.NUMBER ? numberValue : 0.0; }
-  public long asLong() { return (long) asDouble(); }
+  public long asLong() {
+    if (type != Type.NUMBER) return 0L;
+    return integral ? longValue : (long) numberValue;
+  }
   public String asString() { return type == Type.STRING ? stringValue : ""; }
 
   public int size() { return type == Type.ARRAY ? arrayValue.size() : 0; }
@@ -103,7 +119,9 @@ public final class Json {
       case NULL: sb.append("null"); break;
       case BOOL: sb.append(boolValue); break;
       case NUMBER:
-        if (numberValue == Math.floor(numberValue)
+        if (integral) {
+          sb.append(longValue);
+        } else if (numberValue == Math.floor(numberValue)
             && !Double.isInfinite(numberValue)
             && Math.abs(numberValue) < 9.007199254740992E15) {
           sb.append((long) numberValue);
@@ -313,8 +331,19 @@ public final class Json {
           && "+-0123456789.eE".indexOf(text.charAt(pos)) >= 0) {
         pos++;
       }
+      String token = text.substring(start, pos);
+      // no fraction/exponent: parse as long first so full int64 range
+      // survives (falls back to double on overflow)
+      if (token.indexOf('.') < 0 && token.indexOf('e') < 0
+          && token.indexOf('E') < 0) {
+        try {
+          return Json.of(Long.parseLong(token));
+        } catch (NumberFormatException ignored) {
+          // out of long range: fall through to double
+        }
+      }
       try {
-        return Json.of(Double.parseDouble(text.substring(start, pos)));
+        return Json.of(Double.parseDouble(token));
       } catch (NumberFormatException e) {
         throw new InferenceServerException("bad JSON number at " + start);
       }

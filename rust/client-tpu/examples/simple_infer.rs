@@ -1,0 +1,52 @@
+//! The reference's simple example (src/rust/triton-client/examples) in this
+//! crate's idiom: health checks, metadata, one `simple` model inference.
+//!
+//! Run (once a cargo toolchain is available):
+//!   cargo run --example simple_infer -- http://127.0.0.1:8001
+
+use client_tpu::{Client, DataType, InferInput, InferRequestBuilder};
+
+#[tokio::main]
+async fn main() -> Result<(), client_tpu::Error> {
+    let url = std::env::args()
+        .nth(1)
+        .unwrap_or_else(|| "http://127.0.0.1:8001".to_string());
+    let client = Client::connect(&url).await?;
+
+    assert!(client.is_server_live().await?);
+    assert!(client.is_server_ready().await?);
+    let metadata = client.server_metadata().await?;
+    println!("server: {} {}", metadata.name, metadata.version);
+
+    let model = client.model_metadata("simple", "").await?;
+    println!(
+        "model 'simple': {} inputs, {} outputs",
+        model.inputs.len(),
+        model.outputs.len()
+    );
+
+    let ones = [1i32; 16];
+    let request = InferRequestBuilder::new("simple")
+        .input(
+            InferInput::new("INPUT0", vec![1, 16], DataType::Int32)
+                .with_data_i32(&ones),
+        )
+        .input(
+            InferInput::new("INPUT1", vec![1, 16], DataType::Int32)
+                .with_data_i32(&ones),
+        )
+        .build();
+    let response = client.infer(request).await?;
+    let sum = response
+        .output("OUTPUT0")
+        .expect("OUTPUT0 missing")
+        .as_i32()?;
+    let diff = response
+        .output("OUTPUT1")
+        .expect("OUTPUT1 missing")
+        .as_i32()?;
+    println!("sum: {sum:?}");
+    println!("diff: {diff:?}");
+    assert!(sum.iter().all(|&v| v == 2) && diff.iter().all(|&v| v == 0));
+    Ok(())
+}

@@ -1,0 +1,498 @@
+//! Async client over the `h2` crate: one multiplexed HTTP/2 connection,
+//! every RPC a stream on it — the same model the C++ client's
+//! completion-queue worker uses (native/src/grpc_client.cc AsyncTransfer)
+//! and the role of the reference `TritonClient` (client.rs:178-704).
+
+use std::collections::BTreeMap;
+use std::sync::Arc;
+use std::time::Duration;
+
+use bytes::{Bytes, BytesMut};
+use http::{Request, Uri};
+use tokio::net::TcpStream;
+use tokio::sync::{mpsc, Mutex};
+
+use crate::error::{Error, Result, StatusCode};
+use crate::messages::{
+    decode_bool_field1, decode_infer_response, decode_model_metadata,
+    decode_repository_index, decode_server_metadata, decode_stream_response,
+    encode_infer_request, encode_name_only, encode_name_version,
+    encode_system_shm_register, encode_tpu_shm_register, InferResponse,
+    ModelIndexEntry, ModelMetadata, ServerMetadata,
+};
+use crate::pbwire::{frame_message, unframe_message};
+use crate::types::InferRequest;
+
+const SERVICE: &str = "/inference.GRPCInferenceService/";
+
+/// Connection knobs (reference `ClientOptions`, client.rs:91-152).
+#[derive(Debug, Clone)]
+pub struct ClientOptions {
+    pub connect_timeout: Duration,
+    pub request_timeout: Option<Duration>,
+    pub max_message_size: usize,
+    pub keep_alive_interval: Option<Duration>,
+    pub keep_alive_timeout: Duration,
+}
+
+impl Default for ClientOptions {
+    fn default() -> Self {
+        Self {
+            connect_timeout: Duration::from_secs(10),
+            request_timeout: None,
+            max_message_size: (1 << 31) - 1,
+            keep_alive_interval: None,
+            keep_alive_timeout: Duration::from_secs(20),
+        }
+    }
+}
+
+impl ClientOptions {
+    pub fn connect_timeout(mut self, timeout: Duration) -> Self {
+        self.connect_timeout = timeout;
+        self
+    }
+    pub fn request_timeout(mut self, timeout: Duration) -> Self {
+        self.request_timeout = Some(timeout);
+        self
+    }
+    pub fn max_message_size(mut self, size: usize) -> Self {
+        self.max_message_size = size;
+        self
+    }
+    pub fn keep_alive_interval(mut self, interval: Duration) -> Self {
+        self.keep_alive_interval = Some(interval);
+        self
+    }
+    pub fn keep_alive_timeout(mut self, timeout: Duration) -> Self {
+        self.keep_alive_timeout = timeout;
+        self
+    }
+}
+
+/// Async KServe v2 gRPC client.
+///
+/// Cloning is cheap: clones share the underlying multiplexed connection
+/// (h2's `SendRequest` is a handle), so concurrent `infer` calls from many
+/// tasks ride one socket — in-flight concurrency is the transport's
+/// stream multiplexing, not a connection pool.
+#[derive(Clone)]
+pub struct Client {
+    send_request: Arc<Mutex<h2::client::SendRequest<Bytes>>>,
+    authority: String,
+    options: ClientOptions,
+}
+
+impl Client {
+    pub async fn connect(url: &str) -> Result<Self> {
+        Self::connect_with_options(url, ClientOptions::default()).await
+    }
+
+    pub async fn connect_with_options(url: &str, options: ClientOptions) -> Result<Self> {
+        let authority = url
+            .trim_start_matches("http://")
+            .trim_start_matches("grpc://")
+            .trim_end_matches('/')
+            .to_string();
+        if authority.is_empty() {
+            return Err(Error::InvalidArgument("empty server url".into()));
+        }
+        let tcp = tokio::time::timeout(
+            options.connect_timeout,
+            TcpStream::connect(&authority),
+        )
+        .await
+        .map_err(|_| Error::Transport(format!("connect to {authority} timed out")))??;
+        tcp.set_nodelay(true)?;
+        let (send_request, mut connection) = h2::client::Builder::new()
+            .initial_window_size(1 << 24)
+            .initial_connection_window_size(1 << 24)
+            .max_frame_size(1 << 20)
+            .handshake(tcp)
+            .await?;
+        // keep-alive: h2 PING on the configured interval; a ping that gets
+        // no pong within keep_alive_timeout abandons the probe task (the
+        // connection itself will surface the failure on the next RPC).
+        if let Some(interval) = options.keep_alive_interval {
+            if let Some(mut ping_pong) = connection.ping_pong() {
+                let timeout = options.keep_alive_timeout;
+                tokio::spawn(async move {
+                    loop {
+                        tokio::time::sleep(interval).await;
+                        let probe = ping_pong.ping(h2::Ping::opaque());
+                        match tokio::time::timeout(timeout, probe).await {
+                            Ok(Ok(_pong)) => continue,
+                            _ => return,  // dead peer or closed connection
+                        }
+                    }
+                });
+            }
+        }
+        // The connection task owns the socket; it ends when the client and
+        // all in-flight streams drop.
+        tokio::spawn(async move {
+            let _ = connection.await;
+        });
+        Ok(Self {
+            send_request: Arc::new(Mutex::new(send_request)),
+            authority,
+            options,
+        })
+    }
+
+    // -- unary plumbing ----------------------------------------------------
+
+    async fn unary(&self, method: &str, payload: Vec<u8>) -> Result<Bytes> {
+        let call = self.unary_inner(method, payload);
+        match self.options.request_timeout {
+            Some(timeout) => tokio::time::timeout(timeout, call)
+                .await
+                .map_err(|_| Error::DeadlineExceeded)?,
+            None => call.await,
+        }
+    }
+
+    async fn unary_inner(&self, method: &str, payload: Vec<u8>) -> Result<Bytes> {
+        let uri: Uri = format!("http://{}{}{}", self.authority, SERVICE, method)
+            .parse()
+            .map_err(|e| Error::Transport(format!("bad uri: {e}")))?;
+        let request = Request::builder()
+            .method("POST")
+            .uri(uri)
+            .header("content-type", "application/grpc")
+            .header("te", "trailers")
+            .body(())
+            .map_err(|e| Error::Transport(e.to_string()))?;
+
+        let (response_fut, mut send_stream) = {
+            let mut sender = self.send_request.lock().await;
+            // ready() waits for stream credit; the lock is held only for
+            // stream creation, not the exchange — calls still overlap.
+            futures_ready(&mut sender).await?;
+            sender.send_request(request, false)?
+        };
+        send_stream.send_data(frame_message(&payload), true)?;
+
+        let response = response_fut.await?;
+        let grpc_status_header = decode_status(response.headers());
+        let mut body = response.into_body();
+        let mut buf = BytesMut::new();
+        let mut flow = body.flow_control().clone();
+        while let Some(chunk) = body.data().await {
+            let chunk = chunk?;
+            if buf.len() + chunk.len() > self.options.max_message_size {
+                return Err(Error::Decode("response exceeds max_message_size".into()));
+            }
+            let _ = flow.release_capacity(chunk.len());
+            buf.extend_from_slice(&chunk);
+        }
+        let trailers = body.trailers().await?;
+        let status = trailers
+            .as_ref()
+            .map(|t| decode_status(t))
+            .unwrap_or(grpc_status_header);
+        if let Some((code, message)) = status {
+            if code != StatusCode::Ok {
+                return Err(Error::Grpc { code, message });
+            }
+        }
+        match unframe_message(&mut buf)? {
+            Some(message) => Ok(message),
+            None if buf.is_empty() => Ok(Bytes::new()),
+            None => Err(Error::Decode("truncated gRPC response frame".into())),
+        }
+    }
+
+    // -- health / metadata (reference client.rs:243-406) --------------------
+
+    pub async fn is_server_live(&self) -> Result<bool> {
+        decode_bool_field1(&self.unary("ServerLive", Vec::new()).await?)
+    }
+
+    pub async fn is_server_ready(&self) -> Result<bool> {
+        decode_bool_field1(&self.unary("ServerReady", Vec::new()).await?)
+    }
+
+    pub async fn is_model_ready(&self, model_name: &str, model_version: &str) -> Result<bool> {
+        let payload = encode_name_version(model_name, model_version);
+        decode_bool_field1(&self.unary("ModelReady", payload).await?)
+    }
+
+    pub async fn server_metadata(&self) -> Result<ServerMetadata> {
+        decode_server_metadata(&self.unary("ServerMetadata", Vec::new()).await?)
+    }
+
+    pub async fn model_metadata(
+        &self, model_name: &str, model_version: &str,
+    ) -> Result<ModelMetadata> {
+        let payload = encode_name_version(model_name, model_version);
+        decode_model_metadata(&self.unary("ModelMetadata", payload).await?)
+    }
+
+    /// Raw ModelConfig response bytes (the config proto is large and
+    /// backend-specific; callers that need fields decode with `pbwire`).
+    pub async fn model_config(
+        &self, model_name: &str, model_version: &str,
+    ) -> Result<Bytes> {
+        let payload = encode_name_version(model_name, model_version);
+        self.unary("ModelConfig", payload).await
+    }
+
+    // -- inference (reference client.rs:407-458) ----------------------------
+
+    pub async fn infer(&self, request: InferRequest) -> Result<InferResponse> {
+        let payload = encode_infer_request(&request)?;
+        decode_infer_response(&self.unary("ModelInfer", payload).await?)
+    }
+
+    /// Bi-di streaming: returns (request sender, response receiver). Each
+    /// sent `InferRequest` yields one response (or a stream error) on the
+    /// receiver, in server order. Dropping the sender half-closes the
+    /// stream; the receiver then drains and ends.
+    pub async fn infer_stream(
+        &self,
+    ) -> Result<(
+        mpsc::Sender<InferRequest>,
+        mpsc::Receiver<Result<InferResponse>>,
+    )> {
+        let uri: Uri = format!("http://{}{}ModelStreamInfer", self.authority, SERVICE)
+            .parse()
+            .map_err(|e| Error::Transport(format!("bad uri: {e}")))?;
+        let request = Request::builder()
+            .method("POST")
+            .uri(uri)
+            .header("content-type", "application/grpc")
+            .header("te", "trailers")
+            .body(())
+            .map_err(|e| Error::Transport(e.to_string()))?;
+        let (response_fut, mut send_stream) = {
+            let mut sender = self.send_request.lock().await;
+            futures_ready(&mut sender).await?;
+            sender.send_request(request, false)?
+        };
+
+        let (req_tx, mut req_rx) = mpsc::channel::<InferRequest>(16);
+        let (resp_tx, resp_rx) = mpsc::channel::<Result<InferResponse>>(16);
+
+        // writer task: frame + send each request; half-close on sender drop.
+        // Encode/validate failures are DELIVERED on the response channel
+        // before the stream closes — a vanished request with a silently
+        // ended receiver is indistinguishable from a server-side close.
+        let resp_tx_writer = resp_tx.clone();
+        tokio::spawn(async move {
+            while let Some(request) = req_rx.recv().await {
+                let payload = match encode_infer_request(&request) {
+                    Ok(p) => p,
+                    Err(e) => {
+                        let _ = resp_tx_writer.send(Err(e)).await;
+                        break;
+                    }
+                };
+                if let Err(e) = send_stream.send_data(frame_message(&payload), false) {
+                    let _ = resp_tx_writer.send(Err(e.into())).await;
+                    break;
+                }
+            }
+            let _ = send_stream.send_data(Bytes::new(), true);
+        });
+
+        // reader task: unframe + decode each response message
+        let max_message_size = self.options.max_message_size;
+        tokio::spawn(async move {
+            let response = match response_fut.await {
+                Ok(r) => r,
+                Err(e) => {
+                    let _ = resp_tx.send(Err(e.into())).await;
+                    return;
+                }
+            };
+            let mut body = response.into_body();
+            let mut flow = body.flow_control().clone();
+            let mut buf = BytesMut::new();
+            while let Some(chunk) = body.data().await {
+                let chunk = match chunk {
+                    Ok(c) => c,
+                    Err(e) => {
+                        let _ = resp_tx.send(Err(e.into())).await;
+                        return;
+                    }
+                };
+                let _ = flow.release_capacity(chunk.len());
+                if buf.len() + chunk.len() > max_message_size {
+                    // the unary path enforces this cap; the stream must too
+                    let _ = resp_tx
+                        .send(Err(Error::Decode(
+                            "stream response exceeds max_message_size".into(),
+                        )))
+                        .await;
+                    return;
+                }
+                buf.extend_from_slice(&chunk);
+                loop {
+                    match unframe_message(&mut buf) {
+                        Ok(Some(message)) => {
+                            let _ = resp_tx
+                                .send(decode_stream_response(&message))
+                                .await;
+                        }
+                        Ok(None) => break,
+                        Err(e) => {
+                            let _ = resp_tx.send(Err(e)).await;
+                            return;
+                        }
+                    }
+                }
+            }
+            if let Ok(Some(trailers)) = body.trailers().await {
+                if let Some((code, message)) = decode_status(&trailers) {
+                    if code != StatusCode::Ok {
+                        let _ = resp_tx.send(Err(Error::Grpc { code, message })).await;
+                    }
+                }
+            }
+        });
+
+        Ok((req_tx, resp_rx))
+    }
+
+    // -- repository / statistics (reference client.rs:460-529) --------------
+
+    pub async fn model_statistics(
+        &self, model_name: &str, model_version: &str,
+    ) -> Result<Bytes> {
+        let payload = encode_name_version(model_name, model_version);
+        self.unary("ModelStatistics", payload).await
+    }
+
+    pub async fn repository_index(&self) -> Result<Vec<ModelIndexEntry>> {
+        decode_repository_index(&self.unary("RepositoryIndex", Vec::new()).await?)
+    }
+
+    pub async fn load_model(&self, model_name: &str) -> Result<()> {
+        // RepositoryModelLoadRequest: repository_name=1 (unused), model_name=2
+        let mut w = crate::pbwire::Writer::new();
+        w.string(2, model_name);
+        self.unary("RepositoryModelLoad", w.finish().to_vec()).await?;
+        Ok(())
+    }
+
+    pub async fn unload_model(&self, model_name: &str) -> Result<()> {
+        let mut w = crate::pbwire::Writer::new();
+        w.string(2, model_name);
+        self.unary("RepositoryModelUnload", w.finish().to_vec()).await?;
+        Ok(())
+    }
+
+    // -- shared memory (tpu family in the reference's cuda seat) ------------
+
+    pub async fn system_shared_memory_status(&self, name: &str) -> Result<Bytes> {
+        self.unary("SystemSharedMemoryStatus", encode_name_only(name)).await
+    }
+
+    pub async fn system_shared_memory_register(
+        &self, name: &str, key: &str, offset: u64, byte_size: u64,
+    ) -> Result<()> {
+        let payload = encode_system_shm_register(name, key, offset, byte_size);
+        self.unary("SystemSharedMemoryRegister", payload).await?;
+        Ok(())
+    }
+
+    pub async fn system_shared_memory_unregister(&self, name: &str) -> Result<()> {
+        self.unary("SystemSharedMemoryUnregister", encode_name_only(name)).await?;
+        Ok(())
+    }
+
+    pub async fn tpu_shared_memory_status(&self, name: &str) -> Result<Bytes> {
+        self.unary("TpuSharedMemoryStatus", encode_name_only(name)).await
+    }
+
+    /// Register a tpu_shared_memory region by its base64 raw handle (the
+    /// cudaIpcMemHandle seat; `client_tpu/utils/tpu_shared_memory`
+    /// get_raw_handle produces these).
+    pub async fn tpu_shared_memory_register(
+        &self, name: &str, raw_handle_b64: &str, device_id: i64, byte_size: u64,
+    ) -> Result<()> {
+        let payload =
+            encode_tpu_shm_register(name, raw_handle_b64, device_id, byte_size);
+        self.unary("TpuSharedMemoryRegister", payload).await?;
+        Ok(())
+    }
+
+    pub async fn tpu_shared_memory_unregister(&self, name: &str) -> Result<()> {
+        self.unary("TpuSharedMemoryUnregister", encode_name_only(name)).await?;
+        Ok(())
+    }
+
+    // cuda-named aliases (drop-in reference surface; the server aliases
+    // CudaSharedMemory* onto the tpu family)
+    pub async fn cuda_shared_memory_status(&self, name: &str) -> Result<Bytes> {
+        self.unary("CudaSharedMemoryStatus", encode_name_only(name)).await
+    }
+
+    pub async fn cuda_shared_memory_unregister(&self, name: &str) -> Result<()> {
+        self.unary("CudaSharedMemoryUnregister", encode_name_only(name)).await?;
+        Ok(())
+    }
+
+    // -- trace / log settings (reference client.rs:668-704) -----------------
+
+    pub async fn trace_setting(&self, model_name: &str) -> Result<Bytes> {
+        // TraceSettingRequest: settings=1 (empty = read), model_name=2
+        let mut w = crate::pbwire::Writer::new();
+        w.string(2, model_name);
+        self.unary("TraceSetting", w.finish().to_vec()).await
+    }
+
+    pub async fn log_settings(&self) -> Result<Bytes> {
+        self.unary("LogSettings", Vec::new()).await
+    }
+}
+
+/// grpc-status/grpc-message out of a header/trailer map.
+fn decode_status(headers: &http::HeaderMap) -> Option<(StatusCode, String)> {
+    let code = headers
+        .get("grpc-status")?
+        .to_str()
+        .ok()?
+        .parse::<i32>()
+        .ok()?;
+    let message = headers
+        .get("grpc-message")
+        .and_then(|v| v.to_str().ok())
+        .map(percent_decode)
+        .unwrap_or_default();
+    Some((StatusCode::from_i32(code), message))
+}
+
+fn percent_decode(s: &str) -> String {
+    let bytes = s.as_bytes();
+    let mut out = Vec::with_capacity(bytes.len());
+    let mut i = 0;
+    while i < bytes.len() {
+        if bytes[i] == b'%' && i + 2 < bytes.len() {
+            if let Ok(v) = u8::from_str_radix(
+                std::str::from_utf8(&bytes[i + 1..i + 3]).unwrap_or(""), 16,
+            ) {
+                out.push(v);
+                i += 3;
+                continue;
+            }
+        }
+        out.push(bytes[i]);
+        i += 1;
+    }
+    String::from_utf8_lossy(&out).into_owned()
+}
+
+/// SendRequest::ready() is a poll-style API; adapt to async/await.
+async fn futures_ready(
+    sender: &mut h2::client::SendRequest<Bytes>,
+) -> Result<()> {
+    std::future::poll_fn(|cx| sender.poll_ready(cx))
+        .await
+        .map_err(Error::from)
+}
+
+/// Unused but kept for API completeness with the reference's parameter
+/// plumbing: BTreeMap is the canonical parameter container here.
+pub type Parameters = BTreeMap<String, crate::types::ParamValue>;

@@ -1,0 +1,88 @@
+//! Typed errors (role parity: reference `error.rs` — 89 LoC of
+//! thiserror-derived variants over tonic/prost causes; ours wrap h2/io and
+//! carry gRPC status codes directly since there is no tonic layer).
+
+use thiserror::Error;
+
+/// gRPC status codes (the subset is the full canonical set — stable ABI).
+#[derive(Debug, Clone, Copy, PartialEq, Eq)]
+pub enum StatusCode {
+    Ok = 0,
+    Cancelled = 1,
+    Unknown = 2,
+    InvalidArgument = 3,
+    DeadlineExceeded = 4,
+    NotFound = 5,
+    AlreadyExists = 6,
+    PermissionDenied = 7,
+    ResourceExhausted = 8,
+    FailedPrecondition = 9,
+    Aborted = 10,
+    OutOfRange = 11,
+    Unimplemented = 12,
+    Internal = 13,
+    Unavailable = 14,
+    DataLoss = 15,
+    Unauthenticated = 16,
+}
+
+impl StatusCode {
+    pub fn from_i32(code: i32) -> Self {
+        match code {
+            0 => Self::Ok,
+            1 => Self::Cancelled,
+            3 => Self::InvalidArgument,
+            4 => Self::DeadlineExceeded,
+            5 => Self::NotFound,
+            6 => Self::AlreadyExists,
+            7 => Self::PermissionDenied,
+            8 => Self::ResourceExhausted,
+            9 => Self::FailedPrecondition,
+            10 => Self::Aborted,
+            11 => Self::OutOfRange,
+            12 => Self::Unimplemented,
+            13 => Self::Internal,
+            14 => Self::Unavailable,
+            15 => Self::DataLoss,
+            16 => Self::Unauthenticated,
+            _ => Self::Unknown,
+        }
+    }
+}
+
+#[derive(Debug, Error)]
+pub enum Error {
+    /// The server answered with a non-OK grpc-status.
+    #[error("gRPC error {code:?}: {message}")]
+    Grpc { code: StatusCode, message: String },
+
+    /// HTTP/2 / socket level failure.
+    #[error("transport error: {0}")]
+    Transport(String),
+
+    /// Malformed protobuf or gRPC framing in a response.
+    #[error("malformed response: {0}")]
+    Decode(String),
+
+    /// Local misuse (bad shapes, missing output, oversized message).
+    #[error("invalid argument: {0}")]
+    InvalidArgument(String),
+
+    /// The configured request timeout elapsed.
+    #[error("deadline exceeded")]
+    DeadlineExceeded,
+}
+
+impl From<h2::Error> for Error {
+    fn from(e: h2::Error) -> Self {
+        Error::Transport(e.to_string())
+    }
+}
+
+impl From<std::io::Error> for Error {
+    fn from(e: std::io::Error) -> Self {
+        Error::Transport(e.to_string())
+    }
+}
+
+pub type Result<T> = std::result::Result<T, Error>;

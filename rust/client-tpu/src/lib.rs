@@ -1,0 +1,28 @@
+//! # client-tpu
+//!
+//! Async Rust client for the client_tpu inference server (KServe v2 over
+//! gRPC). Role parity with the reference Rust client
+//! (`/root/reference/src/rust/triton-client`: `client.rs:178-704` surface,
+//! `infer.rs` typed builders), re-designed for this framework: hand-framed
+//! protobuf over the `h2` crate instead of tonic/prost codegen, and the
+//! tpu shared-memory family in the CUDA one's seat.
+//!
+//! NOTE: source-complete but never compiled — this image has no cargo.
+//! See README.md for the honesty note and design rationale.
+
+pub mod client;
+pub mod error;
+pub mod messages;
+pub mod pbwire;
+pub mod types;
+
+pub use client::{Client, ClientOptions};
+pub use error::{Error, Result, StatusCode};
+pub use messages::{
+    InferResponse, ModelIndexEntry, ModelMetadata, ServerMetadata,
+    TensorMetadata,
+};
+pub use types::{
+    DataType, InferInput, InferRequest, InferRequestBuilder,
+    InferRequestedOutput, OutputTensor, ParamValue,
+};

@@ -1,0 +1,362 @@
+//! KServe gRPC message encodings. Field numbers follow the public
+//! `grpc_service.proto` (the same numbers `client_tpu/grpc/_messages.py`
+//! carries and cross-validates against protoc, and
+//! `native/src/grpc_client.cc` mirrors in C++).
+
+use std::collections::BTreeMap;
+
+use crate::error::{Error, Result};
+use crate::pbwire::{Reader, Writer, WIRE_LEN, WIRE_VARINT};
+use crate::types::{
+    DataType, InferRequest, OutputTensor, ParamValue,
+};
+
+// ---------------------------------------------------------------------------
+// parameter maps (InferParameter: bool=1, int64=2, string=3, double=4)
+// ---------------------------------------------------------------------------
+
+fn encode_param(value: &ParamValue) -> Vec<u8> {
+    let mut w = Writer::new();
+    match value {
+        ParamValue::Bool(b) => w.bool(1, *b),
+        ParamValue::Int(i) => w.int64(2, *i),
+        ParamValue::Str(s) => w.string(3, s),
+        ParamValue::Double(d) => w.fixed64(4, d.to_bits()),
+    }
+    w.finish().to_vec()
+}
+
+fn encode_param_map(w: &mut Writer, field: u32, params: &BTreeMap<String, ParamValue>) {
+    for (key, value) in params {
+        let mut entry = Writer::new();
+        entry.string(1, key);
+        entry.submessage(2, &encode_param(value));
+        w.submessage(field, &entry.finish());
+    }
+}
+
+// ---------------------------------------------------------------------------
+// ModelInferRequest
+// ---------------------------------------------------------------------------
+
+/// ModelInferRequest: model_name=1, model_version=2, id=3, parameters=4,
+/// inputs=5, outputs=6, raw_input_contents=7.
+pub fn encode_infer_request(request: &InferRequest) -> Result<Vec<u8>> {
+    let mut w = Writer::new();
+    w.string(1, &request.model_name);
+    w.string(2, &request.model_version);
+    w.string(3, &request.request_id);
+
+    let mut params = request.parameters.clone();
+    if request.sequence_id != 0 {
+        params.insert("sequence_id".into(), ParamValue::Int(request.sequence_id as i64));
+        params.insert("sequence_start".into(), ParamValue::Bool(request.sequence_start));
+        params.insert("sequence_end".into(), ParamValue::Bool(request.sequence_end));
+    }
+    if request.priority != 0 {
+        params.insert("priority".into(), ParamValue::Int(request.priority as i64));
+    }
+    if request.timeout_us != 0 {
+        params.insert("timeout".into(), ParamValue::Int(request.timeout_us as i64));
+    }
+    encode_param_map(&mut w, 4, &params);
+
+    for input in &request.inputs {
+        input.validate()?;
+        // InferInputTensor: name=1, datatype=2, shape=3, parameters=4
+        let mut t = Writer::new();
+        t.string(1, &input.name);
+        t.string(2, input.datatype.as_str());
+        t.packed_int64(3, &input.shape);
+        encode_param_map(&mut t, 4, &input.parameters);
+        w.submessage(5, &t.finish());
+    }
+    for output in &request.outputs {
+        // InferRequestedOutputTensor: name=1, parameters=2
+        let mut t = Writer::new();
+        t.string(1, &output.name);
+        encode_param_map(&mut t, 2, &output.parameters);
+        w.submessage(6, &t.finish());
+    }
+    // raw_input_contents, index-matched to non-shm inputs
+    for input in &request.inputs {
+        if !input.parameters.contains_key("shared_memory_region") {
+            w.bytes_always(7, &input.raw);
+        }
+    }
+    Ok(w.finish().to_vec())
+}
+
+// ---------------------------------------------------------------------------
+// ModelInferResponse
+// ---------------------------------------------------------------------------
+
+/// Decoded response: model_name=1, model_version=2, id=3, outputs=5,
+/// raw_output_contents=6.
+#[derive(Debug, Default)]
+pub struct InferResponse {
+    pub model_name: String,
+    pub model_version: String,
+    pub id: String,
+    pub outputs: Vec<OutputTensor>,
+}
+
+impl InferResponse {
+    pub fn output(&self, name: &str) -> Option<&OutputTensor> {
+        self.outputs.iter().find(|o| o.name == name)
+    }
+}
+
+pub fn decode_infer_response(payload: &[u8]) -> Result<InferResponse> {
+    let mut response = InferResponse::default();
+    let mut raws: Vec<Vec<u8>> = Vec::new();
+    let mut shm_flags: Vec<bool> = Vec::new();
+    let mut r = Reader::new(payload);
+    while let Some((field, wire_type)) = r.next()? {
+        match field {
+            1 => response.model_name = r.string()?,
+            2 => response.model_version = r.string()?,
+            3 => response.id = r.string()?,
+            5 => {
+                let raw = r.length_delimited()?;
+                let mut t = Reader::new(raw);
+                let mut name = String::new();
+                let mut datatype = DataType::Bytes;
+                let mut shape = Vec::new();
+                let mut in_shm = false;
+                while let Some((tf, twt)) = t.next()? {
+                    match tf {
+                        1 => name = t.string()?,
+                        2 => {
+                            let s = t.string()?;
+                            datatype = DataType::parse(&s).ok_or_else(|| {
+                                Error::Decode(format!("unknown datatype {s:?}"))
+                            })?;
+                        }
+                        3 => t.repeated_int64(twt, &mut shape)?,
+                        4 => {
+                            // parameters map: a shared_memory_region key
+                            // marks an shm-placed output (no raw entry)
+                            let entry = t.length_delimited()?;
+                            let mut e = Reader::new(entry);
+                            while let Some((ef, ewt)) = e.next()? {
+                                if ef == 1 {
+                                    if e.string()? == "shared_memory_region" {
+                                        in_shm = true;
+                                    }
+                                } else {
+                                    e.skip(ewt)?;
+                                }
+                            }
+                        }
+                        _ => t.skip(twt)?,
+                    }
+                }
+                response.outputs.push(OutputTensor {
+                    name,
+                    datatype,
+                    shape,
+                    raw: Vec::new(),
+                });
+                shm_flags.push(in_shm);
+            }
+            6 => raws.push(r.length_delimited()?.to_vec()),
+            _ => r.skip(wire_type)?,
+        }
+    }
+    // raw_output_contents is index-matched to NON-shm outputs only (the
+    // same skip the Python client applies, grpc/_infer.py:226-236)
+    let mut raw_iter = raws.into_iter();
+    for (output, in_shm) in response.outputs.iter_mut().zip(shm_flags) {
+        if !in_shm {
+            if let Some(raw) = raw_iter.next() {
+                output.raw = raw;
+            }
+        }
+    }
+    Ok(response)
+}
+
+// ---------------------------------------------------------------------------
+// ModelStreamInferResponse (error_message=1, infer_response=2)
+// ---------------------------------------------------------------------------
+
+pub fn decode_stream_response(payload: &[u8]) -> Result<InferResponse> {
+    let mut r = Reader::new(payload);
+    let mut error_message = String::new();
+    let mut inner: Option<InferResponse> = None;
+    while let Some((field, wire_type)) = r.next()? {
+        match field {
+            1 => error_message = r.string()?,
+            2 => inner = Some(decode_infer_response(r.length_delimited()?)?),
+            _ => r.skip(wire_type)?,
+        }
+    }
+    if !error_message.is_empty() {
+        return Err(Error::Grpc {
+            code: crate::error::StatusCode::Unknown,
+            message: error_message,
+        });
+    }
+    inner.ok_or_else(|| Error::Decode("stream response missing infer_response".into()))
+}
+
+// ---------------------------------------------------------------------------
+// admin RPCs (requests encoded here; responses decoded into simple structs)
+// ---------------------------------------------------------------------------
+
+/// name=1 + version=2 request shell shared by several RPCs.
+pub fn encode_name_version(name: &str, version: &str) -> Vec<u8> {
+    let mut w = Writer::new();
+    w.string(1, name);
+    w.string(2, version);
+    w.finish().to_vec()
+}
+
+/// Single-bool responses (ServerLive ready=1, ServerReady, ModelReady).
+pub fn decode_bool_field1(payload: &[u8]) -> Result<bool> {
+    let mut r = Reader::new(payload);
+    let mut out = false;
+    while let Some((field, wire_type)) = r.next()? {
+        if field == 1 && wire_type == WIRE_VARINT {
+            out = r.varint()? != 0;
+        } else {
+            r.skip(wire_type)?;
+        }
+    }
+    Ok(out)
+}
+
+#[derive(Debug, Default)]
+pub struct ServerMetadata {
+    pub name: String,
+    pub version: String,
+    pub extensions: Vec<String>,
+}
+
+pub fn decode_server_metadata(payload: &[u8]) -> Result<ServerMetadata> {
+    let mut r = Reader::new(payload);
+    let mut out = ServerMetadata::default();
+    while let Some((field, wire_type)) = r.next()? {
+        match field {
+            1 => out.name = r.string()?,
+            2 => out.version = r.string()?,
+            3 => out.extensions.push(r.string()?),
+            _ => r.skip(wire_type)?,
+        }
+    }
+    Ok(out)
+}
+
+#[derive(Debug, Default)]
+pub struct TensorMetadata {
+    pub name: String,
+    pub datatype: String,
+    pub shape: Vec<i64>,
+}
+
+#[derive(Debug, Default)]
+pub struct ModelMetadata {
+    pub name: String,
+    pub versions: Vec<String>,
+    pub platform: String,
+    pub inputs: Vec<TensorMetadata>,
+    pub outputs: Vec<TensorMetadata>,
+}
+
+fn decode_tensor_metadata(raw: &[u8]) -> Result<TensorMetadata> {
+    let mut t = Reader::new(raw);
+    let mut out = TensorMetadata::default();
+    while let Some((field, wire_type)) = t.next()? {
+        match field {
+            1 => out.name = t.string()?,
+            2 => out.datatype = t.string()?,
+            3 => t.repeated_int64(wire_type, &mut out.shape)?,
+            _ => t.skip(wire_type)?,
+        }
+    }
+    Ok(out)
+}
+
+pub fn decode_model_metadata(payload: &[u8]) -> Result<ModelMetadata> {
+    let mut r = Reader::new(payload);
+    let mut out = ModelMetadata::default();
+    while let Some((field, wire_type)) = r.next()? {
+        match field {
+            1 => out.name = r.string()?,
+            2 => out.versions.push(r.string()?),
+            3 => out.platform = r.string()?,
+            4 => out.inputs.push(decode_tensor_metadata(r.length_delimited()?)?),
+            5 => out.outputs.push(decode_tensor_metadata(r.length_delimited()?)?),
+            _ => r.skip(wire_type)?,
+        }
+    }
+    Ok(out)
+}
+
+#[derive(Debug, Default)]
+pub struct ModelIndexEntry {
+    pub name: String,
+    pub version: String,
+    pub state: String,
+    pub reason: String,
+}
+
+/// RepositoryIndexResponse: models=1 { name=1, version=2, state=3, reason=4 }
+pub fn decode_repository_index(payload: &[u8]) -> Result<Vec<ModelIndexEntry>> {
+    let mut r = Reader::new(payload);
+    let mut out = Vec::new();
+    while let Some((field, wire_type)) = r.next()? {
+        if field == 1 && wire_type == WIRE_LEN {
+            let raw = r.length_delimited()?;
+            let mut m = Reader::new(raw);
+            let mut entry = ModelIndexEntry::default();
+            while let Some((mf, mwt)) = m.next()? {
+                match mf {
+                    1 => entry.name = m.string()?,
+                    2 => entry.version = m.string()?,
+                    3 => entry.state = m.string()?,
+                    4 => entry.reason = m.string()?,
+                    _ => m.skip(mwt)?,
+                }
+            }
+            out.push(entry);
+        } else {
+            r.skip(wire_type)?;
+        }
+    }
+    Ok(out)
+}
+
+/// SystemSharedMemoryRegisterRequest: name=1, key=2, offset=3, byte_size=4.
+pub fn encode_system_shm_register(
+    name: &str, key: &str, offset: u64, byte_size: u64,
+) -> Vec<u8> {
+    let mut w = Writer::new();
+    w.string(1, name);
+    w.string(2, key);
+    w.uint64(3, offset);
+    w.uint64(4, byte_size);
+    w.finish().to_vec()
+}
+
+/// TpuSharedMemoryRegisterRequest (this framework's device family; the
+/// reference's CudaSharedMemoryRegisterRequest seat): name=1,
+/// raw_handle=2 (b64 descriptor), device_id=3, byte_size=4.
+pub fn encode_tpu_shm_register(
+    name: &str, raw_handle_b64: &str, device_id: i64, byte_size: u64,
+) -> Vec<u8> {
+    let mut w = Writer::new();
+    w.string(1, name);
+    w.bytes(2, raw_handle_b64.as_bytes());
+    w.int64(3, device_id);
+    w.uint64(4, byte_size);
+    w.finish().to_vec()
+}
+
+/// Single-name request shell (unregister, status filters, load/unload).
+pub fn encode_name_only(name: &str) -> Vec<u8> {
+    let mut w = Writer::new();
+    w.string(1, name);
+    w.finish().to_vec()
+}

@@ -1,0 +1,253 @@
+//! Schema-driven protobuf wire codec + gRPC message framing.
+//!
+//! The Rust sibling of `client_tpu/grpc/_wire.py` (protoc-cross-validated,
+//! hypothesis-fuzzed) and `native/include/client_tpu/pbwire.h`: varints,
+//! the four wire types the KServe protocol uses, and the 5-byte gRPC
+//! message frame (flag byte + big-endian u32 length).
+
+use bytes::{Buf, BufMut, Bytes, BytesMut};
+
+use crate::error::{Error, Result};
+
+pub const WIRE_VARINT: u32 = 0;
+pub const WIRE_I64: u32 = 1;
+pub const WIRE_LEN: u32 = 2;
+pub const WIRE_I32: u32 = 5;
+
+// ---------------------------------------------------------------------------
+// writer
+// ---------------------------------------------------------------------------
+
+#[derive(Default)]
+pub struct Writer {
+    buf: BytesMut,
+}
+
+impl Writer {
+    pub fn new() -> Self {
+        Self::default()
+    }
+
+    fn key(&mut self, field: u32, wire_type: u32) {
+        self.varint(u64::from(field << 3 | wire_type));
+    }
+
+    pub fn varint(&mut self, mut v: u64) {
+        loop {
+            let byte = (v & 0x7f) as u8;
+            v >>= 7;
+            if v == 0 {
+                self.buf.put_u8(byte);
+                return;
+            }
+            self.buf.put_u8(byte | 0x80);
+        }
+    }
+
+    pub fn uint64(&mut self, field: u32, v: u64) {
+        if v != 0 {
+            self.key(field, WIRE_VARINT);
+            self.varint(v);
+        }
+    }
+
+    pub fn int64(&mut self, field: u32, v: i64) {
+        if v != 0 {
+            self.key(field, WIRE_VARINT);
+            self.varint(v as u64); // two's-complement, 10-byte form for negatives
+        }
+    }
+
+    pub fn fixed64(&mut self, field: u32, v: u64) {
+        self.key(field, WIRE_I64);
+        self.buf.put_u64_le(v);
+    }
+
+    pub fn bool(&mut self, field: u32, v: bool) {
+        if v {
+            self.key(field, WIRE_VARINT);
+            self.varint(1);
+        }
+    }
+
+    pub fn string(&mut self, field: u32, v: &str) {
+        if !v.is_empty() {
+            self.bytes(field, v.as_bytes());
+        }
+    }
+
+    pub fn bytes(&mut self, field: u32, v: &[u8]) {
+        self.key(field, WIRE_LEN);
+        self.varint(v.len() as u64);
+        self.buf.put_slice(v);
+    }
+
+    /// Length-delimited submessage from an already-encoded body. Unlike
+    /// string/bytes this always emits, even empty (presence semantics).
+    pub fn submessage(&mut self, field: u32, body: &[u8]) {
+        self.bytes_always(field, body);
+    }
+
+    pub fn bytes_always(&mut self, field: u32, v: &[u8]) {
+        self.key(field, WIRE_LEN);
+        self.varint(v.len() as u64);
+        self.buf.put_slice(v);
+    }
+
+    /// Packed repeated int64 (shape fields).
+    pub fn packed_int64(&mut self, field: u32, values: &[i64]) {
+        if values.is_empty() {
+            return;
+        }
+        let mut inner = Writer::new();
+        for v in values {
+            inner.varint(*v as u64);
+        }
+        self.bytes_always(field, &inner.finish());
+    }
+
+    pub fn finish(self) -> Bytes {
+        self.buf.freeze()
+    }
+}
+
+// ---------------------------------------------------------------------------
+// reader
+// ---------------------------------------------------------------------------
+
+pub struct Reader<'a> {
+    data: &'a [u8],
+    pos: usize,
+}
+
+impl<'a> Reader<'a> {
+    pub fn new(data: &'a [u8]) -> Self {
+        Self { data, pos: 0 }
+    }
+
+    pub fn done(&self) -> bool {
+        self.pos >= self.data.len()
+    }
+
+    pub fn varint(&mut self) -> Result<u64> {
+        let mut out: u64 = 0;
+        let mut shift = 0u32;
+        loop {
+            if self.pos >= self.data.len() {
+                return Err(Error::Decode("truncated varint".into()));
+            }
+            let byte = self.data[self.pos];
+            self.pos += 1;
+            if shift >= 64 {
+                return Err(Error::Decode("varint overflow".into()));
+            }
+            out |= u64::from(byte & 0x7f) << shift;
+            if byte & 0x80 == 0 {
+                return Ok(out);
+            }
+            shift += 7;
+        }
+    }
+
+    /// Next (field, wire_type); None at end of buffer.
+    pub fn next(&mut self) -> Result<Option<(u32, u32)>> {
+        if self.done() {
+            return Ok(None);
+        }
+        let key = self.varint()?;
+        Ok(Some(((key >> 3) as u32, (key & 0x7) as u32)))
+    }
+
+    pub fn length_delimited(&mut self) -> Result<&'a [u8]> {
+        let len = self.varint()? as usize;
+        // overflow-safe: `pos + len` with an untrusted len near usize::MAX
+        // would wrap (release) or panic (debug); compare against remaining
+        if len > self.data.len() - self.pos {
+            return Err(Error::Decode("truncated length-delimited field".into()));
+        }
+        let out = &self.data[self.pos..self.pos + len];
+        self.pos += len;
+        Ok(out)
+    }
+
+    pub fn string(&mut self) -> Result<String> {
+        let raw = self.length_delimited()?;
+        String::from_utf8(raw.to_vec())
+            .map_err(|_| Error::Decode("invalid utf-8 in string field".into()))
+    }
+
+    /// Packed or single repeated int64 (shape fields appear both ways).
+    pub fn repeated_int64(&mut self, wire_type: u32, out: &mut Vec<i64>) -> Result<()> {
+        if wire_type == WIRE_LEN {
+            let raw = self.length_delimited()?;
+            let mut inner = Reader::new(raw);
+            while !inner.done() {
+                out.push(inner.varint()? as i64);
+            }
+        } else {
+            out.push(self.varint()? as i64);
+        }
+        Ok(())
+    }
+
+    pub fn skip(&mut self, wire_type: u32) -> Result<()> {
+        match wire_type {
+            WIRE_VARINT => {
+                self.varint()?;
+            }
+            WIRE_I64 => {
+                if self.data.len() - self.pos < 8 {
+                    return Err(Error::Decode("truncated fixed64 field".into()));
+                }
+                self.pos += 8;
+            }
+            WIRE_LEN => {
+                self.length_delimited()?;
+            }
+            WIRE_I32 => {
+                if self.data.len() - self.pos < 4 {
+                    return Err(Error::Decode("truncated fixed32 field".into()));
+                }
+                self.pos += 4;
+            }
+            other => {
+                return Err(Error::Decode(format!("unknown wire type {other}")));
+            }
+        }
+        Ok(())
+    }
+}
+
+// ---------------------------------------------------------------------------
+// gRPC message framing
+// ---------------------------------------------------------------------------
+
+/// 5-byte prefix: compressed flag (always 0 — this client does not
+/// negotiate message compression) + big-endian u32 payload length.
+pub fn frame_message(payload: &[u8]) -> Bytes {
+    let mut out = BytesMut::with_capacity(5 + payload.len());
+    out.put_u8(0);
+    out.put_u32(payload.len() as u32);
+    out.put_slice(payload);
+    out.freeze()
+}
+
+/// Split one framed message off the front of `buf`; None until a complete
+/// frame has accumulated. Errors on the compressed flag (unsupported here).
+pub fn unframe_message(buf: &mut BytesMut) -> Result<Option<Bytes>> {
+    if buf.len() < 5 {
+        return Ok(None);
+    }
+    let compressed = buf[0] != 0;
+    let len = u32::from_be_bytes([buf[1], buf[2], buf[3], buf[4]]) as usize;
+    if buf.len() < 5 + len {
+        return Ok(None);
+    }
+    if compressed {
+        return Err(Error::Decode(
+            "compressed gRPC message (compression not negotiated)".into(),
+        ));
+    }
+    buf.advance(5);
+    Ok(Some(buf.split_to(len).freeze()))
+}

@@ -1,0 +1,150 @@
+"""Structural gate for the polyglot client sources (java/, rust/).
+
+Neither toolchain exists in this image (no JDK, no cargo — both trees ship
+source-complete with honesty READMEs), so this is the VERDICT-r2-#8 "parse
+the sources" CI gate: strip comments and string literals, require balanced
+delimiters, forbid stub markers, and pin the presence of the API surface
+and semantics (Java retry loop, Json int64 precision; Rust client surface)
+that reviews keep having to re-verify by eye.
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+JAVA = sorted((REPO / "java").rglob("*.java"))
+RUST = sorted((REPO / "rust").rglob("*.rs"))
+
+
+def _strip(source: str, line_comment: str) -> str:
+    """Remove string/char literals and comments, keeping everything else."""
+    out = []
+    i = 0
+    n = len(source)
+    while i < n:
+        c = source[i]
+        if c == '"':
+            i += 1
+            while i < n and source[i] != '"':
+                i += 2 if source[i] == "\\" else 1
+            i += 1
+        elif c == "'":
+            # char literal (java) / lifetime or char (rust): consume a short
+            # quoted span when it closes within a few chars, else keep going
+            end = source.find("'", i + 1)
+            if 0 < end - i <= 4 and "\n" not in source[i:end]:
+                i = end + 1
+            else:
+                out.append(c)
+                i += 1
+        elif source.startswith(line_comment, i):
+            i = source.find("\n", i)
+            i = n if i < 0 else i
+        elif source.startswith("/*", i):
+            i = source.find("*/", i + 2)
+            i = n if i < 0 else i + 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+@pytest.mark.parametrize(
+    "path", JAVA + RUST, ids=lambda p: str(p.relative_to(REPO))
+)
+def test_balanced_and_stub_free(path):
+    source = path.read_text()
+    stripped = _strip(source, "//")
+    for open_ch, close_ch in (("{", "}"), ("(", ")"), ("[", "]")):
+        assert stripped.count(open_ch) == stripped.count(close_ch), (
+            f"{path.name}: unbalanced {open_ch}{close_ch} "
+            f"({stripped.count(open_ch)} vs {stripped.count(close_ch)})"
+        )
+    for marker in ("TODO", "FIXME", "unimplemented!", "todo!", "XXX"):
+        assert marker not in stripped, f"{path.name}: stub marker {marker!r}"
+
+
+def test_source_trees_exist():
+    assert len(JAVA) >= 7, [p.name for p in JAVA]
+    assert len(RUST) >= 6, [p.name for p in RUST]
+
+
+def test_java_retry_loop_present():
+    """Reference InferenceServerClient.java:293-317 parity: a bounded retry
+    on transport failures, last error rethrown, interrupts not absorbed."""
+    source = (REPO / "java/src/main/java/client_tpu/InferenceServerClient.java").read_text()
+    assert "int retryCnt" in source
+    assert re.search(r"for \(int attempt = 0; ; attempt\+\+\)", source)
+    assert "attempt >= retryCnt" in source
+    assert "Thread.currentThread().interrupt()" in source
+
+
+def test_java_json_preserves_int64():
+    """ADVICE r2: int64 above 2^53 must not round-trip through double."""
+    source = (REPO / "java/src/main/java/client_tpu/Json.java").read_text()
+    assert "static Json of(long v)" in source
+    assert "Long.parseLong" in source
+    assert "integral ? longValue : (long) numberValue" in source
+    # no remaining lossy double casts at long-valued call sites
+    for path in JAVA:
+        assert "Json.of((double)" not in path.read_text(), path.name
+
+
+def test_rust_client_surface():
+    """The README parity table's methods exist in client.rs (reference
+    client.rs:178-704 surface)."""
+    source = (REPO / "rust/client-tpu/src/client.rs").read_text()
+    for method in (
+        "pub async fn connect",
+        "pub async fn connect_with_options",
+        "pub async fn is_server_live",
+        "pub async fn is_server_ready",
+        "pub async fn is_model_ready",
+        "pub async fn server_metadata",
+        "pub async fn model_metadata",
+        "pub async fn model_config",
+        "pub async fn infer",
+        "pub async fn infer_stream",
+        "pub async fn model_statistics",
+        "pub async fn repository_index",
+        "pub async fn load_model",
+        "pub async fn unload_model",
+        "pub async fn system_shared_memory_status",
+        "pub async fn system_shared_memory_register",
+        "pub async fn system_shared_memory_unregister",
+        "pub async fn tpu_shared_memory_status",
+        "pub async fn tpu_shared_memory_register",
+        "pub async fn tpu_shared_memory_unregister",
+        "pub async fn cuda_shared_memory_status",
+        "pub async fn cuda_shared_memory_unregister",
+        "pub async fn trace_setting",
+        "pub async fn log_settings",
+    ):
+        assert method in source, f"missing {method!r}"
+
+
+def test_rust_typed_builders():
+    source = (REPO / "rust/client-tpu/src/types.rs").read_text()
+    for method in (
+        "with_data_bool", "with_data_u8", "with_data_i8", "with_data_u16",
+        "with_data_i16", "with_data_u32", "with_data_i32", "with_data_u64",
+        "with_data_i64", "with_data_f32", "with_data_f64", "with_data_raw",
+        "with_data_bytes", "with_shared_memory",
+    ):
+        assert f"pub fn {method}" in source, f"missing builder {method!r}"
+
+
+def test_rust_wire_codec_matches_python_fields():
+    """The Rust encoder's ModelInferRequest field numbers must match the
+    (protoc-cross-validated) Python schema — drift here is wire corruption."""
+    source = (REPO / "rust/client-tpu/src/messages.rs").read_text()
+    # model_name=1, model_version=2, id=3, parameters=4, inputs=5,
+    # outputs=6, raw_input_contents=7
+    assert "w.string(1, &request.model_name)" in source
+    assert "w.string(2, &request.model_version)" in source
+    assert "w.string(3, &request.request_id)" in source
+    assert "w.submessage(5, &t.finish())" in source
+    assert "w.submessage(6, &t.finish())" in source
+    assert "w.bytes_always(7, &input.raw)" in source
