@@ -116,6 +116,15 @@ class Connection {
       int64_t timeout_ms = 0);
 
   bool Alive() const { return alive_.load(); }
+  // True once the peer sent GOAWAY: the socket may still be open (drain),
+  // but new streams will be refused — callers must not reuse/pool this
+  // connection (RFC 7540 §6.8: new work goes on a new connection).
+  bool GoawayReceived() {
+    std::lock_guard<std::mutex> lock(state_mutex_);
+    return goaway_received_;
+  }
+  // Reusable = alive AND not draining.
+  bool Reusable() { return Alive() && !GoawayReceived(); }
   const std::string& PeerDescription() const { return host_port_; }
   // Peer's advertised SETTINGS_MAX_CONCURRENT_STREAMS (RFC 7540 §6.5.2;
   // unset = unlimited). Multiplexing callers must not open more.
@@ -174,6 +183,7 @@ class Connection {
   int64_t peer_max_concurrent_streams_ = INT64_MAX;  // unset = unlimited
   int64_t conn_send_window_ = 65535;
   std::string goaway_debug_;
+  bool goaway_received_ = false;  // state_mutex_; StreamOpen fails fast
 };
 
 }  // namespace h2

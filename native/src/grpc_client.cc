@@ -1026,7 +1026,7 @@ std::unique_ptr<h2::Connection> InferenceServerGrpcClient::AcquireConnection(
     while (!idle_.empty()) {
       std::unique_ptr<h2::Connection> conn = std::move(idle_.back());
       idle_.pop_back();
-      if (conn->Alive()) return conn;
+      if (conn->Reusable()) return conn;
     }
   }
   std::unique_ptr<h2::Connection> conn;
@@ -1040,7 +1040,9 @@ std::unique_ptr<h2::Connection> InferenceServerGrpcClient::AcquireConnection(
 
 void InferenceServerGrpcClient::ReleaseConnection(
     std::unique_ptr<h2::Connection> conn) {
-  if (conn == nullptr || !conn->Alive()) return;
+  // a draining (GOAWAY) connection must not go back in the pool: its
+  // socket can stay open long after new streams started being refused
+  if (conn == nullptr || !conn->Reusable()) return;
   std::lock_guard<std::mutex> lock(pool_mutex_);
   idle_.push_back(std::move(conn));
 }
@@ -1690,7 +1692,7 @@ void InferenceServerGrpcClient::AsyncTransfer() {
       }
     }
 
-    if (!to_open.empty() && (conn == nullptr || !conn->Alive())) {
+    if (!to_open.empty() && (conn == nullptr || !conn->Reusable())) {
       Error cerr;
       std::unique_ptr<h2::Connection> fresh;
       cerr = h2::Connection::Connect(&fresh, url_, 10000, &ssl_options_);

@@ -668,11 +668,31 @@ Error Connection::RecvFrameLocked(int64_t timeout_ms) {
     }
     case kGoaway: {
       if (length >= 8) {
+        int32_t last_stream_id = static_cast<int32_t>(
+            ((static_cast<uint32_t>(payload[0]) << 24) |
+             (static_cast<uint32_t>(payload[1]) << 16) |
+             (static_cast<uint32_t>(payload[2]) << 8) | payload[3]) &
+            0x7FFFFFFF);
+        // Streams above last_stream_id will NEVER complete (RFC 7540
+        // §6.8): error them now so waiters get a typed failure instead of
+        // blocking until the peer closes the socket; streams at or below
+        // the id may still finish normally. New opens must fail fast.
+        // goaway_debug_ is written under state_mutex_: StreamOpen/PumpOne
+        // read it under the same lock from other threads.
+        std::lock_guard<std::mutex> lock(state_mutex_);
         goaway_debug_.assign(
             reinterpret_cast<const char*>(payload + 8), length - 8);
+        goaway_received_ = true;
+        for (auto& kv : streams_) {
+          if (kv.first > last_stream_id && !kv.second.closed) {
+            kv.second.error = Error(
+                "stream rejected: peer sent GOAWAY" +
+                (goaway_debug_.empty() ? std::string()
+                                       : " (" + goaway_debug_ + ")"));
+            kv.second.closed = true;
+          }
+        }
       }
-      // streams above last_stream_id will never complete; the read loop
-      // surfaces the condition when the peer closes the socket
       break;
     }
     case kWindowUpdate: {
@@ -753,6 +773,12 @@ Error Connection::StreamOpen(
     {
       // register the stream before its HEADERS can be answered
       std::lock_guard<std::mutex> lock(state_mutex_);
+      if (goaway_received_) {
+        return Error(
+            "connection is shutting down (GOAWAY received" +
+            (goaway_debug_.empty() ? std::string()
+                                   : ": " + goaway_debug_) + ")");
+      }
       id = next_stream_id_;
       next_stream_id_ += 2;
       streams_[id].send_window = peer_initial_window_;
