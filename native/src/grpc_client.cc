@@ -1693,17 +1693,30 @@ void InferenceServerGrpcClient::AsyncTransfer() {
     }
 
     if (!to_open.empty() && (conn == nullptr || !conn->Reusable())) {
-      Error cerr;
-      std::unique_ptr<h2::Connection> fresh;
-      cerr = h2::Connection::Connect(&fresh, url_, 10000, &ssl_options_);
-      if (cerr) {
-        for (AsyncRequest* request : to_open) {
-          FinishAsyncError(
-              request, Error("[StatusCode.UNAVAILABLE] " + cerr.Message()));
+      if (conn != nullptr && !inflight.empty()) {
+        // Draining (GOAWAY) with streams still in flight — streams at or
+        // below last_stream_id may yet complete, and a fresh connection's
+        // ids (1,3,5,…) would collide with inflight's keys. Requeue and
+        // finish the drain first; the reap path below either delivers the
+        // survivors or fails them all and resets conn, so this converges.
+        std::lock_guard<std::mutex> lock(queue_mutex_);
+        while (!to_open.empty()) {
+          pending_.push_front(to_open.back());
+          to_open.pop_back();
         }
-        to_open.clear();
       } else {
-        conn = std::move(fresh);
+        Error cerr;
+        std::unique_ptr<h2::Connection> fresh;
+        cerr = h2::Connection::Connect(&fresh, url_, 10000, &ssl_options_);
+        if (cerr) {
+          for (AsyncRequest* request : to_open) {
+            FinishAsyncError(
+                request, Error("[StatusCode.UNAVAILABLE] " + cerr.Message()));
+          }
+          to_open.clear();
+        } else {
+          conn = std::move(fresh);
+        }
       }
     }
     if (conn != nullptr && !to_open.empty()) {
