@@ -1,0 +1,20 @@
+#!/bin/sh
+# Generate the Go stub package for inference.GRPCInferenceService from the
+# vendored proto (reference parity: src/grpc_generated/go/gen_go_stubs.sh).
+#
+# Requires: protoc, protoc-gen-go, protoc-gen-go-grpc on PATH
+#   go install google.golang.org/protobuf/cmd/protoc-gen-go@latest
+#   go install google.golang.org/grpc/cmd/protoc-gen-go-grpc@latest
+set -e
+HERE=$(dirname "$0")
+PROTO_DIR="$HERE/../../proto"
+OUT="$HERE/inference"
+mkdir -p "$OUT"
+protoc \
+  -I "$PROTO_DIR" \
+  --go_out="$OUT" --go_opt=paths=source_relative \
+  --go_opt=Mgrpc_service.proto=client_tpu_grpc/inference \
+  --go-grpc_out="$OUT" --go-grpc_opt=paths=source_relative \
+  --go-grpc_opt=Mgrpc_service.proto=client_tpu_grpc/inference \
+  "$PROTO_DIR/grpc_service.proto"
+echo "stubs written to $OUT"

@@ -1,0 +1,8 @@
+module client_tpu_grpc
+
+go 1.21
+
+require (
+	google.golang.org/grpc v1.64.0
+	google.golang.org/protobuf v1.34.0
+)

@@ -1,0 +1,71 @@
+// JS generated-stub example for inference.GRPCInferenceService.
+//
+// Parity with the reference's src/grpc_generated/javascript/client.js
+// (:28-53 — @grpc/proto-loader dynamic load + simple infer), written fresh
+// against this repo's vendored proto/grpc_service.proto.
+//
+//   npm install @grpc/grpc-js @grpc/proto-loader
+//   node client.js [host:port]    (default localhost:8001)
+//
+// The "simple" model takes two INT32[1,16] tensors and returns their
+// elementwise sum (OUTPUT0) and difference (OUTPUT1).
+"use strict";
+
+const path = require("path");
+const grpc = require("@grpc/grpc-js");
+const protoLoader = require("@grpc/proto-loader");
+
+const PROTO = path.join(__dirname, "..", "..", "proto", "grpc_service.proto");
+const url = process.argv[2] || "localhost:8001";
+
+const definition = protoLoader.loadSync(PROTO, {
+  keepCase: true,
+  longs: Number,
+  enums: String,
+  defaults: true,
+});
+const inference = grpc.loadPackageDefinition(definition).inference;
+const client = new inference.GRPCInferenceService(
+  url, grpc.credentials.createInsecure());
+
+function int32sToLE(values) {
+  const buf = Buffer.alloc(4 * values.length);
+  values.forEach((v, i) => buf.writeInt32LE(v, 4 * i));
+  return buf;
+}
+
+function leToInt32s(buf) {
+  const out = [];
+  for (let i = 0; i + 4 <= buf.length; i += 4) out.push(buf.readInt32LE(i));
+  return out;
+}
+
+const input0 = Array.from({ length: 16 }, (_, i) => i);
+const input1 = Array.from({ length: 16 }, () => 1);
+
+client.ServerLive({}, (err, live) => {
+  if (err) throw err;
+  console.log("server live:", live.live);
+  const request = {
+    model_name: "simple",
+    inputs: [
+      { name: "INPUT0", datatype: "INT32", shape: [1, 16] },
+      { name: "INPUT1", datatype: "INT32", shape: [1, 16] },
+    ],
+    outputs: [{ name: "OUTPUT0" }, { name: "OUTPUT1" }],
+    raw_input_contents: [int32sToLE(input0), int32sToLE(input1)],
+  };
+  client.ModelInfer(request, (inferErr, response) => {
+    if (inferErr) throw inferErr;
+    const sum = leToInt32s(response.raw_output_contents[0]);
+    const diff = leToInt32s(response.raw_output_contents[1]);
+    for (let i = 0; i < 16; i += 1) {
+      if (sum[i] !== input0[i] + input1[i] ||
+          diff[i] !== input0[i] - input1[i]) {
+        throw new Error(`mismatch at ${i}: sum=${sum[i]} diff=${diff[i]}`);
+      }
+    }
+    console.log("PASS: sum/diff verified for all 16 elements");
+    client.close();
+  });
+});

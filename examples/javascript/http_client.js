@@ -1,0 +1,77 @@
+// Dependency-free KServe v2 HTTP client for Node >= 18 (built-in fetch).
+//
+// No npm install needed — this is the REST analog of client.js for users
+// who can't take grpc dependencies. Exercises /v2 health + metadata and a
+// binary-tensor infer with the Inference-Header-Content-Length framing
+// (the same body layout client_tpu.http builds).
+//
+//   node http_client.js [host:port]    (default localhost:8000)
+"use strict";
+
+const base = `http://${process.argv[2] || "localhost:8000"}`;
+
+function int32sToLE(values) {
+  const buf = Buffer.alloc(4 * values.length);
+  values.forEach((v, i) => buf.writeInt32LE(v, 4 * i));
+  return buf;
+}
+
+function leToInt32s(buf) {
+  const out = [];
+  for (let i = 0; i + 4 <= buf.length; i += 4) out.push(buf.readInt32LE(i));
+  return out;
+}
+
+async function main() {
+  const live = await fetch(`${base}/v2/health/live`);
+  console.log("server live:", live.ok);
+  const meta = await (await fetch(`${base}/v2/models/simple`)).json();
+  console.log("model:", meta.name, "inputs:", meta.inputs.length);
+
+  const input0 = Array.from({ length: 16 }, (_, i) => i);
+  const input1 = Array.from({ length: 16 }, () => 1);
+  const raw0 = int32sToLE(input0);
+  const raw1 = int32sToLE(input1);
+  const header = Buffer.from(JSON.stringify({
+    inputs: [
+      { name: "INPUT0", datatype: "INT32", shape: [1, 16],
+        parameters: { binary_data_size: raw0.length } },
+      { name: "INPUT1", datatype: "INT32", shape: [1, 16],
+        parameters: { binary_data_size: raw1.length } },
+    ],
+    outputs: [
+      { name: "OUTPUT0", parameters: { binary_data: true } },
+      { name: "OUTPUT1", parameters: { binary_data: true } },
+    ],
+  }));
+
+  const resp = await fetch(`${base}/v2/models/simple/infer`, {
+    method: "POST",
+    headers: {
+      "Content-Type": "application/octet-stream",
+      "Inference-Header-Content-Length": String(header.length),
+    },
+    body: Buffer.concat([header, raw0, raw1]),
+  });
+  if (!resp.ok) throw new Error(`infer failed: ${resp.status}`);
+
+  const body = Buffer.from(await resp.arrayBuffer());
+  const jsonLen = Number(resp.headers.get("inference-header-content-length"));
+  const reply = JSON.parse(body.subarray(0, jsonLen).toString());
+  let offset = jsonLen;
+  const outputs = {};
+  for (const out of reply.outputs) {
+    const size = out.parameters.binary_data_size;
+    outputs[out.name] = leToInt32s(body.subarray(offset, offset + size));
+    offset += size;
+  }
+  for (let i = 0; i < 16; i += 1) {
+    if (outputs.OUTPUT0[i] !== input0[i] + input1[i] ||
+        outputs.OUTPUT1[i] !== input0[i] - input1[i]) {
+      throw new Error(`mismatch at ${i}`);
+    }
+  }
+  console.log("PASS: sum/diff verified for all 16 elements");
+}
+
+main().catch((e) => { console.error(e); process.exit(1); });

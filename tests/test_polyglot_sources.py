@@ -16,18 +16,25 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 JAVA = sorted((REPO / "java").rglob("*.java"))
 RUST = sorted((REPO / "rust").rglob("*.rs"))
+GO = sorted((REPO / "examples" / "go").rglob("*.go"))
+JS = sorted((REPO / "examples" / "javascript").rglob("*.js"))
 
 
-def _strip(source: str, line_comment: str) -> str:
-    """Remove string/char literals and comments, keeping everything else."""
+def _strip(source: str, line_comment: str, js: bool = False) -> str:
+    """Remove string/char literals and comments, keeping everything else.
+
+    ``js=True`` additionally treats ``'...'`` and backtick template
+    literals as full strings (Java/Rust treat ``'`` as a char-literal /
+    lifetime marker instead)."""
     out = []
     i = 0
     n = len(source)
     while i < n:
         c = source[i]
-        if c == '"':
+        if c == '"' or (js and c in "'`"):
+            quote = c
             i += 1
-            while i < n and source[i] != '"':
+            while i < n and source[i] != quote:
                 i += 2 if source[i] == "\\" else 1
             i += 1
         elif c == "'":
@@ -52,11 +59,11 @@ def _strip(source: str, line_comment: str) -> str:
 
 
 @pytest.mark.parametrize(
-    "path", JAVA + RUST, ids=lambda p: str(p.relative_to(REPO))
+    "path", JAVA + RUST + GO + JS, ids=lambda p: str(p.relative_to(REPO))
 )
 def test_balanced_and_stub_free(path):
     source = path.read_text()
-    stripped = _strip(source, "//")
+    stripped = _strip(source, "//", js=path.suffix == ".js")
     for open_ch, close_ch in (("{", "}"), ("(", ")"), ("[", "]")):
         assert stripped.count(open_ch) == stripped.count(close_ch), (
             f"{path.name}: unbalanced {open_ch}{close_ch} "
@@ -69,6 +76,31 @@ def test_balanced_and_stub_free(path):
 def test_source_trees_exist():
     assert len(JAVA) >= 7, [p.name for p in JAVA]
     assert len(RUST) >= 6, [p.name for p in RUST]
+    assert len(GO) >= 1, [p.name for p in GO]
+    assert len(JS) >= 2, [p.name for p in JS]
+
+
+def test_go_client_surface():
+    """Reference grpc_simple_client.go:66-160 parity: health, metadata, and
+    a raw_input_contents infer with verified arithmetic."""
+    source = (REPO / "examples/go/grpc_simple_client.go").read_text()
+    for needle in (
+        "ServerLive", "ServerReady", "ModelMetadata", "ModelInfer",
+        "RawInputContents", "binary.LittleEndian",
+    ):
+        assert needle in source, f"missing {needle!r}"
+
+
+def test_js_clients_surface():
+    """client.js loads the vendored proto at runtime; http_client.js frames
+    binary tensors with Inference-Header-Content-Length, dependency-free."""
+    grpc_src = (REPO / "examples/javascript/client.js").read_text()
+    assert "proto-loader" in grpc_src
+    assert "grpc_service.proto" in grpc_src
+    assert "raw_input_contents" in grpc_src
+    http_src = (REPO / "examples/javascript/http_client.js").read_text()
+    assert "Inference-Header-Content-Length" in http_src
+    assert "require(" not in http_src, "http client must stay dependency-free"
 
 
 def test_java_retry_loop_present():

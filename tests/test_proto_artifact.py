@@ -1,0 +1,182 @@
+"""Gates for the vendored ``proto/grpc_service.proto``.
+
+Three independent asserts that the artifact users feed to protoc (for
+Go/JS/Java/... stub generation — reference: src/grpc_generated/*/README.md
+all point at the vendored grpc_service.proto) matches what this framework's
+wire codec actually speaks:
+
+1. drift: regenerating from the specs reproduces the committed file byte
+   for byte;
+2. protoc accepts it, and the resulting descriptor carries every rpc with
+   the right streaming flags;
+3. byte-level interop both directions on representative messages (rich
+   infer request, enum-carrying model config, uint64 shm offsets, oneof
+   parameters, trace-settings maps).
+"""
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+PROTO = REPO / "proto" / "grpc_service.proto"
+
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def test_proto_matches_specs():
+    import gen_proto
+
+    assert PROTO.read_text() == gen_proto.generate(), (
+        "proto/grpc_service.proto is stale — run: python tools/gen_proto.py"
+    )
+
+
+@pytest.fixture(scope="module")
+def pb2(tmp_path_factory):
+    try:
+        subprocess.run(["protoc", "--version"], capture_output=True, check=True)
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("protoc unavailable")
+    td = tmp_path_factory.mktemp("pb2")
+    subprocess.run(
+        ["protoc", f"-I{PROTO.parent}", f"--python_out={td}", str(PROTO)],
+        check=True,
+    )
+    out = td / "grpc_service_pb2.py"
+    spec = importlib.util.spec_from_file_location("grpc_service_pb2", out)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_service_descriptor_methods(pb2):
+    from client_tpu.grpc._messages import METHODS
+
+    svc = pb2.DESCRIPTOR.services_by_name["GRPCInferenceService"]
+    assert {m.name for m in svc.methods} == set(METHODS)
+    stream = svc.methods_by_name["ModelStreamInfer"]
+    # bidi: both ends streaming; everything else unary
+    assert stream.client_streaming and stream.server_streaming
+    unary = svc.methods_by_name["ModelInfer"]
+    assert not unary.client_streaming and not unary.server_streaming
+
+
+def _roundtrip(pb2, spec, pb_name, payload):
+    """our-encode -> protoc-decode -> protoc-encode -> our-decode."""
+    from client_tpu.grpc._wire import decode_message, encode_message
+
+    ours = encode_message(spec, payload)
+    msg = getattr(pb2, pb_name)()
+    msg.ParseFromString(ours)  # protoc accepts our bytes
+    theirs = msg.SerializeToString()
+    assert decode_message(spec, theirs) == decode_message(spec, ours)
+    return msg
+
+
+def test_infer_request_interop(pb2):
+    from client_tpu.grpc import _messages as M
+
+    payload = {
+        "model_name": "simple",
+        "model_version": "2",
+        "id": "req-1",
+        "parameters": {
+            "sequence_id": {"int64_param": 42},
+            "priority": {"uint64_param": 2**63 + 7},
+            "binary": {"bool_param": True},
+            "note": {"string_param": "hi"},
+        },
+        "inputs": [
+            {
+                "name": "INPUT0",
+                "datatype": "INT32",
+                "shape": [1, 16],
+                "contents": {"int_contents": list(range(16))},
+            },
+            {
+                "name": "INPUT1",
+                "datatype": "BYTES",
+                "shape": [2],
+                "contents": {"bytes_contents": [b"ab", b"\x00\xff"]},
+            },
+        ],
+        "outputs": [{"name": "OUTPUT0"}],
+        "raw_input_contents": [b"\x01\x02", b""],
+    }
+    msg = _roundtrip(pb2, M.MODEL_INFER_REQUEST, "ModelInferRequest", payload)
+    assert msg.model_name == "simple"
+    assert msg.parameters["priority"].uint64_param == 2**63 + 7
+    assert list(msg.inputs[0].contents.int_contents) == list(range(16))
+
+
+def test_model_config_enum_interop(pb2):
+    from client_tpu.grpc import _messages as M
+
+    payload = {
+        "config": {
+            "name": "densenet_onnx",
+            "platform": "jax",
+            "max_batch_size": 8,
+            "input": [
+                {
+                    "name": "data_0",
+                    "data_type": M.CONFIG_DATATYPE_NAMES.index("TYPE_FP32"),
+                    "format": 2,  # FORMAT_NCHW
+                    "dims": [3, 224, 224],
+                }
+            ],
+            "output": [
+                {
+                    "name": "fc6_1",
+                    "data_type": M.CONFIG_DATATYPE_NAMES.index("TYPE_FP32"),
+                    "dims": [1000],
+                }
+            ],
+            "model_transaction_policy": {"decoupled": False},
+        }
+    }
+    msg = _roundtrip(pb2, M.MODEL_CONFIG_RESPONSE, "ModelConfigResponse", payload)
+    assert msg.config.input[0].data_type == pb2.TYPE_FP32
+    assert msg.config.input[0].format == msg.config.input[0].Format.FORMAT_NCHW
+
+
+def test_shm_register_uint64_interop(pb2):
+    from client_tpu.grpc import _messages as M
+
+    payload = {
+        "name": "region0",
+        "raw_handle": b"\x00" * 16,
+        "device_id": 0,
+        "byte_size": 2**40 + 3,
+    }
+    msg = _roundtrip(
+        pb2, M.DEVICE_SHM_REGISTER_REQUEST, "CudaSharedMemoryRegisterRequest",
+        payload,
+    )
+    assert msg.byte_size == 2**40 + 3
+    sys_payload = {"name": "r", "key": "/r", "offset": 2**33, "byte_size": 64}
+    sys_msg = _roundtrip(
+        pb2, M.SYSTEM_SHM_REGISTER_REQUEST, "SystemSharedMemoryRegisterRequest",
+        sys_payload,
+    )
+    assert sys_msg.offset == 2**33
+
+
+def test_trace_setting_map_interop(pb2):
+    from client_tpu.grpc import _messages as M
+
+    payload = {
+        "model_name": "simple",
+        "settings": {
+            "trace_level": {"value": ["TIMESTAMPS"]},
+            "trace_rate": {"value": ["1000"]},
+        },
+    }
+    msg = _roundtrip(
+        pb2, M.TRACE_SETTING_REQUEST, "TraceSettingRequest", payload
+    )
+    assert list(msg.settings["trace_level"].value) == ["TIMESTAMPS"]
