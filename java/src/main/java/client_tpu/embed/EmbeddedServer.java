@@ -1,0 +1,305 @@
+// In-process embedded inference server for Java hosts.
+//
+// Parity role: the reference's java-api-bindings (JavaCPP over the
+// tritonserver C API — reference:
+// src/java-api-bindings/scripts/install_dependencies_and_build.sh). Here
+// the C API is native/include/client_tpu/server_embed.h
+// (libclient_tpu_embed.so hosts the Python ServerCore + JAX inside this
+// process), and the binding uses the JDK-22 Foreign Function & Memory API
+// instead of JavaCPP/JNI — no codegen, no extra dependency.
+//
+// Requests and responses cross the boundary as the KServe v2 two-part
+// HTTP body (JSON header + binary tails), the same bytes
+// client_tpu.InferenceServerClient builds — so InferInput/InferResult
+// marshaling is reusable verbatim on top of this class.
+//
+// Usage:
+//   try (EmbeddedServer server =
+//            EmbeddedServer.create("/path/to/repo", "{\"models\":[\"simple\"]}")) {
+//     byte[] response = server.infer("simple", "", body, headerLen);
+//     String meta = server.modelMetadata("simple");
+//   }
+
+package client_tpu.embed;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+
+public final class EmbeddedServer implements AutoCloseable {
+
+  private static final Linker LINKER = Linker.nativeLinker();
+  private static final SymbolLookup LIB =
+      SymbolLookup.libraryLookup("libclient_tpu_embed.so", Arena.global());
+
+  private static MethodHandle handle(String name, FunctionDescriptor desc) {
+    return LINKER.downcallHandle(
+        LIB.find(name).orElseThrow(
+            () -> new UnsatisfiedLinkError("missing symbol " + name)),
+        desc);
+  }
+
+  private static final MethodHandle INIT = handle(
+      "ctpu_embed_init",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+          ValueLayout.ADDRESS));
+  private static final MethodHandle CREATE = handle(
+      "ctpu_embed_server_create",
+      FunctionDescriptor.of(ValueLayout.JAVA_LONG, ValueLayout.ADDRESS,
+          ValueLayout.ADDRESS));
+  private static final MethodHandle INFER = handle(
+      "ctpu_embed_infer",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+          ValueLayout.ADDRESS, ValueLayout.ADDRESS, ValueLayout.ADDRESS,
+          ValueLayout.JAVA_LONG, ValueLayout.JAVA_LONG, ValueLayout.ADDRESS,
+          ValueLayout.ADDRESS, ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+  private static final MethodHandle METADATA = handle(
+      "ctpu_embed_metadata",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+          ValueLayout.ADDRESS, ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+  private static final MethodHandle REPOSITORY_INDEX = handle(
+      "ctpu_embed_repository_index",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+          ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+  private static final MethodHandle STATISTICS = handle(
+      "ctpu_embed_statistics",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+          ValueLayout.ADDRESS, ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+  private static final MethodHandle LOAD = handle(
+      "ctpu_embed_load_model",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+          ValueLayout.ADDRESS, ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+  private static final MethodHandle UNLOAD = handle(
+      "ctpu_embed_unload_model",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+          ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+  private static final MethodHandle START_HTTP = handle(
+      "ctpu_embed_start_http",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+          ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+  private static final MethodHandle DESTROY = handle(
+      "ctpu_embed_server_destroy",
+      FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.JAVA_LONG,
+          ValueLayout.ADDRESS));
+  private static final MethodHandle FREE = handle(
+      "ctpu_embed_free",
+      FunctionDescriptor.ofVoid(ValueLayout.ADDRESS));
+
+  private final long server;
+  private boolean closed;
+
+  private EmbeddedServer(long server) {
+    this.server = server;
+  }
+
+  /** Reads *error (char**), frees it, and throws when set. */
+  private static void throwIfError(int rc, MemorySegment errorOut)
+      throws EmbeddedServerException {
+    if (rc == 0) {
+      return;
+    }
+    MemorySegment message = errorOut.get(ValueLayout.ADDRESS, 0);
+    String text = "native call failed";
+    if (!MemorySegment.NULL.equals(message)) {
+      text = message.reinterpret(Long.MAX_VALUE).getString(0);
+      try {
+        FREE.invokeExact(message);
+      } catch (Throwable ignored) {
+        // freeing the error string is best-effort
+      }
+    }
+    throw new EmbeddedServerException(text);
+  }
+
+  /**
+   * Initialize the embedded interpreter and create a server.
+   *
+   * @param repoPath path to the client_tpu checkout/install (null when
+   *     importable from the environment)
+   * @param optionsJson e.g. {"models": ["simple"]}; empty = full zoo
+   */
+  public static EmbeddedServer create(String repoPath, String optionsJson)
+      throws EmbeddedServerException {
+    try (Arena arena = Arena.ofConfined()) {
+      MemorySegment errorOut = arena.allocate(ValueLayout.ADDRESS);
+      MemorySegment repo = repoPath == null
+          ? MemorySegment.NULL : arena.allocateFrom(repoPath);
+      int rc = (int) INIT.invokeExact(repo, errorOut);
+      throwIfError(rc, errorOut);
+      MemorySegment options = arena.allocateFrom(
+          optionsJson == null ? "" : optionsJson);
+      long server = (long) CREATE.invokeExact(options, errorOut);
+      if (server == 0) {
+        throwIfError(1, errorOut);
+      }
+      return new EmbeddedServer(server);
+    } catch (EmbeddedServerException e) {
+      throw e;
+    } catch (Throwable t) {
+      throw new EmbeddedServerException("FFM invocation failed", t);
+    }
+  }
+
+  /**
+   * One inference in the v2 two-part body format; returns the full
+   * response body. The response header length (byte offset where binary
+   * tails start; -1 = pure JSON) is returned via responseHeaderLen[0].
+   */
+  public byte[] infer(String modelName, String modelVersion, byte[] body,
+      long headerLength, long[] responseHeaderLen)
+      throws EmbeddedServerException {
+    checkOpen();
+    try (Arena arena = Arena.ofConfined()) {
+      MemorySegment errorOut = arena.allocate(ValueLayout.ADDRESS);
+      MemorySegment bodySeg = arena.allocate(body.length);
+      MemorySegment.copy(body, 0, bodySeg, ValueLayout.JAVA_BYTE, 0,
+          body.length);
+      MemorySegment responseOut = arena.allocate(ValueLayout.ADDRESS);
+      MemorySegment lenOut = arena.allocate(ValueLayout.JAVA_LONG);
+      MemorySegment headerOut = arena.allocate(ValueLayout.JAVA_LONG);
+      int rc = (int) INFER.invokeExact(server,
+          arena.allocateFrom(modelName),
+          arena.allocateFrom(modelVersion == null ? "" : modelVersion),
+          bodySeg, (long) body.length, headerLength,
+          responseOut, lenOut, headerOut, errorOut);
+      throwIfError(rc, errorOut);
+      MemorySegment data = responseOut.get(ValueLayout.ADDRESS, 0);
+      long len = lenOut.get(ValueLayout.JAVA_LONG, 0);
+      byte[] response = new byte[(int) len];
+      MemorySegment.copy(data.reinterpret(len), ValueLayout.JAVA_BYTE, 0,
+          response, 0, (int) len);
+      FREE.invokeExact(data);
+      if (responseHeaderLen != null && responseHeaderLen.length > 0) {
+        responseHeaderLen[0] = headerOut.get(ValueLayout.JAVA_LONG, 0);
+      }
+      return response;
+    } catch (EmbeddedServerException e) {
+      throw e;
+    } catch (Throwable t) {
+      throw new EmbeddedServerException("FFM invocation failed", t);
+    }
+  }
+
+  private String jsonCall(MethodHandle method, String arg)
+      throws EmbeddedServerException {
+    checkOpen();
+    try (Arena arena = Arena.ofConfined()) {
+      MemorySegment errorOut = arena.allocate(ValueLayout.ADDRESS);
+      MemorySegment jsonOut = arena.allocate(ValueLayout.ADDRESS);
+      int rc = arg == null
+          ? (int) method.invokeExact(server, jsonOut, errorOut)
+          : (int) method.invokeExact(server, arena.allocateFrom(arg),
+              jsonOut, errorOut);
+      throwIfError(rc, errorOut);
+      MemorySegment data = jsonOut.get(ValueLayout.ADDRESS, 0);
+      String json = data.reinterpret(Long.MAX_VALUE).getString(0);
+      FREE.invokeExact(data);
+      return json;
+    } catch (EmbeddedServerException e) {
+      throw e;
+    } catch (Throwable t) {
+      throw new EmbeddedServerException("FFM invocation failed", t);
+    }
+  }
+
+  public String serverMetadata() throws EmbeddedServerException {
+    return jsonCall(METADATA, "");
+  }
+
+  public String modelMetadata(String modelName)
+      throws EmbeddedServerException {
+    return jsonCall(METADATA, modelName);
+  }
+
+  public String repositoryIndex() throws EmbeddedServerException {
+    return jsonCall(REPOSITORY_INDEX, null);
+  }
+
+  public String statistics(String modelName) throws EmbeddedServerException {
+    return jsonCall(STATISTICS, modelName == null ? "" : modelName);
+  }
+
+  public void loadModel(String modelName, String configJson)
+      throws EmbeddedServerException {
+    checkOpen();
+    try (Arena arena = Arena.ofConfined()) {
+      MemorySegment errorOut = arena.allocate(ValueLayout.ADDRESS);
+      int rc = (int) LOAD.invokeExact(server, arena.allocateFrom(modelName),
+          arena.allocateFrom(configJson == null ? "" : configJson), errorOut);
+      throwIfError(rc, errorOut);
+    } catch (EmbeddedServerException e) {
+      throw e;
+    } catch (Throwable t) {
+      throw new EmbeddedServerException("FFM invocation failed", t);
+    }
+  }
+
+  public void unloadModel(String modelName) throws EmbeddedServerException {
+    checkOpen();
+    try (Arena arena = Arena.ofConfined()) {
+      MemorySegment errorOut = arena.allocate(ValueLayout.ADDRESS);
+      int rc = (int) UNLOAD.invokeExact(server,
+          arena.allocateFrom(modelName), errorOut);
+      throwIfError(rc, errorOut);
+    } catch (EmbeddedServerException e) {
+      throw e;
+    } catch (Throwable t) {
+      throw new EmbeddedServerException("FFM invocation failed", t);
+    }
+  }
+
+  /** Also expose the embedded core over HTTP; returns the bound port. */
+  public int startHttp(int port) throws EmbeddedServerException {
+    checkOpen();
+    try (Arena arena = Arena.ofConfined()) {
+      MemorySegment errorOut = arena.allocate(ValueLayout.ADDRESS);
+      MemorySegment portSeg = arena.allocate(ValueLayout.JAVA_INT);
+      portSeg.set(ValueLayout.JAVA_INT, 0, port);
+      int rc = (int) START_HTTP.invokeExact(server, portSeg, errorOut);
+      throwIfError(rc, errorOut);
+      return portSeg.get(ValueLayout.JAVA_INT, 0);
+    } catch (EmbeddedServerException e) {
+      throw e;
+    } catch (Throwable t) {
+      throw new EmbeddedServerException("FFM invocation failed", t);
+    }
+  }
+
+  private void checkOpen() throws EmbeddedServerException {
+    if (closed) {
+      throw new EmbeddedServerException("server already closed");
+    }
+  }
+
+  @Override
+  public void close() throws EmbeddedServerException {
+    if (closed) {
+      return;
+    }
+    closed = true;
+    try (Arena arena = Arena.ofConfined()) {
+      MemorySegment errorOut = arena.allocate(ValueLayout.ADDRESS);
+      int rc = (int) DESTROY.invokeExact(server, errorOut);
+      throwIfError(rc, errorOut);
+    } catch (EmbeddedServerException e) {
+      throw e;
+    } catch (Throwable t) {
+      throw new EmbeddedServerException("FFM invocation failed", t);
+    }
+  }
+
+  /** Typed failure from the embedded server or the FFM boundary. */
+  public static final class EmbeddedServerException extends Exception {
+    public EmbeddedServerException(String message) {
+      super(message);
+    }
+
+    public EmbeddedServerException(String message, Throwable cause) {
+      super(message, cause);
+    }
+  }
+}

@@ -1,0 +1,76 @@
+// In-process server embedding C API.
+//
+// The role the reference's java-api-bindings plays for tritonserver
+// (reference: src/java-api-bindings/scripts/install_dependencies_and_build.sh
+// — JavaCPP over the tritonserver C API): host the inference server INSIDE
+// a C/C++/Java process. Here the engine is the Python ServerCore + JAX,
+// reached by embedding CPython (libclient_tpu_embed.so links libpython and
+// drives client_tpu.server.embed).
+//
+// Threading: every call is safe from any thread (the shim takes the GIL
+// per call). Strings/buffers returned via ctpu_embed_* must be released
+// with ctpu_embed_free().
+//
+// Request/response contract for infer: the KServe v2 two-part HTTP body
+// (JSON header + concatenated binary tails). header_length < 0 means pure
+// JSON. The same bytes every client library in this repo builds/parses.
+
+#ifndef CLIENT_TPU_SERVER_EMBED_H_
+#define CLIENT_TPU_SERVER_EMBED_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// Initialize the embedded interpreter (idempotent; called implicitly by
+// ctpu_embed_server_create). repo_path may be NULL when client_tpu is
+// importable from the environment. Returns 0 on success.
+int ctpu_embed_init(const char* repo_path, char** error);
+
+// Create a server; options_json e.g. {"models": ["simple"]} (empty = full
+// default zoo). Returns a handle > 0, or 0 with *error set.
+int64_t ctpu_embed_server_create(const char* options_json, char** error);
+
+// One inference in the v2 two-part body format. On success fills
+// *response/*response_len/*response_header_len (-1 = pure JSON) and
+// returns 0. On failure returns nonzero and sets *error.
+int ctpu_embed_infer(
+    int64_t server, const char* model_name, const char* model_version,
+    const uint8_t* body, size_t body_len, int64_t header_length,
+    uint8_t** response, size_t* response_len, int64_t* response_header_len,
+    char** error);
+
+// Server (model_name = NULL/"") or model metadata as JSON.
+int ctpu_embed_metadata(
+    int64_t server, const char* model_name, char** json, char** error);
+
+// Repository index / statistics as JSON.
+int ctpu_embed_repository_index(int64_t server, char** json, char** error);
+int ctpu_embed_statistics(
+    int64_t server, const char* model_name, char** json, char** error);
+
+// Model lifecycle (config_json may be NULL).
+int ctpu_embed_load_model(
+    int64_t server, const char* model_name, const char* config_json,
+    char** error);
+int ctpu_embed_unload_model(
+    int64_t server, const char* model_name, char** error);
+
+// Also expose the embedded core over HTTP; returns the bound port via
+// *port (pass desired port or 0 for ephemeral).
+int ctpu_embed_start_http(int64_t server, int* port, char** error);
+
+// Destroy a server (stops any HTTP frontend it started).
+int ctpu_embed_server_destroy(int64_t server, char** error);
+
+// Release any buffer/string returned by this API.
+void ctpu_embed_free(void* ptr);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // CLIENT_TPU_SERVER_EMBED_H_

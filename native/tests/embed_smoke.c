@@ -1,0 +1,141 @@
+/* C host for the embedded server (server_embed.h smoke).
+ *
+ * Proves the java-api-bindings parity story end-to-end from plain C: init
+ * the interpreter, create a server with the "simple" model, run a
+ * two-part-body inference, check the sum/diff arithmetic, hit the admin
+ * JSON surfaces, start the HTTP frontend, destroy.
+ *
+ * Usage: embed_smoke <repo_path>
+ * Exits 0 and prints PASS on success.
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "client_tpu/server_embed.h"
+
+static int fail(const char* stage, char* error) {
+  fprintf(stderr, "FAIL at %s: %s\n", stage,
+          error != NULL ? error : "(no message)");
+  ctpu_embed_free(error);
+  return 1;
+}
+
+int main(int argc, char** argv) {
+  const char* repo = argc > 1 ? argv[1] : NULL;
+  char* error = NULL;
+
+  if (ctpu_embed_init(repo, &error) != 0) return fail("init", error);
+
+  int64_t server = ctpu_embed_server_create("{\"models\": [\"simple\"]}",
+                                            &error);
+  if (server == 0) return fail("create", error);
+
+  /* two-part v2 body: JSON header + two INT32[1,16] binary tails */
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; i++) {
+    input0[i] = i;
+    input1[i] = 2 * i;
+  }
+  const char* header_json =
+      "{\"inputs\":["
+      "{\"name\":\"INPUT0\",\"datatype\":\"INT32\",\"shape\":[1,16],"
+      "\"parameters\":{\"binary_data_size\":64}},"
+      "{\"name\":\"INPUT1\",\"datatype\":\"INT32\",\"shape\":[1,16],"
+      "\"parameters\":{\"binary_data_size\":64}}],"
+      "\"outputs\":["
+      "{\"name\":\"OUTPUT0\",\"parameters\":{\"binary_data\":true}},"
+      "{\"name\":\"OUTPUT1\",\"parameters\":{\"binary_data\":true}}]}";
+  size_t header_len = strlen(header_json);
+  size_t body_len = header_len + sizeof(input0) + sizeof(input1);
+  uint8_t* body = malloc(body_len);
+  memcpy(body, header_json, header_len);
+  memcpy(body + header_len, input0, sizeof(input0));
+  memcpy(body + header_len + sizeof(input0), input1, sizeof(input1));
+
+  uint8_t* response = NULL;
+  size_t response_len = 0;
+  int64_t response_header_len = -1;
+  int rc = ctpu_embed_infer(server, "simple", "", body, body_len,
+                            (int64_t)header_len, &response, &response_len,
+                            &response_header_len, &error);
+  free(body);
+  if (rc != 0) return fail("infer", error);
+  if (response_header_len <= 0 ||
+      (size_t)response_header_len + 128 != response_len) {
+    fprintf(stderr, "FAIL: unexpected response framing (header %lld of %zu)\n",
+            (long long)response_header_len, response_len);
+    return 1;
+  }
+  /* binary tail: OUTPUT0 (sum) then OUTPUT1 (diff), 64 bytes each */
+  const int32_t* sum = (const int32_t*)(response + response_header_len);
+  const int32_t* diff = sum + 16;
+  for (int i = 0; i < 16; i++) {
+    if (sum[i] != input0[i] + input1[i] || diff[i] != input0[i] - input1[i]) {
+      fprintf(stderr, "FAIL: wrong arithmetic at %d: sum=%d diff=%d\n", i,
+              sum[i], diff[i]);
+      return 1;
+    }
+  }
+  ctpu_embed_free(response);
+  printf("ok embedded infer (sum/diff verified)\n");
+
+  char* json = NULL;
+  if (ctpu_embed_metadata(server, NULL, &json, &error) != 0)
+    return fail("server metadata", error);
+  if (strstr(json, "\"name\"") == NULL) {
+    fprintf(stderr, "FAIL: metadata missing name: %s\n", json);
+    return 1;
+  }
+  ctpu_embed_free(json);
+
+  if (ctpu_embed_metadata(server, "simple", &json, &error) != 0)
+    return fail("model metadata", error);
+  if (strstr(json, "INPUT0") == NULL) {
+    fprintf(stderr, "FAIL: model metadata missing INPUT0: %s\n", json);
+    return 1;
+  }
+  ctpu_embed_free(json);
+
+  if (ctpu_embed_repository_index(server, &json, &error) != 0)
+    return fail("repository index", error);
+  ctpu_embed_free(json);
+
+  if (ctpu_embed_statistics(server, "", &json, &error) != 0)
+    return fail("statistics", error);
+  if (strstr(json, "simple") == NULL) {
+    fprintf(stderr, "FAIL: statistics missing model row: %s\n", json);
+    return 1;
+  }
+  ctpu_embed_free(json);
+  printf("ok admin surfaces\n");
+
+  int port = 0;
+  if (ctpu_embed_start_http(server, &port, &error) != 0)
+    return fail("start_http", error);
+  if (port <= 0) {
+    fprintf(stderr, "FAIL: http port %d\n", port);
+    return 1;
+  }
+  printf("ok http frontend on port %d\n", port);
+
+  /* error path: unknown model must fail cleanly, not crash */
+  rc = ctpu_embed_infer(server, "no_such_model", "", (const uint8_t*)"{}", 2,
+                        -1, &response, &response_len, &response_header_len,
+                        &error);
+  if (rc == 0) {
+    fprintf(stderr, "FAIL: unknown model inference succeeded\n");
+    return 1;
+  }
+  ctpu_embed_free(error);
+  error = NULL;
+  printf("ok typed error on unknown model\n");
+
+  if (ctpu_embed_server_destroy(server, &error) != 0)
+    return fail("destroy", error);
+
+  printf("PASS embed_smoke\n");
+  return 0;
+}

@@ -41,6 +41,7 @@ def pb2(tmp_path_factory):
         subprocess.run(["protoc", "--version"], capture_output=True, check=True)
     except (OSError, subprocess.CalledProcessError):
         pytest.skip("protoc unavailable")
+    pytest.importorskip("google.protobuf")  # runtime for the generated module
     td = tmp_path_factory.mktemp("pb2")
     subprocess.run(
         ["protoc", f"-I{PROTO.parent}", f"--python_out={td}", str(PROTO)],

@@ -1,0 +1,212 @@
+"""Compute-bound chip benchmark: MXU sustained rate, Pallas flash attention,
+and the densenet model family, with MFU estimates.
+
+VERDICT-r2 #10 ("compute-bound chip benchmark … infer/sec + an MFU
+estimate"). Methodology matters on tunneled chips: per-dispatch wall-clock
+through the axon tunnel is unreliable for sub-ms ops (completion
+notifications are decoupled from device completion — a 8192^3 matmul
+"measured" 75 PFLOP/s dispatched one-at-a-time), so every measurement here
+chains N iterations INSIDE one jitted computation (`lax.fori_loop` /
+unrolled chain) and divides one dispatch's wall time by N. First compile is
+excluded by a warmup dispatch.
+
+Prints one JSON object; run on the chip via
+    python tools/chip_bench.py [--json-out PATH]
+
+Reference parity: perf_analyzer's concurrency/throughput role for the
+compute-bound regime (the reference publishes no numbers — BASELINE.md §1);
+MFU framing follows the public scaling-book convention (achieved FLOPs /
+peak FLOPs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# bf16 peak TFLOP/s per chip generation (public spec sheets); device_kind
+# strings as PJRT reports them
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5": 459.0,  # v5p
+    "TPU v6 lite": 918.0,  # v6e/Trillium
+}
+
+
+def _peak_for(kind: str):
+    for prefix, peak in sorted(PEAK_TFLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(prefix):
+            return peak
+    return None
+
+
+def _timed_single_dispatch(fn, *args, iters_inside: int, repeats: int = 5):
+    """Median wall time of one dispatch that runs ``iters_inside`` steps."""
+    fn(*args).block_until_ready()  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn(*args).block_until_ready()
+        times.append((time.perf_counter() - t0) / iters_inside)
+    return sorted(times)[len(times) // 2]
+
+
+def bench_matmul(jax, jnp, np, n=4096, chain=16):
+    """Sustained MXU rate: ``chain`` dependent n^3 bf16 matmuls, 1 dispatch."""
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((n, n), dtype=np.float32),
+                    dtype=jnp.bfloat16)
+
+    @jax.jit
+    def chained(x):
+        # pure dependent chain: each matmul needs the previous result, so
+        # nothing can be elided or reordered; XLA does not rewrite
+        # (x@a)@a -> x@(a@a). A tanh between steps (tried first) adds ~4 ms
+        # of VPU transcendental per step and corrupts the MXU number.
+        for _ in range(chain):
+            x = x @ a
+        return x
+
+    dt = _timed_single_dispatch(chained, a, iters_inside=chain)
+    tflops = 2 * n**3 / dt / 1e12
+    return {"n": n, "chain": chain, "ms_per_matmul": round(dt * 1000, 3),
+            "tflops": round(tflops, 3)}
+
+
+def bench_flash_attention(jax, jnp, np, batch=4, seq=2048, heads=8, dim=128,
+                          steps=10):
+    """Pallas flash attention under real Mosaic, chained in one dispatch."""
+    from client_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(1)
+    shape = (batch, seq, heads, dim)
+
+    def mk():
+        return jnp.asarray(rng.standard_normal(shape, dtype=np.float32),
+                           dtype=jnp.bfloat16)
+
+    q, k, v = mk(), mk(), mk()
+
+    @jax.jit
+    def chained(q, k, v):
+        def body(_, acc):
+            o = flash_attention(q, k, v)
+            # full-output reduction: a scalar slice would let XLA narrow
+            # the computation (it can't see into pallas_call, but keep the
+            # protocol uniform with bench_densenet where slicing bit)
+            return acc + jnp.sum(o.astype(jnp.float32))
+
+        return jax.lax.fori_loop(0, steps, body, jnp.float32(0))
+
+    dt = _timed_single_dispatch(chained, q, k, v, iters_inside=steps)
+    flops = 4 * batch * heads * seq * seq * dim  # QK^T + PV, 2*S*S*D each
+    return {"batch": batch, "seq": seq, "heads": heads, "dim": dim,
+            "ms_per_call": round(dt * 1000, 3),
+            "tflops": round(flops / dt / 1e12, 3)}
+
+
+def _flax_model_flops(width, stages, num_classes):
+    """Forward-pass FLOPs for models/vision.py's DenseNetish at 224x224 via
+    XLA's own cost analysis (exact for the compiled graph)."""
+    import jax
+    import jax.numpy as jnp
+
+    from client_tpu.models.vision import _build_flax_model
+
+    module = _build_flax_model(num_classes, width, stages)
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 224, 224, 3), jnp.bfloat16))
+    lowered = jax.jit(module.apply).lower(
+        params, jnp.zeros((1, 224, 224, 3), jnp.bfloat16))
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    return float(cost.get("flops", 0.0)), module, params
+
+
+def bench_densenet(jax, jnp, np, width, arch, steps=20, batch=8):
+    """On-device forward rate for the densenet family at serving batch."""
+    from client_tpu.models.vision import DenseNetModel
+
+    flops1, module, params = _flax_model_flops(
+        width, DenseNetModel.ARCHS[arch], 1000)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(
+        rng.standard_normal((batch, 224, 224, 3), dtype=np.float32),
+        dtype=jnp.bfloat16)
+
+    @jax.jit
+    def chained(params, x):
+        def body(_, carry):
+            out = module.apply(params, x)
+            # sum over the WHOLE batch: carrying out[0, 0] alone let XLA
+            # slice the conv stack to batch=1 (measured "MFU" 1.28 — the
+            # impossible number that exposed it)
+            return carry + jnp.sum(out.astype(jnp.float32))
+
+        return jax.lax.fori_loop(0, steps, body, jnp.float32(0))
+
+    dt = _timed_single_dispatch(chained, params, x, iters_inside=steps)
+    flops = flops1 * batch  # cost_analysis counted the batch=1 graph
+    return {"width": width, "arch": arch, "batch": batch,
+            "ms_per_batch": round(dt * 1000, 3),
+            "images_per_sec": round(batch / dt, 1),
+            "gflops_per_image": round(flops1 / 1e9, 2),
+            "tflops": round(flops / dt / 1e12, 2)}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json-out", default=None)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    device = jax.devices()[0]
+    peak = _peak_for(device.device_kind)
+    result = {
+        "platform": jax.default_backend(),
+        "device_kind": device.device_kind,
+        "peak_bf16_tflops": peak,
+    }
+
+    mm = bench_matmul(jax, jnp, np)
+    result["matmul_bf16"] = mm
+    fa = bench_flash_attention(jax, jnp, np)
+    result["flash_attention"] = fa
+    dn = {}
+    for width, arch, batch in ((96, "lite", 8), (256, "lite", 8), (64, "121", 8)):
+        key = f"w{width}_{arch}"
+        try:
+            dn[key] = bench_densenet(jax, jnp, np, width, arch, batch=batch)
+        except Exception as e:  # keep partial results on tunnel flakes
+            dn[key] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    result["densenet"] = dn
+
+    if peak:
+        result["mfu"] = {
+            "matmul": round(mm["tflops"] / peak, 3),
+            "flash_attention": round(fa["tflops"] / peak, 3),
+            **{
+                f"densenet_{k}": round(v["tflops"] / peak, 3)
+                for k, v in dn.items() if "tflops" in v
+            },
+        }
+
+    text = json.dumps(result, indent=1)
+    print(text)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()
