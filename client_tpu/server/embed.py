@@ -73,15 +73,16 @@ def infer(handle: int, model_name: str, model_version: str,
     ``(response_body, response_header_length)`` with header_length -1 when
     the response is pure JSON.
     """
-    from .http_server import encode_infer_response, parse_infer_request
+    from .http_server import (
+        encode_infer_response,
+        infer_request_encoding_prefs,
+        parse_infer_request,
+    )
 
     core = _entry(handle)["core"]
     request = parse_infer_request(
         bytes(body), header_length if header_length >= 0 else None)
-    requested = request.get("outputs")
-    binary_default = bool(
-        request.get("binary_default")
-        or request.get("parameters", {}).get("binary_data_output", False))
+    requested, binary_default = infer_request_encoding_prefs(request)
     responses = core.infer(model_name, model_version, request)
     out, json_size = encode_infer_response(
         responses[0], requested, binary_default)
@@ -90,17 +91,9 @@ def infer(handle: int, model_name: str, model_version: str,
 
 def metadata_json(handle: int, model_name: str = "") -> bytes:
     core = _entry(handle)["core"]
-    if model_name:
-        model = core.model(model_name)
-        doc = {
-            "name": model.name,
-            "versions": ["1"],
-            "platform": model.platform,
-            "inputs": [t.metadata() for t in model.inputs()],
-            "outputs": [t.metadata() for t in model.outputs()],
-        }
-    else:
-        doc = core.server_metadata()
+    # same documents the HTTP frontend serves (http_server.py GET routes)
+    doc = (core.model(model_name).metadata() if model_name
+           else core.server_metadata())
     return json.dumps(doc).encode()
 
 

@@ -127,6 +127,18 @@ def parse_infer_request(body: bytes, header_length: Optional[int]) -> Dict[str, 
     return request
 
 
+def infer_request_encoding_prefs(request: Dict[str, Any]):
+    """``(requested, binary_default)`` for ``encode_infer_response`` —
+    shared by the HTTP frontend and the embedding API so identical request
+    bytes always produce identically-encoded responses."""
+    requested = request.get("outputs")
+    binary_default = bool(
+        request.get("binary_default")
+        or request.get("parameters", {}).get("binary_data_output", False)
+    )
+    return requested, binary_default
+
+
 def encode_infer_response(
     response: Dict[str, Any], requested: Optional[List[Dict[str, Any]]],
     binary_default: bool,
@@ -348,11 +360,7 @@ class _Handler(BaseHTTPRequestHandler):
         request = parse_infer_request(
             body, int(header_length) if header_length is not None else None
         )
-        requested = request.get("outputs")
-        binary_default = bool(
-            request.get("binary_default")
-            or request.get("parameters", {}).get("binary_data_output", False)
-        )
+        requested, binary_default = infer_request_encoding_prefs(request)
         responses = self.core.infer(model_name, model_version, request)
         body_out, json_size = encode_infer_response(
             responses[0], requested, binary_default

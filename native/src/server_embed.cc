@@ -212,6 +212,11 @@ int ctpu_embed_infer(
     return 1;
   }
   uint8_t* out = static_cast<uint8_t*>(std::malloc(size > 0 ? size : 1));
+  if (out == nullptr) {
+    Py_DECREF(result);
+    SetError(error, "out of memory copying response");
+    return 1;
+  }
   std::memcpy(out, data, size);
   *response = out;
   *response_len = static_cast<size_t>(size);
@@ -238,9 +243,15 @@ int JsonCall(const char* fn, PyObject* args, char** json, char** error) {
     SetError(error, FetchPyError());
     return 1;
   }
-  *json = static_cast<char*>(std::malloc(size + 1));
-  std::memcpy(*json, data, size);
-  (*json)[size] = '\0';
+  char* out = static_cast<char*>(std::malloc(size + 1));
+  if (out == nullptr) {
+    Py_DECREF(result);
+    SetError(error, "out of memory copying json");
+    return 1;
+  }
+  std::memcpy(out, data, size);
+  out[size] = '\0';
+  *json = out;
   Py_DECREF(result);
   return 0;
 }

@@ -18,9 +18,15 @@ import chip_bench  # noqa: E402
 
 
 def test_matmul_bench_small():
-    out = chip_bench.bench_matmul(jax, jnp, np, n=128, chain=3)
+    out = chip_bench.bench_matmul(jax, jnp, np, n=128, chain=3, pipeline=2)
     assert out["tflops"] > 0
-    assert out["ms_per_matmul"] > 0
+    assert out["ms_per_matmul_blocked"] > 0
+    assert out["ms_per_matmul_pipelined"] > 0
+
+
+def test_dispatch_overhead_small():
+    ms = chip_bench.bench_dispatch_overhead(jax, jnp, np, repeats=3)
+    assert ms >= 0
 
 
 def test_flash_attention_bench_small():
