@@ -1,0 +1,142 @@
+"""REAL multi-process distributed tests: separate OS processes form one
+global mesh over the Gloo/TCP transport (the CPU stand-in for DCN) and the
+results are asserted against single-process math.
+
+This is the multi-host claim made executable — not a virtual-device
+simulation: each worker is its own interpreter with its own PJRT client,
+jax.distributed handshake, and cross-process collectives
+(`client_tpu/parallel/multihost.py`)."""
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1]); nprocs = int(sys.argv[2]); coord = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, {repo!r})
+from client_tpu.parallel import multihost
+
+multihost.initialize(coord, nprocs, proc_id)
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == nprocs, jax.process_count()
+assert len(jax.devices()) == 4 * nprocs
+
+mesh = multihost.global_mesh(("data", "model"))
+assert mesh.devices.shape == (nprocs, 4)
+
+# 1) cross-process psum over both axes
+@partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P())
+def allsum(v):
+    return jax.lax.psum(v, ("data", "model")) / (4.0 * nprocs)
+
+x = jnp.arange(8.0)
+np.testing.assert_allclose(np.asarray(jax.jit(allsum)(x)), np.arange(8.0),
+                           rtol=1e-6)
+
+# 2) dp-sharded global array: each process contributes its local rows, the
+#    jitted global sum must equal the full-batch sum
+assert multihost.process_local_batch(8 * nprocs) == 8
+global_shape = (8 * nprocs, 16)
+sharding = NamedSharding(mesh, P("data", None))
+local = np.arange(np.prod(global_shape), dtype=np.float32).reshape(global_shape)
+# rows shard over the data axis and REPLICATE over model: device at mesh
+# position (di, mi) holds data-group di's rows; each process device_puts
+# only its own devices' shards
+per_group = global_shape[0] // nprocs
+arrs = []
+for di in range(mesh.devices.shape[0]):
+    for mi in range(mesh.devices.shape[1]):
+        d = mesh.devices[di, mi]
+        if d.process_index == jax.process_index():
+            arrs.append(
+                jax.device_put(local[di * per_group:(di + 1) * per_group], d))
+garr = jax.make_array_from_single_device_arrays(global_shape, sharding, arrs)
+
+total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(garr)
+np.testing.assert_allclose(float(total), float(local.sum()), rtol=1e-5)
+
+# 3) data-parallel train step across processes: per-shard grads reduce
+#    over DCN (the Gloo stand-in); the updated weights must equal the
+#    single-process full-batch step on every host
+rng = np.random.default_rng(0)
+w0 = rng.standard_normal((16, 4)).astype(np.float32)
+targets = rng.standard_normal((global_shape[0], 4)).astype(np.float32)
+lr = 0.1
+
+def loss_fn(w, xb, yb):
+    return jnp.mean((xb @ w - yb) ** 2)
+
+@partial(jax.jit,
+         in_shardings=(NamedSharding(mesh, P()), sharding,
+                       NamedSharding(mesh, P("data", None))),
+         out_shardings=NamedSharding(mesh, P()))
+def train_step(w, xb, yb):
+    return w - lr * jax.grad(loss_fn)(w, xb, yb)
+
+ty = []
+for di in range(mesh.devices.shape[0]):
+    for mi in range(mesh.devices.shape[1]):
+        d = mesh.devices[di, mi]
+        if d.process_index == jax.process_index():
+            ty.append(jax.device_put(
+                targets[di * per_group:(di + 1) * per_group], d))
+gy = jax.make_array_from_single_device_arrays(
+    targets.shape, NamedSharding(mesh, P("data", None)), ty)
+w1 = train_step(jnp.asarray(w0), garr, gy)
+
+# reference: plain numpy full-batch gradient
+pred = local @ w0
+grad = 2.0 * local.T @ (pred - targets) / (global_shape[0] * 4)
+np.testing.assert_allclose(np.asarray(w1), w0 - lr * grad, rtol=2e-4)
+
+print(f"WORKER_OK {proc_id}", flush=True)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("nprocs", [2])
+def test_two_process_global_mesh(tmp_path, nprocs):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.replace("{repo!r}", repr(str(REPO))))
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {"PATH": "/usr/bin:/bin", "PYTHONPATH": ""}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(nprocs), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"WORKER_OK {i}" in out
