@@ -1,0 +1,205 @@
+"""Autoregressive decoder with a KV cache behind the v2 sequence API.
+
+The reference's sequence extension (sequence_id/start/end request
+parameters — SURVEY §2.4 sequence examples; simple_sequence is the
+accumulator fixture) exists precisely for stateful models. This is the
+real thing: a transformer decoder whose per-sequence KV cache lives in
+server-side sequence state, exercised one token per request the way an
+LLM serving loop drives it.
+
+TPU-first choices:
+- the KV cache is STATIC-SHAPE ([max_len, ...] preallocated,
+  ``lax.dynamic_update_slice`` at the current position) so the decode step
+  compiles ONCE and every token reuses the same executable — no
+  shape-polymorphic retraces;
+- the attention mask is position-based (iota <= pos) rather than
+  shape-based, so one compiled step serves every position;
+- weights and math are bf16 (MXU-native) with fp32 softmax/logits.
+
+Wire contract (stateful, one token per request after the start request):
+  inputs:  TOKENS INT32[1, -1] — full prompt when sequence_start, exactly
+           one token otherwise
+  outputs: LOGITS FP32[1, vocab] (next-token logits, fp32)
+           NEXT_TOKEN INT32[1, 1] (greedy argmax, a convenience)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .base import Model, TensorSpec
+
+
+class TinyDecoderModel(Model):
+    """``decoder_lm``: 2-layer pre-norm transformer decoder fixture."""
+
+    name = "decoder_lm"
+    platform = "jax"
+    max_batch_size = 0
+    stateful = True
+
+    VOCAB = 256
+    D_MODEL = 128
+    HEADS = 4
+    LAYERS = 2
+    MAX_LEN = 128
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self._seed = seed
+        self._lock = threading.Lock()
+        self._params = None
+        self._step_fn = None
+        self._sequences: Dict[Any, Dict[str, Any]] = {}
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("TOKENS", "INT32", [1, -1])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [
+            TensorSpec("LOGITS", "FP32", [1, self.VOCAB]),
+            TensorSpec("NEXT_TOKEN", "INT32", [1, 1]),
+        ]
+
+    # -- model ---------------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        D, H, L, V, M = (self.D_MODEL, self.HEADS, self.LAYERS, self.VOCAB,
+                         self.MAX_LEN)
+        Dh = D // H
+        rng = np.random.default_rng(self._seed)
+
+        def w(*shape, scale=None):
+            scale = scale if scale is not None else (shape[0] ** -0.5)
+            return jnp.asarray(
+                rng.standard_normal(shape).astype(np.float32) * scale,
+                dtype=jnp.bfloat16)
+
+        params = {
+            "embed": w(V, D, scale=0.02),
+            "pos": w(M, D, scale=0.02),
+            "layers": [
+                {
+                    "qkv": w(D, 3 * D),
+                    "proj": w(D, D),
+                    "mlp_in": w(D, 4 * D),
+                    "mlp_out": w(4 * D, D),
+                }
+                for _ in range(L)
+            ],
+            "unembed": w(D, V, scale=0.02),
+        }
+
+        def norm(x):
+            x32 = x.astype(jnp.float32)
+            mu = jnp.mean(x32, axis=-1, keepdims=True)
+            var = jnp.var(x32, axis=-1, keepdims=True)
+            return ((x32 - mu) * lax.rsqrt(var + 1e-5)).astype(x.dtype)
+
+        def step(params, caches, token, pos):
+            """One decode step. caches: [L] dicts of k/v [H, M, Dh]."""
+            x = params["embed"][token] + params["pos"][pos]  # [D]
+            new_caches = []
+            for layer, cache in zip(params["layers"], caches):
+                h = norm(x)
+                qkv = h @ layer["qkv"]  # [3D]
+                q, k_new, v_new = jnp.split(qkv, 3)
+                q = q.reshape(H, Dh)
+                k_new = k_new.reshape(H, 1, Dh)
+                v_new = v_new.reshape(H, 1, Dh)
+                k = lax.dynamic_update_slice(cache["k"], k_new, (0, pos, 0))
+                v = lax.dynamic_update_slice(cache["v"], v_new, (0, pos, 0))
+                new_caches.append({"k": k, "v": v})
+                # position-based mask: only slots <= pos attend
+                scores = jnp.einsum(
+                    "hd,hmd->hm", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) * (Dh ** -0.5)
+                mask = jnp.arange(M) <= pos
+                scores = jnp.where(mask[None, :], scores, -jnp.inf)
+                probs = jax.nn.softmax(scores, axis=-1)
+                attn = jnp.einsum(
+                    "hm,hmd->hd", probs, v.astype(jnp.float32))
+                x = x + (attn.reshape(D).astype(jnp.bfloat16) @ layer["proj"])
+                h2 = norm(x)
+                x = x + jax.nn.gelu(h2 @ layer["mlp_in"]) @ layer["mlp_out"]
+            logits = (norm(x) @ params["unembed"]).astype(jnp.float32)
+            return logits, new_caches
+
+        self._params = params
+        self._step_fn = jax.jit(step)
+
+    def _ensure_built(self):
+        with self._lock:
+            if self._step_fn is None:
+                self._build()
+
+    def _fresh_cache(self):
+        import jax.numpy as jnp
+
+        Dh = self.D_MODEL // self.HEADS
+        return [
+            {
+                "k": jnp.zeros((self.HEADS, self.MAX_LEN, Dh), jnp.bfloat16),
+                "v": jnp.zeros((self.HEADS, self.MAX_LEN, Dh), jnp.bfloat16),
+            }
+            for _ in range(self.LAYERS)
+        ]
+
+    # -- serving -------------------------------------------------------------
+    def execute(self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]):
+        self._ensure_built()
+        seq_id = parameters.get("sequence_id", 0)
+        start = parameters.get("sequence_start", False)
+        end = parameters.get("sequence_end", False)
+        if not seq_id:
+            raise ValueError("decoder_lm requires a sequence_id")
+
+        tokens = np.asarray(inputs["TOKENS"]).reshape(-1).astype(np.int64)
+        if np.any(tokens < 0) or np.any(tokens >= self.VOCAB):
+            raise ValueError(f"tokens out of range [0, {self.VOCAB})")
+
+        with self._lock:
+            if start:
+                state = {"caches": self._fresh_cache(), "pos": 0}
+            else:
+                state = self._sequences.get(seq_id)
+                if state is None:
+                    raise ValueError(
+                        f"sequence {seq_id} has no live state "
+                        "(missing sequence_start?)")
+                if len(tokens) != 1:
+                    raise ValueError(
+                        "continuation requests carry exactly one token")
+            if state["pos"] + len(tokens) > self.MAX_LEN:
+                raise ValueError(
+                    f"sequence longer than max_len {self.MAX_LEN}")
+
+        # the compiled step runs one token at a time — same executable for
+        # prefill and decode (static shapes; cache carries the history)
+        caches, pos = state["caches"], state["pos"]
+        logits = None
+        for t in tokens:
+            logits, caches = self._step_fn(self._params, caches, int(t), pos)
+            pos += 1
+
+        with self._lock:
+            if end:
+                self._sequences.pop(seq_id, None)
+            else:
+                self._sequences[seq_id] = {"caches": caches, "pos": pos}
+
+        logits_np = np.asarray(logits, dtype=np.float32).reshape(1, self.VOCAB)
+        return {
+            "LOGITS": logits_np,
+            "NEXT_TOKEN": np.array([[int(logits_np.argmax())]], dtype=np.int32),
+        }
+
+    def live_sequences(self) -> int:
+        with self._lock:
+            return len(self._sequences)

@@ -206,6 +206,8 @@ class RepeatModel(Model):
 
 def default_model_zoo() -> List[Model]:
     """The fixture set every test/example expects to find on the server."""
+    from .decoder import TinyDecoderModel
+
     return [
         AddSubModel(),
         StringAddSubModel(),
@@ -217,4 +219,5 @@ def default_model_zoo() -> List[Model]:
         IdentityModel("identity_int8", "INT8"),
         SequenceAccumulatorModel(),
         RepeatModel(),
+        TinyDecoderModel(),
     ]

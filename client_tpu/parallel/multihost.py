@@ -101,26 +101,31 @@ def hybrid_mesh(
     boundaries don't exist.
     """
     import jax
-    from jax.experimental import mesh_utils
     from jax.sharding import Mesh
 
     shape = tuple(dcn_axes) + tuple(ici_axes)
     if len(shape) != len(axis_names):
         raise ValueError(f"{len(shape)} axis sizes vs {len(axis_names)} names")
-    try:
+    devices = jax.devices()
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh {shape} needs {int(np.prod(shape))} devices, "
+            f"have {len(devices)}")
+    if jax.default_backend() == "tpu":
+        from jax.experimental import mesh_utils
+
+        # Each named axis is PURELY dcn or PURELY ici: pad both per-axis
+        # factor tuples with 1s so create_hybrid_device_mesh's elementwise
+        # products land each size on its own axis — no reshape afterwards
+        # (a reshape from the combined grid interleaves dcn/ici granules
+        # across named axes and silently routes model collectives to DCN).
         grid = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=tuple(ici_axes),
-            dcn_mesh_shape=tuple(dcn_axes) + (1,) * (len(ici_axes) - len(dcn_axes))
-            if len(dcn_axes) < len(ici_axes) else tuple(dcn_axes),
+            mesh_shape=(1,) * len(dcn_axes) + tuple(ici_axes),
+            dcn_mesh_shape=tuple(dcn_axes) + (1,) * len(ici_axes),
         )
-        grid = grid.reshape(shape)
-    except Exception:
-        # CPU / single-slice: topology-blind reshape is the only layout
-        devices = jax.devices()
-        if int(np.prod(shape)) != len(devices):
-            raise ValueError(
-                f"mesh {shape} needs {int(np.prod(shape))} devices, "
-                f"have {len(devices)}")
+    else:
+        # CPU / GPU: no slice topology exists; document-order reshape is
+        # the only meaningful layout (process-major, like jax.devices())
         grid = np.array(devices).reshape(shape)
     return Mesh(grid, axis_names)
 

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""LLM-style autoregressive decode loop over the bidi stream.
+
+Drives the ``decoder_lm`` model (KV cache in server-side sequence state)
+exactly how an LLM serving client works: send the prompt with
+sequence_start, then feed each greedy NEXT_TOKEN back one request at a
+time on the same sequence_id, and close with sequence_end.
+
+This is the workload the reference's sequence extension exists for —
+simple_grpc_sequence_stream_infer_client.py demonstrates the protocol
+with an accumulator; this demonstrates it with a real transformer decode.
+"""
+
+import argparse
+import queue
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("--prompt", default="42,17,99",
+                        help="comma-separated token ids (< 256)")
+    parser.add_argument("-n", "--new-tokens", type=int, default=16)
+    args = parser.parse_args()
+
+    prompt = [int(t) % 256 for t in args.prompt.split(",")]
+    results = queue.Queue()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.start_stream(callback=lambda r, e: results.put((r, e)))
+
+        inp = grpcclient.InferInput("TOKENS", [1, len(prompt)], "INT32")
+        inp.set_data_from_numpy(np.asarray(prompt, np.int32).reshape(1, -1))
+        client.async_stream_infer(
+            "decoder_lm", [inp], sequence_id=1, sequence_start=True)
+
+        generated = []
+        for i in range(args.new_tokens):
+            result, error = results.get(timeout=60)
+            if error is not None:
+                raise SystemExit(f"stream error at step {i}: {error}")
+            token = int(result.as_numpy("NEXT_TOKEN")[0, 0])
+            generated.append(token)
+            last = i == args.new_tokens - 1
+            inp = grpcclient.InferInput("TOKENS", [1, 1], "INT32")
+            inp.set_data_from_numpy(np.array([[token]], np.int32))
+            client.async_stream_infer(
+                "decoder_lm", [inp], sequence_id=1, sequence_end=last)
+        results.get(timeout=60)  # the sequence_end response
+        client.stop_stream()
+
+    print(f"prompt:    {prompt}")
+    print(f"generated: {generated}")
+    print("PASS" if len(generated) == args.new_tokens else "FAIL")
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,7 @@ GRPC_EXAMPLES = [
     "simple_grpc_health_metadata.py",
     "simple_grpc_model_control.py",
     "grpc_raw_wire_client.py",
+    "grpc_decoder_stream_client.py",
 ]
 
 
