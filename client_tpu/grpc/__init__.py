@@ -11,7 +11,23 @@ from ..utils import InferenceServerException
 from ._client import CallContext, InferenceServerClient, KeepAliveOptions
 from ._infer import InferResult
 
+
+def proto_path() -> str:
+    """Filesystem path of the vendored ``grpc_service.proto``.
+
+    Ships as package data so a pip install can generate stubs in any
+    language: ``protoc -I $(dirname path) --go_out=... grpc_service.proto``
+    (reference analog: the vendored proto tree the generated-stub examples
+    build against). Generated from the wire specs by ``tools/gen_proto.py``
+    and drift-gated in CI."""
+    import os
+
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "grpc_service.proto")
+
+
 __all__ = [
+    "proto_path",
     "BasicAuth",
     "CallContext",
     "InferInput",

@@ -1,0 +1,67 @@
+"""Batchable matmul model — the dynamic-batcher's showcase fixture.
+
+``batched_matmul``: X FP32[-1, 64] @ W[64, 16] -> Y FP32[-1, 16], with
+``max_batch_size`` declared so the core's DynamicBatcher coalesces
+concurrent [1, 64] requests into one [k, 64] execution. On the MXU a
+[32, 64]x[64, 16] costs barely more than [1, 64]x[64, 16] — the entire
+point of batching — and the jitted matmul compiles once per distinct k
+(bounded by max_batch_size).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .base import Model, TensorSpec
+
+
+class BatchedMatMulModel(Model):
+    name = "batched_matmul"
+    platform = "jax"
+    max_batch_size = 32
+
+    IN_DIM = 64
+    OUT_DIM = 16
+
+    def __init__(self, seed: int = 0, delay_s: float = 0.0):
+        """``delay_s`` simulates per-EXECUTION cost (not per-row): tests use
+        it to make coalescing observable in wall time."""
+        super().__init__()
+        self._delay_s = delay_s
+        self._lock = threading.Lock()
+        self._w = None
+        self._fn = None
+        rng = np.random.default_rng(seed)
+        self._w_np = rng.standard_normal(
+            (self.IN_DIM, self.OUT_DIM)).astype(np.float32)
+        self.executed_batches: List[int] = []  # instrumentation for tests
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("X", "FP32", [-1, self.IN_DIM])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [TensorSpec("Y", "FP32", [-1, self.OUT_DIM])]
+
+    def _ensure_built(self):
+        with self._lock:
+            if self._fn is None:
+                import jax
+                import jax.numpy as jnp
+
+                self._w = jnp.asarray(self._w_np)
+                self._fn = jax.jit(lambda x, w: x @ w)
+
+    def execute(self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]):
+        self._ensure_built()
+        import time
+
+        x = np.asarray(inputs["X"], dtype=np.float32)
+        with self._lock:
+            self.executed_batches.append(int(x.shape[0]))
+        if self._delay_s:
+            time.sleep(self._delay_s)
+        y = np.asarray(self._fn(x, self._w))
+        return {"Y": y}

@@ -54,6 +54,10 @@ class TinyDecoderModel(Model):
         self._params = None
         self._step_fn = None
         self._sequences: Dict[Any, Dict[str, Any]] = {}
+        # per-sequence serialization: concurrent requests on one sequence_id
+        # must not interleave read-compute-write (lost KV updates otherwise;
+        # the reference's sequence batcher serializes per CORRID the same way)
+        self._seq_locks: Dict[Any, threading.Lock] = {}
 
     def inputs(self) -> List[TensorSpec]:
         return [TensorSpec("TOKENS", "INT32", [1, -1])]
@@ -165,34 +169,44 @@ class TinyDecoderModel(Model):
             raise ValueError(f"tokens out of range [0, {self.VOCAB})")
 
         with self._lock:
-            if start:
-                state = {"caches": self._fresh_cache(), "pos": 0}
-            else:
-                state = self._sequences.get(seq_id)
-                if state is None:
-                    raise ValueError(
-                        f"sequence {seq_id} has no live state "
-                        "(missing sequence_start?)")
-                if len(tokens) != 1:
-                    raise ValueError(
-                        "continuation requests carry exactly one token")
-            if state["pos"] + len(tokens) > self.MAX_LEN:
-                raise ValueError(
-                    f"sequence longer than max_len {self.MAX_LEN}")
+            seq_lock = self._seq_locks.setdefault(seq_id, threading.Lock())
 
-        # the compiled step runs one token at a time — same executable for
-        # prefill and decode (static shapes; cache carries the history)
-        caches, pos = state["caches"], state["pos"]
-        logits = None
-        for t in tokens:
-            logits, caches = self._step_fn(self._params, caches, int(t), pos)
-            pos += 1
+        # the whole read-compute-write is serialized PER SEQUENCE (other
+        # sequences decode concurrently); without this, two requests on one
+        # sequence_id both read pos=P and the later writer silently drops
+        # the earlier token's KV update
+        with seq_lock:
+            with self._lock:
+                if start:
+                    state = {"caches": self._fresh_cache(), "pos": 0}
+                else:
+                    state = self._sequences.get(seq_id)
+                    if state is None:
+                        raise ValueError(
+                            f"sequence {seq_id} has no live state "
+                            "(missing sequence_start?)")
+                    if len(tokens) != 1:
+                        raise ValueError(
+                            "continuation requests carry exactly one token")
+                if state["pos"] + len(tokens) > self.MAX_LEN:
+                    raise ValueError(
+                        f"sequence longer than max_len {self.MAX_LEN}")
 
-        with self._lock:
-            if end:
-                self._sequences.pop(seq_id, None)
-            else:
-                self._sequences[seq_id] = {"caches": caches, "pos": pos}
+            # the compiled step runs one token at a time — same executable
+            # for prefill and decode (static shapes; cache carries history)
+            caches, pos = state["caches"], state["pos"]
+            logits = None
+            for t in tokens:
+                logits, caches = self._step_fn(
+                    self._params, caches, int(t), pos)
+                pos += 1
+
+            with self._lock:
+                if end:
+                    self._sequences.pop(seq_id, None)
+                    self._seq_locks.pop(seq_id, None)
+                else:
+                    self._sequences[seq_id] = {"caches": caches, "pos": pos}
 
         logits_np = np.asarray(logits, dtype=np.float32).reshape(1, self.VOCAB)
         return {

@@ -206,9 +206,11 @@ class RepeatModel(Model):
 
 def default_model_zoo() -> List[Model]:
     """The fixture set every test/example expects to find on the server."""
+    from .batched import BatchedMatMulModel
     from .decoder import TinyDecoderModel
 
     return [
+        BatchedMatMulModel(),
         AddSubModel(),
         StringAddSubModel(),
         IdentityModel("simple_identity", "BYTES"),

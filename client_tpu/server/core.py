@@ -12,6 +12,7 @@ import base64
 import json
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -171,20 +172,44 @@ class _ModelStats:
         self.success = [0, 0]  # count, ns
         self.fail = [0, 0]
         self.compute_infer = [0, 0]
+        self.queue = [0, 0]
+        self.batches: Dict[int, List[int]] = {}  # batch_size -> [count, ns]
 
-    def record(self, ok: bool, total_ns: int, infer_ns: int, batch: int) -> None:
+    def record(self, ok: bool, total_ns: int, infer_ns: int, batch: int,
+               executed: bool = True) -> None:
+        """``executed=False`` for dynamically-batched requests: the model
+        execution is counted once by record_batch, not once per request
+        (reference semantics: execution_count < inference_count under
+        batching)."""
         with self.lock:
             if ok:
                 self.inference_count += batch
-                self.execution_count += 1
+                if executed:
+                    self.execution_count += 1
+                    self.compute_infer[0] += 1
+                    self.compute_infer[1] += infer_ns
                 self.last_inference = int(time.time() * 1000)
                 self.success[0] += 1
                 self.success[1] += total_ns
-                self.compute_infer[0] += 1
-                self.compute_infer[1] += infer_ns
             else:
                 self.fail[0] += 1
                 self.fail[1] += total_ns
+
+    def record_batch(self, batch_size: int, exec_ns: int, queue_ns: int,
+                     n_requests: int) -> None:
+        """One dynamic-batcher execution (InferBatchStatistics feed).
+
+        ``queue`` counts per REQUEST (Triton semantics — the average must
+        be a request's wait, not the batch's summed waits)."""
+        with self.lock:
+            row = self.batches.setdefault(batch_size, [0, 0])
+            row[0] += 1
+            row[1] += exec_ns
+            self.queue[0] += n_requests
+            self.queue[1] += queue_ns
+            self.execution_count += 1
+            self.compute_infer[0] += 1
+            self.compute_infer[1] += exec_ns
 
     def as_dict(self, name: str, version: str) -> Dict[str, Any]:
         with self.lock:
@@ -197,7 +222,7 @@ class _ModelStats:
                 "inference_stats": {
                     "success": {"count": self.success[0], "ns": self.success[1]},
                     "fail": {"count": self.fail[0], "ns": self.fail[1]},
-                    "queue": {"count": 0, "ns": 0},
+                    "queue": {"count": self.queue[0], "ns": self.queue[1]},
                     "compute_input": {"count": 0, "ns": 0},
                     "compute_infer": {
                         "count": self.compute_infer[0],
@@ -205,7 +230,13 @@ class _ModelStats:
                     },
                     "compute_output": {"count": 0, "ns": 0},
                 },
-                "batch_stats": [],
+                "batch_stats": [
+                    {
+                        "batch_size": size,
+                        "compute_infer": {"count": row[0], "ns": row[1]},
+                    }
+                    for size, row in sorted(self.batches.items())
+                ],
             }
 
 
@@ -226,6 +257,8 @@ class ServerCore:
         self._models: Dict[str, Model] = {}
         self._stats: Dict[str, _ModelStats] = {}
         self._regions: Dict[str, _Region] = {}
+        self._batchers: Dict[str, Any] = {}  # model name -> (max_batch, DynamicBatcher)
+        self.batch_timeout_s = 60.0  # future wait for one batched request
         self.trace_settings: Dict[str, Any] = {
             "trace_level": ["OFF"],
             "trace_rate": "1000",
@@ -503,8 +536,24 @@ class ServerCore:
             inputs = self._resolve_inputs(model, request)
             params = request.get("parameters", {})
             t_infer = time.perf_counter_ns()
+            batched = False
             if model.decoupled:
                 raw_responses = list(model.execute_decoupled(inputs, params))
+            elif self._batchable(model, params):
+                batched = True
+                try:
+                    raw_responses = [
+                        self._batcher_for(model).submit(inputs, params).result(
+                            timeout=self.batch_timeout_s)
+                    ]
+                except FuturesTimeoutError:
+                    raise InferError(
+                        f"batched inference timed out after "
+                        f"{self.batch_timeout_s:.0f}s (the execution may "
+                        f"still complete server-side; raise "
+                        f"core.batch_timeout_s for cold-compile workloads)",
+                        504,
+                    )
             else:
                 raw_responses = [model.execute(inputs, params)]
             infer_ns = time.perf_counter_ns() - t_infer
@@ -536,8 +585,42 @@ class ServerCore:
         if responses and model.effective_max_batch_size():
             first = next(iter(raw_responses[0].values()))
             batch = int(first.shape[0]) if first.ndim else 1
-        self._stats[model_name].record(True, time.perf_counter_ns() - t0, infer_ns, batch)
+        self._stats[model_name].record(
+            True, time.perf_counter_ns() - t0, infer_ns, batch,
+            executed=not batched)
         return responses
+
+    # -- dynamic batching ---------------------------------------------------
+    def _batchable(self, model: Model, params: Dict[str, Any]) -> bool:
+        """Coalescing is for stateless, non-sequence, non-decoupled models
+        that declared batch capacity; sequence requests must never merge."""
+        return (
+            model.effective_max_batch_size() > 1
+            and not model.decoupled
+            and not getattr(model, "stateful", False)
+            and not params.get("sequence_id")
+        )
+
+    def _batcher_for(self, model: Model):
+        from .batcher import DynamicBatcher
+
+        max_batch = model.effective_max_batch_size()
+        stale = None
+        with self._lock:
+            entry = self._batchers.get(model.name)
+            if entry is not None and entry[0] == max_batch:
+                return entry[1]
+            stale = entry[1] if entry is not None else None
+            stats = self._stats[model.name]
+            batcher = DynamicBatcher(
+                model.execute, max_batch, report=stats.record_batch)
+            self._batchers[model.name] = (max_batch, batcher)
+        if stale is not None:
+            # max_batch_size changed via load override; close OUTSIDE the
+            # core lock — close() joins the worker (seconds under load) and
+            # every server operation takes this lock
+            stale.close()
+        return batcher
 
     def _resolve_inputs(self, model: Model, request: Dict[str, Any]) -> Dict[str, np.ndarray]:
         specs = {s.name: s for s in model.inputs()}

@@ -1,0 +1,207 @@
+"""Dynamic batcher: coalescing, correctness-per-caller, stats, isolation.
+
+The load-bearing asserts: every caller gets exactly its own rows back from
+a coalesced execution, incompatible shapes never merge, one request's
+failure reaches every caller in its batch, and the protocol surfaces real
+``InferBatchStatistics`` rows (batch sizes > 1) when concurrency exists."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu.models.batched import BatchedMatMulModel
+from client_tpu.server import HttpInferenceServer, ServerCore
+from client_tpu.server.batcher import DynamicBatcher
+
+
+# ---------------------------------------------------------------------------
+# unit tier: the batcher alone
+# ---------------------------------------------------------------------------
+
+def test_coalesces_and_scatters_rows():
+    seen = []
+
+    def execute(inputs, params):
+        seen.append(int(inputs["X"].shape[0]))
+        return {"Y": inputs["X"] * 2.0}
+
+    b = DynamicBatcher(execute, max_batch=8, max_delay_s=0.05)
+    try:
+        futures = [
+            b.submit({"X": np.full((1, 4), float(i))}, {}) for i in range(6)
+        ]
+        for i, f in enumerate(futures):
+            out = f.result(timeout=10)["Y"]
+            np.testing.assert_array_equal(out, np.full((1, 4), 2.0 * i))
+    finally:
+        b.close()
+    assert max(seen) > 1, f"never coalesced: {seen}"
+    assert sum(seen) == 6
+
+
+def test_incompatible_shapes_form_separate_groups():
+    shapes_seen = []
+
+    def execute(inputs, params):
+        shapes_seen.append(inputs["X"].shape)
+        return {"Y": inputs["X"]}
+
+    b = DynamicBatcher(execute, max_batch=8, max_delay_s=0.05)
+    try:
+        f1 = b.submit({"X": np.zeros((1, 4))}, {})
+        f2 = b.submit({"X": np.zeros((1, 5))}, {})  # different trailing dim
+        assert f1.result(timeout=10)["Y"].shape == (1, 4)
+        assert f2.result(timeout=10)["Y"].shape == (1, 5)
+    finally:
+        b.close()
+    assert (1, 4) in shapes_seen and (1, 5) in shapes_seen
+
+
+def test_execution_error_reaches_every_caller():
+    def execute(inputs, params):
+        raise RuntimeError("boom")
+
+    b = DynamicBatcher(execute, max_batch=4, max_delay_s=0.05)
+    try:
+        futures = [b.submit({"X": np.zeros((1, 2))}, {}) for _ in range(3)]
+        for f in futures:
+            with pytest.raises(RuntimeError, match="boom"):
+                f.result(timeout=10)
+    finally:
+        b.close()
+
+
+def test_multirow_requests_count_toward_the_batch_cap():
+    seen = []
+
+    def execute(inputs, params):
+        seen.append(int(inputs["X"].shape[0]))
+        return {"Y": inputs["X"]}
+
+    b = DynamicBatcher(execute, max_batch=4, max_delay_s=0.2)
+    try:
+        f1 = b.submit({"X": np.arange(12.0).reshape(3, 4)}, {})
+        f2 = b.submit({"X": np.arange(4.0).reshape(1, 4) + 100}, {})
+        out1 = f1.result(timeout=10)["Y"]
+        out2 = f2.result(timeout=10)["Y"]
+        np.testing.assert_array_equal(out1, np.arange(12.0).reshape(3, 4))
+        np.testing.assert_array_equal(out2, np.arange(4.0).reshape(1, 4) + 100)
+    finally:
+        b.close()
+    # 3 rows + 1 row hit the cap of 4 in one execution (or two if timing split)
+    assert sum(seen) == 4
+
+
+def test_cap_overflow_carries_to_next_window():
+    """A request that would push past max_batch starts the NEXT window —
+    the declared max_batch_size is a contract, never exceeded."""
+    seen = []
+
+    def execute(inputs, params):
+        seen.append(int(inputs["X"].shape[0]))
+        return {"Y": inputs["X"]}
+
+    b = DynamicBatcher(execute, max_batch=4, max_delay_s=0.2)
+    try:
+        f1 = b.submit({"X": np.zeros((3, 4))}, {})
+        f2 = b.submit({"X": np.ones((2, 4))}, {})
+        assert f1.result(timeout=10)["Y"].shape == (3, 4)
+        assert f2.result(timeout=10)["Y"].shape == (2, 4)
+    finally:
+        b.close()
+    assert seen == [3, 2], seen  # two executions; 5 rows never merged
+
+
+def test_differing_parameters_never_merge():
+    """execute() may honor any parameter, so requests only coalesce with
+    identical parameter dicts."""
+    param_sets = []
+
+    def execute(inputs, params):
+        param_sets.append((int(inputs["X"].shape[0]), dict(params)))
+        return {"Y": inputs["X"] * params.get("scale", 1.0)}
+
+    b = DynamicBatcher(execute, max_batch=8, max_delay_s=0.1)
+    try:
+        f1 = b.submit({"X": np.ones((1, 4))}, {"scale": 2.0})
+        f2 = b.submit({"X": np.ones((1, 4))}, {"scale": 10.0})
+        np.testing.assert_array_equal(
+            f1.result(timeout=10)["Y"], np.full((1, 4), 2.0))
+        np.testing.assert_array_equal(
+            f2.result(timeout=10)["Y"], np.full((1, 4), 10.0))
+    finally:
+        b.close()
+    assert all(rows == 1 for rows, _ in param_sets), param_sets
+
+
+# ---------------------------------------------------------------------------
+# e2e tier: through the server + HTTP client under real concurrency
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served_model():
+    model = BatchedMatMulModel(delay_s=0.005)
+    core = ServerCore([model])
+    with HttpInferenceServer(core) as server:
+        yield model, core, server
+
+
+def test_concurrent_requests_batch_and_stay_correct(served_model):
+    model, core, server = served_model
+    n_threads = 12
+    per_thread = 5
+    errors = []
+
+    def worker(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            with httpclient.InferenceServerClient(server.url) as client:
+                for _ in range(per_thread):
+                    x = rng.standard_normal((1, model.IN_DIM)).astype(np.float32)
+                    inp = httpclient.InferInput("X", [1, model.IN_DIM], "FP32")
+                    inp.set_data_from_numpy(x)
+                    r = client.infer("batched_matmul", [inp])
+                    got = r.as_numpy("Y")
+                    np.testing.assert_allclose(
+                        got, x @ model._w_np, rtol=1e-5, atol=1e-5)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"thread {tid}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
+
+    # coalescing actually happened under concurrency
+    assert max(model.executed_batches) > 1, model.executed_batches
+    total_rows = n_threads * per_thread
+    assert sum(model.executed_batches) == total_rows
+    assert len(model.executed_batches) < total_rows, "never coalesced"
+
+    # and the protocol reports it: InferBatchStatistics rows with size > 1
+    stats = core.statistics("batched_matmul")["model_stats"][0]
+    sizes = {row["batch_size"] for row in stats["batch_stats"]}
+    assert any(s > 1 for s in sizes), stats["batch_stats"]
+    assert stats["inference_count"] == total_rows
+    assert stats["execution_count"] == len(model.executed_batches)
+    assert stats["inference_stats"]["queue"]["count"] >= 1
+
+
+def test_sequence_params_bypass_the_batcher():
+    """A request carrying sequence_id must never merge with others."""
+    model = BatchedMatMulModel()
+    core = ServerCore([model])
+    x = np.ones((1, model.IN_DIM), dtype=np.float32)
+    req = {
+        "id": "", "parameters": {"sequence_id": 9, "sequence_start": True},
+        "inputs": [{"name": "X", "datatype": "FP32",
+                    "shape": [1, model.IN_DIM], "array": x}],
+    }
+    core.infer("batched_matmul", "", req)
+    # direct execution path: exactly one executed batch of exactly 1 row
+    assert model.executed_batches == [1]

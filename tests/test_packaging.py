@@ -111,6 +111,9 @@ def test_wheel_builds_and_contains_all_namespaces(built_wheel):
         "tritongrpcclient/__init__.py",
         "tritonclientutils/__init__.py",
         "tritonshmutils/shared_memory.py",
+        # the vendored protocol artifact rides as package data so pip
+        # installs can generate stubs (client_tpu.grpc.proto_path())
+        "client_tpu/grpc/grpc_service.proto",
     ):
         assert pkg in names, f"{pkg} missing from wheel"
 
@@ -133,6 +136,8 @@ def test_wheel_imports_outside_the_checkout(built_wheel, tmp_path):
         "import tritonhttpclient, tritongrpcclient, tritonclientutils\n"
         "import tritonshmutils.shared_memory\n"
         f"assert client_tpu.__file__.startswith({str(site)!r}), client_tpu.__file__\n"
+        "import os\n"
+        "assert os.path.exists(client_tpu.grpc.proto_path()), 'packaged proto missing'\n"
         "print('WHEEL_OK')\n"
     )
     proc = subprocess.run(

@@ -35,6 +35,15 @@ def test_proto_matches_specs():
     )
 
 
+def test_packaged_proto_copy_in_sync():
+    """The wheel-shipped copy (client_tpu.grpc.proto_path()) must match."""
+    import client_tpu.grpc as grpcclient
+
+    packaged = Path(grpcclient.proto_path())
+    assert packaged.exists(), "run: python tools/gen_proto.py"
+    assert packaged.read_text() == PROTO.read_text()
+
+
 @pytest.fixture(scope="module")
 def pb2(tmp_path_factory):
     try:
