@@ -1,0 +1,361 @@
+"""LLM streaming perf harness: TTFT / inter-token latency / token throughput.
+
+The reference ecosystem measures LLM serving with genai-perf (the
+perf_analyzer companion that moved out-of-repo with it —
+/root/reference/src/c++/perf_analyzer/genai-perf/README.md): time to first
+token, inter-token latency, output token throughput, request throughput,
+per session-concurrency level. This is that tool for the tpu-native stack,
+built on the framework's own streaming GRPC client.
+
+Two serving styles, matching the two LLM fixtures:
+
+- ``decoupled`` (default, model ``tiny_lm_generate``): one request carries
+  the prompt + MAX_TOKENS and the server streams one response per
+  generated token — the Triton TensorRT-LLM/vLLM backend shape. TTFT is
+  send→first streamed response (prefill + first decode step + wire); each
+  subsequent gap is one inter-token latency.
+- ``sequence`` (model ``decoder_lm``): the client drives decoding one
+  token per request over the stateful sequence API (sequence_id +
+  start/end), feeding each NEXT_TOKEN back. Same metrics; the ITL now
+  includes a full client round trip per token — measuring exactly what
+  client-side decoding costs vs server-side generation.
+
+Usage:
+    python -m client_tpu.genai_perf -u 127.0.0.1:8001 \
+        --concurrency-range 1:4 --sessions 20 \
+        --prompt-tokens 32 --output-tokens 32
+
+Prints one JSON list (``-f json``) or a table; exit 1 if any level
+produced zero completed sessions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _percentile(sorted_values: List[float], q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, max(0, int(round(q * (len(sorted_values) - 1)))))
+    return sorted_values[idx]
+
+
+def _summary(values: List[float]) -> Dict[str, float]:
+    vs = sorted(values)
+    return {
+        "avg": round(sum(vs) / len(vs), 3) if vs else 0.0,
+        "p50": round(_percentile(vs, 0.50), 3),
+        "p90": round(_percentile(vs, 0.90), 3),
+        "p99": round(_percentile(vs, 0.99), 3),
+    }
+
+
+class _Session:
+    """Per-session measurement record (all times perf_counter seconds)."""
+
+    __slots__ = ("start", "first", "last", "tokens", "error")
+
+    def __init__(self):
+        self.start = 0.0
+        self.first: Optional[float] = None
+        self.last = 0.0
+        self.tokens = 0
+        self.error: Optional[str] = None
+
+
+class GenAiPerfRunner:
+    """Drives N concurrent generation sessions and aggregates LLM metrics."""
+
+    def __init__(self, url: str, model_name: str, mode: str,
+                 prompt_tokens: int, output_tokens: int, chunk: int = 1,
+                 vocab: int = 256, seed: int = 0):
+        if mode not in ("decoupled", "sequence"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if output_tokens < 1:
+            raise ValueError("output_tokens must be >= 1")
+        if prompt_tokens < 1:
+            raise ValueError("prompt_tokens must be >= 1")
+        self.url = url
+        self.model_name = model_name
+        self.mode = mode
+        self.prompt_tokens = prompt_tokens
+        self.output_tokens = output_tokens
+        self.chunk = chunk
+        self.vocab = vocab
+        self.seed = seed
+
+    # -- one session ---------------------------------------------------------
+    def _prompt(self, rng: np.random.Generator) -> np.ndarray:
+        return rng.integers(
+            0, self.vocab, size=(1, self.prompt_tokens), dtype=np.int32)
+
+    def _run_decoupled_session(self, client, InferInput, sess: _Session,
+                               responses: "queue.Queue",
+                               rng: np.random.Generator) -> None:
+        """One request → stream of per-token responses until the final
+        marker (triton_enable_empty_final_response semantics)."""
+        tokens_in = InferInput("TOKENS", [1, self.prompt_tokens], "INT32")
+        tokens_in.set_data_from_numpy(self._prompt(rng))
+        max_in = InferInput("MAX_TOKENS", [1], "INT32")
+        max_in.set_data_from_numpy(
+            np.array([self.output_tokens], dtype=np.int32))
+        params = {"chunk": self.chunk} if self.chunk != 1 else None
+
+        sess.start = time.perf_counter()
+        client.async_stream_infer(
+            self.model_name, [tokens_in, max_in],
+            enable_empty_final_response=True,
+            parameters=params,
+        )
+        while True:
+            result, error = responses.get(timeout=120.0)
+            now = time.perf_counter()
+            if error is not None:
+                sess.error = str(error) or "stream error"
+                return
+            if result.is_final_response() and result.is_null_response():
+                sess.last = sess.last or now
+                return
+            if sess.first is None:
+                sess.first = now
+            sess.last = now
+            sess.tokens += 1
+
+    def _run_sequence_session(self, client, InferInput, sess: _Session,
+                              responses: "queue.Queue", sequence_id: int,
+                              rng: np.random.Generator) -> None:
+        """Client-driven decode loop over the stateful sequence API.
+
+        Always closes the sequence: the server keeps per-sequence KV caches
+        until a sequence_end arrives (decoder.py state map), so an aborted
+        session must still send end=True or every error leaks a cache."""
+        ended = False
+
+        def send(tokens: np.ndarray, start: bool, end: bool):
+            nonlocal ended
+            inp = InferInput("TOKENS", list(tokens.shape), "INT32")
+            inp.set_data_from_numpy(tokens)
+            client.async_stream_infer(
+                self.model_name, [inp], sequence_id=sequence_id,
+                sequence_start=start, sequence_end=end)
+            ended = ended or end
+
+        def recv() -> Optional[int]:
+            result, error = responses.get(timeout=120.0)
+            if error is not None:
+                sess.error = str(error) or "stream error"
+                return None
+            return int(result.as_numpy("NEXT_TOKEN").reshape(-1)[0])
+
+        try:
+            sess.start = time.perf_counter()
+            send(self._prompt(rng), start=True, end=self.output_tokens == 1)
+            nxt = recv()
+            if nxt is None:
+                return
+            now = time.perf_counter()
+            sess.first = sess.last = now
+            sess.tokens = 1
+            while sess.tokens < self.output_tokens:
+                last = sess.tokens + 1 >= self.output_tokens
+                send(np.array([[nxt]], dtype=np.int32), start=False, end=last)
+                nxt = recv()
+                if nxt is None:
+                    return
+                sess.last = time.perf_counter()
+                sess.tokens += 1
+        finally:
+            if not ended:
+                # best-effort server-side state cleanup; whatever response
+                # or error this produces lands in a queue the worker
+                # discards (error paths rebuild the stream + queue)
+                try:
+                    send(np.array([[0]], dtype=np.int32), start=False, end=True)
+                except Exception:
+                    pass
+
+    # -- one concurrency level ----------------------------------------------
+    def run(self, concurrency: int, sessions: int) -> Dict[str, Any]:
+        from .grpc import InferenceServerClient, InferInput
+
+        done: List[_Session] = []
+        done_lock = threading.Lock()
+        counter = {"n": 0}
+        seq_counter = {"n": int(time.time()) % 100000 * 1000}
+        barrier = threading.Barrier(concurrency + 1)
+
+        def worker(worker_id: int):
+            # numpy Generators are not thread-safe: one independent
+            # stream per worker (seeded deterministically per id)
+            rng = np.random.default_rng((self.seed, worker_id))
+            # the callback reads the queue through this holder so a stream
+            # rebuild can swap in a fresh queue atomically
+            holder = {"q": queue.Queue()}
+            client = None
+            setup_error: Optional[str] = None
+            try:
+                client = InferenceServerClient(self.url)
+                client.start_stream(
+                    lambda result, error: holder["q"].put((result, error)))
+            except Exception as e:
+                # keep the thread alive through barrier.wait() — dying here
+                # would strand run() on the barrier forever
+                setup_error = f"worker setup failed: {e}"
+            try:
+                barrier.wait()
+                while True:
+                    with done_lock:
+                        if counter["n"] >= sessions:
+                            return
+                        counter["n"] += 1
+                        seq_counter["n"] += 1
+                        seq_id = seq_counter["n"]
+                    sess = _Session()
+                    if setup_error is not None:
+                        sess.error = setup_error
+                    else:
+                        try:
+                            if self.mode == "decoupled":
+                                self._run_decoupled_session(
+                                    client, InferInput, sess, holder["q"],
+                                    rng)
+                            else:
+                                self._run_sequence_session(
+                                    client, InferInput, sess, holder["q"],
+                                    seq_id, rng)
+                        except Exception as e:  # survive one bad session
+                            sess.error = str(e) or type(e).__name__
+                        if sess.error is not None:
+                            # the broken session's late responses may still
+                            # be in flight: cancel the stream, then swap in
+                            # a fresh queue so the next session can't
+                            # consume another session's tokens
+                            try:
+                                client.stop_stream(cancel_requests=True)
+                            except Exception:
+                                pass
+                            holder["q"] = queue.Queue()
+                            try:
+                                client.start_stream(
+                                    lambda result, error:
+                                    holder["q"].put((result, error)))
+                            except Exception as e:
+                                setup_error = f"stream restart failed: {e}"
+                    with done_lock:
+                        done.append(sess)
+            finally:
+                if client is not None:
+                    try:
+                        client.stop_stream()
+                        client.close()
+                    except Exception:
+                        pass
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(concurrency)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+        ok = [s for s in done if s.error is None and s.first is not None]
+        errors = [s for s in done if s.error is not None]
+        ttft_ms = [(s.first - s.start) * 1e3 for s in ok]
+        e2e_ms = [(s.last - s.start) * 1e3 for s in ok]
+        itl_ms: List[float] = []
+        for s in ok:
+            if s.tokens > 1:
+                itl_ms.append((s.last - s.first) * 1e3 / (s.tokens - 1))
+        total_tokens = sum(s.tokens for s in ok)
+        return {
+            "mode": self.mode,
+            "model": self.model_name,
+            "concurrency": concurrency,
+            "sessions": len(ok),
+            "errors": len(errors),
+            "error_sample": errors[0].error if errors else None,
+            "prompt_tokens": self.prompt_tokens,
+            "output_tokens": self.output_tokens,
+            "chunk": self.chunk,
+            "wall_s": round(wall, 3),
+            "ttft_ms": _summary(ttft_ms),
+            "inter_token_ms": _summary(itl_ms),
+            "e2e_ms": _summary(e2e_ms),
+            "output_tokens_per_sec": round(total_tokens / wall, 1) if wall else 0.0,
+            "requests_per_sec": round(len(ok) / wall, 2) if wall else 0.0,
+        }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="client_tpu.genai_perf",
+        description="LLM streaming perf: TTFT / inter-token latency / token throughput",
+    )
+    parser.add_argument("-u", "--url", default="127.0.0.1:8001",
+                        help="GRPC endpoint (streaming requires grpc)")
+    parser.add_argument("-m", "--model-name", default=None,
+                        help="default: tiny_lm_generate (decoupled) / decoder_lm (sequence)")
+    parser.add_argument("--mode", choices=("decoupled", "sequence"),
+                        default="decoupled")
+    parser.add_argument("--concurrency-range", default="1",
+                        help="start[:end[:step]] concurrent sessions")
+    parser.add_argument("--sessions", type=int, default=20,
+                        help="measured sessions per concurrency level")
+    parser.add_argument("--prompt-tokens", type=int, default=32)
+    parser.add_argument("--output-tokens", type=int, default=32)
+    parser.add_argument("--chunk", type=int, default=1,
+                        help="tokens per device dispatch (decoupled mode)")
+    parser.add_argument("--warmup-sessions", type=int, default=2)
+    parser.add_argument("-f", "--format", choices=("table", "json"),
+                        default="table")
+    args = parser.parse_args(argv)
+
+    model = args.model_name or (
+        "tiny_lm_generate" if args.mode == "decoupled" else "decoder_lm")
+    parts = [int(x) for x in args.concurrency_range.split(":")]
+    start = parts[0]
+    end = parts[1] if len(parts) > 1 else start
+    step = parts[2] if len(parts) > 2 else 1
+
+    runner = GenAiPerfRunner(
+        args.url, model, args.mode, args.prompt_tokens, args.output_tokens,
+        chunk=args.chunk)
+    if args.warmup_sessions:
+        runner.run(1, args.warmup_sessions)
+
+    results = []
+    for concurrency in range(start, end + 1, step):
+        results.append(runner.run(concurrency, args.sessions))
+
+    if args.format == "json":
+        print(json.dumps(results))
+    else:
+        print(f"model={model} mode={args.mode} prompt={args.prompt_tokens} "
+              f"max_tokens={args.output_tokens} chunk={args.chunk}")
+        print(f"{'conc':>5} {'sess':>5} {'ttft p50':>9} {'ttft p99':>9} "
+              f"{'itl p50':>8} {'itl p99':>8} {'tok/s':>8} {'req/s':>7} {'err':>4}")
+        for r in results:
+            print(f"{r['concurrency']:>5} {r['sessions']:>5} "
+                  f"{r['ttft_ms']['p50']:>9} {r['ttft_ms']['p99']:>9} "
+                  f"{r['inter_token_ms']['p50']:>8} {r['inter_token_ms']['p99']:>8} "
+                  f"{r['output_tokens_per_sec']:>8} {r['requests_per_sec']:>7} "
+                  f"{r['errors']:>4}")
+    return 1 if any(not r["sessions"] for r in results) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

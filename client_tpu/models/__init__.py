@@ -10,6 +10,7 @@ TPU VM: the same wire contracts, but the compute runs on XLA.
 
 from .base import Model, TensorSpec
 from .ensemble import EnsembleModel, EnsembleStep, build_image_ensemble
+from .generate import TinyGenerateModel
 from .simple import (
     AddSubModel,
     IdentityModel,
@@ -29,6 +30,7 @@ __all__ = [
     "SequenceAccumulatorModel",
     "StringAddSubModel",
     "TensorSpec",
+    "TinyGenerateModel",
     "build_image_ensemble",
     "default_model_zoo",
 ]

@@ -1,0 +1,206 @@
+"""Decoupled autoregressive generation — the LLM serving pattern.
+
+The reference's decoupled transaction policy (repeat_int32 fixture,
+SURVEY §2.4 "decoupled/repeat models"; model_transaction_policy in
+grpc_service.proto) exists so one request can stream many responses.
+Production LLM serving on Triton (the TensorRT-LLM / vLLM backends) is
+exactly this shape: the client sends one request carrying the prompt and
+``max_tokens`` and receives one streamed response per generated token.
+``tiny_lm_generate`` is that contract implemented tpu-first, sharing
+weights with the stateful ``decoder_lm`` fixture so greedy generation is
+bit-exact across both serving styles (the cross-check the tests pin).
+
+TPU-first choices:
+- one compiled decode step (static-shape KV cache, position-based mask —
+  see decoder.py) serves prefill AND every generated token: no
+  shape-polymorphic retraces, ever;
+- multi-token decoding runs INSIDE XLA via ``lax.scan`` when the request
+  sets the ``chunk`` parameter > 1: the greedy argmax→feed-back loop is a
+  scan carry, so K tokens cost one device dispatch instead of K (the
+  dispatch-bound regime on a tunneled chip is exactly where this wins);
+  chunk=1 (the default) dispatches per token, which is what a
+  streaming-latency harness should measure;
+- greedy argmax happens on-device in int32 — the host only ever sees the
+  emitted token ids, one int per token.
+
+Wire contract (decoupled — use streaming inference):
+  inputs:  TOKENS     INT32[1, -1]  prompt token ids
+           MAX_TOKENS INT32[1]      max tokens to generate (optional,
+                                    default 16, clamped to cache room)
+           END_ID     INT32[1]      stop token id (optional; generation
+                                    stops AFTER emitting it)
+  outputs: NEXT_TOKEN INT32[1, 1]   one generated token per response
+           INDEX      INT32[1, 1]   0-based position of that token
+  request parameters: "chunk": int — tokens per device dispatch (default 1)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List
+
+import numpy as np
+
+from .base import Model, TensorSpec
+from .decoder import TinyDecoderModel
+
+
+class TinyGenerateModel(Model):
+    """``tiny_lm_generate``: decoupled streaming generation over the
+    decoder_lm transformer (same seed → identical weights)."""
+
+    name = "tiny_lm_generate"
+    platform = "jax"
+    max_batch_size = 0
+    decoupled = True
+
+    DEFAULT_MAX_TOKENS = 16
+
+    def __init__(self, seed: int = 0, decoder: TinyDecoderModel = None):
+        super().__init__()
+        # weight/step sharing by composition: generation must agree with the
+        # sequence-API decoder token-for-token. Pass the zoo's decoder_lm
+        # instance to share its weights and compiled step (params/step are
+        # read-only at serving time; only per-request cache state is local)
+        self._decoder = decoder if decoder is not None else TinyDecoderModel(seed=seed)
+        self._lock = threading.Lock()
+        self._chunk_fns: Dict[int, Any] = {}  # scan length K -> jitted fn
+
+    def inputs(self) -> List[TensorSpec]:
+        return [
+            TensorSpec("TOKENS", "INT32", [1, -1]),
+            TensorSpec("MAX_TOKENS", "INT32", [1], optional=True),
+            TensorSpec("END_ID", "INT32", [1], optional=True),
+        ]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [
+            TensorSpec("NEXT_TOKEN", "INT32", [1, 1]),
+            TensorSpec("INDEX", "INT32", [1, 1]),
+        ]
+
+    # -- compiled pieces -----------------------------------------------------
+    def _ensure_built(self):
+        self._decoder._ensure_built()
+
+    def _chunk_fn(self, k: int):
+        """Jitted K-token greedy decode: the argmax→feed-back loop as a
+        ``lax.scan`` carry, one device dispatch for K tokens."""
+        with self._lock:
+            fn = self._chunk_fns.get(k)
+            if fn is not None:
+                return fn
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        step = self._decoder._step_fn
+
+        def decode_k(params, caches, token, pos):
+            # int32 up front: the scan carry pytree must keep identical
+            # dtypes across iterations (weak-typed host ints would not)
+            token = jnp.asarray(token, jnp.int32)
+            pos = jnp.asarray(pos, jnp.int32)
+
+            def body(carry, _):
+                caches, token, pos = carry
+                logits, caches = step(params, caches, token, pos)
+                nxt = jnp.argmax(logits).astype(jnp.int32)
+                return (caches, nxt, pos + jnp.int32(1)), nxt
+
+            (caches, _, _), toks = lax.scan(
+                body, (caches, token, pos), None, length=k)
+            return toks, caches
+
+        fn = jax.jit(decode_k)
+        with self._lock:
+            self._chunk_fns.setdefault(k, fn)
+        return self._chunk_fns[k]
+
+    # -- serving -------------------------------------------------------------
+    def execute(self, inputs, parameters):
+        raise ValueError(
+            "tiny_lm_generate is a decoupled model; use streaming inference")
+
+    def execute_decoupled(
+        self, inputs: Dict[str, np.ndarray], parameters: Dict[str, Any]
+    ) -> Iterable[Dict[str, np.ndarray]]:
+        self._ensure_built()
+        dec = self._decoder
+        max_len = dec.MAX_LEN
+
+        tokens = np.asarray(inputs["TOKENS"]).reshape(-1).astype(np.int64)
+        if tokens.size == 0:
+            raise ValueError("empty prompt")
+        if np.any(tokens < 0) or np.any(tokens >= dec.VOCAB):
+            raise ValueError(f"tokens out of range [0, {dec.VOCAB})")
+        if tokens.size >= max_len:
+            raise ValueError(f"prompt longer than max_len {max_len}")
+
+        max_tokens = int(
+            np.asarray(inputs.get("MAX_TOKENS", self.DEFAULT_MAX_TOKENS))
+            .reshape(-1)[0])
+        if max_tokens < 1:
+            raise ValueError("MAX_TOKENS must be >= 1")
+        end_id = None
+        if "END_ID" in inputs:
+            end_id = int(np.asarray(inputs["END_ID"]).reshape(-1)[0])
+        chunk = int(parameters.get("chunk", 1))
+        if chunk < 1:
+            raise ValueError("chunk parameter must be >= 1")
+
+        # room left in the static cache bounds generation length
+        budget = min(max_tokens, max_len - int(tokens.size))
+
+        # prefill: the single compiled step over the prompt (same executable
+        # the decode loop uses — nothing new compiles per prompt length)
+        caches, pos = dec._fresh_cache(), 0
+        logits = None
+        for t in tokens:
+            logits, caches = dec._step_fn(dec._params, caches, int(t), pos)
+            pos += 1
+
+        def response(token_id: int, index: int):
+            return {
+                "NEXT_TOKEN": np.array([[token_id]], dtype=np.int32),
+                "INDEX": np.array([[index]], dtype=np.int32),
+            }
+
+        emitted = 0
+        next_token = int(np.asarray(logits).argmax())
+        if chunk == 1:
+            # per-token dispatch: one streamed response per device step —
+            # honest TTFT/inter-token latency for a perf harness
+            while emitted < budget:
+                yield response(next_token, emitted)
+                emitted += 1
+                if emitted >= budget or (end_id is not None
+                                         and next_token == end_id):
+                    return
+                logits, caches = dec._step_fn(
+                    dec._params, caches, next_token, pos)
+                pos += 1
+                next_token = int(np.asarray(logits).argmax())
+            return
+
+        # chunked: first token came from prefill; subsequent tokens arrive
+        # K at a time from one scan dispatch and stream out burst-wise
+        yield response(next_token, emitted)
+        emitted += 1
+        if end_id is not None and next_token == end_id:
+            return
+        while emitted < budget:
+            k = min(chunk, budget - emitted, max_len - pos)
+            if k <= 0:
+                return
+            toks, caches = self._chunk_fn(k)(
+                dec._params, caches, next_token, pos)
+            pos += k
+            toks = np.asarray(toks).reshape(-1)
+            for t in toks:
+                yield response(int(t), emitted)
+                emitted += 1
+                if end_id is not None and int(t) == end_id:
+                    return
+            next_token = int(toks[-1])

@@ -208,7 +208,9 @@ def default_model_zoo() -> List[Model]:
     """The fixture set every test/example expects to find on the server."""
     from .batched import BatchedMatMulModel
     from .decoder import TinyDecoderModel
+    from .generate import TinyGenerateModel
 
+    decoder = TinyDecoderModel()
     return [
         BatchedMatMulModel(),
         AddSubModel(),
@@ -221,5 +223,6 @@ def default_model_zoo() -> List[Model]:
         IdentityModel("identity_int8", "INT8"),
         SequenceAccumulatorModel(),
         RepeatModel(),
-        TinyDecoderModel(),
+        decoder,
+        TinyGenerateModel(decoder=decoder),
     ]

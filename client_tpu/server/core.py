@@ -532,14 +532,18 @@ class ServerCore:
             raise InferError(
                 f"model '{model_name}' is a decoupled model: use streaming inference", 400
             )
+        if model.decoupled:
+            # delegate to the incremental generator (it owns stats/tracing
+            # for the decoupled path); materializing here keeps infer()'s
+            # list-of-responses contract
+            return list(self._decoupled_stream(
+                model, model_name, model_version, request, t0))
         try:
             inputs = self._resolve_inputs(model, request)
             params = request.get("parameters", {})
             t_infer = time.perf_counter_ns()
             batched = False
-            if model.decoupled:
-                raw_responses = list(model.execute_decoupled(inputs, params))
-            elif self._batchable(model, params):
+            if self._batchable(model, params):
                 batched = True
                 try:
                     raw_responses = [
@@ -569,18 +573,7 @@ class ServerCore:
             responses.append(
                 self._build_response(model, model_version, request, raw)
             )
-        if self._trace_enabled():
-            end_ns = time.perf_counter_ns()
-            self._record_trace(
-                model_name,
-                request.get("id", ""),
-                {
-                    "request_start_ns": t0,
-                    "compute_start_ns": t_infer,
-                    "compute_end_ns": t_infer + infer_ns,
-                    "request_end_ns": end_ns,
-                },
-            )
+        self._trace_request(model_name, request, t0, t_infer, infer_ns)
         batch = 1
         if responses and model.effective_max_batch_size():
             first = next(iter(raw_responses[0].values()))
@@ -589,6 +582,91 @@ class ServerCore:
             True, time.perf_counter_ns() - t0, infer_ns, batch,
             executed=not batched)
         return responses
+
+    def infer_stream(self, model_name: str, model_version: str,
+                     request: Dict[str, Any]):
+        """Incremental inference: a generator yielding response dicts AS the
+        model produces them. For decoupled models every yield reaches the
+        caller before the next response is computed — a streaming frontend
+        that forwards each yield gives true time-to-first-token (the
+        reference's decoupled transaction policy streams the same way:
+        TRITONBACKEND_ResponseSend per response, not a batch at the end).
+        Non-decoupled models yield their single infer() response."""
+        model = self.model(model_name, model_version)
+        if not model.decoupled:
+            yield from self.infer(model_name, model_version, request)
+            return
+        if not model.ready:
+            raise InferError(
+                f"Request for unknown model: '{model_name}' is not ready", 400)
+        yield from self._decoupled_stream(
+            model, model_name, model_version, request, time.perf_counter_ns())
+
+    def _decoupled_stream(self, model: Model, model_name: str,
+                          model_version: str, request: Dict[str, Any],
+                          t0: int):
+        """Drive ``execute_decoupled`` lazily, building + yielding each
+        response as it is produced. Owns stats and trace recording for the
+        whole decoupled request (exactly-once, whether it completes, fails
+        mid-stream, or the consumer abandons the generator)."""
+        recorded = False
+
+        def record(ok: bool, infer_ns: int):
+            nonlocal recorded
+            if recorded:
+                return
+            recorded = True
+            # inference_count counts the REQUEST once, regardless of how
+            # many responses streamed (reference decoupled semantics:
+            # response count != request count)
+            self._stats[model_name].record(
+                ok, time.perf_counter_ns() - t0, infer_ns, 1 if ok else 0)
+
+        try:
+            inputs = self._resolve_inputs(model, request)
+            params = request.get("parameters", {})
+        except InferError:
+            record(False, 0)
+            raise
+        except Exception as e:
+            record(False, 0)
+            raise InferError(f"inference failed: {e}", 400)
+
+        t_infer = time.perf_counter_ns()
+        gen = model.execute_decoupled(inputs, params)
+        try:
+            for raw in gen:
+                yield self._build_response(model, model_version, request, raw)
+        except GeneratorExit:
+            # consumer went away mid-stream (client cancel/disconnect):
+            # count what ran, close the model generator via the raise
+            record(True, time.perf_counter_ns() - t_infer)
+            raise
+        except InferError:
+            record(False, 0)
+            raise
+        except Exception as e:
+            record(False, 0)
+            raise InferError(f"inference failed: {e}", 400)
+        infer_ns = time.perf_counter_ns() - t_infer
+        record(True, infer_ns)
+        self._trace_request(model_name, request, t0, t_infer, infer_ns)
+
+    def _trace_request(self, model_name: str, request: Dict[str, Any],
+                       t0: int, t_infer: int, infer_ns: int) -> None:
+        """Shared per-request trace capture (sync infer + decoupled stream)."""
+        if not self._trace_enabled():
+            return
+        self._record_trace(
+            model_name,
+            request.get("id", ""),
+            {
+                "request_start_ns": t0,
+                "compute_start_ns": t_infer,
+                "compute_end_ns": t_infer + infer_ns,
+                "request_end_ns": time.perf_counter_ns(),
+            },
+        )
 
     # -- dynamic batching ---------------------------------------------------
     def _batchable(self, model: Model, params: Dict[str, Any]) -> bool:

@@ -264,11 +264,12 @@ class _Handlers(grpc.GenericRpcHandler):
                     core_req["parameters"].get("triton_enable_empty_final_response")
                 )
                 model = self._core.model(model_name, request.get("model_version", ""))
-                responses = self._core.infer(
-                    model_name, request.get("model_version", ""), core_req,
-                    decoupled_ok=True,
-                )
-                for resp in responses:
+                # incremental: each decoupled response hits the wire as the
+                # model yields it (true streaming TTFT), not after the full
+                # generation materializes
+                for resp in self._core.infer_stream(
+                    model_name, request.get("model_version", ""), core_req
+                ):
                     final = (want_final and not model.decoupled) or None
                     yield {"infer_response": _encode_core_response(resp, final=final)}
                 if want_final and model.decoupled:
