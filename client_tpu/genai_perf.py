@@ -41,12 +41,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-
-def _percentile(sorted_values: List[float], q: float) -> float:
-    if not sorted_values:
-        return 0.0
-    idx = min(len(sorted_values) - 1, max(0, int(round(q * (len(sorted_values) - 1)))))
-    return sorted_values[idx]
+from .perf import _percentile
 
 
 def _summary(values: List[float]) -> Dict[str, float]:

@@ -200,110 +200,126 @@ class PerfRunner:
         return outputs or None, cleanup
 
     # -- one worker --------------------------------------------------------
-    def _worker(self, client, barrier, stop, latencies, errors, counter, worker_id):
+    def _worker_setup(self, client, worker_id):
+        """Per-worker client/tensor/shm setup shared by the closed-loop
+        (concurrency) and open-loop (request-rate) workers.
+
+        Returns (client, inputs, outputs, shm_cleanup, own_client)."""
         from .utils import serialized_byte_size
 
         mod = self._client_mod
         shm_ctx = None
         own_client = None
-        setup_failed = False
-        try:
-            if self.protocol == "native-grpc-async":
-                # ONE client shared by every worker: the async worker keeps
-                # all their RPCs in flight on a single multiplexed h2
-                # connection (completion-queue model) — this mode measures
-                # exactly what per-worker instances cannot: one instance's
-                # concurrent throughput
-                inputs = [(name, data) for name, _, _, data in self._tensors]
-                outputs = None
-            elif self.protocol in ("native", "native-grpc"):
-                # one C++ client per worker: the native sync Infer serializes
-                # on a per-client transport handle, so sharing one client
-                # would measure lock contention instead of concurrency
-                own_client = self._make_client()
-                client = own_client
+        if self.protocol == "native-grpc-async":
+            # ONE client shared by every worker: the async worker keeps
+            # all their RPCs in flight on a single multiplexed h2
+            # connection (completion-queue model) — this mode measures
+            # exactly what per-worker instances cannot: one instance's
+            # concurrent throughput
+            inputs = [(name, data) for name, _, _, data in self._tensors]
+            outputs = None
+        elif self.protocol in ("native", "native-grpc"):
+            # one C++ client per worker: the native sync Infer serializes
+            # on a per-client transport handle, so sharing one client
+            # would measure lock contention instead of concurrency
+            own_client = self._make_client()
+            client = own_client
+            try:
                 inputs, outputs, shm_ctx = self._native_worker_setup(
                     client, worker_id
                 )
-            elif self.shared_memory == "system":
-                import client_tpu.utils.shared_memory as shm
+            except Exception:
+                # the caller never receives own_client on failure — close
+                # here or the native socket/handle leaks per failed worker
+                own_client.close()
+                raise
+        elif self.shared_memory == "system":
+            import client_tpu.utils.shared_memory as shm
 
-                regions = []
-                inputs = []
-                for name, datatype, shape, data in self._tensors:
-                    nbytes = serialized_byte_size(data) if datatype == "BYTES" else data.nbytes
-                    rname = f"perf_{worker_id}_{name}"
-                    region = shm.create_shared_memory_region(rname, f"/{rname}", nbytes)
-                    shm.set_shared_memory_region(region, [data])
-                    client.register_system_shared_memory(rname, f"/{rname}", nbytes)
-                    inp = mod.InferInput(name, shape, datatype)
-                    inp.set_shared_memory(rname, nbytes)
-                    regions.append((rname, region))
-                    inputs.append(inp)
+            regions = []
+            inputs = []
+            for name, datatype, shape, data in self._tensors:
+                nbytes = serialized_byte_size(data) if datatype == "BYTES" else data.nbytes
+                rname = f"perf_{worker_id}_{name}"
+                region = shm.create_shared_memory_region(rname, f"/{rname}", nbytes)
+                shm.set_shared_memory_region(region, [data])
+                client.register_system_shared_memory(rname, f"/{rname}", nbytes)
+                inp = mod.InferInput(name, shape, datatype)
+                inp.set_shared_memory(rname, nbytes)
+                regions.append((rname, region))
+                inputs.append(inp)
 
-                outputs, out_cleanup = self._make_shm_outputs(client, worker_id, "system")
+            outputs, out_cleanup = self._make_shm_outputs(client, worker_id, "system")
 
-                def cleanup():
-                    for rname, region in regions:
-                        try:
-                            client.unregister_system_shared_memory(rname)
-                        except Exception:
-                            pass
-                        shm.destroy_shared_memory_region(region)
-                    out_cleanup()
+            def cleanup():
+                for rname, region in regions:
+                    try:
+                        client.unregister_system_shared_memory(rname)
+                    except Exception:
+                        pass
+                    shm.destroy_shared_memory_region(region)
+                out_cleanup()
 
-                shm_ctx = cleanup
-            elif self.shared_memory == "tpu":
-                import jax
+            shm_ctx = cleanup
+        elif self.shared_memory == "tpu":
+            import jax
 
-                import client_tpu.utils.tpu_shared_memory as tpushm
+            import client_tpu.utils.tpu_shared_memory as tpushm
 
-                regions = []
-                inputs = []
-                for name, datatype, shape, data in self._tensors:
-                    if datatype == "BYTES":
-                        nbytes = serialized_byte_size(data)
-                        region = tpushm.create_shared_memory_region(
-                            f"perf_{worker_id}_{name}", nbytes
-                        )
-                        tpushm.set_shared_memory_region(region, [data])
-                    else:
-                        nbytes = data.nbytes
-                        region = tpushm.create_shared_memory_region(
-                            f"perf_{worker_id}_{name}", nbytes, colocated=True
-                        )
-                        dev = jax.device_put(data)
-                        dev.block_until_ready()
-                        tpushm.set_shared_memory_region_from_jax(region, dev)
-                    rname = region.name
-                    client.register_tpu_shared_memory(
-                        rname, tpushm.get_raw_handle(region), 0, nbytes
+            regions = []
+            inputs = []
+            for name, datatype, shape, data in self._tensors:
+                if datatype == "BYTES":
+                    nbytes = serialized_byte_size(data)
+                    region = tpushm.create_shared_memory_region(
+                        f"perf_{worker_id}_{name}", nbytes
                     )
-                    inp = mod.InferInput(name, shape, datatype)
-                    inp.set_shared_memory(rname, nbytes)
-                    regions.append((rname, region))
-                    inputs.append(inp)
+                    tpushm.set_shared_memory_region(region, [data])
+                else:
+                    nbytes = data.nbytes
+                    region = tpushm.create_shared_memory_region(
+                        f"perf_{worker_id}_{name}", nbytes, colocated=True
+                    )
+                    dev = jax.device_put(data)
+                    dev.block_until_ready()
+                    tpushm.set_shared_memory_region_from_jax(region, dev)
+                rname = region.name
+                client.register_tpu_shared_memory(
+                    rname, tpushm.get_raw_handle(region), 0, nbytes
+                )
+                inp = mod.InferInput(name, shape, datatype)
+                inp.set_shared_memory(rname, nbytes)
+                regions.append((rname, region))
+                inputs.append(inp)
 
-                outputs, out_cleanup = self._make_shm_outputs(client, worker_id, "tpu")
+            outputs, out_cleanup = self._make_shm_outputs(client, worker_id, "tpu")
 
-                def cleanup():
-                    for rname, region in regions:
-                        try:
-                            client.unregister_tpu_shared_memory(rname)
-                        except Exception:
-                            pass
-                        tpushm.destroy_shared_memory_region(region)
-                    out_cleanup()
+            def cleanup():
+                for rname, region in regions:
+                    try:
+                        client.unregister_tpu_shared_memory(rname)
+                    except Exception:
+                        pass
+                    tpushm.destroy_shared_memory_region(region)
+                out_cleanup()
 
-                shm_ctx = cleanup
-            else:
-                outputs = None
-                inputs = []
-                for name, datatype, shape, data in self._tensors:
-                    inp = mod.InferInput(name, shape, datatype)
-                    inp.set_data_from_numpy(data)
-                    inputs.append(inp)
+            shm_ctx = cleanup
+        else:
+            outputs = None
+            inputs = []
+            for name, datatype, shape, data in self._tensors:
+                inp = mod.InferInput(name, shape, datatype)
+                inp.set_data_from_numpy(data)
+                inputs.append(inp)
+        return client, inputs, outputs, shm_ctx, own_client
 
+    def _worker(self, client, barrier, stop, latencies, errors, counter, worker_id):
+        shm_ctx = None
+        own_client = None
+        setup_failed = False
+        try:
+            client, inputs, outputs, shm_ctx, own_client = self._worker_setup(
+                client, worker_id)
         except Exception as e:
             errors.append(f"worker setup failed: {e}")
             setup_failed = True
@@ -326,6 +342,52 @@ class PerfRunner:
                     count[0] += 1
                     if count[0] >= limit:
                         stop.set()
+        finally:
+            if shm_ctx is not None:
+                shm_ctx()
+            if own_client is not None:
+                own_client.close()
+
+    def _rate_worker(self, client, barrier, stop, schedule, cursor, t0_box,
+                     records, errors, worker_id):
+        """Open-loop worker: claims the next arrival slot from the shared
+        schedule, sleeps until its wall-clock time, then issues one sync
+        infer. Lateness (actual start - scheduled start) is recorded per
+        request — under saturation the pool can't keep up and the lag
+        distribution, not just latency, shows it (perf_analyzer's delayed
+        request semantics for --request-rate-range)."""
+        shm_ctx = None
+        own_client = None
+        setup_failed = False
+        try:
+            client, inputs, outputs, shm_ctx, own_client = self._worker_setup(
+                client, worker_id)
+        except Exception as e:
+            errors.append(f"worker setup failed: {e}")
+            setup_failed = True
+        try:
+            barrier.wait(timeout=120)
+            if setup_failed:
+                stop.set()
+                return
+            lock, idx = cursor
+            while not stop.is_set():
+                with lock:
+                    i = idx[0]
+                    if i >= len(schedule):
+                        return
+                    idx[0] += 1
+                target = t0_box[0] + schedule[i]
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                lag = max(0.0, time.perf_counter() - target)
+                t1 = time.perf_counter()
+                try:
+                    self._infer_once(client, inputs, outputs)
+                    records.append((time.perf_counter() - t1, lag))
+                except Exception as e:  # measured as failure, loop continues
+                    errors.append(str(e))
         finally:
             if shm_ctx is not None:
                 shm_ctx()
@@ -457,6 +519,84 @@ class PerfRunner:
             },
         }
 
+    def run_rate(self, rate: float, measurement_requests: int,
+                 distribution: str = "constant",
+                 pool_size: int = 16) -> Dict[str, Any]:
+        """Open-loop measurement at a fixed arrival rate (perf_analyzer's
+        --request-rate-range). Arrivals follow the schedule regardless of
+        completions, so queueing shows up as schedule lag + latency growth
+        instead of the closed-loop's self-throttling."""
+        if rate <= 0:
+            raise ValueError("rate must be > 0")
+        if distribution == "constant":
+            gaps = np.full(measurement_requests, 1.0 / rate)
+        elif distribution == "poisson":
+            gaps = self.rng.exponential(1.0 / rate, size=measurement_requests)
+        else:
+            raise ValueError(f"unknown distribution {distribution!r}")
+        schedule = np.concatenate([[0.0], np.cumsum(gaps[:-1])]).tolist()
+
+        client = self._make_client(pool_size)
+        if self.protocol == "native-grpc-async":
+            client.set_async_concurrency(pool_size)
+        records: List[Tuple[float, float]] = []  # (latency_s, lag_s)
+        errors: List[str] = []
+        stop = threading.Event()
+        barrier = threading.Barrier(pool_size + 1)
+        cursor = (threading.Lock(), [0])
+        t0_box = [0.0]
+        workers = [
+            threading.Thread(
+                target=self._rate_worker,
+                args=(client, barrier, stop, schedule, cursor, t0_box,
+                      records, errors, i),
+                daemon=True,
+            )
+            for i in range(pool_size)
+        ]
+        for w in workers:
+            w.start()
+        # t0 must be written BEFORE the barrier releases the workers — they
+        # read it immediately to place the schedule on the wall clock
+        t0_box[0] = time.perf_counter()
+        barrier.wait()
+        for w in workers:
+            w.join(timeout=600)
+        elapsed = time.perf_counter() - t0_box[0]
+        client.close()
+
+        lat_sorted = sorted(r[0] for r in records)
+        lag_sorted = sorted(r[1] for r in records)
+        n = len(lat_sorted)
+        # a request is "delayed" when the pool could not start it on time
+        # (reference threshold: perf_analyzer flags schedule slip; 1 ms
+        # separates scheduler jitter from genuine queueing)
+        delayed = sum(1 for lag in lag_sorted if lag > 1e-3)
+        return {
+            "model": self.model_name,
+            "protocol": self.protocol,
+            "shared_memory": self.shared_memory,
+            "request_rate": rate,
+            "distribution": distribution,
+            "pool_size": pool_size,
+            "requests": n,
+            "errors": len(errors),
+            "error_sample": errors[0] if errors else None,
+            "duration_s": round(elapsed, 3),
+            "achieved_rate": round(n / elapsed, 1) if elapsed > 0 else 0.0,
+            "latency_ms": {
+                "avg": round(1000 * sum(lat_sorted) / n, 3) if n else 0.0,
+                "p50": round(1000 * _percentile(lat_sorted, 0.50), 3),
+                "p90": round(1000 * _percentile(lat_sorted, 0.90), 3),
+                "p99": round(1000 * _percentile(lat_sorted, 0.99), 3),
+            },
+            "schedule_lag_ms": {
+                "p50": round(1000 * _percentile(lag_sorted, 0.50), 3),
+                "p99": round(1000 * _percentile(lag_sorted, 0.99), 3),
+            },
+            "delayed_pct": round(100.0 * delayed / n, 1) if n else 0.0,
+        }
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
@@ -476,6 +616,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--concurrency-range", default="1",
         help="start[:end[:step]] concurrency sweep (e.g. 1:8:2)",
+    )
+    parser.add_argument(
+        "--request-rate-range", default=None,
+        help="start[:end[:step]] open-loop arrival rate sweep in req/s "
+             "(overrides --concurrency-range; perf_analyzer semantics)",
+    )
+    parser.add_argument(
+        "--request-distribution", choices=("constant", "poisson"),
+        default="constant",
+        help="arrival process for --request-rate-range",
+    )
+    parser.add_argument(
+        "--rate-pool-size", type=int, default=16,
+        help="worker pool servicing the open-loop schedule",
     )
     parser.add_argument("--measurement-requests", type=int, default=200)
     parser.add_argument("-b", "--batch-size", type=int, default=0)
@@ -504,11 +658,40 @@ def main(argv: Optional[List[str]] = None) -> int:
         runner.run(1, args.warmup_requests)
 
     results = []
-    for concurrency in range(start, end + 1, step):
-        results.append(runner.run(concurrency, args.measurement_requests))
+    if args.request_rate_range is not None:
+        rparts = [float(x) for x in args.request_rate_range.split(":")]
+        rstart = rparts[0]
+        rend = rparts[1] if len(rparts) > 1 else rstart
+        rstep = rparts[2] if len(rparts) > 2 else 1.0
+        rate = rstart
+        while rate <= rend + 1e-9:
+            results.append(runner.run_rate(
+                rate, args.measurement_requests,
+                distribution=args.request_distribution,
+                pool_size=args.rate_pool_size))
+            rate += rstep
+    else:
+        for concurrency in range(start, end + 1, step):
+            results.append(runner.run(concurrency, args.measurement_requests))
 
     if args.format == "json":
         print(json.dumps(results))
+    elif args.request_rate_range is not None:
+        print(
+            f"model={args.model_name} protocol={args.protocol} "
+            f"shared_memory={args.shared_memory} "
+            f"distribution={args.request_distribution}"
+        )
+        print(f"{'rate':>7} {'ach':>7} {'p50 ms':>8} {'p90 ms':>8} {'p99 ms':>8} "
+              f"{'lag p99':>8} {'late%':>6} {'err':>4}")
+        for r in results:
+            lm = r["latency_ms"]
+            print(
+                f"{r['request_rate']:>7} {r['achieved_rate']:>7} {lm['p50']:>8} "
+                f"{lm['p90']:>8} {lm['p99']:>8} "
+                f"{r['schedule_lag_ms']['p99']:>8} {r['delayed_pct']:>6} "
+                f"{r['errors']:>4}"
+            )
     else:
         print(
             f"model={args.model_name} protocol={args.protocol} "

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""LLM-style streaming generation: one request, one response per token.
+
+Drives the decoupled ``tiny_lm_generate`` fixture the way an LLM serving
+client drives a Triton TensorRT-LLM/vLLM backend: the request carries the
+prompt and MAX_TOKENS, the server streams a NEXT_TOKEN response per
+generated token, and the client prints tokens as they arrive with a
+time-to-first-token measurement. (Reference pattern: decoupled
+model_transaction_policy + bi-di ModelStreamInfer; see
+simple_grpc_custom_repeat for the generic decoupled fixture.)
+"""
+
+import argparse
+import queue
+import sys
+import time
+
+import numpy as np
+
+import client_tpu.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-p", "--prompt", default="10,20,30,40",
+                        help="comma-separated prompt token ids (0-255)")
+    parser.add_argument("-n", "--max-tokens", type=int, default=16)
+    parser.add_argument("--chunk", type=int, default=1,
+                        help="tokens per device dispatch (lax.scan burst)")
+    args = parser.parse_args()
+
+    prompt = np.array(
+        [[int(t) for t in args.prompt.split(",")]], dtype=np.int32)
+    results = queue.Queue()
+
+    with grpcclient.InferenceServerClient(args.url) as client:
+        client.start_stream(callback=lambda r, e: results.put((r, e)))
+        inputs = [
+            grpcclient.InferInput("TOKENS", list(prompt.shape), "INT32"),
+            grpcclient.InferInput("MAX_TOKENS", [1], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(prompt)
+        inputs[1].set_data_from_numpy(
+            np.array([args.max_tokens], dtype=np.int32))
+
+        t0 = time.perf_counter()
+        client.async_stream_infer(
+            "tiny_lm_generate", inputs,
+            enable_empty_final_response=True,
+            parameters={"chunk": args.chunk} if args.chunk != 1 else None,
+        )
+
+        tokens = []
+        ttft_ms = None
+        while True:
+            result, error = results.get(timeout=60)
+            if error is not None:
+                print(f"stream error: {error}", file=sys.stderr)
+                return 1
+            if result.is_final_response() and result.is_null_response():
+                break
+            if ttft_ms is None:
+                ttft_ms = (time.perf_counter() - t0) * 1e3
+            tok = int(result.as_numpy("NEXT_TOKEN").reshape(-1)[0])
+            tokens.append(tok)
+            print(f"token[{len(tokens) - 1:>2}] = {tok}", flush=True)
+        total_ms = (time.perf_counter() - t0) * 1e3
+        client.stop_stream()
+
+    if len(tokens) != args.max_tokens:
+        print(f"expected {args.max_tokens} tokens, got {len(tokens)}",
+              file=sys.stderr)
+        return 1
+    rate = len(tokens) / (total_ms / 1e3)
+    print(f"TTFT {ttft_ms:.1f} ms, {len(tokens)} tokens in {total_ms:.1f} ms "
+          f"({rate:.0f} tok/s)")
+    print("PASS: llm_generate_stream")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

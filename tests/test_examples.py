@@ -69,6 +69,7 @@ GRPC_EXAMPLES = [
     "simple_grpc_model_control.py",
     "grpc_raw_wire_client.py",
     "grpc_decoder_stream_client.py",
+    "llm_generate_stream_client.py",
 ]
 
 
