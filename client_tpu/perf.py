@@ -349,7 +349,7 @@ class PerfRunner:
                 own_client.close()
 
     def _rate_worker(self, client, barrier, stop, schedule, cursor, t0_box,
-                     records, errors, worker_id):
+                     records, lags, errors, worker_id):
         """Open-loop worker: claims the next arrival slot from the shared
         schedule, sleeps until its wall-clock time, then issues one sync
         infer. Lateness (actual start - scheduled start) is recorded per
@@ -382,10 +382,15 @@ class PerfRunner:
                 if delay > 0:
                     time.sleep(delay)
                 lag = max(0.0, time.perf_counter() - target)
+                # lag is recorded for EVERY issued request — under overload
+                # the failing requests are the latest-starting ones, and
+                # excluding them would understate exactly the slip this
+                # mode exists to measure
+                lags.append(lag)
                 t1 = time.perf_counter()
                 try:
                     self._infer_once(client, inputs, outputs)
-                    records.append((time.perf_counter() - t1, lag))
+                    records.append(time.perf_counter() - t1)
                 except Exception as e:  # measured as failure, loop continues
                     errors.append(str(e))
         finally:
@@ -528,6 +533,8 @@ class PerfRunner:
         instead of the closed-loop's self-throttling."""
         if rate <= 0:
             raise ValueError("rate must be > 0")
+        if measurement_requests < 1:
+            raise ValueError("measurement_requests must be >= 1")
         if distribution == "constant":
             gaps = np.full(measurement_requests, 1.0 / rate)
         elif distribution == "poisson":
@@ -539,7 +546,8 @@ class PerfRunner:
         client = self._make_client(pool_size)
         if self.protocol == "native-grpc-async":
             client.set_async_concurrency(pool_size)
-        records: List[Tuple[float, float]] = []  # (latency_s, lag_s)
+        records: List[float] = []  # latency_s of successful requests
+        lags: List[float] = []  # schedule lag of EVERY issued request
         errors: List[str] = []
         stop = threading.Event()
         barrier = threading.Barrier(pool_size + 1)
@@ -549,7 +557,7 @@ class PerfRunner:
             threading.Thread(
                 target=self._rate_worker,
                 args=(client, barrier, stop, schedule, cursor, t0_box,
-                      records, errors, i),
+                      records, lags, errors, i),
                 daemon=True,
             )
             for i in range(pool_size)
@@ -565,9 +573,10 @@ class PerfRunner:
         elapsed = time.perf_counter() - t0_box[0]
         client.close()
 
-        lat_sorted = sorted(r[0] for r in records)
-        lag_sorted = sorted(r[1] for r in records)
+        lat_sorted = sorted(records)
+        lag_sorted = sorted(lags)
         n = len(lat_sorted)
+        issued = len(lag_sorted)
         # a request is "delayed" when the pool could not start it on time
         # (reference threshold: perf_analyzer flags schedule slip; 1 ms
         # separates scheduler jitter from genuine queueing)
@@ -580,6 +589,7 @@ class PerfRunner:
             "distribution": distribution,
             "pool_size": pool_size,
             "requests": n,
+            "issued": issued,
             "errors": len(errors),
             "error_sample": errors[0] if errors else None,
             "duration_s": round(elapsed, 3),
@@ -594,7 +604,7 @@ class PerfRunner:
                 "p50": round(1000 * _percentile(lag_sorted, 0.50), 3),
                 "p99": round(1000 * _percentile(lag_sorted, 0.99), 3),
             },
-            "delayed_pct": round(100.0 * delayed / n, 1) if n else 0.0,
+            "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
         }
 
 
@@ -663,6 +673,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         rstart = rparts[0]
         rend = rparts[1] if len(rparts) > 1 else rstart
         rstep = rparts[2] if len(rparts) > 2 else 1.0
+        if rstep <= 0:
+            # match the closed-loop path, where range() rejects step=0
+            raise ValueError("--request-rate-range step must be > 0")
         rate = rstart
         while rate <= rend + 1e-9:
             results.append(runner.run_rate(

@@ -128,6 +128,53 @@ def test_grpc_aio_stream_decoupled(servers):
     asyncio.run(run())
 
 
+def test_grpc_aio_stream_llm_generate(servers):
+    """Decoupled LLM generation over the aio streaming client: per-token
+    responses arrive until the final marker, tokens match the sync path."""
+    _, grpc_server = servers
+    import client_tpu.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as client:
+            async def requests():
+                tok = aioclient.InferInput("TOKENS", [1, 3], "INT32")
+                tok.set_data_from_numpy(np.array([[9, 8, 7]], dtype=np.int32))
+                mx = aioclient.InferInput("MAX_TOKENS", [1], "INT32")
+                mx.set_data_from_numpy(np.array([5], dtype=np.int32))
+                yield {
+                    "model_name": "tiny_lm_generate",
+                    "inputs": [tok, mx],
+                    "enable_empty_final_response": True,
+                }
+
+            stream = await client.stream_infer(requests())
+            toks = []
+            async for result, error in stream:
+                assert error is None
+                if result.is_null_response():
+                    break
+                toks.append(int(result.as_numpy("NEXT_TOKEN").reshape(-1)[0]))
+            return toks
+
+    toks = asyncio.run(run())
+    assert len(toks) == 5
+    # exactness vs the in-process decoupled path (same weights/server)
+    core = servers[1].core
+    expected = []
+    for resp in core.infer_stream("tiny_lm_generate", "", {
+        "id": "x", "parameters": {},
+        "inputs": [
+            {"name": "TOKENS", "datatype": "INT32", "shape": [1, 3],
+             "array": np.array([[9, 8, 7]], np.int32)},
+            {"name": "MAX_TOKENS", "datatype": "INT32", "shape": [1],
+             "array": np.array([5], np.int32)},
+        ],
+    }):
+        out = {o["name"]: np.asarray(o["array"]) for o in resp["outputs"]}
+        expected.append(int(out["NEXT_TOKEN"].reshape(-1)[0]))
+    assert toks == expected
+
+
 def test_grpc_aio_stream_error_in_band(servers):
     """Stream errors reach the aio consumer as (None, error) pairs."""
     _, grpc_server = servers

@@ -55,6 +55,17 @@ def test_rate_validation(runner):
         runner.run_rate(0.0, 10)
     with pytest.raises(ValueError, match="distribution"):
         runner.run_rate(10.0, 10, distribution="uniform")
+    with pytest.raises(ValueError, match="measurement_requests"):
+        runner.run_rate(10.0, 0)
+
+
+def test_rate_cli_zero_step_rejected(http_url):
+    from client_tpu.perf import main
+
+    with pytest.raises(ValueError, match="step"):
+        main(["-m", "simple", "-u", http_url,
+              "--request-rate-range", "10:20:0",
+              "--measurement-requests", "5", "--warmup-requests", "0"])
 
 
 def test_rate_cli(http_url):

@@ -193,6 +193,60 @@ def bench_densenet(jax, jnp, np, width, arch, steps=20, batch=8):
             "tflops": round(flops / dt / 1e12, 2)}
 
 
+def bench_generate(jax, jnp, np, prompt=32, k=64):
+    """Autoregressive decode rate for the tiny_lm_generate fixture.
+
+    Two numbers: per-token dispatch (each step blocked — the chunk=1
+    streaming-serving latency, paying one dispatch RTT per token) and the
+    lax.scan chunked path (K tokens inside ONE XLA dispatch — the
+    dispatch-amortized device decode rate). Their ratio is the tunnel/RTT
+    amortization the scan-in-XLA design buys (genai-perf's ITL regime)."""
+    from client_tpu.models.generate import TinyGenerateModel
+
+    model = TinyGenerateModel()
+    model._ensure_built()
+    dec = model._decoder
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, dec.VOCAB, size=prompt)
+
+    caches, pos = dec._fresh_cache(), 0
+    logits = None
+    for t in toks:
+        logits, caches = dec._step_fn(dec._params, caches, int(t), pos)
+        pos += 1
+    first = int(np.asarray(logits).argmax())
+
+    k = min(k, dec.MAX_LEN - pos - 1)
+    chunk_fn = model._chunk_fn(k)
+
+    def chunked(token, p):
+        out, _ = chunk_fn(dec._params, caches, token, p)
+        return out
+
+    dt_chunked = _timed_single_dispatch(chunked, first, pos, iters_inside=k)
+
+    # per-token: block every step — the feed-back loop round-trips the
+    # host for the argmax, so serving really does pay this per token
+    step = dec._step_fn
+    step(dec._params, caches, first, pos)[0].block_until_ready()
+    times = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        out, _ = step(dec._params, caches, first, pos)
+        out.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    dt_token = sorted(times)[len(times) // 2]
+
+    return {
+        "prompt_tokens": int(prompt), "chunk": int(k),
+        "ms_per_token_dispatch": round(dt_token * 1000, 3),
+        "tokens_per_sec_dispatch": round(1.0 / dt_token, 1),
+        "ms_per_token_chunked": round(dt_chunked * 1000, 3),
+        "tokens_per_sec_chunked": round(1.0 / dt_chunked, 1),
+        "chunk_amortization": round(dt_token / dt_chunked, 1),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--json-out", default=None)
@@ -218,13 +272,16 @@ def main():
         mm = bench_matmul(jax, jnp, np, n=256, chain=4, pipeline=2)
         fa = bench_flash_attention(
             jax, jnp, np, batch=1, seq=256, heads=2, dim=64, steps=2)
+        gen = bench_generate(jax, jnp, np, prompt=8, k=8)
         dn_specs = ((8, "lite", 1),)
     else:
         mm = bench_matmul(jax, jnp, np)
         fa = bench_flash_attention(jax, jnp, np)
+        gen = bench_generate(jax, jnp, np)
         dn_specs = ((96, "lite", 8), (256, "lite", 8), (64, "121", 8))
     result["matmul_bf16"] = mm
     result["flash_attention"] = fa
+    result["llm_decode"] = gen
     dn = {}
     for width, arch, batch in dn_specs:
         key = f"w{width}_{arch}"
