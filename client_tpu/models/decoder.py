@@ -47,9 +47,15 @@ class TinyDecoderModel(Model):
     LAYERS = 2
     MAX_LEN = 128
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, attention_impl: str = "einsum"):
+        """``attention_impl``: "einsum" (dense, default) or "pallas" (the
+        ops/decode_attention.py flash-decoding kernel — same math, K/V
+        blocks streamed through VMEM; interpret mode off-TPU)."""
+        if attention_impl not in ("einsum", "pallas"):
+            raise ValueError(f"unknown attention_impl {attention_impl!r}")
         super().__init__()
         self._seed = seed
+        self._attention_impl = attention_impl
         self._lock = threading.Lock()
         self._params = None
         self._step_fn = None
@@ -120,16 +126,26 @@ class TinyDecoderModel(Model):
                 k = lax.dynamic_update_slice(cache["k"], k_new, (0, pos, 0))
                 v = lax.dynamic_update_slice(cache["v"], v_new, (0, pos, 0))
                 new_caches.append({"k": k, "v": v})
-                # position-based mask: only slots <= pos attend
-                scores = jnp.einsum(
-                    "hd,hmd->hm", q.astype(jnp.float32),
-                    k.astype(jnp.float32)) * (Dh ** -0.5)
-                mask = jnp.arange(M) <= pos
-                scores = jnp.where(mask[None, :], scores, -jnp.inf)
-                probs = jax.nn.softmax(scores, axis=-1)
-                attn = jnp.einsum(
-                    "hm,hmd->hd", probs, v.astype(jnp.float32))
-                x = x + (attn.reshape(D).astype(jnp.bfloat16) @ layer["proj"])
+                if self._attention_impl == "pallas":
+                    from ..ops.decode_attention import decode_attention
+
+                    attn = decode_attention(
+                        q[None], k[None], v[None],
+                        jnp.asarray(pos, jnp.int32).reshape(1),
+                    )[0]  # [H, Dh], bf16 (kernel accumulates fp32)
+                    x = x + (attn.reshape(D) @ layer["proj"])
+                else:
+                    # position-based mask: only slots <= pos attend
+                    scores = jnp.einsum(
+                        "hd,hmd->hm", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (Dh ** -0.5)
+                    mask = jnp.arange(M) <= pos
+                    scores = jnp.where(mask[None, :], scores, -jnp.inf)
+                    probs = jax.nn.softmax(scores, axis=-1)
+                    attn = jnp.einsum(
+                        "hm,hmd->hd", probs, v.astype(jnp.float32))
+                    x = x + (attn.reshape(D).astype(jnp.bfloat16)
+                             @ layer["proj"])
                 h2 = norm(x)
                 x = x + jax.nn.gelu(h2 @ layer["mlp_in"]) @ layer["mlp_out"]
             logits = (norm(x) @ params["unembed"]).astype(jnp.float32)

@@ -45,6 +45,15 @@ def test_densenet_bench_small():
     assert out["gflops_per_image"] > 0.01
 
 
+def test_generate_bench_small():
+    out = chip_bench.bench_generate(jax, jnp, np, prompt=4, k=4)
+    assert out["chunk"] == 4
+    assert out["ms_per_token_dispatch"] > 0
+    assert out["ms_per_token_chunked"] > 0
+    assert out["tokens_per_sec_chunked"] > 0
+    assert out["chunk_amortization"] > 0
+
+
 def test_peak_lookup():
     assert chip_bench._peak_for("TPU v5 lite") == 197.0
     assert chip_bench._peak_for("TPU v5") == 459.0

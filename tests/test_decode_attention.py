@@ -1,0 +1,117 @@
+"""Flash-decoding kernel exactness + the pallas-backed decoder path.
+
+ops/decode_attention.py is the single-query KV-cache attention kernel (the
+LLM decode hot op). Off-TPU it runs in Pallas interpret mode, so these are
+true exactness tests of the kernel math (online softmax over K blocks,
+position masking, query-row padding) against a dense fp32 reference — the
+same CI strategy flash_attention uses (SURVEY §4 tier 1: serverless
+numerics).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from client_tpu.ops.decode_attention import (
+    decode_attention,
+    decode_attention_reference,
+)
+
+
+@pytest.mark.parametrize(
+    "batch,heads,max_len,dim,positions",
+    [
+        (1, 4, 128, 32, [5]),          # the decoder_lm fixture shape
+        (3, 2, 200, 64, [0, 99, 199]),  # ragged block tail + pos extremes
+        (2, 8, 384, 128, [100, 383]),   # multi-block, MXU-native dim
+    ],
+)
+def test_matches_dense_reference(batch, heads, max_len, dim, positions):
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((batch, heads, dim)), jnp.float32)
+    k = jnp.asarray(
+        rng.standard_normal((batch, heads, max_len, dim)), jnp.float32)
+    v = jnp.asarray(
+        rng.standard_normal((batch, heads, max_len, dim)), jnp.float32)
+    pos = jnp.asarray(positions, jnp.int32)
+    out = decode_attention(q, k, v, pos)
+    ref = decode_attention_reference(q, k, v, pos)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+def test_bf16_inputs():
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((2, 4, 32)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((2, 4, 128, 32)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((2, 4, 128, 32)), jnp.bfloat16)
+    pos = jnp.asarray([7, 127], jnp.int32)
+    out = decode_attention(q, k, v, pos).astype(jnp.float32)
+    ref = decode_attention_reference(q, k, v, pos).astype(jnp.float32)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-2
+    assert decode_attention(q, k, v, pos).dtype == jnp.bfloat16
+
+
+def test_pos_zero_attends_single_slot():
+    """pos=0 must reduce to 'output = v[:, :, 0]' (softmax over one slot)."""
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((1, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 64, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 64, 32)), jnp.float32)
+    out = decode_attention(q, k, v, jnp.asarray([0], jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(v[:, :, 0]), rtol=1e-5, atol=1e-6)
+
+
+def test_cache_tail_is_ignored():
+    """Garbage in unwritten cache slots (> pos) must not leak into the
+    output — the serving contract: the cache is preallocated at MAX_LEN."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((1, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 96, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 96, 32)), jnp.float32)
+    pos = jnp.asarray([40], jnp.int32)
+    base = decode_attention(q, k, v, pos)
+    k_junk = k.at[:, :, 41:].set(1e6)
+    v_junk = v.at[:, :, 41:].set(-1e6)
+    junk = decode_attention(q, k_junk, v_junk, pos)
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(junk), rtol=1e-6, atol=1e-7)
+
+
+def test_decoder_pallas_attention_matches_einsum():
+    """The decoder's opt-in pallas attention path tracks the dense path:
+    near-identical logits, identical greedy generation."""
+    from client_tpu.models.decoder import TinyDecoderModel
+
+    dense = TinyDecoderModel(seed=0)
+    pallas = TinyDecoderModel(seed=0, attention_impl="pallas")
+
+    def drive(model, n=6):
+        params = {"sequence_id": 11, "sequence_start": True,
+                  "sequence_end": False}
+        req = {"TOKENS": np.array([[5, 6, 7]], np.int32)}
+        out = model.execute(req, params)
+        logits = [out["LOGITS"]]
+        tok = int(out["NEXT_TOKEN"][0, 0])
+        toks = [tok]
+        for i in range(n - 1):
+            params = {"sequence_id": 11, "sequence_start": False,
+                      "sequence_end": i == n - 2}
+            out = model.execute({"TOKENS": np.array([[tok]], np.int32)}, params)
+            logits.append(out["LOGITS"])
+            tok = int(out["NEXT_TOKEN"][0, 0])
+            toks.append(tok)
+        return toks, np.concatenate(logits)
+
+    toks_d, logits_d = drive(dense)
+    toks_p, logits_p = drive(pallas)
+    assert toks_p == toks_d
+    np.testing.assert_allclose(logits_p, logits_d, atol=5e-2, rtol=0)
+
+
+def test_attention_impl_validation():
+    from client_tpu.models.decoder import TinyDecoderModel
+
+    with pytest.raises(ValueError, match="attention_impl"):
+        TinyDecoderModel(attention_impl="flash")

@@ -227,15 +227,11 @@ def bench_generate(jax, jnp, np, prompt=32, k=64):
 
     # per-token: block every step — the feed-back loop round-trips the
     # host for the argmax, so serving really does pay this per token
-    step = dec._step_fn
-    step(dec._params, caches, first, pos)[0].block_until_ready()
-    times = []
-    for _ in range(7):
-        t0 = time.perf_counter()
-        out, _ = step(dec._params, caches, first, pos)
-        out.block_until_ready()
-        times.append(time.perf_counter() - t0)
-    dt_token = sorted(times)[len(times) // 2]
+    def one_step(token, p):
+        return dec._step_fn(dec._params, caches, token, p)[0]
+
+    dt_token = _timed_single_dispatch(
+        one_step, first, pos, iters_inside=1, repeats=7)
 
     return {
         "prompt_tokens": int(prompt), "chunk": int(k),
