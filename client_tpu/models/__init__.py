@@ -9,6 +9,7 @@ TPU VM: the same wire contracts, but the compute runs on XLA.
 """
 
 from .base import Model, TensorSpec
+from .decoder_batched import BatchedDecoderModel
 from .ensemble import EnsembleModel, EnsembleStep, build_image_ensemble
 from .generate import TinyGenerateModel
 from .simple import (
@@ -22,6 +23,7 @@ from .simple import (
 
 __all__ = [
     "AddSubModel",
+    "BatchedDecoderModel",
     "EnsembleModel",
     "EnsembleStep",
     "IdentityModel",

@@ -1,0 +1,344 @@
+"""Slot-based sequence batcher: concurrent decodes share one dispatch.
+
+tritonserver's *sequence batcher* (direct mode) assigns each live sequence
+a batch slot and runs every slot's next step in a single model execution —
+the client repo exposes it through the same sequence_id/start/end controls
+the ``decoder_lm`` fixture serves (SURVEY §5 long-context/sequence).
+``decoder_lm`` executes each sequence's step as its own device dispatch;
+at S concurrent sequences that is S dispatches per token — exactly the
+regime batching exists for, since an [S, ...] step costs barely more than
+a [1, ...] step until S fills the MXU tile.
+
+``decoder_lm_batched`` is the TPU-first version: per-slot KV caches live
+stacked on device ([slots, heads, max_len, head_dim] per layer), a
+coalescer thread gathers whatever sequence requests are in flight inside a
+~2 ms window, and ONE jitted batched step (``jax.vmap`` of the decoder's
+single-sequence step — the identical math, so tokens are bit-comparable)
+advances them all. Slots whose sequence has no pending request this round
+ride along masked: their cache/pos updates are discarded by a
+``jnp.where`` select, which keeps the executable static-shape — the same
+compile-once property the single-sequence decoder has. Prompts longer than
+one token naturally lockstep: each coalescer round consumes the next token
+of every gathered request, so two sequences prefilling together share
+every dispatch.
+
+Weights come from a composed TinyDecoderModel (same seed ⇒ greedy tokens
+match the unbatched fixture token-for-token — pinned by the tests).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .base import Model, TensorSpec
+from .decoder import TinyDecoderModel
+
+
+class _SeqRequest:
+    __slots__ = ("seq_id", "tokens", "start", "end", "future")
+
+    def __init__(self, seq_id, tokens, start, end):
+        self.seq_id = seq_id
+        self.tokens = tokens  # list of ints, consumed one per round
+        self.start = start
+        self.end = end
+        self.future: Future = Future()
+
+
+class BatchedDecoderModel(Model):
+    """``decoder_lm_batched``: the decoder_lm contract, slot-batched."""
+
+    name = "decoder_lm_batched"
+    platform = "jax"
+    max_batch_size = 0
+    stateful = True
+
+    def __init__(self, seed: int = 0, slots: int = 8,
+                 max_delay_s: float = 0.002, attention_impl: str = "einsum"):
+        super().__init__()
+        self._decoder = TinyDecoderModel(seed=seed,
+                                         attention_impl=attention_impl)
+        self.slots = int(slots)
+        self._max_delay_s = max_delay_s
+        self._lock = threading.Lock()
+        self._built = False
+        self._queue: "queue.Queue[_SeqRequest]" = queue.Queue(maxsize=1024)
+        self._closed = False
+        self._carry: List[_SeqRequest] = []
+        # observability for tests/tuning: rounds executed per batch width
+        self.batch_histogram: Dict[int, int] = {}
+        self._worker = None  # started lazily with the first build
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("TOKENS", "INT32", [1, -1])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [
+            TensorSpec("LOGITS", "FP32", [1, self._decoder.VOCAB]),
+            TensorSpec("NEXT_TOKEN", "INT32", [1, 1]),
+        ]
+
+    # -- compiled pieces -----------------------------------------------------
+    def _ensure_built(self):
+        with self._lock:
+            if self._built:
+                return
+            self._decoder._ensure_built()
+            import jax
+            import jax.numpy as jnp
+
+            dec = self._decoder
+            S = self.slots
+            Dh = dec.D_MODEL // dec.HEADS
+            step1 = dec._step_fn  # (params, caches, token, pos) per sequence
+            vstep = jax.vmap(step1, in_axes=(None, 0, 0, 0))
+
+            def batched_step(params, caches, tokens, pos, active):
+                logits, new_caches = vstep(params, caches, tokens, pos)
+
+                def sel(new, old):
+                    mask = active.reshape((-1,) + (1,) * (new.ndim - 1))
+                    return jnp.where(mask, new, old)
+
+                caches = jax.tree_util.tree_map(sel, new_caches, caches)
+                return logits, caches
+
+            self._batched_step = jax.jit(batched_step)
+            self._caches = [
+                {
+                    "k": jnp.zeros((S, dec.HEADS, dec.MAX_LEN, Dh),
+                                   jnp.bfloat16),
+                    "v": jnp.zeros((S, dec.HEADS, dec.MAX_LEN, Dh),
+                                   jnp.bfloat16),
+                }
+                for _ in range(dec.LAYERS)
+            ]
+            # positions live HOST-side (0 on start, +1 per active token —
+            # fully derivable without a device readback) and ship to the
+            # device each round alongside the token vector; carrying them
+            # on-device would cost a blocking readback per request in
+            # _run_window, the exact per-dispatch RTT the batcher
+            # amortizes (~60 ms each on a tunneled chip)
+            self._pos = np.zeros((S,), np.int32)
+            self._slot_of: Dict[Any, int] = {}
+            self._free = list(range(S))
+            self._worker = threading.Thread(
+                target=self._run, name="sequence-batcher", daemon=True)
+            self._worker.start()
+            self._built = True
+
+    # -- serving (caller side) ----------------------------------------------
+    def execute(self, inputs: Dict[str, np.ndarray],
+                parameters: Dict[str, Any]):
+        self._ensure_built()
+        seq_id = parameters.get("sequence_id", 0)
+        if not seq_id:
+            raise ValueError("decoder_lm_batched requires a sequence_id")
+        start = bool(parameters.get("sequence_start", False))
+        end = bool(parameters.get("sequence_end", False))
+        tokens = np.asarray(inputs["TOKENS"]).reshape(-1).astype(np.int64)
+        if tokens.size == 0:
+            raise ValueError("empty prompt")
+        if np.any(tokens < 0) or np.any(tokens >= self._decoder.VOCAB):
+            raise ValueError(f"tokens out of range [0, {self._decoder.VOCAB})")
+        if not start and len(tokens) != 1:
+            raise ValueError("continuation requests carry exactly one token")
+        if self._closed:
+            raise ValueError("model is shutting down")
+        req = _SeqRequest(seq_id, [int(t) for t in tokens], start, end)
+        self._queue.put(req)
+        if self._closed:
+            # unload() raced us: the worker may already be past its
+            # sentinel, leaving this request stranded behind it — fail it
+            # here (the worker wins harmlessly if it got there first)
+            try:
+                req.future.set_exception(
+                    ValueError("model is shutting down"))
+            except Exception:
+                pass  # worker already resolved it
+        logits = req.future.result(timeout=120)
+        logits_np = np.asarray(logits, dtype=np.float32).reshape(
+            1, self._decoder.VOCAB)
+        return {
+            "LOGITS": logits_np,
+            "NEXT_TOKEN": np.array([[int(logits_np.argmax())]], dtype=np.int32),
+        }
+
+    def live_sequences(self) -> int:
+        self._ensure_built()
+        with self._lock:
+            return len(self._slot_of)
+
+    def unload(self) -> None:
+        self._closed = True
+        self._queue.put(None)
+        if self._worker is not None:
+            self._worker.join(timeout=10)
+        # fail anything that slipped in behind the sentinel (the worker has
+        # exited; nothing else will ever resolve those futures)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None and not req.future.done():
+                try:
+                    req.future.set_exception(
+                        ValueError("model is shutting down"))
+                except Exception:
+                    pass
+        super().unload()
+
+    # -- coalescer worker ----------------------------------------------------
+    def _collect(self) -> List[_SeqRequest]:
+        """One window: at most one request per sequence (two requests on a
+        sequence must observe each other's cache updates, so the second
+        waits for the next round — the reference sequence batcher
+        serializes per CORRID the same way)."""
+        window, seen, still_carried = [], set(), []
+        for req in self._carry:
+            if req.seq_id in seen:
+                still_carried.append(req)  # FIFO within a sequence
+            else:
+                window.append(req)
+                seen.add(req.seq_id)
+        self._carry = still_carried
+        if not window:
+            first = self._queue.get()
+            if first is None:
+                return []
+            window.append(first)
+            seen.add(first.seq_id)
+        deadline = time.monotonic() + self._max_delay_s
+        while len(window) < self.slots:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._queue.put(None)
+                break
+            if nxt.seq_id in seen:
+                # serialize per CORRID but KEEP collecting: a fast client's
+                # back-to-back request must not shut other sequences out of
+                # this round
+                self._carry.append(nxt)
+                continue
+            window.append(nxt)
+            seen.add(nxt.seq_id)
+        return window
+
+    def _admit(self, req: _SeqRequest) -> int:
+        """Resolve the request to a slot (allocating on sequence_start)."""
+        with self._lock:
+            if req.start:
+                if req.seq_id in self._slot_of:
+                    slot = self._slot_of[req.seq_id]  # restart in place
+                elif self._free:
+                    slot = self._free.pop()
+                    self._slot_of[req.seq_id] = slot
+                else:
+                    raise ValueError(
+                        f"no free sequence slot (capacity {self.slots}); "
+                        "end a sequence first")
+                return slot
+            slot = self._slot_of.get(req.seq_id)
+            if slot is None:
+                raise ValueError(
+                    f"sequence {req.seq_id} has no live state "
+                    "(missing sequence_start?)")
+            return slot
+
+    def _run(self) -> None:
+        while True:
+            window = self._collect()
+            if not window:
+                return
+            try:
+                self._run_window(window)
+            except Exception as e:  # the worker thread must NEVER die — a
+                # dead coalescer wedges every future request on the model
+                for req in window:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+
+    def _run_window(self, window: List[_SeqRequest]) -> None:
+        import jax.numpy as jnp
+
+        dec = self._decoder
+        active_reqs: List[tuple] = []  # (req, slot)
+        for req in window:
+            try:
+                slot = self._admit(req)
+            except Exception as e:
+                req.future.set_exception(e)
+                continue
+            if req.start:
+                # zero pos; cache rows are fully overwritten as the
+                # prompt streams in, and masked reads never see slots
+                # beyond pos, so stale cache content is harmless
+                self._pos[slot] = 0
+            pos_here = int(self._pos[slot])
+            if pos_here + len(req.tokens) > dec.MAX_LEN:
+                req.future.set_exception(ValueError(
+                    f"sequence longer than max_len {dec.MAX_LEN}"))
+                with self._lock:
+                    self._free_slot(req.seq_id)
+                continue
+            active_reqs.append((req, slot))
+
+        # lockstep rounds: each round consumes ONE token from every
+        # request that still has tokens left (prompts prefill together)
+        last_logits: Dict[int, Any] = {}
+        try:
+            while any(req.tokens for req, _ in active_reqs):
+                tokens = np.zeros((self.slots,), np.int32)
+                active = np.zeros((self.slots,), bool)
+                for req, slot in active_reqs:
+                    if req.tokens:
+                        tokens[slot] = req.tokens.pop(0)
+                        active[slot] = True
+                logits, self._caches = self._batched_step(
+                    dec._params, self._caches,
+                    jnp.asarray(tokens), jnp.asarray(self._pos),
+                    jnp.asarray(active))
+                self._pos[active] += 1
+                self.batch_histogram[int(active.sum())] = (
+                    self.batch_histogram.get(int(active.sum()), 0) + 1)
+                for req, slot in active_reqs:
+                    if active[slot]:
+                        last_logits[slot] = logits[slot]
+        except Exception as e:  # a failed dispatch must not strand callers
+            for req, _ in active_reqs:
+                if not req.future.done():
+                    req.future.set_exception(e)
+                if req.end:
+                    # the sequence is over either way; keeping the slot
+                    # would leak capacity one failed window at a time
+                    with self._lock:
+                        self._free_slot(req.seq_id)
+            return
+
+        for req, slot in active_reqs:
+            if req.end:
+                with self._lock:
+                    self._free_slot(req.seq_id)
+            if slot in last_logits:
+                req.future.set_result(last_logits[slot])
+            elif not req.future.done():
+                req.future.set_exception(
+                    ValueError("request executed no decode step"))
+
+    def _free_slot(self, seq_id) -> None:
+        slot = self._slot_of.pop(seq_id, None)
+        if slot is not None:
+            self._free.append(slot)

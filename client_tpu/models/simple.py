@@ -208,6 +208,7 @@ def default_model_zoo() -> List[Model]:
     """The fixture set every test/example expects to find on the server."""
     from .batched import BatchedMatMulModel
     from .decoder import TinyDecoderModel
+    from .decoder_batched import BatchedDecoderModel
     from .generate import TinyGenerateModel
 
     decoder = TinyDecoderModel()
@@ -225,4 +226,5 @@ def default_model_zoo() -> List[Model]:
         RepeatModel(),
         decoder,
         TinyGenerateModel(decoder=decoder),
+        BatchedDecoderModel(),
     ]

@@ -1,6 +1,8 @@
 // Native-client latency bench: the tpu-shm control-message hot path.
 // Usage: CLIENT_TPU_TEST_URL=host:port native_bench [n_elems] [iters]
 // Prints one JSON line with p50/p99 for wire vs tpu-shm data planes.
+#include <malloc.h>
+
 #include <algorithm>
 #include <chrono>
 #include <cstdint>
@@ -31,6 +33,14 @@ int main(int argc, char** argv) {
   size_t n_elems = argc > 1 ? strtoull(argv[1], nullptr, 10) : (1u << 20);
   int iters = argc > 2 ? atoi(argv[2]) : 50;
   size_t nbytes = n_elems * sizeof(float);
+  // CLIENT_TPU_BENCH_TRIM_EVERY=N: malloc_trim(0) every N iterations, so an
+  // external RSS sampler (the soak tier) reads reachable heap rather than
+  // glibc's free-but-unreturned retention — the same post-trim protocol the
+  // python soak uses; a true leak still shows as a positive trimmed slope
+  long trim_every = 0;
+  if (const char* te = getenv("CLIENT_TPU_BENCH_TRIM_EVERY")) {
+    trim_every = atol(te);
+  }
 
   std::unique_ptr<InferenceServerHttpClient> client;
   if (InferenceServerHttpClient::Create(&client, url)) return 1;
@@ -66,6 +76,13 @@ int main(int argc, char** argv) {
       outputs.push_back(out0);
     }
     std::vector<float> readback(n_elems);
+    // soak runs pass huge iter counts: cap retained samples and reserve
+    // upfront — an unboundedly growing vector whose doubling reallocations
+    // interleave with the per-request transient buffers ratchets the glibc
+    // heap high-water (the r03 soak's "native leak": LSan-clean, in-use
+    // heap flat, yet RSS climbing ~400 KB/min on a quiet machine)
+    constexpr size_t kMaxSamples = 1u << 18;
+    times->reserve(std::min(static_cast<size_t>(iters), kMaxSamples));
     for (int i = 0; i < iters + 5; ++i) {
       auto t0 = std::chrono::steady_clock::now();
       Error err;
@@ -100,7 +117,8 @@ int main(int argc, char** argv) {
       auto dt = std::chrono::duration<double, std::milli>(
                     std::chrono::steady_clock::now() - t0)
                     .count();
-      if (i >= 5) times->push_back(dt);
+      if (i >= 5 && times->size() < kMaxSamples) times->push_back(dt);
+      if (trim_every > 0 && i % trim_every == 0) malloc_trim(0);
     }
     if (shm) {
       client->UnregisterTpuSharedMemory("");
