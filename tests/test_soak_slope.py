@@ -288,6 +288,42 @@ def test_soak_grpc_stream(servers):
         assert not errors, errors[:3]
 
 
+def test_soak_llm_generate(servers):
+    """Decoupled generation path: server-side per-token streaming + the
+    incremental ServerCore.infer_stream generator + per-session stream
+    requests — none of which the identity rows exercise. Leak surface:
+    per-request generator state, per-response encode buffers, KV caches
+    created/dropped per session."""
+    with grpcclient.InferenceServerClient(servers.grpc_url) as client:
+        import queue as _q
+
+        responses: "_q.Queue" = _q.Queue()
+        client.start_stream(lambda r, e: responses.put((r, e)))
+        prompt = np.arange(1, 9, dtype=np.int32).reshape(1, 8)
+
+        def step():
+            tok = grpcclient.InferInput("TOKENS", [1, 8], "INT32")
+            tok.set_data_from_numpy(prompt)
+            mx = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+            mx.set_data_from_numpy(np.array([4], np.int32))
+            client.async_stream_infer(
+                "tiny_lm_generate", [tok, mx],
+                enable_empty_final_response=True)
+            got = 0
+            while True:
+                result, error = responses.get(timeout=30)
+                assert error is None, error
+                if result.is_null_response():
+                    break
+                got += 1
+            assert got == 4
+
+        try:
+            _soak("llm_generate_stream", step, trim=True)
+        finally:
+            client.stop_stream()
+
+
 def test_soak_system_shm(servers):
     nbytes = _PAYLOAD.nbytes
     with httpclient.InferenceServerClient(servers.http_url) as client:
