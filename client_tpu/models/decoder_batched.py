@@ -31,7 +31,8 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List
 
 import numpy as np
@@ -49,6 +50,24 @@ class _SeqRequest:
         self.start = start
         self.end = end
         self.future: Future = Future()
+
+    # The caller may cancel() the future (120s timeout) at any moment —
+    # set_result/set_exception on a cancelled future raises
+    # InvalidStateError, and an unguarded raise inside the worker's
+    # resolution loop would strand every later request in the window.
+    def resolve(self, value) -> None:
+        try:
+            if not self.future.done():
+                self.future.set_result(value)
+        except InvalidStateError:
+            pass  # caller cancelled between the check and the set
+
+    def fail(self, exc: BaseException) -> None:
+        try:
+            if not self.future.done():
+                self.future.set_exception(exc)
+        except InvalidStateError:
+            pass
 
 
 class BatchedDecoderModel(Model):
@@ -162,7 +181,20 @@ class BatchedDecoderModel(Model):
                     ValueError("model is shutting down"))
             except Exception:
                 pass  # worker already resolved it
-        logits = req.future.result(timeout=120)
+        try:
+            logits = req.future.result(timeout=120)
+        except FuturesTimeout:
+            # the worker is wedged or the dispatch is pathologically slow;
+            # the caller is gone either way, so surface a gateway-timeout
+            # rather than an untyped 500. The slot is NOT freed here — the
+            # window may still be in flight and a new sequence claiming the
+            # slot would share its cache; the window's own error path (or
+            # sequence_end) reclaims it.
+            req.future.cancel()
+            from ..server.core import InferError
+
+            raise InferError(
+                "batched decode timed out after 120s", 504) from None
         logits_np = np.asarray(logits, dtype=np.float32).reshape(
             1, self._decoder.VOCAB)
         return {
@@ -268,8 +300,7 @@ class BatchedDecoderModel(Model):
             except Exception as e:  # the worker thread must NEVER die — a
                 # dead coalescer wedges every future request on the model
                 for req in window:
-                    if not req.future.done():
-                        req.future.set_exception(e)
+                    req.fail(e)
 
     def _run_window(self, window: List[_SeqRequest]) -> None:
         import jax.numpy as jnp
@@ -280,7 +311,7 @@ class BatchedDecoderModel(Model):
             try:
                 slot = self._admit(req)
             except Exception as e:
-                req.future.set_exception(e)
+                req.fail(e)
                 continue
             if req.start:
                 # zero pos; cache rows are fully overwritten as the
@@ -289,7 +320,7 @@ class BatchedDecoderModel(Model):
                 self._pos[slot] = 0
             pos_here = int(self._pos[slot])
             if pos_here + len(req.tokens) > dec.MAX_LEN:
-                req.future.set_exception(ValueError(
+                req.fail(ValueError(
                     f"sequence longer than max_len {dec.MAX_LEN}"))
                 with self._lock:
                     self._free_slot(req.seq_id)
@@ -307,9 +338,14 @@ class BatchedDecoderModel(Model):
                     if req.tokens:
                         tokens[slot] = req.tokens.pop(0)
                         active[slot] = True
+                # snapshot pos: device_put may alias the host buffer
+                # (CPU zero-copy) or read it after dispatch returns
+                # (ImmutableUntilTransferCompletes), so handing JAX
+                # self._pos itself and then mutating it in place races
+                # the in-flight step — the round-3 nondeterminism
                 logits, self._caches = self._batched_step(
                     dec._params, self._caches,
-                    jnp.asarray(tokens), jnp.asarray(self._pos),
+                    jnp.asarray(tokens), jnp.asarray(self._pos.copy()),
                     jnp.asarray(active))
                 self._pos[active] += 1
                 self.batch_histogram[int(active.sum())] = (
@@ -319,13 +355,13 @@ class BatchedDecoderModel(Model):
                         last_logits[slot] = logits[slot]
         except Exception as e:  # a failed dispatch must not strand callers
             for req, _ in active_reqs:
-                if not req.future.done():
-                    req.future.set_exception(e)
-                if req.end:
-                    # the sequence is over either way; keeping the slot
-                    # would leak capacity one failed window at a time
-                    with self._lock:
-                        self._free_slot(req.seq_id)
+                req.fail(e)
+                # a failed step ends the sequence regardless of req.end:
+                # the client has no valid continuation state (the cache may
+                # be partially updated), and keeping the slot would leak
+                # capacity one failed window at a time
+                with self._lock:
+                    self._free_slot(req.seq_id)
             return
 
         for req, slot in active_reqs:
@@ -333,10 +369,9 @@ class BatchedDecoderModel(Model):
                 with self._lock:
                     self._free_slot(req.seq_id)
             if slot in last_logits:
-                req.future.set_result(last_logits[slot])
-            elif not req.future.done():
-                req.future.set_exception(
-                    ValueError("request executed no decode step"))
+                req.resolve(last_logits[slot])
+            else:
+                req.fail(ValueError("request executed no decode step"))
 
     def _free_slot(self, seq_id) -> None:
         slot = self._slot_of.pop(seq_id, None)

@@ -50,13 +50,17 @@ def _decode_kernel(pos_ref, k_ref, v_ref, q_ref, o_ref, m_scr, l_scr, acc_scr,
     # K blocks wholly above pos contribute nothing — skip the whole body
     @pl.when(ik * block_k <= pos)
     def _body():
-        q = q_ref[0].astype(jnp.float32)            # [8, d] (row 0 live)
-        k = k_ref[0].astype(jnp.float32)            # [bk, d]
-        v = v_ref[0].astype(jnp.float32)            # [bk, d]
+        # native-dtype operands + f32 accumulation: bf16 caches ride the
+        # full-rate MXU path instead of the pre-cast fp32 one (same change
+        # as flash_attention.py — decode is bandwidth-bound so the win is
+        # smaller, but the halved VMEM footprint of bf16 blocks also helps)
+        q = q_ref[0]                                 # [8, d] (row 0 live)
+        k = k_ref[0]                                 # [bk, d]
+        v = v_ref[0]                                 # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                                    # [8, bk]
+        ) * scale                                    # [8, bk] f32
         k_pos = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (_SUBLANES, block_k), 1
         )
@@ -70,7 +74,7 @@ def _decode_kernel(pos_ref, k_ref, v_ref, q_ref, o_ref, m_scr, l_scr, acc_scr,
         acc_scr[...] = (
             acc_scr[...] * correction[:, :1]
             + jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
         )

@@ -46,13 +46,17 @@ def _flash_kernel(
 
     @pl.when(run_block)
     def _body():
-        q = q_ref[0].astype(jnp.float32)            # [bq, d]
-        k = k_ref[0].astype(jnp.float32)            # [bk, d]
-        v = v_ref[0].astype(jnp.float32)            # [bk, d]
+        # dots take the operands in their NATIVE dtype with fp32
+        # accumulation: bf16×bf16→f32 is the MXU's full-rate mode, while
+        # pre-casting to f32 (the round-3 kernel) dropped every matmul to
+        # the ~4x-slower fp32 MXU path — the bulk of the 4.9%-MFU finding
+        q = q_ref[0]                                 # [bq, d]
+        k = k_ref[0]                                 # [bk, d]
+        v = v_ref[0]                                 # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                                    # [bq, bk]
+        ) * scale                                    # [bq, bk] f32
         k_pos = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
@@ -75,7 +79,10 @@ def _flash_kernel(
         acc_scr[...] = (
             acc_scr[...] * correction[:, :1]
             + jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
+                # probabilities rounded to the value dtype so the PV dot
+                # also rides the full-rate MXU path (f32 accumulate keeps
+                # the running sum exact); for f32 inputs this is a no-op
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
         )
