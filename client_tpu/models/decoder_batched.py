@@ -171,16 +171,22 @@ class BatchedDecoderModel(Model):
         if self._closed:
             raise ValueError("model is shutting down")
         req = _SeqRequest(seq_id, [int(t) for t in tokens], start, end)
-        self._queue.put(req)
+        try:
+            # bounded wait: with a wedged worker the queue fills, and an
+            # unbounded put() would hang callers before the future timeout
+            # below ever ran — overload must surface as a typed 503
+            self._queue.put(req, timeout=30)
+        except queue.Full:
+            from ..server.core import InferError
+
+            raise InferError(
+                "sequence batcher queue full (worker stalled?)", 503
+            ) from None
         if self._closed:
             # unload() raced us: the worker may already be past its
             # sentinel, leaving this request stranded behind it — fail it
             # here (the worker wins harmlessly if it got there first)
-            try:
-                req.future.set_exception(
-                    ValueError("model is shutting down"))
-            except Exception:
-                pass  # worker already resolved it
+            req.fail(ValueError("model is shutting down"))
         try:
             logits = req.future.result(timeout=120)
         except FuturesTimeout:
@@ -219,12 +225,8 @@ class BatchedDecoderModel(Model):
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if req is not None and not req.future.done():
-                try:
-                    req.future.set_exception(
-                        ValueError("model is shutting down"))
-                except Exception:
-                    pass
+            if req is not None:
+                req.fail(ValueError("model is shutting down"))
         super().unload()
 
     # -- coalescer worker ----------------------------------------------------

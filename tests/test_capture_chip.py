@@ -1,0 +1,43 @@
+"""CI tier for the one-command chip capture (tools/capture_chip.py).
+
+The capture runs opportunistically inside a green tunnel window; a harness
+bug discovered ON the chip wastes the window (the round-3 failure mode).
+This tier runs the whole orchestration off-chip — every section subprocess,
+the JSON artifact assembly, the per-section isolation — in --smoke mode
+(CPU backend, tiny shapes), so chip-day is measurement only.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_smoke_capture_produces_all_sections(tmp_path):
+    out = tmp_path / "capture.json"
+    proc = subprocess.run(
+        [sys.executable, "tools/capture_chip.py", "--smoke", "--out",
+         str(out)],
+        capture_output=True, text=True, timeout=560, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    status = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert status["ok"] is True
+    data = json.loads(out.read_text())
+    assert set(data["sections"]) == {
+        "chip_bench", "decode_attn", "flash_sweep", "genai_perf"}
+    for name, section in data["sections"].items():
+        assert section["ok"], (name, section.get("error"))
+    # the sections carry the numbers the artifact exists for
+    cb = data["sections"]["chip_bench"]["data"]
+    assert "ms_per_matmul_pipelined" in cb["matmul_bf16"]
+    assert "dispatch_overhead_ms" in cb
+    da = data["sections"]["decode_attn"]["data"]
+    assert da["exactness"]["ok"] is True
+    fs = data["sections"]["flash_sweep"]["data"]
+    assert fs["best"] is not None and fs["exactness"]["ok"] is True
+    gp = data["sections"]["genai_perf"]["data"]
+    assert gp["decoupled_c1"]["errors"] == 0
+    assert gp["sequence_c4"]["errors"] == 0

@@ -47,7 +47,11 @@ def _peak_for(kind: str):
 
 
 def _timed_single_dispatch(fn, *args, iters_inside: int, repeats: int = 5):
-    """Median wall time of one dispatch that runs ``iters_inside`` steps."""
+    """Median wall time of one dispatch that runs ``iters_inside`` steps.
+
+    The shared timing primitive for every chip tool (decode_attn_chip,
+    flash_sweep import it) — methodology changes here change all numbers
+    together, keeping them comparable."""
     fn(*args).block_until_ready()  # compile + warm
     times = []
     for _ in range(repeats):

@@ -30,19 +30,15 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools.chip_bench import _timed_single_dispatch  # noqa: E402
+
 
 def _median_dispatch(fn, *args, steps, repeats=5):
-    fn(*args).block_until_ready()
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        fn(*args).block_until_ready()
-        times.append((time.perf_counter() - t0) / steps)
-    return sorted(times)[len(times) // 2]
+    return _timed_single_dispatch(
+        fn, *args, iters_inside=steps, repeats=repeats)
 
 
 def check_exactness(jnp, np, interpret):
