@@ -17,10 +17,12 @@ use crate::types::{
 
 fn encode_param(value: &ParamValue) -> Vec<u8> {
     let mut w = Writer::new();
+    // oneof members have explicit presence: emit even at the default
+    // (false/0/""), or the entry decodes as "no parameter case set"
     match value {
-        ParamValue::Bool(b) => w.bool(1, *b),
-        ParamValue::Int(i) => w.int64(2, *i),
-        ParamValue::Str(s) => w.string(3, s),
+        ParamValue::Bool(b) => w.bool_always(1, *b),
+        ParamValue::Int(i) => w.int64_always(2, *i),
+        ParamValue::Str(s) => w.string_always(3, s),
         ParamValue::Double(d) => w.fixed64(4, d.to_bits()),
     }
     w.finish().to_vec()

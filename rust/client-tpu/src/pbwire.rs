@@ -70,6 +70,25 @@ impl Writer {
         }
     }
 
+    /// Explicit-presence variants for oneof members (InferParameter):
+    /// proto3 oneof fields serialize even at their default value, unlike
+    /// ordinary singular fields — skipping a `false`/`0`/`""` here frames
+    /// an EMPTY InferParameter, which a strict peer reads as "no case set"
+    /// (caught by the golden wire vectors in tests/vectors/).
+    pub fn bool_always(&mut self, field: u32, v: bool) {
+        self.key(field, WIRE_VARINT);
+        self.varint(u64::from(v));
+    }
+
+    pub fn int64_always(&mut self, field: u32, v: i64) {
+        self.key(field, WIRE_VARINT);
+        self.varint(v as u64);
+    }
+
+    pub fn string_always(&mut self, field: u32, v: &str) {
+        self.bytes_always(field, v.as_bytes());
+    }
+
     pub fn string(&mut self, field: u32, v: &str) {
         if !v.is_empty() {
             self.bytes(field, v.as_bytes());

@@ -1,0 +1,103 @@
+"""Tensor-parallel decode (decoder_lm_tp): tp>1 must serve the decoder_lm
+contract with identical greedy tokens.
+
+The serving-side multi-chip story (VERDICT-r3 #8): the parallel layer
+already proves training math on the virtual mesh; this tier pins that a
+SHARDED decode step behind the sequence API — head-sharded KV caches,
+GSPMD-inserted psums — is token-for-token the single-device model, locally
+and over the wire. Runs on the 8-device virtual CPU mesh (conftest).
+"""
+
+import numpy as np
+import pytest
+
+from client_tpu.models.decoder import TinyDecoderModel
+from client_tpu.models.decoder_tp import TPDecoderModel
+
+
+def _drive(model, seq, prompt, n=6):
+    p = {"sequence_id": seq, "sequence_start": True, "sequence_end": False}
+    out = model.execute({"TOKENS": np.array([prompt], np.int32)}, p)
+    tok = int(out["NEXT_TOKEN"][0, 0])
+    toks = [tok]
+    for i in range(n - 1):
+        p = {"sequence_id": seq, "sequence_start": False,
+             "sequence_end": i == n - 2}
+        out = model.execute({"TOKENS": np.array([[tok]], np.int32)}, p)
+        tok = int(out["NEXT_TOKEN"][0, 0])
+        toks.append(tok)
+    return toks
+
+
+def test_tp_matches_single_device():
+    ref = TinyDecoderModel(seed=0)
+    tp = TPDecoderModel(seed=0, tp=4)
+    assert tp.tp_degree == 4
+    for seq, prompt in ((1, [1, 2, 3]), (2, [42]), (3, [9, 8, 7, 6])):
+        assert _drive(tp, seq, prompt) == _drive(ref, seq, prompt), seq
+    assert tp.live_sequences() == 0
+
+
+def test_tp2_matches_single_device():
+    ref = TinyDecoderModel(seed=0)
+    tp = TPDecoderModel(seed=0, tp=2)
+    assert _drive(tp, 5, [3, 1]) == _drive(ref, 5, [3, 1])
+
+
+def test_tp_concurrent_sequences():
+    import threading
+
+    ref = TinyDecoderModel(seed=0)
+    tp = TPDecoderModel(seed=0, tp=4)
+    prompts = {11: [1, 2, 3], 12: [7], 13: [5, 6]}
+    expected = {s: _drive(ref, s, p) for s, p in prompts.items()}
+    results, errors = {}, []
+
+    def worker(s, p):
+        try:
+            results[s] = _drive(tp, s, p)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s, p))
+               for s, p in prompts.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert results == expected
+    assert tp.live_sequences() == 0
+
+
+def test_heads_must_divide_axis():
+    with pytest.raises(ValueError, match="not divisible"):
+        TPDecoderModel(seed=0, tp=3)._ensure_built()
+
+
+def test_served_over_grpc_sequence_api():
+    """tp=4 decode driven over the wire: the multi-chip serving path."""
+    import client_tpu.grpc as grpcclient
+    from client_tpu.server import GrpcInferenceServer, ServerCore
+
+    ref = TinyDecoderModel(seed=0)
+    tp = TPDecoderModel(seed=0, tp=4)
+    with GrpcInferenceServer(ServerCore([tp])) as server:
+        client = grpcclient.InferenceServerClient(server.url)
+        try:
+            toks, tok = [], None
+            for i in range(5):
+                arr = (np.array([[1, 2, 3]], np.int32) if i == 0
+                       else np.array([[tok]], np.int32))
+                inp = grpcclient.InferInput("TOKENS", list(arr.shape),
+                                            "INT32")
+                inp.set_data_from_numpy(arr)
+                res = client.infer(
+                    "decoder_lm_tp", [inp], sequence_id=77,
+                    sequence_start=(i == 0), sequence_end=(i == 4))
+                tok = int(res.as_numpy("NEXT_TOKEN")[0, 0])
+                toks.append(tok)
+        finally:
+            client.close()
+    assert toks == _drive(ref, 77, [1, 2, 3], n=5)
+    assert tp.live_sequences() == 0

@@ -180,3 +180,41 @@ def test_rust_wire_codec_matches_python_fields():
     assert "w.submessage(5, &t.finish())" in source
     assert "w.submessage(6, &t.finish())" in source
     assert "w.bytes_always(7, &input.raw)" in source
+
+
+# ---------------------------------------------------------------------------
+# golden wire vectors (VERDICT-r3 #6)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_vectors_match_python_codec():
+    """The committed golden vectors in rust/client-tpu/tests/vectors/ and
+    java/src/test/resources/ must be byte-identical to what the Python
+    codec generates NOW — so the vectors the first real cargo/JDK run will
+    validate against can never silently drift from the living protocol."""
+    import sys as _sys
+
+    _sys.path.insert(0, str(REPO / "tools"))
+    import gen_wire_vectors
+
+    for rel, data in gen_wire_vectors.generate().items():
+        path = REPO / rel
+        assert path.exists(), f"{rel} missing; run tools/gen_wire_vectors.py"
+        assert path.read_bytes() == data, (
+            f"{rel} drifted from the Python codec; "
+            "re-run tools/gen_wire_vectors.py")
+
+
+def test_wire_vector_consumers_reference_vectors():
+    """The polyglot test sources must actually consume the vector files
+    (golden vectors that nothing reads are dead weight)."""
+    rust_test = (REPO / "rust/client-tpu/tests/wire_vectors.rs").read_text()
+    for vec in ("infer_request.hex", "shm_infer_request.hex",
+                "infer_response.hex"):
+        assert vec in rust_test, vec
+    java_test = (
+        REPO / "java/src/test/java/client_tpu/WireVectorsTest.java"
+    ).read_text()
+    for vec in ("infer_request_body.bin", "infer_response_body.bin",
+                "wire_vectors_meta.json"):
+        assert vec in java_test, vec

@@ -36,11 +36,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.chip_bench import _timed_single_dispatch  # noqa: E402
 
 
-def _median_dispatch(fn, *args, steps, repeats=5):
-    return _timed_single_dispatch(
-        fn, *args, iters_inside=steps, repeats=repeats)
-
-
 def check_exactness(jnp, np, interpret):
     from client_tpu.ops.decode_attention import (
         decode_attention,
@@ -123,7 +118,7 @@ def bench_latency(jax, jnp, np, interpret, small):
 
             return jax.lax.fori_loop(0, steps, body, jnp.float32(0))
 
-        return _median_dispatch(chained, q, k, v, pos, steps=steps)
+        return _timed_single_dispatch(chained, q, k, v, pos, iters_inside=steps)
 
     rows = []
     for batch, heads, max_len, dim, fills, steps in grid:

@@ -33,11 +33,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.chip_bench import _peak_for, _timed_single_dispatch  # noqa: E402
 
 
-def _median_dispatch(fn, *args, steps, repeats=5):
-    return _timed_single_dispatch(
-        fn, *args, iters_inside=steps, repeats=repeats)
-
-
 def sweep(jax, jnp, np, interpret, small):
     from client_tpu.ops.flash_attention import flash_attention
 
@@ -75,7 +70,7 @@ def sweep(jax, jnp, np, interpret, small):
 
                 return jax.lax.fori_loop(0, steps, body, jnp.float32(0))
 
-            dt = _median_dispatch(jax.jit(chained), q, k, v, steps=steps)
+            dt = _timed_single_dispatch(jax.jit(chained), q, k, v, iters_inside=steps)
             row["ms_per_call"] = round(dt * 1000, 3)
             row["tflops"] = round(flops / dt / 1e12, 2)
         except Exception as e:
