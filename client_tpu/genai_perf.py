@@ -269,6 +269,12 @@ class GenAiPerfRunner:
 
         ok = [s for s in done if s.error is None and s.first is not None]
         errors = [s for s in done if s.error is not None]
+        # error-free sessions that streamed zero tokens: neither completed
+        # nor errored — dropping them from both buckets silently
+        # undercounted (they break the tokens-received contract, so they
+        # count toward the nonzero exit the same way errors do)
+        incomplete = [s for s in done
+                      if s.error is None and s.first is None]
         ttft_ms = [(s.first - s.start) * 1e3 for s in ok]
         e2e_ms = [(s.last - s.start) * 1e3 for s in ok]
         itl_ms: List[float] = []
@@ -282,6 +288,7 @@ class GenAiPerfRunner:
             "concurrency": concurrency,
             "sessions": len(ok),
             "errors": len(errors),
+            "incomplete": len(incomplete),
             "error_sample": errors[0].error if errors else None,
             "prompt_tokens": self.prompt_tokens,
             "output_tokens": self.output_tokens,

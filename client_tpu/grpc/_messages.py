@@ -237,6 +237,9 @@ INFER_STATISTICS = MessageSpec(
         message("compute_output", 6, STATISTIC_DURATION),
         message("cache_hit", 7, STATISTIC_DURATION),
         message("cache_miss", 8, STATISTIC_DURATION),
+        # extension past the reference protocol: client-abandoned requests
+        # (neither success nor fail; see server/core.py record_cancel)
+        message("cancel", 9, STATISTIC_DURATION),
     ],
 )
 

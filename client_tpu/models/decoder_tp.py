@@ -66,7 +66,13 @@ class TPDecoderModel(TinyDecoderModel):
             import numpy as np
 
             devices = jax.devices()
-            tp = self._tp or min(len(devices), self.HEADS)
+            if self._tp:
+                tp = self._tp
+            else:
+                # auto: the largest divisor of HEADS that fits the host —
+                # a 3-device host serves tp=2, not a divisibility error
+                tp = max(d for d in range(1, self.HEADS + 1)
+                         if self.HEADS % d == 0 and d <= len(devices))
             if tp > len(devices):
                 raise ValueError(
                     f"tp={tp} but only {len(devices)} devices")

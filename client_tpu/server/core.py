@@ -171,6 +171,9 @@ class _ModelStats:
         self.last_inference = 0
         self.success = [0, 0]  # count, ns
         self.fail = [0, 0]
+        # client cancel/disconnect mid-stream: neither a success nor a
+        # model failure (reference tracks cancelled requests separately)
+        self.cancel = [0, 0]
         self.compute_infer = [0, 0]
         self.queue = [0, 0]
         self.batches: Dict[int, List[int]] = {}  # batch_size -> [count, ns]
@@ -194,6 +197,12 @@ class _ModelStats:
             else:
                 self.fail[0] += 1
                 self.fail[1] += total_ns
+
+    def record_cancel(self, total_ns: int) -> None:
+        with self.lock:
+            self.cancel[0] += 1
+            self.cancel[1] += total_ns
+            self.last_inference = int(time.time() * 1000)
 
     def record_batch(self, batch_size: int, exec_ns: int, queue_ns: int,
                      n_requests: int) -> None:
@@ -222,6 +231,8 @@ class _ModelStats:
                 "inference_stats": {
                     "success": {"count": self.success[0], "ns": self.success[1]},
                     "fail": {"count": self.fail[0], "ns": self.fail[1]},
+                    "cancel": {"count": self.cancel[0],
+                               "ns": self.cancel[1]},
                     "queue": {"count": self.queue[0], "ns": self.queue[1]},
                     "compute_input": {"count": 0, "ns": 0},
                     "compute_infer": {
@@ -639,8 +650,10 @@ class ServerCore:
                 yield self._build_response(model, model_version, request, raw)
         except GeneratorExit:
             # consumer went away mid-stream (client cancel/disconnect):
-            # count what ran, close the model generator via the raise
-            record(True, time.perf_counter_ns() - t_infer)
+            # a separate cancel bucket — counting it as success made
+            # abandonment indistinguishable from completed generations
+            self._stats[model_name].record_cancel(
+                time.perf_counter_ns() - t0)
             raise
         except InferError:
             record(False, 0)

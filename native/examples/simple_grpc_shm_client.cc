@@ -1,0 +1,185 @@
+// Full system shared-memory lifecycle over GRPC — the zero-copy data
+// plane a user graduates to after simple_grpc_infer_client. Role parity
+// with the reference's src/c++/examples/simple_grpc_shm_client.cc
+// (create → register → place tensors in the region → infer with NO tensor
+// bytes on the wire → read outputs straight from the region → unregister →
+// unlink; .py:90-183 is the matching Python walk-through).
+//
+// Run:   simple_grpc_shm_client [-u host:port] [-v]
+//        (default URL from $CLIENT_TPU_TEST_GRPC_URL, else 127.0.0.1:8001)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "client_tpu/common.h"
+#include "client_tpu/grpc_client.h"
+#include "client_tpu/shm_utils.h"
+
+namespace tc = client_tpu;
+
+#define FAIL_IF_ERR(X, MSG)                                        \
+  do {                                                             \
+    const tc::Error err = (X);                                     \
+    if (!err.IsOk()) {                                             \
+      std::cerr << "error: " << (MSG) << ": " << err.Message() << std::endl; \
+      return 1;                                                    \
+    }                                                              \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "127.0.0.1:8001";
+  if (const char* env = std::getenv("CLIENT_TPU_TEST_GRPC_URL")) {
+    url = env;
+  }
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-u") == 0 && i + 1 < argc) {
+      url = argv[++i];
+    } else if (std::strcmp(argv[i], "-v") == 0) {
+      verbose = true;
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+  constexpr size_t kInputBytes = 2 * kTensorBytes;   // INPUT0 + INPUT1
+  constexpr size_t kOutputBytes = 2 * kTensorBytes;  // OUTPUT0 + OUTPUT1
+  const std::string in_key = "/simple_grpc_shm_example_in";
+  const std::string out_key = "/simple_grpc_shm_example_out";
+
+  // a fresh run must not inherit a stale region from a crashed one
+  (void)tc::UnlinkSharedMemoryRegion(in_key);
+  (void)tc::UnlinkSharedMemoryRegion(out_key);
+
+  // create + map both regions
+  int in_fd = -1;
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(in_key, kInputBytes, &in_fd),
+      "creating input region");
+  void* in_addr = nullptr;
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(in_fd, 0, kInputBytes, &in_addr),
+      "mapping input region");
+  int out_fd = -1;
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(out_key, kOutputBytes, &out_fd),
+      "creating output region");
+  void* out_addr = nullptr;
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(out_fd, 0, kOutputBytes, &out_addr),
+      "mapping output region");
+
+  // tensor data goes INTO the region, not the request
+  int32_t* in_region = reinterpret_cast<int32_t*>(in_addr);
+  for (int i = 0; i < 16; ++i) {
+    in_region[i] = i;       // INPUT0 at offset 0
+    in_region[16 + i] = 1;  // INPUT1 at offset kTensorBytes
+  }
+
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "example_input_region", in_key, kInputBytes),
+      "registering input region");
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "example_output_region", out_key, kOutputBytes),
+      "registering output region");
+  tc::Json status;
+  FAIL_IF_ERR(client->SystemSharedMemoryStatus(&status), "shm status");
+
+  // inputs/outputs carry only {region, byte_size, offset}
+  std::vector<int64_t> shape{1, 16};
+  tc::InferInput* input0_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0_raw, "INPUT0", shape, "INT32"),
+      "creating INPUT0");
+  std::unique_ptr<tc::InferInput> input0(input0_raw);
+  FAIL_IF_ERR(
+      input0->SetSharedMemory("example_input_region", kTensorBytes, 0),
+      "INPUT0 shm placement");
+  tc::InferInput* input1_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1_raw, "INPUT1", shape, "INT32"),
+      "creating INPUT1");
+  std::unique_ptr<tc::InferInput> input1(input1_raw);
+  FAIL_IF_ERR(
+      input1->SetSharedMemory(
+          "example_input_region", kTensorBytes, kTensorBytes),
+      "INPUT1 shm placement");
+
+  tc::InferRequestedOutput* output0_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0_raw, "OUTPUT0"),
+      "creating OUTPUT0");
+  std::unique_ptr<tc::InferRequestedOutput> output0(output0_raw);
+  FAIL_IF_ERR(
+      output0->SetSharedMemory("example_output_region", kTensorBytes, 0),
+      "OUTPUT0 shm placement");
+  tc::InferRequestedOutput* output1_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output1_raw, "OUTPUT1"),
+      "creating OUTPUT1");
+  std::unique_ptr<tc::InferRequestedOutput> output1(output1_raw);
+  FAIL_IF_ERR(
+      output1->SetSharedMemory(
+          "example_output_region", kTensorBytes, kTensorBytes),
+      "OUTPUT1 shm placement");
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result_raw = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(
+          &result_raw, options, {input0.get(), input1.get()},
+          {output0.get(), output1.get()}),
+      "running inference");
+  std::unique_ptr<tc::InferResult> result(result_raw);
+  FAIL_IF_ERR(result->RequestStatus(), "inference response status");
+
+  // outputs are read from the REGION; the response carried no bytes
+  const int32_t* out_region = reinterpret_cast<const int32_t*>(out_addr);
+  int rc = 0;
+  for (int i = 0; i < 16; ++i) {
+    const int32_t sum = out_region[i];
+    const int32_t diff = out_region[16 + i];
+    if (sum != in_region[i] + in_region[16 + i] ||
+        diff != in_region[i] - in_region[16 + i]) {
+      std::cerr << "error: wrong shm result at " << i << ": " << sum << ", "
+                << diff << std::endl;
+      rc = 1;
+      break;
+    }
+    std::cout << in_region[i] << " + " << in_region[16 + i] << " = " << sum
+              << "   " << in_region[i] << " - " << in_region[16 + i] << " = "
+              << diff << std::endl;
+  }
+
+  // teardown mirrors setup exactly: unregister, unmap, unlink
+  FAIL_IF_ERR(
+      client->UnregisterSystemSharedMemory("example_input_region"),
+      "unregistering input region");
+  FAIL_IF_ERR(
+      client->UnregisterSystemSharedMemory("example_output_region"),
+      "unregistering output region");
+  FAIL_IF_ERR(tc::UnmapSharedMemory(in_addr, kInputBytes), "unmap input");
+  FAIL_IF_ERR(tc::UnmapSharedMemory(out_addr, kOutputBytes), "unmap output");
+  FAIL_IF_ERR(tc::CloseSharedMemory(in_fd), "close input fd");
+  FAIL_IF_ERR(tc::CloseSharedMemory(out_fd), "close output fd");
+  FAIL_IF_ERR(tc::UnlinkSharedMemoryRegion(in_key), "unlink input");
+  FAIL_IF_ERR(tc::UnlinkSharedMemoryRegion(out_key), "unlink output");
+
+  if (rc == 0) {
+    std::cout << "PASS : simple_grpc_shm_client" << std::endl;
+  }
+  return rc;
+}

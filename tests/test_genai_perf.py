@@ -175,9 +175,20 @@ def test_infer_stream_is_incremental():
     assert len(emitted) == 2
     gen.close()  # abandon mid-stream: no further tokens computed
     assert len(emitted) == 2
-    # stats recorded exactly once, as a completed request
+    # an abandoned stream lands in the cancel bucket, NOT success —
+    # cancellations must be distinguishable from completed generations
     stats = core.statistics("tiny_lm_generate", "")["model_stats"][0]
-    assert stats["inference_count"] == 1
+    assert stats["inference_stats"]["cancel"]["count"] == 1
+    assert stats["inference_stats"]["success"]["count"] == 0
+    assert stats["inference_count"] == 0
+
+    # a stream consumed to completion still counts as success
+    for _ in core.infer_stream(
+            "tiny_lm_generate", "", _gen_request([4, 5], max_tokens=3)):
+        pass
+    stats = core.statistics("tiny_lm_generate", "")["model_stats"][0]
+    assert stats["inference_stats"]["success"]["count"] == 1
+    assert stats["inference_stats"]["cancel"]["count"] == 1
 
 
 def test_infer_stream_nondecoupled_passthrough(core):
