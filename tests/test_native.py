@@ -643,3 +643,23 @@ def test_native_example_programs(grpc_server, binary):
     assert f"PASS : {binary}" in proc.stdout
     # examples verify their own math; spot-check one line anyway
     assert "0 + 1 = 1" in proc.stdout
+
+
+def test_dual_protocol_typed_suite(server, grpc_server):
+    """ONE suite body over both native clients (reference
+    INSTANTIATE_TYPED_TEST_SUITE_P role): symmetry is enforced at compile
+    time; this runs the instantiations against the live server."""
+    path = BUILD / "dual_client_test"
+    assert path.exists(), "dual_client_test not built"
+    proc = subprocess.run(
+        [str(path)], capture_output=True, text=True, timeout=180,
+        env={
+            **os.environ,
+            "CLIENT_TPU_TEST_URL": server.url,
+            "CLIENT_TPU_TEST_GRPC_URL": grpc_server.url,
+        },
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+    assert "PASS HTTP/ClientTest" in proc.stdout
+    assert "PASS GRPC/ClientTest" in proc.stdout
