@@ -285,3 +285,86 @@ class InferenceServerClient(InferenceServerClientBase):
         if self._verbose:
             print(result.get_response())
         return result
+
+    # -- generate extension (LLM JSON API) ----------------------------------
+    # Server counterpart: client_tpu/server/http_server_aio.py generate
+    # routes (reference protocol: tritonserver extension_generate — flat
+    # JSON keys map to input tensors; streaming responses arrive as SSE).
+    def _generate_path(
+        self, model_name: str, model_version: str, stream: bool
+    ) -> str:
+        tail = "generate_stream" if stream else "generate"
+        if model_version:
+            return f"v2/models/{quote(model_name)}/versions/{model_version}/{tail}"
+        return f"v2/models/{quote(model_name)}/{tail}"
+
+    @staticmethod
+    def _generate_payload(inputs, request_id, parameters) -> bytes:
+        payload = dict(inputs)
+        if request_id:
+            payload["id"] = request_id
+        if parameters:
+            payload["parameters"] = parameters
+        return json.dumps(payload).encode("utf-8")
+
+    async def generate(
+        self,
+        model_name: str,
+        inputs: Dict[str, Any],
+        model_version: str = "",
+        request_id: str = "",
+        parameters: Optional[Dict[str, Any]] = None,
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """One-shot generate: flat JSON in, flat JSON out (the model must
+        produce exactly one response; decoupled many-response models need
+        :meth:`generate_stream`)."""
+        return await self._post_json(
+            self._generate_path(model_name, model_version, stream=False),
+            self._generate_payload(inputs, request_id, parameters),
+            headers, query_params,
+        )
+
+    async def generate_stream(
+        self,
+        model_name: str,
+        inputs: Dict[str, Any],
+        model_version: str = "",
+        request_id: str = "",
+        parameters: Optional[Dict[str, Any]] = None,
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[Dict[str, Any]] = None,
+    ):
+        """Async iterator over generate-extension SSE events, one dict per
+        streamed response. Abandoning the iterator mid-stream closes the
+        connection, which the server accounts as a client cancel (the
+        cancel stats bucket), not a success. In-band error events raise."""
+        hdrs = dict(headers or {})
+        request = Request(hdrs)
+        self._call_plugin(request)
+        url = f"{self._base}/{self._generate_path(model_name, model_version, stream=True)}"
+        body = self._generate_payload(inputs, request_id, parameters)
+        try:
+            # no total timeout: generation streams for as long as it streams
+            async with self._session.post(
+                url, data=body, headers=request.headers, params=query_params,
+                timeout=aiohttp.ClientTimeout(total=None),
+            ) as resp:
+                if resp.status != 200:
+                    raise_if_error(resp.status, await resp.read())
+                    # 2xx-not-200/3xx from an intermediary: raise_if_error
+                    # is a no-op below 400, and falling through would yield
+                    # an empty stream with no error at all
+                    raise InferenceServerException(
+                        f"unexpected generate_stream status {resp.status}")
+                async for raw_line in resp.content:
+                    line = raw_line.strip()
+                    if not line.startswith(b"data:"):
+                        continue
+                    event = json.loads(line[len(b"data:"):].strip())
+                    if set(event) == {"error"}:
+                        raise InferenceServerException(event["error"])
+                    yield event
+        except aiohttp.ClientError as e:
+            raise InferenceServerException(f"connection error: {e}") from e

@@ -79,12 +79,19 @@ class BatchedDecoderModel(Model):
     stateful = True
 
     def __init__(self, seed: int = 0, slots: int = 8,
-                 max_delay_s: float = 0.002, attention_impl: str = "einsum"):
+                 max_delay_s: float = 0.002, attention_impl: str = "einsum",
+                 idle_ttl_s: float = 300.0):
         super().__init__()
         self._decoder = TinyDecoderModel(seed=seed,
                                          attention_impl=attention_impl)
         self.slots = int(slots)
         self._max_delay_s = max_delay_s
+        # Idle-sequence reaper TTL (reference semantics:
+        # max_sequence_idle_microseconds in tritonserver's sequence
+        # batcher). Must exceed the 120 s caller timeout so a slot whose
+        # window is merely slow is never reclaimed under an in-flight step.
+        self._idle_ttl_s = float(idle_ttl_s)
+        self._last_seen: Dict[Any, float] = {}
         self._lock = threading.Lock()
         self._built = False
         self._queue: "queue.Queue[_SeqRequest]" = queue.Queue(maxsize=1024)
@@ -284,13 +291,32 @@ class BatchedDecoderModel(Model):
                     raise ValueError(
                         f"no free sequence slot (capacity {self.slots}); "
                         "end a sequence first")
+                self._last_seen[req.seq_id] = time.monotonic()
                 return slot
             slot = self._slot_of.get(req.seq_id)
             if slot is None:
                 raise ValueError(
                     f"sequence {req.seq_id} has no live state "
                     "(missing sequence_start?)")
+            self._last_seen[req.seq_id] = time.monotonic()
             return slot
+
+    def _reap_idle(self, exclude) -> None:
+        """Free slots whose sequence has been idle past the TTL.
+
+        Covers the 120 s-timeout abandonment path: a client that times out
+        mid-sequence and walks away would otherwise hold one of ``slots``
+        forever (only a same-id restart or unload reclaimed it). Sequences
+        with a request in the current window or carried for the next round
+        are excluded — they are active by definition.
+        """
+        now = time.monotonic()
+        with self._lock:
+            for seq_id, last in list(self._last_seen.items()):
+                if seq_id in exclude:
+                    continue
+                if now - last > self._idle_ttl_s:
+                    self._free_slot(seq_id)
 
     def _run(self) -> None:
         while True:
@@ -306,6 +332,12 @@ class BatchedDecoderModel(Model):
 
     def _run_window(self, window: List[_SeqRequest]) -> None:
         import jax.numpy as jnp
+
+        # reap BEFORE admitting so a full house of abandoned sequences
+        # frees up for this window's sequence_start requests
+        self._reap_idle(
+            exclude={req.seq_id for req in window}
+            | {r.seq_id for r in self._carry})
 
         dec = self._decoder
         active_reqs: List[tuple] = []  # (req, slot)
@@ -377,5 +409,6 @@ class BatchedDecoderModel(Model):
 
     def _free_slot(self, seq_id) -> None:
         slot = self._slot_of.pop(seq_id, None)
+        self._last_seen.pop(seq_id, None)
         if slot is not None:
             self._free.append(slot)

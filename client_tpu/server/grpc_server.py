@@ -267,11 +267,23 @@ class _Handlers(grpc.GenericRpcHandler):
                 # incremental: each decoupled response hits the wire as the
                 # model yields it (true streaming TTFT), not after the full
                 # generation materializes
-                for resp in self._core.infer_stream(
+                stream = self._core.infer_stream(
                     model_name, request.get("model_version", ""), core_req
-                ):
-                    final = (want_final and not model.decoupled) or None
-                    yield {"infer_response": _encode_core_response(resp, final=final)}
+                )
+                try:
+                    for resp in stream:
+                        # with the empty-final opt-in, EVERY response carries
+                        # an explicit triton_final_response (false on
+                        # decoupled intermediates — reference semantics;
+                        # clients may default absent to final, so omission
+                        # is not a safe "not final")
+                        final = (not model.decoupled) if want_final else None
+                        yield {"infer_response": _encode_core_response(resp, final=final)}
+                finally:
+                    # a client cancel closes THIS generator at the yield
+                    # above; close the core stream eagerly (not at GC) so
+                    # the cancel bucket is recorded before the RPC unwinds
+                    stream.close()
                 if want_final and model.decoupled:
                     empty: Dict[str, Any] = {
                         "model_name": model_name,

@@ -9,6 +9,7 @@ frontend.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -18,6 +19,93 @@ from aiohttp import web
 
 from .core import InferError, ServerCore
 from .http_server import _FAMILY, encode_infer_response, parse_infer_request
+
+
+def _generate_core_request(model, payload: Any) -> dict:
+    """Map a generate-extension JSON payload onto a core infer request.
+
+    Reference protocol (tritonserver's HTTP generate extension,
+    docs/protocol/extension_generate.md): 'id' and 'parameters' are
+    reserved; every other key names an input tensor whose value is a JSON
+    scalar or (nested) list. Shapes are conformed to the model's metadata
+    by prepending singleton dims ([1,2,3] -> [1,3] for an INT32[1,-1]
+    input), the KServe analog of the reference's flat-JSON mapping.
+    """
+    import numpy as np
+
+    from ..utils import triton_to_np_dtype
+
+    if not isinstance(payload, dict):
+        raise InferError("generate request must be a JSON object", 400)
+    specs = {s.name: s for s in model.inputs()}
+    params = payload.get("parameters", {})
+    if not isinstance(params, dict):
+        raise InferError("generate 'parameters' must be an object", 400)
+    req: dict = {"inputs": [], "parameters": dict(params)}
+    if payload.get("id"):
+        req["id"] = str(payload["id"])
+    for key, value in payload.items():
+        if key in ("id", "parameters"):
+            continue
+        spec = specs.get(key)
+        if spec is None:
+            raise InferError(
+                f"unexpected generate input '{key}' for model "
+                f"'{model.name}'", 400)
+        if spec.datatype == "BYTES":
+            shaped = np.asarray(value, dtype=object)
+            arr = np.array(
+                [v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                 for v in shaped.reshape(-1)],
+                dtype=object).reshape(shaped.shape)
+        else:
+            try:
+                arr = np.asarray(value, dtype=triton_to_np_dtype(spec.datatype))
+            except (TypeError, ValueError) as e:
+                raise InferError(
+                    f"generate input '{key}' does not parse as "
+                    f"{spec.datatype}: {e}", 400)
+        while arr.ndim < len(spec.shape):
+            arr = arr[np.newaxis, ...]
+        req["inputs"].append({
+            "name": key,
+            "datatype": spec.datatype,
+            "shape": list(arr.shape),
+            "array": arr,
+        })
+    return req
+
+
+def _generate_event(resp: dict) -> dict:
+    """Flatten one core response into the generate extension's JSON shape:
+    metadata keys plus one flat key per output tensor (scalar when the
+    tensor has a single element)."""
+    import numpy as np
+
+    out: dict = {
+        "model_name": resp["model_name"],
+        "model_version": resp["model_version"],
+    }
+    if resp.get("id"):
+        out["id"] = resp["id"]
+    for entry in resp["outputs"]:
+        arr = entry["array"]
+        if entry["datatype"] == "BYTES":
+            values = [
+                v.decode("utf-8", "replace")
+                if isinstance(v, (bytes, np.bytes_)) else str(v)
+                for v in np.asarray(arr, dtype=object).reshape(-1)
+            ]
+        else:
+            values = np.asarray(arr, dtype=np.float32).reshape(-1).tolist() \
+                if entry["datatype"] == "BF16" \
+                else np.asarray(arr).reshape(-1).tolist()
+        out[entry["name"]] = values[0] if len(values) == 1 else values
+    return out
+
+
+def _sse_event(obj: Any) -> bytes:
+    return b"data: " + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n"
 
 
 def _json_response(obj: Any, status: int = 200) -> web.Response:
@@ -143,6 +231,114 @@ class AioHttpInferenceServer:
         )
         r.add_post("/v2/models/{name}/infer", infer_route)
         r.add_post("/v2/models/{name}/versions/{version}/infer", infer_route)
+
+        # -- generate extension (reference: tritonserver's HTTP
+        # extension_generate; the LLM-serving JSON API genai-perf drives) --
+        async def generate_route(request):
+            name = request.match_info["name"]
+            version = request.match_info.get("version", "")
+            try:
+                payload = await request.json()
+                core_req = _generate_core_request(
+                    core.model(name, version), payload)
+                loop = asyncio.get_running_loop()
+
+                def run():
+                    # pull at most TWO responses: a second yield already
+                    # proves this generation belongs on /generate_stream,
+                    # and closing there (rather than list()-ing a possibly
+                    # minutes-long generation to throw it away) frees the
+                    # model and the worker thread immediately
+                    gen = core.infer_stream(name, version, core_req)
+                    try:
+                        return list(itertools.islice(gen, 2))
+                    finally:
+                        gen.close()
+
+                responses = await loop.run_in_executor(self._executor, run)
+            except Exception as e:
+                return _error_response(e)
+            if len(responses) != 1:
+                return _json_response(
+                    {"error": f"generate expects exactly one response but "
+                              f"model '{name}' produced more; "
+                              f"use /generate_stream"}, 400)
+            return _json_response(_generate_event(responses[0]))
+
+        async def generate_stream_route(request):
+            name = request.match_info["name"]
+            version = request.match_info.get("version", "")
+            loop = asyncio.get_running_loop()
+            sentinel = object()
+            try:
+                payload = await request.json()
+                core_req = _generate_core_request(
+                    core.model(name, version), payload)
+            except Exception as e:
+                return _error_response(e)
+            gen = core.infer_stream(name, version, core_req)
+            fut = None
+
+            def _close_gen():
+                try:
+                    gen.close()
+                except Exception:
+                    pass
+
+            # From here every exit path — including a disconnect while the
+            # FIRST response is still computing, or a failed prepare() —
+            # runs the finally below, so the model's GeneratorExit path
+            # (cancel stats bucket) fires eagerly rather than at GC.
+            try:
+                fut = loop.run_in_executor(
+                    self._executor, next, gen, sentinel)
+                try:
+                    # shield: a client disconnect must not cancel the
+                    # worker mid-frame (close() on an executing generator
+                    # raises); the finally sequences close after the frame
+                    first = await asyncio.shield(fut)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # request-level failure surfaces as an HTTP status,
+                    # not an in-band event (mid-stream failures below ARE
+                    # in-band)
+                    return _error_response(e)
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream",
+                             "Cache-Control": "no-cache"})
+                await resp.prepare(request)
+                item = first
+                while item is not sentinel:
+                    await resp.write(_sse_event(_generate_event(item)))
+                    fut = loop.run_in_executor(
+                        self._executor, next, gen, sentinel)
+                    try:
+                        item = await asyncio.shield(fut)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        await resp.write(_sse_event({"error": str(e)}))
+                        break
+                await resp.write_eof()
+                return resp
+            finally:
+                if fut is not None and not fut.done():
+                    def _on_done(f):
+                        if not f.cancelled():
+                            f.exception()  # retrieve, silencing the warning
+                        self._executor.submit(_close_gen)
+                    fut.add_done_callback(_on_done)
+                else:
+                    self._executor.submit(_close_gen)
+
+        r.add_post("/v2/models/{name}/generate", generate_route)
+        r.add_post(
+            "/v2/models/{name}/versions/{version}/generate", generate_route)
+        r.add_post("/v2/models/{name}/generate_stream", generate_stream_route)
+        r.add_post(
+            "/v2/models/{name}/versions/{version}/generate_stream",
+            generate_stream_route)
 
         async def repo_index(request):
             return _json_response(core.repository_index())

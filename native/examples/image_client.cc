@@ -1,0 +1,325 @@
+// Metadata-driven image classification application — the "full program"
+// native example. Role parity with the reference's
+// src/c++/examples/image_client.cc:60-510: interrogate the model's
+// metadata to learn its input name/shape/datatype, preprocess an image
+// client-side to match (resize + scaling + CHW layout), request the
+// output with the classification extension, and print ranked
+// "value (index) = label" lines. Where the reference links OpenCV, this
+// reads binary PPM (P6) — no dependency — and synthesizes a
+// deterministic test image when no file is given so the example doubles
+// as a smoke test (SURVEY §4 tier 3).
+//
+// Build: part of the normal native build (cmake -S native -B native/build).
+// Run:   image_client [-u host:port] [-m model] [-c topk]
+//                     [-s NONE|INCEPTION] [image.ppm]
+//        (default URL from $CLIENT_TPU_TEST_GRPC_URL, else 127.0.0.1:8001)
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "client_tpu/common.h"
+#include "client_tpu/grpc_client.h"
+#include "client_tpu/json.h"
+
+namespace tc = client_tpu;
+
+#define FAIL_IF_ERR(X, MSG)                                                  \
+  do {                                                                       \
+    const tc::Error err = (X);                                               \
+    if (!err.IsOk()) {                                                       \
+      std::cerr << "error: " << (MSG) << ": " << err.Message() << std::endl; \
+      return 1;                                                              \
+    }                                                                        \
+  } while (false)
+
+namespace {
+
+struct Image {
+  int width = 0;
+  int height = 0;
+  std::vector<uint8_t> rgb;  // HWC, 3 channels
+};
+
+// Binary PPM (P6) loader: header tokens (magic, width, height, maxval,
+// '#' comments allowed) followed by raw RGB triplets.
+bool
+LoadPpm(const std::string& path, Image* img, std::string* error)
+{
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    *error = "cannot open " + path;
+    return false;
+  }
+  auto next_token = [&f]() -> std::string {
+    std::string token;
+    int c;
+    while ((c = f.get()) != EOF) {
+      if (c == '#') {  // comment to end of line
+        while ((c = f.get()) != EOF && c != '\n') {
+        }
+        continue;
+      }
+      if (std::isspace(c)) {
+        if (!token.empty()) {
+          break;
+        }
+        continue;
+      }
+      token.push_back(static_cast<char>(c));
+    }
+    return token;
+  };
+  if (next_token() != "P6") {
+    *error = path + " is not a binary PPM (P6)";
+    return false;
+  }
+  img->width = std::atoi(next_token().c_str());
+  img->height = std::atoi(next_token().c_str());
+  const int maxval = std::atoi(next_token().c_str());
+  if (img->width <= 0 || img->height <= 0 || maxval != 255) {
+    *error = "unsupported PPM geometry/maxval in " + path;
+    return false;
+  }
+  img->rgb.resize(static_cast<size_t>(img->width) * img->height * 3);
+  f.read(reinterpret_cast<char*>(img->rgb.data()),
+         static_cast<std::streamsize>(img->rgb.size()));
+  if (static_cast<size_t>(f.gcount()) != img->rgb.size()) {
+    *error = "truncated pixel data in " + path;
+    return false;
+  }
+  return true;
+}
+
+// Deterministic stand-in when no image file is supplied: a smooth RGB
+// gradient, so runs are reproducible and CI needs no fixture file.
+Image
+SyntheticImage(int width = 64, int height = 64)
+{
+  Image img;
+  img.width = width;
+  img.height = height;
+  img.rgb.resize(static_cast<size_t>(width) * height * 3);
+  for (int y = 0; y < height; ++y) {
+    for (int x = 0; x < width; ++x) {
+      uint8_t* px = &img.rgb[(static_cast<size_t>(y) * width + x) * 3];
+      px[0] = static_cast<uint8_t>((x * 255) / (width - 1));
+      px[1] = static_cast<uint8_t>((y * 255) / (height - 1));
+      px[2] = static_cast<uint8_t>(((x + y) * 255) / (width + height - 2));
+    }
+  }
+  return img;
+}
+
+// Nearest-neighbor resize + scaling + CHW layout, mirroring the server's
+// preprocess model (client_tpu/models/vision.py ImagePreprocessModel) so
+// either side of the pipeline produces the same tensor.
+std::vector<float>
+Preprocess(
+    const Image& img, int out_h, int out_w, const std::string& scaling)
+{
+  std::vector<float> chw(static_cast<size_t>(3) * out_h * out_w);
+  const float scale = scaling == "INCEPTION" ? 2.0f / 255.0f : 1.0f;
+  const float shift = scaling == "INCEPTION" ? -1.0f : 0.0f;
+  for (int y = 0; y < out_h; ++y) {
+    const int src_y = y * img.height / out_h;
+    for (int x = 0; x < out_w; ++x) {
+      const int src_x = x * img.width / out_w;
+      const uint8_t* px =
+          &img.rgb[(static_cast<size_t>(src_y) * img.width + src_x) * 3];
+      for (int c = 0; c < 3; ++c) {
+        chw[(static_cast<size_t>(c) * out_h + y) * out_w + x] =
+            px[c] * scale + shift;
+      }
+    }
+  }
+  return chw;
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "127.0.0.1:8001";
+  if (const char* env = std::getenv("CLIENT_TPU_TEST_GRPC_URL")) {
+    url = env;
+  }
+  std::string model_name = "densenet_onnx";
+  std::string scaling = "INCEPTION";
+  std::string image_path;
+  int topk = 3;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-u") == 0 && i + 1 < argc) {
+      url = argv[++i];
+    } else if (std::strcmp(argv[i], "-m") == 0 && i + 1 < argc) {
+      model_name = argv[++i];
+    } else if (std::strcmp(argv[i], "-c") == 0 && i + 1 < argc) {
+      topk = std::atoi(argv[++i]);
+    } else if (std::strcmp(argv[i], "-s") == 0 && i + 1 < argc) {
+      scaling = argv[++i];
+    } else if (argv[i][0] != '-') {
+      image_path = argv[i];
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url),
+      "unable to create grpc client");
+
+  // -- interrogate the model: everything below is driven by metadata ----
+  bool model_ready = false;
+  FAIL_IF_ERR(
+      client->IsModelReady(&model_ready, model_name), "model readiness");
+  if (!model_ready) {
+    std::cerr << "error: model " << model_name << " not ready" << std::endl;
+    return 1;
+  }
+  tc::Json metadata;
+  FAIL_IF_ERR(
+      client->ModelMetadata(&metadata, model_name), "model metadata");
+  if (metadata.At("inputs").size() != 1 ||
+      metadata.At("outputs").size() != 1) {
+    std::cerr << "error: image_client expects a single-input single-output "
+              << "model; " << model_name << " has "
+              << metadata.At("inputs").size() << "/"
+              << metadata.At("outputs").size() << std::endl;
+    return 1;
+  }
+  const tc::Json& input_meta = metadata.At("inputs")[0];
+  const tc::Json& output_meta = metadata.At("outputs")[0];
+  const std::string input_name = input_meta.At("name").AsString();
+  const std::string input_dtype = input_meta.At("datatype").AsString();
+  const std::string output_name = output_meta.At("name").AsString();
+  if (input_dtype != "FP32") {
+    std::cerr << "error: expected FP32 image input, got " << input_dtype
+              << std::endl;
+    return 1;
+  }
+  std::vector<int64_t> shape;
+  for (size_t i = 0; i < input_meta.At("shape").size(); ++i) {
+    shape.push_back(input_meta.At("shape")[i].AsInt());
+  }
+  // accept CHW or HWC, with or without a leading batch dim
+  std::vector<int64_t> dims = shape;
+  if (dims.size() == 4) {
+    dims.erase(dims.begin());
+  }
+  if (dims.size() != 3) {
+    std::cerr << "error: unsupported input rank for image model" << std::endl;
+    return 1;
+  }
+  const bool chw = dims[0] == 3;
+  const int height = static_cast<int>(chw ? dims[1] : dims[0]);
+  const int width = static_cast<int>(chw ? dims[2] : dims[1]);
+  if (!chw && dims[2] != 3) {
+    std::cerr << "error: input is neither CHW nor HWC" << std::endl;
+    return 1;
+  }
+
+  // -- load + preprocess ------------------------------------------------
+  Image img;
+  if (image_path.empty()) {
+    img = SyntheticImage();
+    std::cout << "no image file given; using synthetic "
+              << img.width << "x" << img.height << " gradient" << std::endl;
+  } else {
+    std::string error;
+    if (!LoadPpm(image_path, &img, &error)) {
+      std::cerr << "error: " << error << std::endl;
+      return 1;
+    }
+  }
+  std::vector<float> pixels = Preprocess(img, height, width, scaling);
+  if (!chw) {
+    // transpose CHW -> HWC for HWC models
+    std::vector<float> hwc(pixels.size());
+    for (int c = 0; c < 3; ++c) {
+      for (int y = 0; y < height; ++y) {
+        for (int x = 0; x < width; ++x) {
+          hwc[(static_cast<size_t>(y) * width + x) * 3 + c] =
+              pixels[(static_cast<size_t>(c) * height + y) * width + x];
+        }
+      }
+    }
+    pixels.swap(hwc);
+  }
+
+  // -- infer with the classification extension --------------------------
+  tc::InferInput* input_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input_raw, input_name, shape, "FP32"),
+      "creating input");
+  std::unique_ptr<tc::InferInput> input(input_raw);
+  FAIL_IF_ERR(
+      input->AppendRaw(
+          reinterpret_cast<const uint8_t*>(pixels.data()),
+          pixels.size() * sizeof(float)),
+      "setting input data");
+
+  tc::InferRequestedOutput* output_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(
+          &output_raw, output_name, static_cast<size_t>(topk)),
+      "creating requested output");
+  std::unique_ptr<tc::InferRequestedOutput> output(output_raw);
+
+  tc::InferOptions options(model_name);
+  options.request_id = "image-1";
+  tc::InferResult* result_raw = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(&result_raw, options, {input.get()}, {output.get()}),
+      "running inference");
+  std::unique_ptr<tc::InferResult> result(result_raw);
+  FAIL_IF_ERR(result->RequestStatus(), "inference response status");
+
+  // classification responses are BYTES "value:index[:label]" strings
+  std::vector<std::string> classes;
+  FAIL_IF_ERR(result->StringData(output_name, &classes), "classification");
+  if (classes.size() != static_cast<size_t>(topk)) {
+    std::cerr << "error: asked for top-" << topk << ", got "
+              << classes.size() << std::endl;
+    return 1;
+  }
+  std::cout << "Image '" << (image_path.empty() ? "<synthetic>" : image_path)
+            << "':" << std::endl;
+  double prev_value = 0.0;
+  for (size_t i = 0; i < classes.size(); ++i) {
+    const std::string& entry = classes[i];
+    const size_t first = entry.find(':');
+    const size_t second =
+        first == std::string::npos ? std::string::npos
+                                   : entry.find(':', first + 1);
+    if (first == std::string::npos) {
+      std::cerr << "error: malformed classification entry '" << entry << "'"
+                << std::endl;
+      return 1;
+    }
+    const std::string value_str = entry.substr(0, first);
+    const std::string index_str = entry.substr(
+        first + 1,
+        second == std::string::npos ? std::string::npos : second - first - 1);
+    const std::string label =
+        second == std::string::npos ? "" : entry.substr(second + 1);
+    const double value = std::atof(value_str.c_str());
+    if (i > 0 && value > prev_value) {
+      std::cerr << "error: classification not ranked: " << value << " after "
+                << prev_value << std::endl;
+      return 1;
+    }
+    prev_value = value;
+    std::cout << "    " << value_str << " (" << index_str << ")"
+              << (label.empty() ? "" : " = " + label) << std::endl;
+  }
+
+  std::cout << "PASS : image_client" << std::endl;
+  return 0;
+}

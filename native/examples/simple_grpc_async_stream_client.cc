@@ -1,0 +1,178 @@
+// Decoupled LLM generation over bi-di GRPC streaming — the native
+// counterpart of the LLM-streaming Python example. Role parity with the
+// reference's src/c++/examples/simple_grpc_async_infer_client.cc (async
+// requests in flight, completion out of band) composed with its decoupled
+// streaming examples: ONE stream carries the request and N incremental
+// responses (NEXT_TOKEN/INDEX per generated token), the client consumes
+// tokens as they arrive, and a final-response marker ends the exchange.
+//
+// Build: part of the normal native build (cmake -S native -B native/build).
+// Run:   simple_grpc_async_stream_client [-u host:port] [-n max_tokens]
+//        (default URL from $CLIENT_TPU_TEST_GRPC_URL, else 127.0.0.1:8001)
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "client_tpu/common.h"
+#include "client_tpu/grpc_client.h"
+
+namespace tc = client_tpu;
+
+#define FAIL_IF_ERR(X, MSG)                                                  \
+  do {                                                                       \
+    const tc::Error err = (X);                                               \
+    if (!err.IsOk()) {                                                       \
+      std::cerr << "error: " << (MSG) << ": " << err.Message() << std::endl; \
+      return 1;                                                              \
+    }                                                                        \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "127.0.0.1:8001";
+  if (const char* env = std::getenv("CLIENT_TPU_TEST_GRPC_URL")) {
+    url = env;
+  }
+  int32_t max_tokens = 8;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-u") == 0 && i + 1 < argc) {
+      url = argv[++i];
+    } else if (std::strcmp(argv[i], "-n") == 0 && i + 1 < argc) {
+      max_tokens = std::atoi(argv[++i]);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url),
+      "unable to create grpc client");
+
+  // The stream callback runs on the reader thread: collect tokens under a
+  // lock and wake the main thread when the final-response marker lands.
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> tokens;
+  std::vector<int32_t> indexes;
+  bool done = false;
+  std::string stream_error;
+
+  FAIL_IF_ERR(
+      client->StartStream([&](tc::InferResult* result, const tc::Error& err) {
+        std::unique_ptr<tc::InferResult> owned(result);
+        std::lock_guard<std::mutex> lock(mu);
+        if (err) {
+          stream_error = err.Message();
+          done = true;
+          cv.notify_one();
+          return;
+        }
+        if (owned == nullptr) {
+          return;
+        }
+        bool is_final = false;
+        (void)owned->IsFinalResponse(&is_final);
+        bool is_null = false;
+        (void)owned->IsNullResponse(&is_null);
+        if (!is_null) {
+          const uint8_t* buf = nullptr;
+          size_t nbytes = 0;
+          if (!owned->RawData("NEXT_TOKEN", &buf, &nbytes) &&
+              nbytes == sizeof(int32_t)) {
+            int32_t tok;
+            std::memcpy(&tok, buf, sizeof(tok));
+            tokens.push_back(tok);
+          }
+          if (!owned->RawData("INDEX", &buf, &nbytes) &&
+              nbytes == sizeof(int32_t)) {
+            int32_t idx;
+            std::memcpy(&idx, buf, sizeof(idx));
+            indexes.push_back(idx);
+          }
+        }
+        if (is_final) {
+          done = true;
+          cv.notify_one();
+        }
+      }),
+      "starting stream");
+
+  // prompt + generation budget; the decoupled model answers with one
+  // response per generated token on the same stream
+  std::vector<int32_t> prompt{1, 2, 3};
+  tc::InferInput* prompt_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(
+          &prompt_raw, "TOKENS", {1, static_cast<int64_t>(prompt.size())},
+          "INT32"),
+      "creating TOKENS");
+  std::unique_ptr<tc::InferInput> prompt_in(prompt_raw);
+  FAIL_IF_ERR(
+      prompt_in->AppendRaw(
+          reinterpret_cast<const uint8_t*>(prompt.data()),
+          prompt.size() * sizeof(int32_t)),
+      "setting TOKENS");
+
+  tc::InferInput* max_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&max_raw, "MAX_TOKENS", {1}, "INT32"),
+      "creating MAX_TOKENS");
+  std::unique_ptr<tc::InferInput> max_in(max_raw);
+  FAIL_IF_ERR(
+      max_in->AppendRaw(
+          reinterpret_cast<const uint8_t*>(&max_tokens), sizeof(max_tokens)),
+      "setting MAX_TOKENS");
+
+  tc::InferOptions options("tiny_lm_generate");
+  options.request_id = "stream-1";
+  options.enable_empty_final_response = true;
+  FAIL_IF_ERR(
+      client->AsyncStreamInfer(options, {prompt_in.get(), max_in.get()}),
+      "sending stream request");
+
+  {
+    std::unique_lock<std::mutex> lock(mu);
+    if (!cv.wait_for(
+            lock, std::chrono::seconds(60), [&] { return done; })) {
+      std::cerr << "error: stream timed out" << std::endl;
+      return 1;
+    }
+  }
+  FAIL_IF_ERR(client->StopStream(), "stopping stream");
+
+  if (!stream_error.empty()) {
+    std::cerr << "error: stream callback: " << stream_error << std::endl;
+    return 1;
+  }
+  // no END_ID is sent, so generation must run the full budget
+  if (tokens.size() != static_cast<size_t>(max_tokens)) {
+    std::cerr << "error: expected " << max_tokens << " tokens, got "
+              << tokens.size() << std::endl;
+    return 1;
+  }
+  // incremental delivery contract: INDEX is the 0-based position of each
+  // token, so the stream must arrive in order with no gaps
+  for (size_t i = 0; i < indexes.size(); ++i) {
+    if (indexes[i] != static_cast<int32_t>(i)) {
+      std::cerr << "error: response " << i << " carried INDEX " << indexes[i]
+                << std::endl;
+      return 1;
+    }
+  }
+
+  std::cout << "generated " << tokens.size() << " tokens:";
+  for (int32_t tok : tokens) {
+    std::cout << " " << tok;
+  }
+  std::cout << std::endl;
+  std::cout << "PASS : simple_grpc_async_stream_client" << std::endl;
+  return 0;
+}

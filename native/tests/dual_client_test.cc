@@ -144,6 +144,76 @@ RunSuite(const char* suite, const std::string& url)
         "unknown model must fail (result status)");
   }
 
+  // -- requested-output subset (reference cc_client_test.cc:300-420:
+  // explicit outputs restrict the response to exactly that set) ---------
+  std::unique_ptr<tc::InferRequestedOutput> want1;
+  {
+    tc::InferRequestedOutput* raw = nullptr;
+    CHECK_OK(
+        tc::InferRequestedOutput::Create(&raw, "OUTPUT1"),
+        "InferRequestedOutput::Create");
+    want1.reset(raw);
+  }
+  tc::InferResult* sub_raw = nullptr;
+  CHECK_OK(
+      client->Infer(
+          &sub_raw, options, {input0.get(), input1.get()}, {want1.get()}),
+      "Infer subset");
+  std::unique_ptr<tc::InferResult> sub(sub_raw);
+  CHECK_OK(sub->RequestStatus(), "Infer subset status");
+  std::vector<std::string> sub_names;
+  CHECK_OK(sub->OutputNames(&sub_names), "subset OutputNames");
+  CHECK_TRUE(
+      sub_names.size() == 1 && sub_names[0] == "OUTPUT1",
+      "subset must contain exactly OUTPUT1");
+  const uint8_t* diff_buf = nullptr;
+  size_t diff_nbytes = 0;
+  CHECK_OK(sub->RawData("OUTPUT1", &diff_buf, &diff_nbytes), "OUTPUT1 data");
+  CHECK_TRUE(diff_nbytes == 16 * sizeof(int32_t), "OUTPUT1 size");
+  const int32_t* diffs = reinterpret_cast<const int32_t*>(diff_buf);
+  for (int i = 0; i < 16; ++i) {
+    CHECK_TRUE(diffs[i] == in0[i] - in1[i], "OUTPUT1 values");
+  }
+  const uint8_t* absent_buf = nullptr;
+  size_t absent_nbytes = 0;
+  CHECK_TRUE(
+      !sub->RawData("OUTPUT0", &absent_buf, &absent_nbytes).IsOk(),
+      "unrequested OUTPUT0 must not be present");
+
+  // -- request_id roundtrip --------------------------------------------
+  {
+    tc::InferOptions id_options("simple");
+    id_options.request_id = "dual-42";
+    tc::InferResult* id_raw = nullptr;
+    CHECK_OK(
+        client->Infer(&id_raw, id_options, {input0.get(), input1.get()}),
+        "Infer with request_id");
+    std::unique_ptr<tc::InferResult> id_result(id_raw);
+    CHECK_OK(id_result->RequestStatus(), "request_id status");
+    std::string id;
+    CHECK_OK(id_result->Id(&id), "result Id");
+    CHECK_TRUE(id == "dual-42", "request_id must round-trip");
+  }
+
+  // -- shape mismatch is a typed error, not a crash --------------------
+  {
+    std::unique_ptr<tc::InferInput> short_input;
+    CHECK_OK(
+        MakeInt32Input(&short_input, "INPUT0", in0), "mismatch input");
+    // 16 int32 elements but a declared shape of [1, 8]: the server must
+    // reject the request and the client must surface it as Error/status.
+    CHECK_OK(short_input->SetShape({1, 8}), "SetShape");
+    tc::InferResult* mm_raw = nullptr;
+    const tc::Error mm =
+        client->Infer(&mm_raw, options, {short_input.get(), input1.get()});
+    if (mm.IsOk()) {
+      std::unique_ptr<tc::InferResult> mm_result(mm_raw);
+      CHECK_TRUE(
+          !mm_result->RequestStatus().IsOk(),
+          "shape/body mismatch must fail (result status)");
+    }
+  }
+
   // -- InferMulti with option broadcasting -----------------------------
   std::vector<tc::InferResult*> multi_raw;
   CHECK_OK(
@@ -155,6 +225,19 @@ RunSuite(const char* suite, const std::string& url)
   for (tc::InferResult* r : multi_raw) {
     std::unique_ptr<tc::InferResult> owned(r);
     CHECK_OK(owned->RequestStatus(), "InferMulti status");
+  }
+
+  // -- InferMulti broadcast-mismatch is a typed client-side error ------
+  // (2 options for 3 requests is neither broadcast-1 nor match-N)
+  {
+    std::vector<tc::InferResult*> bad_multi;
+    const std::vector<tc::InferInput*> req = {input0.get(), input1.get()};
+    const tc::Error mism = client->InferMulti(
+        &bad_multi, {options, options}, {req, req, req});
+    for (tc::InferResult* r : bad_multi) {
+      delete r;
+    }
+    CHECK_TRUE(!mism.IsOk(), "InferMulti options/requests size mismatch");
   }
 
   // -- AsyncInfer ------------------------------------------------------
@@ -183,7 +266,15 @@ RunSuite(const char* suite, const std::string& url)
   CHECK_OK(async_status, "AsyncInfer result status");
 
   // -- system shm lifecycle (register/status/unregister) ---------------
-  const std::string key = std::string("/dual_suite_") + suite;
+  // POSIX shm names must not contain an interior '/'; sanitize the suite
+  // tag ("HTTP/ClientTest") before splicing it into the key.
+  std::string suite_tag(suite);
+  for (char& c : suite_tag) {
+    if (c == '/') {
+      c = '_';
+    }
+  }
+  const std::string key = std::string("/dual_suite_") + suite_tag;
   (void)tc::UnlinkSharedMemoryRegion(key);
   int fd = -1;
   CHECK_OK(tc::CreateSharedMemoryRegion(key, 256, &fd), "shm create");

@@ -1,0 +1,182 @@
+"""Client-side cancellation hits the cancel stats bucket, not success.
+
+VERDICT-r4 #8: the reference tracks cancelled requests distinctly from
+successes and failures (README.md cancellation section; the GRPC client's
+stop_stream(cancel_requests=True) and HTTP connection teardown). Both of
+this repo's streaming frontends must do the same: a client that abandons a
+decoupled generation mid-stream increments ``inference_stats.cancel`` and
+leaves ``success`` untouched.
+
+The decoupled fixture is ``repeat_int32`` with per-response DELAYs: slow
+enough that the cancel deterministically lands mid-generation.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.models import default_model_zoo
+from client_tpu.server import GrpcInferenceServer, ServerCore
+
+
+def _bucket(core: ServerCore, model: str, name: str) -> int:
+    stats = core.statistics(model)["model_stats"][0]["inference_stats"]
+    return stats[name]["count"]
+
+
+def _wait_for(predicate, timeout_s: float = 15.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _repeat_inputs(n: int, delay_ms: int):
+    inp = grpcclient.InferInput("IN", [n], "INT32")
+    inp.set_data_from_numpy(np.arange(n, dtype=np.int32))
+    delay = grpcclient.InferInput("DELAY", [n], "UINT32")
+    delay.set_data_from_numpy(np.full(n, delay_ms, dtype=np.uint32))
+    return [inp, delay]
+
+
+def test_grpc_stream_cancel_hits_cancel_bucket():
+    core = ServerCore(default_model_zoo())
+    with GrpcInferenceServer(core) as server:
+        with grpcclient.InferenceServerClient(server.url) as client:
+            got_first = threading.Event()
+
+            def on_response(result, error):
+                if result is not None:
+                    got_first.set()
+
+            client.start_stream(on_response)
+            # 50 responses x 200 ms: the stream is mid-generation for ~10 s
+            client.async_stream_infer(
+                "repeat_int32", _repeat_inputs(50, 200))
+            assert got_first.wait(30), "no streamed response arrived"
+            before_success = _bucket(core, "repeat_int32", "success")
+            client.stop_stream(cancel_requests=True)
+            assert _wait_for(
+                lambda: _bucket(core, "repeat_int32", "cancel") == 1), (
+                "cancel bucket never incremented after client-side cancel")
+        assert _bucket(core, "repeat_int32", "success") == before_success
+        assert _bucket(core, "repeat_int32", "fail") == 0
+
+
+def test_http_aio_generate_stream_cancel_hits_cancel_bucket():
+    from client_tpu.server import AioHttpInferenceServer
+
+    core = ServerCore(default_model_zoo())
+    with AioHttpInferenceServer(core) as server:
+        import client_tpu.http.aio as aioclient
+
+        async def run():
+            async with aioclient.InferenceServerClient(server.url) as client:
+                stream = client.generate_stream(
+                    "repeat_int32",
+                    {"IN": [1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+                     "DELAY": [0, 0, 200, 200, 200, 200, 200, 200, 200, 200]},
+                )
+                seen = 0
+                async for event in stream:
+                    seen += 1
+                    if seen == 2:
+                        break  # abandon mid-generation
+                await stream.aclose()
+            assert seen == 2
+
+        asyncio.run(run())
+        assert _wait_for(
+            lambda: _bucket(core, "repeat_int32", "cancel") == 1), (
+            "cancel bucket never incremented after aio stream abandonment")
+        assert _bucket(core, "repeat_int32", "success") == 0
+        assert _bucket(core, "repeat_int32", "fail") == 0
+
+
+def test_http_aio_generate_roundtrip():
+    """Happy paths of the generate extension: one-shot on a request/response
+    model, full SSE consumption on a decoupled model (counted as success),
+    and a malformed input key as a 400."""
+    from client_tpu.server import AioHttpInferenceServer
+    from client_tpu.utils import InferenceServerException
+
+    core = ServerCore(default_model_zoo())
+    with AioHttpInferenceServer(core) as server:
+        import client_tpu.http.aio as aioclient
+
+        async def run():
+            async with aioclient.InferenceServerClient(server.url) as client:
+                # one-shot: simple add/sub
+                out = await client.generate(
+                    "simple",
+                    {"INPUT0": [list(range(16))], "INPUT1": [[1] * 16]},
+                    request_id="gen-1",
+                )
+                assert out["model_name"] == "simple"
+                assert out["id"] == "gen-1"
+                assert out["OUTPUT0"] == [i + 1 for i in range(16)]
+                assert out["OUTPUT1"] == [i - 1 for i in range(16)]
+
+                # full stream: every decoupled response arrives as an event
+                events = []
+                async for event in client.generate_stream(
+                    "repeat_int32", {"IN": [5, 6, 7]}
+                ):
+                    events.append(event)
+                assert [e["OUT"] for e in events] == [5, 6, 7]
+                assert [e["IDX"] for e in events] == [0, 1, 2]
+
+                # decoupled model through one-shot generate: a 400
+                with pytest.raises(
+                    InferenceServerException, match="generate_stream"
+                ):
+                    await client.generate("repeat_int32", {"IN": [1, 2]})
+
+                # unknown input key: a 400, not a stream
+                with pytest.raises(
+                    InferenceServerException, match="unexpected generate input"
+                ):
+                    async for _ in client.generate_stream(
+                        "repeat_int32", {"BOGUS": [1]}
+                    ):
+                        pass
+
+        asyncio.run(run())
+    # 1 success: the fully-consumed stream. The one-shot-on-decoupled
+    # attempt is aborted at its SECOND response (the server refuses to run
+    # a multi-response generation to completion just to 400 it), which the
+    # model accounts as a cancel.
+    assert _bucket(core, "repeat_int32", "success") == 1
+    assert _bucket(core, "repeat_int32", "cancel") == 1
+
+
+def test_generate_stream_llm_tokens():
+    """The LLM shape: tiny_lm_generate over HTTP SSE streams one event per
+    token with ordered INDEX values — the HTTP analog of the GRPC
+    streaming example."""
+    from client_tpu.server import AioHttpInferenceServer
+
+    core = ServerCore(default_model_zoo())
+    with AioHttpInferenceServer(core) as server:
+        import client_tpu.http.aio as aioclient
+
+        async def run():
+            async with aioclient.InferenceServerClient(server.url) as client:
+                events = []
+                async for event in client.generate_stream(
+                    "tiny_lm_generate",
+                    {"TOKENS": [[1, 2, 3]], "MAX_TOKENS": 6},
+                ):
+                    events.append(event)
+                assert len(events) == 6
+                assert [e["INDEX"] for e in events] == list(range(6))
+                for e in events:
+                    assert isinstance(e["NEXT_TOKEN"], int)
+
+        asyncio.run(run())

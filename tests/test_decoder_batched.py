@@ -197,6 +197,82 @@ def test_unload_rejects_and_strands_nothing():
                     {"sequence_id": 2, "sequence_start": True})
 
 
+def test_idle_sequences_are_reaped():
+    """Abandoned mid-sequence clients must not hold slots forever.
+
+    Reference semantics: max_sequence_idle_microseconds in tritonserver's
+    sequence batcher. Fill every slot with sequences that never end (the
+    120 s-timeout abandonment shape: client walked away mid-sequence),
+    wait past the TTL, then start `slots` fresh sequences — all must be
+    admitted because the reaper freed the abandoned slots at window start.
+    """
+    slots = 3
+    bat = BatchedDecoderModel(seed=0, slots=slots, idle_ttl_s=1.0)
+    # warm up (first dispatch jit-compiles, which would eat the TTL and
+    # reap earlier starts before the fill loop even finishes)
+    bat.execute({"TOKENS": np.array([[1]], np.int32)},
+                {"sequence_id": 999, "sequence_start": True,
+                 "sequence_end": True})
+    for seq in range(1, slots + 1):
+        bat.execute({"TOKENS": np.array([[5]], np.int32)},
+                    {"sequence_id": seq, "sequence_start": True})
+    assert bat.live_sequences() == slots
+    # capacity genuinely exhausted before the TTL expires
+    with pytest.raises(ValueError, match="no free sequence slot"):
+        bat.execute({"TOKENS": np.array([[5]], np.int32)},
+                    {"sequence_id": 100, "sequence_start": True})
+    time.sleep(1.5)
+    for seq in range(201, 201 + slots):
+        out = bat.execute({"TOKENS": np.array([[7]], np.int32)},
+                          {"sequence_id": seq, "sequence_start": True,
+                           "sequence_end": True})
+        assert out["NEXT_TOKEN"].shape == (1, 1)
+    assert bat.live_sequences() == 0
+
+
+def test_active_sequences_survive_the_reaper():
+    """A sequence making requests is never reaped even when each request
+    gap is a large fraction of the TTL and OTHER sequences keep running
+    reap-triggering windows — activity must refresh the idle clock."""
+    ref = TinyDecoderModel(seed=0)
+    bat = BatchedDecoderModel(seed=0, slots=2, idle_ttl_s=0.3)
+    # warm up so compile time doesn't count against the TTL
+    bat.execute({"TOKENS": np.array([[1]], np.int32)},
+                {"sequence_id": 999, "sequence_start": True,
+                 "sequence_end": True})
+
+    class _SlowJitter:
+        def random(self):
+            return 0.15 / 0.003  # _drive sleeps jitter.random()*0.003
+
+    stop = threading.Event()
+    churn_errors = []
+
+    def churn():
+        # seq 12 churns fast windows; each one runs the reaper, so a
+        # missing last_seen refresh on seq 11 would reap it mid-drive
+        seq = 500
+        while not stop.is_set():
+            try:
+                _drive(bat, seq, [3], n=2)
+            except Exception as e:
+                churn_errors.append(e)
+                return
+            seq += 1
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        # ~0.45 s of slow-gap activity: total > TTL, every gap < TTL
+        toks = _drive(bat, 11, [1, 2, 3], n=4, jitter=_SlowJitter())
+    finally:
+        stop.set()
+        t.join()
+    assert not churn_errors, churn_errors
+    assert toks == _drive(ref, 11, [1, 2, 3], n=4)
+    assert bat.live_sequences() == 0
+
+
 def test_served_over_grpc_sequence_api():
     """End-to-end over the wire via the genai sequence harness."""
     from client_tpu.genai_perf import GenAiPerfRunner
