@@ -292,6 +292,34 @@ def bench_densenet(http_client, grpc_client, httpclient, grpcclient):
     return out
 
 
+def bench_genai(grpc_url, http_url):
+    """LLM serving metrics (genai-perf's role): TTFT / inter-token latency /
+    token throughput in the three transports, at c=1 and c=4. Feeds the
+    decoupled-vs-sequence-batched comparison (VERDICT-r4 #9) into every
+    round-end BENCH artifact — chip numbers land the moment the driver's
+    round-end run executes on the real device, watcher window or not."""
+    from client_tpu.genai_perf import GenAiPerfRunner
+
+    out = {}
+    for mode, runner_mode, url, model in (
+        ("decoupled", "decoupled", grpc_url, "tiny_lm_generate"),
+        ("generate_sse", "generate", http_url, "tiny_lm_generate"),
+        ("sequence_batched", "sequence", grpc_url, "decoder_lm_batched"),
+    ):
+        runner = GenAiPerfRunner(url, model, runner_mode,
+                                 prompt_tokens=16, output_tokens=16)
+        runner.run(1, 1)  # warm the compile outside the measured sessions
+        for conc in (1, 4):
+            r = runner.run(conc, 6)
+            out[f"{mode}_c{conc}"] = {
+                key: r[key]
+                for key in ("sessions", "errors", "ttft_ms",
+                            "inter_token_ms", "output_tokens_per_sec",
+                            "requests_per_sec")
+            }
+    return out
+
+
 def bench_native(url):
     """The C++ client's own wire-vs-tpu-shm race (native_bench), embedded
     when the native build exists; {} otherwise."""
@@ -363,10 +391,15 @@ def main():
     from client_tpu.models.vision import DenseNetModel
     from client_tpu.server import GrpcInferenceServer, HttpInferenceServer, ServerCore
 
+    from client_tpu.models.decoder_batched import BatchedDecoderModel
+    from client_tpu.models.generate import TinyGenerateModel
+
     platform = jax.default_backend()
     core = ServerCore([
         IdentityModel("identity_fp32", "FP32", delay_s=0.0),
         DenseNetModel(width=DENSENET_WIDTH),
+        TinyGenerateModel(),
+        BatchedDecoderModel(seed=0, slots=8),
     ])
     server = HttpInferenceServer(core)
     server.start()
@@ -383,6 +416,7 @@ def main():
     identity = {}
     xproc = {}
     densenet = {}
+    genai = {}
     native = {}
     headline = None
     errors = {}
@@ -447,6 +481,8 @@ def main():
         xproc = attempt("identity_xproc", run_xproc) or {}
         densenet = attempt("densenet", lambda: bench_densenet(
             client, grpc_client, httpclient, grpcclient)) or {}
+        genai = attempt("genai", lambda: bench_genai(
+            grpc_server.url, server.url)) or {}
         native = attempt("native", lambda: bench_native(server.url)) or {}
     finally:
         for stop in (client.close, grpc_client.close, server.stop,
@@ -479,6 +515,7 @@ def main():
                 "width": DENSENET_WIDTH,
                 **densenet,
             },
+            "llm_genai": genai,
             "native_cpp_client": native,
             "mode_errors": errors,
         },

@@ -73,7 +73,7 @@ class GenAiPerfRunner:
     def __init__(self, url: str, model_name: str, mode: str,
                  prompt_tokens: int, output_tokens: int, chunk: int = 1,
                  vocab: int = 256, seed: int = 0):
-        if mode not in ("decoupled", "sequence"):
+        if mode not in ("decoupled", "sequence", "generate"):
             raise ValueError(f"unknown mode {mode!r}")
         if output_tokens < 1:
             raise ValueError("output_tokens must be >= 1")
@@ -120,6 +120,27 @@ class GenAiPerfRunner:
             if result.is_final_response() and result.is_null_response():
                 sess.last = sess.last or now
                 return
+            if sess.first is None:
+                sess.first = now
+            sess.last = now
+            sess.tokens += 1
+
+    def _run_generate_session(self, client, sess: _Session,
+                              rng: np.random.Generator) -> None:
+        """One generate-extension SSE stream over HTTP — the transport the
+        reference genai-perf drives against tritonserver's
+        extension_generate endpoints. Same metrics as decoupled mode; the
+        per-token gap now includes SSE framing + chunked HTTP delivery."""
+        inputs: Dict[str, Any] = {
+            "TOKENS": self._prompt(rng).tolist(),
+            "MAX_TOKENS": self.output_tokens,
+        }
+        params = {"chunk": self.chunk} if self.chunk != 1 else None
+        sess.start = time.perf_counter()
+        for _event in client.generate_stream(
+            self.model_name, inputs, parameters=params
+        ):
+            now = time.perf_counter()
             if sess.first is None:
                 sess.first = now
             sess.last = now
@@ -198,9 +219,14 @@ class GenAiPerfRunner:
             client = None
             setup_error: Optional[str] = None
             try:
-                client = InferenceServerClient(self.url)
-                client.start_stream(
-                    lambda result, error: holder["q"].put((result, error)))
+                if self.mode == "generate":
+                    from .http import InferenceServerClient as HttpClient
+
+                    client = HttpClient(self.url)
+                else:
+                    client = InferenceServerClient(self.url)
+                    client.start_stream(
+                        lambda result, error: holder["q"].put((result, error)))
             except Exception as e:
                 # keep the thread alive through barrier.wait() — dying here
                 # would strand run() on the barrier forever
@@ -223,13 +249,15 @@ class GenAiPerfRunner:
                                 self._run_decoupled_session(
                                     client, InferInput, sess, holder["q"],
                                     rng)
+                            elif self.mode == "generate":
+                                self._run_generate_session(client, sess, rng)
                             else:
                                 self._run_sequence_session(
                                     client, InferInput, sess, holder["q"],
                                     seq_id, rng)
                         except Exception as e:  # survive one bad session
                             sess.error = str(e) or type(e).__name__
-                        if sess.error is not None:
+                        if sess.error is not None and self.mode != "generate":
                             # the broken session's late responses may still
                             # be in flight: cancel the stream, then swap in
                             # a fresh queue so the next session can't
@@ -250,7 +278,8 @@ class GenAiPerfRunner:
             finally:
                 if client is not None:
                     try:
-                        client.stop_stream()
+                        if self.mode != "generate":
+                            client.stop_stream()
                         client.close()
                     except Exception:
                         pass
@@ -308,11 +337,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="LLM streaming perf: TTFT / inter-token latency / token throughput",
     )
     parser.add_argument("-u", "--url", default="127.0.0.1:8001",
-                        help="GRPC endpoint (streaming requires grpc)")
+                        help="GRPC endpoint (decoupled/sequence) or HTTP "
+                             "endpoint (generate mode)")
     parser.add_argument("-m", "--model-name", default=None,
-                        help="default: tiny_lm_generate (decoupled) / decoder_lm (sequence)")
-    parser.add_argument("--mode", choices=("decoupled", "sequence"),
-                        default="decoupled")
+                        help="default: tiny_lm_generate (decoupled/generate)"
+                             " / decoder_lm (sequence)")
+    parser.add_argument("--mode", choices=("decoupled", "sequence", "generate"),
+                        default="decoupled",
+                        help="decoupled: GRPC bi-di stream; generate: the "
+                             "HTTP generate-extension SSE endpoint (what "
+                             "the reference genai-perf drives); sequence: "
+                             "client-driven stateful decode")
     parser.add_argument("--concurrency-range", default="1",
                         help="start[:end[:step]] concurrent sessions")
     parser.add_argument("--sessions", type=int, default=20,
@@ -327,7 +362,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     model = args.model_name or (
-        "tiny_lm_generate" if args.mode == "decoupled" else "decoder_lm")
+        "decoder_lm" if args.mode == "sequence" else "tiny_lm_generate")
     parts = [int(x) for x in args.concurrency_range.split(":")]
     start = parts[0]
     end = parts[1] if len(parts) > 1 else start

@@ -537,3 +537,101 @@ class InferenceServerClient(InferenceServerClientBase):
                 )
         future = self._executor.submit(self.infer, model_name, inputs, **kwargs)
         return InferAsyncRequest(future, self._verbose)
+
+    # -- generate extension (LLM JSON API) ----------------------------------
+    # Server counterpart: the generate/generate_stream routes on both HTTP
+    # frontends (reference protocol: tritonserver extension_generate — flat
+    # JSON keys map to input tensors; streaming responses arrive as SSE).
+    @staticmethod
+    def _generate_path(model_name: str, model_version: str, stream: bool) -> str:
+        tail = "generate_stream" if stream else "generate"
+        if model_version:
+            return f"v2/models/{quote(model_name)}/versions/{model_version}/{tail}"
+        return f"v2/models/{quote(model_name)}/{tail}"
+
+    @staticmethod
+    def _generate_payload(inputs, request_id, parameters) -> bytes:
+        payload = dict(inputs)
+        if request_id:
+            payload["id"] = request_id
+        if parameters:
+            payload["parameters"] = parameters
+        return json.dumps(payload).encode("utf-8")
+
+    def generate(
+        self,
+        model_name: str,
+        inputs: Dict[str, Any],
+        model_version: str = "",
+        request_id: str = "",
+        parameters: Optional[Dict[str, Any]] = None,
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """One-shot generate: flat JSON in, flat JSON out (the model must
+        produce exactly one response; decoupled many-response models need
+        :meth:`generate_stream`)."""
+        resp = self._request(
+            "POST",
+            self._generate_path(model_name, model_version, stream=False),
+            self._generate_payload(inputs, request_id, parameters),
+            headers, query_params,
+        )
+        raise_if_error(resp.status, resp.data)
+        return json.loads(resp.data)
+
+    def generate_stream(
+        self,
+        model_name: str,
+        inputs: Dict[str, Any],
+        model_version: str = "",
+        request_id: str = "",
+        parameters: Optional[Dict[str, Any]] = None,
+        headers: Optional[Dict[str, str]] = None,
+        query_params: Optional[Dict[str, Any]] = None,
+    ):
+        """Iterator over generate-extension SSE events, one dict per
+        streamed response. Abandoning the iterator mid-stream closes the
+        connection, which the server accounts as a client cancel (the
+        cancel stats bucket), not a success. In-band error events raise."""
+        hdrs = dict(headers or {})
+        request = Request(hdrs)
+        self._call_plugin(request)
+        uri = "/" + self._generate_path(model_name, model_version, stream=True)
+        if query_params:
+            uri += "?" + urlencode(query_params)
+        resp = self._pool.request(
+            "POST", uri,
+            body=self._generate_payload(inputs, request_id, parameters),
+            headers=request.headers, preload_content=False,
+        )
+        try:
+            if resp.status != 200:
+                data = resp.read(decode_content=True)
+                raise_if_error(resp.status, data)
+                raise InferenceServerException(
+                    f"unexpected generate_stream status {resp.status}")
+            buf = b""
+            try:
+                for chunk in resp.stream(8192, decode_content=True):
+                    buf += chunk
+                    while b"\n\n" in buf:
+                        event_raw, buf = buf.split(b"\n\n", 1)
+                        for line in event_raw.splitlines():
+                            line = line.strip()
+                            if line.startswith(b"data:"):
+                                event = json.loads(
+                                    line[len(b"data:"):].strip())
+                                if set(event) == {"error"}:
+                                    raise InferenceServerException(
+                                        event["error"])
+                                yield event
+            except urllib3.exceptions.HTTPError as e:
+                # server died mid-stream etc. — keep the client's typed
+                # exception contract (the aio twin wraps ClientError)
+                raise InferenceServerException(
+                    f"connection error: {e}") from e
+        finally:
+            # close (not release): an abandoned stream must tear the
+            # connection down so the server sees the disconnect
+            resp.close()

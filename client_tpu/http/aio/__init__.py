@@ -287,25 +287,13 @@ class InferenceServerClient(InferenceServerClientBase):
         return result
 
     # -- generate extension (LLM JSON API) ----------------------------------
-    # Server counterpart: client_tpu/server/http_server_aio.py generate
-    # routes (reference protocol: tritonserver extension_generate — flat
-    # JSON keys map to input tensors; streaming responses arrive as SSE).
-    def _generate_path(
-        self, model_name: str, model_version: str, stream: bool
-    ) -> str:
-        tail = "generate_stream" if stream else "generate"
-        if model_version:
-            return f"v2/models/{quote(model_name)}/versions/{model_version}/{tail}"
-        return f"v2/models/{quote(model_name)}/{tail}"
-
-    @staticmethod
-    def _generate_payload(inputs, request_id, parameters) -> bytes:
-        payload = dict(inputs)
-        if request_id:
-            payload["id"] = request_id
-        if parameters:
-            payload["parameters"] = parameters
-        return json.dumps(payload).encode("utf-8")
+    # Server counterpart: the generate routes on both HTTP frontends
+    # (reference protocol: tritonserver extension_generate — flat JSON keys
+    # map to input tensors; streaming responses arrive as SSE). Path and
+    # payload builders are the sync client's (same sharing pattern as
+    # generate_request_body above).
+    _generate_path = staticmethod(_SyncClient._generate_path)
+    _generate_payload = staticmethod(_SyncClient._generate_payload)
 
     async def generate(
         self,

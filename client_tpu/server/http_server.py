@@ -34,6 +34,97 @@ _FAMILY = {
 }
 
 
+def _generate_core_request(model, payload: Any) -> Dict[str, Any]:
+    """Map a generate-extension JSON payload onto a core infer request.
+
+    Reference protocol (tritonserver's HTTP generate extension,
+    docs/protocol/extension_generate.md): 'id' and 'parameters' are
+    reserved; every other key names an input tensor whose value is a JSON
+    scalar or (nested) list. Shapes are conformed to the model's metadata
+    by prepending singleton dims ([1,2,3] -> [1,3] for an INT32[1,-1]
+    input), the KServe analog of the reference's flat-JSON mapping.
+    Shared by the threaded and aio frontends.
+    """
+    if not isinstance(payload, dict):
+        raise InferError("generate request must be a JSON object", 400)
+    specs = {s.name: s for s in model.inputs()}
+    params = payload.get("parameters", {})
+    if not isinstance(params, dict):
+        raise InferError("generate 'parameters' must be an object", 400)
+    req: Dict[str, Any] = {"inputs": [], "parameters": dict(params)}
+    if payload.get("id"):
+        req["id"] = str(payload["id"])
+    for key, value in payload.items():
+        if key in ("id", "parameters"):
+            continue
+        spec = specs.get(key)
+        if spec is None:
+            raise InferError(
+                f"unexpected generate input '{key}' for model "
+                f"'{model.name}'", 400)
+        if spec.datatype == "BYTES":
+            shaped = np.asarray(value, dtype=object)
+
+            def as_bytes(v):
+                if isinstance(v, str):
+                    return v.encode("utf-8")
+                if isinstance(v, (bytes, bytearray)):
+                    return bytes(v)
+                # JSON numbers/bools: their string form, NOT bytes(int)
+                # (which would be that many NUL bytes)
+                return str(v).encode("utf-8")
+
+            arr = np.array(
+                [as_bytes(v) for v in shaped.reshape(-1)],
+                dtype=object).reshape(shaped.shape)
+        else:
+            try:
+                arr = np.asarray(value, dtype=triton_to_np_dtype(spec.datatype))
+            except (TypeError, ValueError) as e:
+                raise InferError(
+                    f"generate input '{key}' does not parse as "
+                    f"{spec.datatype}: {e}", 400)
+        while arr.ndim < len(spec.shape):
+            arr = arr[np.newaxis, ...]
+        req["inputs"].append({
+            "name": key,
+            "datatype": spec.datatype,
+            "shape": list(arr.shape),
+            "array": arr,
+        })
+    return req
+
+
+def _generate_event(resp: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten one core response into the generate extension's JSON shape:
+    metadata keys plus one flat key per output tensor (scalar when the
+    tensor has a single element)."""
+    out: Dict[str, Any] = {
+        "model_name": resp["model_name"],
+        "model_version": resp["model_version"],
+    }
+    if resp.get("id"):
+        out["id"] = resp["id"]
+    for entry in resp["outputs"]:
+        arr = entry["array"]
+        if entry["datatype"] == "BYTES":
+            values = [
+                v.decode("utf-8", "replace")
+                if isinstance(v, (bytes, np.bytes_)) else str(v)
+                for v in np.asarray(arr, dtype=object).reshape(-1)
+            ]
+        else:
+            values = np.asarray(arr, dtype=np.float32).reshape(-1).tolist() \
+                if entry["datatype"] == "BF16" \
+                else np.asarray(arr).reshape(-1).tolist()
+        out[entry["name"]] = values[0] if len(values) == 1 else values
+    return out
+
+
+def _sse_event(obj: Any) -> bytes:
+    return b"data: " + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n"
+
+
 def _decode_input(entry: Dict[str, Any], tail: memoryview, cursor: int) -> Tuple[Dict[str, Any], int]:
     """Convert one JSON input descriptor (+binary tail slice) to the core shape."""
     params = entry.get("parameters", {})
@@ -347,6 +438,10 @@ class _Handler(BaseHTTPRequestHandler):
             m = _MODEL_RE.match(path)
             if m and (m.group(3) or "") == "infer":
                 return self._do_infer(unquote(m.group(1)), m.group(2) or "", body)
+            if m and (m.group(3) or "") in ("generate", "generate_stream"):
+                return self._do_generate(
+                    unquote(m.group(1)), m.group(2) or "", body,
+                    stream=m.group(3) == "generate_stream")
             self._send_json({"error": f"unknown route {path}"}, 404)
         except InferError as e:
             self._send_error_json(e)
@@ -379,6 +474,67 @@ class _Handler(BaseHTTPRequestHandler):
                 orca_format, model_name
             )
         self._send(200, body_out, headers)
+
+    def _do_generate(
+        self, model_name: str, model_version: str, body: bytes, stream: bool
+    ):
+        # generate extension (reference: tritonserver extension_generate);
+        # the aio frontend serves the same routes — shared helpers above
+        import itertools
+
+        payload = json.loads(body) if body else {}
+        core_req = _generate_core_request(
+            self.core.model(model_name, model_version), payload)
+        if not stream:
+            gen = self.core.infer_stream(model_name, model_version, core_req)
+            try:
+                # at most TWO pulls: a second response already proves this
+                # belongs on /generate_stream — don't run a long generation
+                # to completion just to 400 it
+                responses = list(itertools.islice(gen, 2))
+            finally:
+                gen.close()
+            if len(responses) != 1:
+                detail = ("no response" if not responses
+                          else "more than one; use /generate_stream")
+                return self._send_json(
+                    {"error": "generate expects exactly one response but "
+                              f"model '{model_name}' produced {detail}"}, 400)
+            return self._send_json(_generate_event(responses[0]))
+
+        gen = self.core.infer_stream(model_name, model_version, core_req)
+        try:
+            try:
+                first = next(gen, None)
+            except InferError:
+                gen.close()
+                raise  # pre-stream failure -> proper HTTP status
+            # committed to a stream: chunked SSE, one event per response;
+            # from here failures are in-band events
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(data: bytes) -> None:
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+
+            item = first
+            while item is not None:
+                chunk(_sse_event(_generate_event(item)))
+                try:
+                    item = next(gen, None)
+                except Exception as e:
+                    chunk(_sse_event({"error": str(e)}))
+                    break
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-stream: closing the generator below
+            # runs the model's GeneratorExit path (cancel stats bucket)
+            self.close_connection = True
+        finally:
+            gen.close()
 
 
 class HttpInferenceServer:

@@ -18,94 +18,14 @@ from typing import Any, Optional
 from aiohttp import web
 
 from .core import InferError, ServerCore
-from .http_server import _FAMILY, encode_infer_response, parse_infer_request
-
-
-def _generate_core_request(model, payload: Any) -> dict:
-    """Map a generate-extension JSON payload onto a core infer request.
-
-    Reference protocol (tritonserver's HTTP generate extension,
-    docs/protocol/extension_generate.md): 'id' and 'parameters' are
-    reserved; every other key names an input tensor whose value is a JSON
-    scalar or (nested) list. Shapes are conformed to the model's metadata
-    by prepending singleton dims ([1,2,3] -> [1,3] for an INT32[1,-1]
-    input), the KServe analog of the reference's flat-JSON mapping.
-    """
-    import numpy as np
-
-    from ..utils import triton_to_np_dtype
-
-    if not isinstance(payload, dict):
-        raise InferError("generate request must be a JSON object", 400)
-    specs = {s.name: s for s in model.inputs()}
-    params = payload.get("parameters", {})
-    if not isinstance(params, dict):
-        raise InferError("generate 'parameters' must be an object", 400)
-    req: dict = {"inputs": [], "parameters": dict(params)}
-    if payload.get("id"):
-        req["id"] = str(payload["id"])
-    for key, value in payload.items():
-        if key in ("id", "parameters"):
-            continue
-        spec = specs.get(key)
-        if spec is None:
-            raise InferError(
-                f"unexpected generate input '{key}' for model "
-                f"'{model.name}'", 400)
-        if spec.datatype == "BYTES":
-            shaped = np.asarray(value, dtype=object)
-            arr = np.array(
-                [v.encode("utf-8") if isinstance(v, str) else bytes(v)
-                 for v in shaped.reshape(-1)],
-                dtype=object).reshape(shaped.shape)
-        else:
-            try:
-                arr = np.asarray(value, dtype=triton_to_np_dtype(spec.datatype))
-            except (TypeError, ValueError) as e:
-                raise InferError(
-                    f"generate input '{key}' does not parse as "
-                    f"{spec.datatype}: {e}", 400)
-        while arr.ndim < len(spec.shape):
-            arr = arr[np.newaxis, ...]
-        req["inputs"].append({
-            "name": key,
-            "datatype": spec.datatype,
-            "shape": list(arr.shape),
-            "array": arr,
-        })
-    return req
-
-
-def _generate_event(resp: dict) -> dict:
-    """Flatten one core response into the generate extension's JSON shape:
-    metadata keys plus one flat key per output tensor (scalar when the
-    tensor has a single element)."""
-    import numpy as np
-
-    out: dict = {
-        "model_name": resp["model_name"],
-        "model_version": resp["model_version"],
-    }
-    if resp.get("id"):
-        out["id"] = resp["id"]
-    for entry in resp["outputs"]:
-        arr = entry["array"]
-        if entry["datatype"] == "BYTES":
-            values = [
-                v.decode("utf-8", "replace")
-                if isinstance(v, (bytes, np.bytes_)) else str(v)
-                for v in np.asarray(arr, dtype=object).reshape(-1)
-            ]
-        else:
-            values = np.asarray(arr, dtype=np.float32).reshape(-1).tolist() \
-                if entry["datatype"] == "BF16" \
-                else np.asarray(arr).reshape(-1).tolist()
-        out[entry["name"]] = values[0] if len(values) == 1 else values
-    return out
-
-
-def _sse_event(obj: Any) -> bytes:
-    return b"data: " + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n"
+from .http_server import (
+    _FAMILY,
+    _generate_core_request,
+    _generate_event,
+    _sse_event,
+    encode_infer_response,
+    parse_infer_request,
+)
 
 
 def _json_response(obj: Any, status: int = 200) -> web.Response:
@@ -259,10 +179,11 @@ class AioHttpInferenceServer:
             except Exception as e:
                 return _error_response(e)
             if len(responses) != 1:
+                detail = ("no response" if not responses
+                          else "more than one; use /generate_stream")
                 return _json_response(
                     {"error": f"generate expects exactly one response but "
-                              f"model '{name}' produced more; "
-                              f"use /generate_stream"}, 400)
+                              f"model '{name}' produced {detail}"}, 400)
             return _json_response(_generate_event(responses[0]))
 
         async def generate_stream_route(request):

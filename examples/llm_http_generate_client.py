@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""LLM generation over the HTTP generate extension (SSE streaming).
+
+The HTTP counterpart of llm_generate_stream_client.py: instead of a GRPC
+bi-di stream, the request is one flat JSON POST to
+``/v2/models/{m}/generate_stream`` and tokens arrive as Server-Sent
+Events — tritonserver's extension_generate shape, the endpoint genai-perf
+benchmarks. Also demonstrates the one-shot ``/generate`` route.
+See docs/generate_extension.md for the protocol mapping.
+"""
+
+import argparse
+import sys
+import time
+
+import client_tpu.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-p", "--prompt", default="10,20,30,40",
+                        help="comma-separated prompt token ids (0-255)")
+    parser.add_argument("-n", "--max-tokens", type=int, default=16)
+    args = parser.parse_args()
+
+    prompt = [[int(t) for t in args.prompt.split(",")]]
+    with httpclient.InferenceServerClient(args.url) as client:
+        # streaming: one SSE event per generated token, consumed live
+        start = time.perf_counter()
+        first_ms = None
+        tokens = []
+        for event in client.generate_stream(
+            "tiny_lm_generate",
+            {"TOKENS": prompt, "MAX_TOKENS": args.max_tokens},
+        ):
+            if first_ms is None:
+                first_ms = (time.perf_counter() - start) * 1e3
+            tokens.append(event["NEXT_TOKEN"])
+            print(f"token[{event['INDEX']}] = {event['NEXT_TOKEN']}")
+        total_ms = (time.perf_counter() - start) * 1e3
+
+        if len(tokens) != args.max_tokens:
+            print(f"error: expected {args.max_tokens} tokens, "
+                  f"got {len(tokens)}")
+            return 1
+        print(f"generated {len(tokens)} tokens: ttft {first_ms:.1f} ms, "
+              f"total {total_ms:.1f} ms")
+
+        # one-shot: a single-response generation comes back as one JSON
+        one = client.generate(
+            "tiny_lm_generate", {"TOKENS": prompt, "MAX_TOKENS": 1})
+        if one["NEXT_TOKEN"] != tokens[0]:
+            print(f"error: one-shot token {one['NEXT_TOKEN']} != "
+                  f"streamed first token {tokens[0]} (greedy must agree)")
+            return 1
+        print(f"one-shot /generate agrees: {one['NEXT_TOKEN']}")
+        print("PASS: llm_http_generate_client")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

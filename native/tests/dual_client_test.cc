@@ -144,6 +144,47 @@ RunSuite(const char* suite, const std::string& url)
         "unknown model must fail (result status)");
   }
 
+  // -- BYTES/string tensors both directions (reference cc_client_test.cc
+  // string cases: AppendFromString on send, StringData on receive) -------
+  {
+    std::vector<std::string> a_strs, b_strs;
+    for (int i = 0; i < 16; ++i) {
+      a_strs.push_back(std::to_string(10 + i));
+      b_strs.push_back(std::to_string(2 * i));
+    }
+    tc::InferInput* sa_raw = nullptr;
+    CHECK_OK(
+        tc::InferInput::Create(&sa_raw, "INPUT0", {1, 16}, "BYTES"),
+        "string INPUT0");
+    std::unique_ptr<tc::InferInput> sa(sa_raw);
+    CHECK_OK(sa->AppendFromString(a_strs), "AppendFromString INPUT0");
+    tc::InferInput* sb_raw = nullptr;
+    CHECK_OK(
+        tc::InferInput::Create(&sb_raw, "INPUT1", {1, 16}, "BYTES"),
+        "string INPUT1");
+    std::unique_ptr<tc::InferInput> sb(sb_raw);
+    CHECK_OK(sb->AppendFromString(b_strs), "AppendFromString INPUT1");
+
+    tc::InferOptions str_options("simple_string");
+    tc::InferResult* str_raw = nullptr;
+    CHECK_OK(
+        client->Infer(&str_raw, str_options, {sa.get(), sb.get()}),
+        "string Infer");
+    std::unique_ptr<tc::InferResult> str_result(str_raw);
+    CHECK_OK(str_result->RequestStatus(), "string Infer status");
+    std::vector<std::string> sums_s, diffs_s;
+    CHECK_OK(str_result->StringData("OUTPUT0", &sums_s), "StringData OUT0");
+    CHECK_OK(str_result->StringData("OUTPUT1", &diffs_s), "StringData OUT1");
+    CHECK_TRUE(
+        sums_s.size() == 16 && diffs_s.size() == 16, "string output count");
+    for (int i = 0; i < 16; ++i) {
+      CHECK_TRUE(
+          sums_s[i] == std::to_string(10 + i + 2 * i), "string sums");
+      CHECK_TRUE(
+          diffs_s[i] == std::to_string(10 + i - 2 * i), "string diffs");
+    }
+  }
+
   // -- requested-output subset (reference cc_client_test.cc:300-420:
   // explicit outputs restrict the response to exactly that set) ---------
   std::unique_ptr<tc::InferRequestedOutput> want1;

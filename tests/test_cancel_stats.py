@@ -156,6 +156,47 @@ def test_http_aio_generate_roundtrip():
     assert _bucket(core, "repeat_int32", "cancel") == 1
 
 
+def test_http_sync_generate_roundtrip_and_cancel():
+    """The same generate extension on the THREADED frontend + sync client:
+    one-shot, full SSE consumption, and abandonment landing in the cancel
+    bucket (BrokenPipe on the chunked write closes the core generator)."""
+    import client_tpu.http as httpclient
+    from client_tpu.server import HttpInferenceServer
+
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            out = client.generate(
+                "simple",
+                {"INPUT0": [list(range(16))], "INPUT1": [[2] * 16]},
+                request_id="gen-sync",
+            )
+            assert out["id"] == "gen-sync"
+            assert out["OUTPUT0"] == [i + 2 for i in range(16)]
+
+            events = list(client.generate_stream(
+                "repeat_int32", {"IN": [9, 8]}))
+            assert [e["OUT"] for e in events] == [9, 8]
+
+            stream = client.generate_stream(
+                "repeat_int32",
+                {"IN": list(range(10)),
+                 "DELAY": [0, 0] + [200] * 8},
+            )
+            seen = 0
+            for _ in stream:
+                seen += 1
+                if seen == 2:
+                    break
+            stream.close()
+            assert seen == 2
+        assert _wait_for(
+            lambda: _bucket(core, "repeat_int32", "cancel") == 1), (
+            "cancel bucket never incremented after sync stream abandonment")
+        assert _bucket(core, "repeat_int32", "success") == 1
+        assert _bucket(core, "repeat_int32", "fail") == 0
+
+
 def test_generate_stream_llm_tokens():
     """The LLM shape: tiny_lm_generate over HTTP SSE streams one event per
     token with ordered INDEX values — the HTTP analog of the GRPC

@@ -50,6 +50,7 @@ HTTP_EXAMPLES = [
     "simple_http_tpushm_client.py",
     "ensemble_image_client.py",
     "quantized_wire_client.py",
+    "llm_http_generate_client.py",
 ]
 
 GRPC_EXAMPLES = [

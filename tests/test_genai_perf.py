@@ -233,6 +233,25 @@ def test_genai_perf_decoupled(grpc_url):
     assert out["inter_token_ms"]["p50"] > 0
 
 
+def test_genai_perf_generate_mode(core):
+    """The generate mode drives the HTTP generate-extension SSE endpoint —
+    the reference genai-perf's actual transport — with the same metrics."""
+    from client_tpu.genai_perf import GenAiPerfRunner
+    from client_tpu.server import HttpInferenceServer
+
+    with HttpInferenceServer(core) as server:
+        runner = GenAiPerfRunner(server.url, "tiny_lm_generate", "generate",
+                                 prompt_tokens=8, output_tokens=6)
+        runner.run(1, 1)  # warm compile
+        out = runner.run(2, 5)
+        assert out["errors"] == 0, out["error_sample"]
+        assert out["sessions"] == 5
+        total = out["output_tokens_per_sec"] * out["wall_s"]
+        assert abs(total - 5 * 6) < 1.0, out
+        assert 0 < out["ttft_ms"]["p50"] <= out["e2e_ms"]["p50"]
+        assert out["inter_token_ms"]["p50"] > 0
+
+
 def test_genai_perf_sequence(grpc_url, core):
     from client_tpu.genai_perf import GenAiPerfRunner
 

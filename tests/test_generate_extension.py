@@ -1,0 +1,124 @@
+"""Generate-extension protocol mapping (docs/generate_extension.md).
+
+Unit coverage for the flat-JSON → core-request mapping shared by both HTTP
+frontends, plus e2e cases the cancel-stats suite doesn't touch: BYTES
+tensors both directions, the versions/ route, and scalar collapsing.
+"""
+
+import numpy as np
+import pytest
+
+from client_tpu.models import default_model_zoo
+from client_tpu.server import ServerCore
+from client_tpu.server.core import InferError
+from client_tpu.server.http_server import (
+    _generate_core_request,
+    _generate_event,
+)
+
+
+@pytest.fixture(scope="module")
+def core():
+    return ServerCore(default_model_zoo())
+
+
+def _model(core, name):
+    return core.model(name, "")
+
+
+def test_mapping_conforms_shapes(core):
+    model = _model(core, "tiny_lm_generate")
+    req = _generate_core_request(
+        model, {"TOKENS": [1, 2, 3], "MAX_TOKENS": 8, "id": "x"})
+    by_name = {i["name"]: i for i in req["inputs"]}
+    # [1,2,3] conformed to the declared [1,-1] rank by a leading 1
+    assert by_name["TOKENS"]["shape"] == [1, 3]
+    assert by_name["TOKENS"]["datatype"] == "INT32"
+    np.testing.assert_array_equal(
+        by_name["TOKENS"]["array"], [[1, 2, 3]])
+    # scalar 8 conformed to [1]
+    assert by_name["MAX_TOKENS"]["shape"] == [1]
+    assert req["id"] == "x"
+
+
+def test_mapping_rejects_unknowns_and_bad_dtypes(core):
+    model = _model(core, "tiny_lm_generate")
+    with pytest.raises(InferError, match="unexpected generate input"):
+        _generate_core_request(model, {"BOGUS": 1})
+    with pytest.raises(InferError, match="does not parse as INT32"):
+        _generate_core_request(model, {"TOKENS": ["not-a-number"]})
+    with pytest.raises(InferError, match="JSON object"):
+        _generate_core_request(model, [1, 2])
+    with pytest.raises(InferError, match="must be an object"):
+        _generate_core_request(model, {"parameters": 7})
+
+
+def test_bytes_inputs_accept_json_numbers(core):
+    """JSON numbers for a BYTES input map to their string form, not
+    bytes(int) (which would be that many NUL bytes)."""
+    model = _model(core, "simple_string")
+    req = _generate_core_request(
+        model, {"INPUT0": [[i for i in range(16)]],
+                "INPUT1": [[str(i) for i in range(16)]]})
+    by_name = {i["name"]: i for i in req["inputs"]}
+    assert by_name["INPUT0"]["array"][0][3] == b"3"
+    assert by_name["INPUT1"]["array"][0][3] == b"3"
+
+
+def test_event_flattening_scalar_collapse():
+    resp = {
+        "model_name": "m", "model_version": "1", "id": "r",
+        "outputs": [
+            {"name": "ONE", "datatype": "INT32",
+             "array": np.array([[5]], np.int32)},
+            {"name": "MANY", "datatype": "FP32",
+             "array": np.array([1.5, 2.5], np.float32)},
+            {"name": "TEXT", "datatype": "BYTES",
+             "array": np.array([b"hi"], dtype=object)},
+        ],
+    }
+    event = _generate_event(resp)
+    assert event["ONE"] == 5          # single element -> scalar
+    assert event["MANY"] == [1.5, 2.5]
+    assert event["TEXT"] == "hi"      # bytes -> str
+    assert event["id"] == "r"
+
+
+def test_bytes_model_roundtrip_and_version_route(core):
+    """BYTES in/out over /generate, on both frontends, via the versioned
+    route: string-encoded integers go in, sum/diff strings come out."""
+    import client_tpu.http as httpclient
+    from client_tpu.server import HttpInferenceServer
+
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            a = [str(10 + i) for i in range(16)]
+            b = [str(i) for i in range(16)]
+            out = client.generate(
+                "simple_string", {"INPUT0": [a], "INPUT1": [b]},
+                model_version="1",
+            )
+            assert out["model_name"] == "simple_string"
+            assert out["OUTPUT0"] == [str(10 + 2 * i) for i in range(16)]
+            assert out["OUTPUT1"] == ["10"] * 16
+
+
+def test_aio_frontend_same_mapping(core):
+    import asyncio
+
+    from client_tpu.server import AioHttpInferenceServer
+
+    with AioHttpInferenceServer(core) as server:
+        import client_tpu.http.aio as aioclient
+
+        async def run():
+            async with aioclient.InferenceServerClient(server.url) as client:
+                out = await client.generate(
+                    "simple_string",
+                    {"INPUT0": [[str(i) for i in range(16)]],
+                     "INPUT1": [[str(i) for i in range(16)]]},
+                    model_version="1",
+                )
+                assert out["OUTPUT1"] == ["0"] * 16
+
+        asyncio.run(run())

@@ -645,6 +645,22 @@ def test_native_example_programs(grpc_server, binary):
     assert "0 + 1 = 1" in proc.stdout
 
 
+def test_native_example_sequence_stream(grpc_server):
+    """Two interleaved stateful sequences on one bi-di stream; the example
+    verifies per-sequence running sums itself."""
+    path = BUILD / "simple_grpc_sequence_stream_client"
+    assert path.exists(), "simple_grpc_sequence_stream_client not built"
+    proc = subprocess.run(
+        [str(path), "-u", grpc_server.url, "-n", "4"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+    assert "PASS : simple_grpc_sequence_stream_client" in proc.stdout
+    assert "sequence A (+5): 5 10 15 20" in proc.stdout
+    assert "sequence B (+7): 7 14 21 28" in proc.stdout
+
+
 def test_native_example_async_stream(grpc_server):
     """Decoupled LLM generation over bi-di streaming (VERDICT-r4 #6):
     the example itself asserts ordered INDEX values and a final-response

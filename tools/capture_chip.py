@@ -50,16 +50,18 @@ sys.path.insert(0, %(root)r)
 from client_tpu.genai_perf import GenAiPerfRunner
 from client_tpu.models.decoder_batched import BatchedDecoderModel
 from client_tpu.models.generate import TinyGenerateModel
-from client_tpu.server import GrpcInferenceServer, ServerCore
+from client_tpu.server import GrpcInferenceServer, HttpInferenceServer, ServerCore
 
 out = {}
 core = ServerCore([TinyGenerateModel(), BatchedDecoderModel(seed=0, slots=8)])
-with GrpcInferenceServer(core) as server:
-    for mode, model, sessions in (
-        ("decoupled", "tiny_lm_generate", 8),
-        ("sequence", "decoder_lm_batched", 8),
+with GrpcInferenceServer(core) as grpc_server, \
+        HttpInferenceServer(core) as http_server:
+    for mode, url, model, sessions in (
+        ("decoupled", grpc_server.url, "tiny_lm_generate", 8),
+        ("generate", http_server.url, "tiny_lm_generate", 8),
+        ("sequence", grpc_server.url, "decoder_lm_batched", 8),
     ):
-        runner = GenAiPerfRunner(server.url, model, mode,
+        runner = GenAiPerfRunner(url, model, mode,
                                  prompt_tokens=16, output_tokens=16)
         for conc in (1, 4):
             out[f"{mode}_c{conc}"] = runner.run(conc, sessions)
