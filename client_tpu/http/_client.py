@@ -600,14 +600,26 @@ class InferenceServerClient(InferenceServerClientBase):
         uri = "/" + self._generate_path(model_name, model_version, stream=True)
         if query_params:
             uri += "?" + urlencode(query_params)
-        resp = self._pool.request(
-            "POST", uri,
-            body=self._generate_payload(inputs, request_id, parameters),
-            headers=request.headers, preload_content=False,
-        )
+        try:
+            # no read deadline: generation streams for as long as it
+            # streams (matches the aio twin's ClientTimeout(total=None));
+            # the pool's connect timeout still applies
+            resp = self._pool.request(
+                "POST", uri,
+                body=self._generate_payload(inputs, request_id, parameters),
+                headers=request.headers, preload_content=False,
+                timeout=urllib3.Timeout(
+                    connect=self._timeout.connect_timeout, read=None),
+            )
+        except urllib3.exceptions.HTTPError as e:
+            raise InferenceServerException(f"connection error: {e}") from e
         try:
             if resp.status != 200:
-                data = resp.read(decode_content=True)
+                try:
+                    data = resp.read(decode_content=True)
+                except urllib3.exceptions.HTTPError as e:
+                    raise InferenceServerException(
+                        f"connection error: {e}") from e
                 raise_if_error(resp.status, data)
                 raise InferenceServerException(
                     f"unexpected generate_stream status {resp.status}")

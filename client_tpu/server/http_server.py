@@ -504,22 +504,24 @@ class _Handler(BaseHTTPRequestHandler):
 
         gen = self.core.infer_stream(model_name, model_version, core_req)
         try:
-            try:
-                first = next(gen, None)
-            except InferError:
-                gen.close()
-                raise  # pre-stream failure -> proper HTTP status
-            # committed to a stream: chunked SSE, one event per response;
-            # from here failures are in-band events
+            first = next(gen, None)
+        except BaseException:
+            gen.close()
+            raise  # pre-stream failure -> proper HTTP status via do_POST
+
+        # committed to a stream: chunked SSE, one event per response. Once
+        # the headers are out NOTHING may escape to do_POST's handler (its
+        # JSON error response would land mid-chunked-body and corrupt the
+        # framing) — every failure below is handled here.
+        def chunk(data: bytes) -> None:
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+
+        try:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
-
-            def chunk(data: bytes) -> None:
-                self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
-
             item = first
             while item is not None:
                 chunk(_sse_event(_generate_event(item)))
@@ -529,9 +531,20 @@ class _Handler(BaseHTTPRequestHandler):
                     chunk(_sse_event({"error": str(e)}))
                     break
             self.wfile.write(b"0\r\n\r\n")
-        except (BrokenPipeError, ConnectionResetError):
-            # client went away mid-stream: closing the generator below
-            # runs the model's GeneratorExit path (cancel stats bucket)
+        except OSError:
+            # client went away mid-stream (BrokenPipe/ConnectionReset/
+            # Aborted/socket timeout): closing the generator below runs
+            # the model's GeneratorExit path (cancel stats bucket)
+            self.close_connection = True
+        except Exception as e:
+            # server-side failure after headers (e.g. event flattening):
+            # best-effort in-band error, then drop the connection — the
+            # chunked framing can no longer be trusted for keep-alive
+            try:
+                chunk(_sse_event({"error": str(e)}))
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                pass
             self.close_connection = True
         finally:
             gen.close()

@@ -1,0 +1,204 @@
+// Server-side pipeline (ensemble) classification — the native counterpart
+// of examples/ensemble_image_client.py. Role parity with the reference's
+// src/c++/examples/ensemble_image_client.cc: the client sends the RAW
+// UINT8 HWC image to the `ensemble_image` model and the server runs the
+// whole pipeline (preprocess -> densenet_onnx) internally; the
+// classification extension returns ranked "value:index:label" strings.
+// Contrast with image_client.cc, which does the preprocessing client-side.
+//
+// Build: part of the normal native build (cmake -S native -B native/build).
+// Run:   ensemble_image_client [-u host:port] [-c topk] [image.ppm]
+//        (default URL from $CLIENT_TPU_TEST_GRPC_URL, else 127.0.0.1:8001)
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "client_tpu/common.h"
+#include "client_tpu/grpc_client.h"
+
+namespace tc = client_tpu;
+
+#define FAIL_IF_ERR(X, MSG)                                                  \
+  do {                                                                       \
+    const tc::Error err = (X);                                               \
+    if (!err.IsOk()) {                                                       \
+      std::cerr << "error: " << (MSG) << ": " << err.Message() << std::endl; \
+      return 1;                                                              \
+    }                                                                        \
+  } while (false)
+
+namespace {
+
+// Binary PPM (P6) loader (same minimal format image_client.cc reads).
+bool
+LoadPpm(
+    const std::string& path, int* width, int* height,
+    std::vector<uint8_t>* rgb, std::string* error)
+{
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    *error = "cannot open " + path;
+    return false;
+  }
+  auto next_token = [&f]() -> std::string {
+    std::string token;
+    int c;
+    while ((c = f.get()) != EOF) {
+      if (c == '#') {
+        while ((c = f.get()) != EOF && c != '\n') {
+        }
+        continue;
+      }
+      if (std::isspace(c)) {
+        if (!token.empty()) {
+          break;
+        }
+        continue;
+      }
+      token.push_back(static_cast<char>(c));
+    }
+    return token;
+  };
+  if (next_token() != "P6") {
+    *error = path + " is not a binary PPM (P6)";
+    return false;
+  }
+  *width = std::atoi(next_token().c_str());
+  *height = std::atoi(next_token().c_str());
+  const int maxval = std::atoi(next_token().c_str());
+  if (*width <= 0 || *height <= 0 || maxval != 255) {
+    *error = "unsupported PPM geometry/maxval in " + path;
+    return false;
+  }
+  rgb->resize(static_cast<size_t>(*width) * *height * 3);
+  f.read(reinterpret_cast<char*>(rgb->data()),
+         static_cast<std::streamsize>(rgb->size()));
+  if (static_cast<size_t>(f.gcount()) != rgb->size()) {
+    *error = "truncated pixel data in " + path;
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "127.0.0.1:8001";
+  if (const char* env = std::getenv("CLIENT_TPU_TEST_GRPC_URL")) {
+    url = env;
+  }
+  std::string image_path;
+  int topk = 3;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-u") == 0 && i + 1 < argc) {
+      url = argv[++i];
+    } else if (std::strcmp(argv[i], "-c") == 0 && i + 1 < argc) {
+      topk = std::atoi(argv[++i]);
+    } else if (argv[i][0] != '-') {
+      image_path = argv[i];
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url),
+      "unable to create grpc client");
+
+  bool model_ready = false;
+  FAIL_IF_ERR(
+      client->IsModelReady(&model_ready, "ensemble_image"),
+      "model readiness");
+  if (!model_ready) {
+    std::cerr << "error: ensemble_image not ready (server must register "
+              << "the image ensemble pipeline)" << std::endl;
+    return 1;
+  }
+
+  // the ensemble takes the raw image: no client-side preprocessing at all
+  int width = 64;
+  int height = 64;
+  std::vector<uint8_t> rgb;
+  if (image_path.empty()) {
+    rgb.resize(static_cast<size_t>(width) * height * 3);
+    for (int y = 0; y < height; ++y) {
+      for (int x = 0; x < width; ++x) {
+        uint8_t* px = &rgb[(static_cast<size_t>(y) * width + x) * 3];
+        px[0] = static_cast<uint8_t>((x * 255) / (width - 1));
+        px[1] = static_cast<uint8_t>((y * 255) / (height - 1));
+        px[2] = static_cast<uint8_t>(((x + y) * 255) / (width + height - 2));
+      }
+    }
+    std::cout << "no image file given; using synthetic " << width << "x"
+              << height << " gradient" << std::endl;
+  } else {
+    std::string error;
+    if (!LoadPpm(image_path, &width, &height, &rgb, &error)) {
+      std::cerr << "error: " << error << std::endl;
+      return 1;
+    }
+  }
+
+  tc::InferInput* input_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(
+          &input_raw, "IMAGE", {height, width, 3}, "UINT8"),
+      "creating IMAGE");
+  std::unique_ptr<tc::InferInput> input(input_raw);
+  FAIL_IF_ERR(input->AppendRaw(rgb.data(), rgb.size()), "setting IMAGE");
+
+  tc::InferRequestedOutput* output_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(
+          &output_raw, "CLASSIFICATION", static_cast<size_t>(topk)),
+      "creating requested output");
+  std::unique_ptr<tc::InferRequestedOutput> output(output_raw);
+
+  tc::InferOptions options("ensemble_image");
+  tc::InferResult* result_raw = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(&result_raw, options, {input.get()}, {output.get()}),
+      "running ensemble inference");
+  std::unique_ptr<tc::InferResult> result(result_raw);
+  FAIL_IF_ERR(result->RequestStatus(), "ensemble response status");
+
+  std::vector<std::string> classes;
+  FAIL_IF_ERR(
+      result->StringData("CLASSIFICATION", &classes), "classification");
+  if (classes.size() != static_cast<size_t>(topk)) {
+    std::cerr << "error: asked for top-" << topk << ", got "
+              << classes.size() << std::endl;
+    return 1;
+  }
+  std::cout << "Top " << topk
+            << " classes (server-side preprocess + classify):" << std::endl;
+  for (const std::string& entry : classes) {
+    const size_t first = entry.find(':');
+    if (first == std::string::npos) {
+      std::cerr << "error: malformed entry '" << entry << "'" << std::endl;
+      return 1;
+    }
+    const size_t second = entry.find(':', first + 1);
+    std::cout << "    " << entry.substr(0, first) << " ("
+              << entry.substr(
+                     first + 1,
+                     second == std::string::npos ? std::string::npos
+                                                 : second - first - 1)
+              << ")"
+              << (second == std::string::npos
+                      ? ""
+                      : " = " + entry.substr(second + 1))
+              << std::endl;
+  }
+
+  std::cout << "PASS : ensemble_image_client" << std::endl;
+  return 0;
+}

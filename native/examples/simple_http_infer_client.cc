@@ -1,0 +1,134 @@
+// Basic sync HTTP inference against the "simple" model — the libcurl
+// client twin of simple_grpc_infer_client.cc. Role parity with the
+// reference's src/c++/examples/simple_http_infer_client.cc: health checks,
+// model metadata, two INT32[1,16] inputs, sum/diff outputs verified element
+// by element, nonzero exit on any mismatch (examples double as smoke
+// tests, SURVEY §4 tier 3).
+//
+// Build: part of the normal native build (cmake -S native -B native/build).
+// Run:   simple_http_infer_client [-u host:port] [-v]
+//        (default URL from $CLIENT_TPU_TEST_URL, else 127.0.0.1:8000)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "client_tpu/common.h"
+#include "client_tpu/http_client.h"
+
+namespace tc = client_tpu;
+
+#define FAIL_IF_ERR(X, MSG)                                                  \
+  do {                                                                       \
+    const tc::Error err = (X);                                               \
+    if (!err.IsOk()) {                                                       \
+      std::cerr << "error: " << (MSG) << ": " << err.Message() << std::endl; \
+      return 1;                                                              \
+    }                                                                        \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "127.0.0.1:8000";
+  if (const char* env = std::getenv("CLIENT_TPU_TEST_URL")) {
+    url = env;
+  }
+  bool verbose = false;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-u") == 0 && i + 1 < argc) {
+      url = argv[++i];
+    } else if (std::strcmp(argv[i], "-v") == 0) {
+      verbose = true;
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url, verbose),
+      "unable to create http client");
+
+  bool live = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server liveness");
+  bool ready = false;
+  FAIL_IF_ERR(client->IsServerReady(&ready), "server readiness");
+  if (!live || !ready) {
+    std::cerr << "error: server not live/ready" << std::endl;
+    return 1;
+  }
+  tc::Json metadata;
+  FAIL_IF_ERR(client->ModelMetadata(&metadata, "simple"), "model metadata");
+
+  std::vector<int32_t> input0_data(16);
+  std::vector<int32_t> input1_data(16);
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+    input1_data[i] = 1;
+  }
+  std::vector<int64_t> shape{1, 16};
+
+  tc::InferInput* input0_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0_raw, "INPUT0", shape, "INT32"),
+      "creating INPUT0");
+  std::unique_ptr<tc::InferInput> input0(input0_raw);
+  FAIL_IF_ERR(
+      input0->AppendRaw(
+          reinterpret_cast<const uint8_t*>(input0_data.data()),
+          input0_data.size() * sizeof(int32_t)),
+      "setting INPUT0 data");
+
+  tc::InferInput* input1_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1_raw, "INPUT1", shape, "INT32"),
+      "creating INPUT1");
+  std::unique_ptr<tc::InferInput> input1(input1_raw);
+  FAIL_IF_ERR(
+      input1->AppendRaw(
+          reinterpret_cast<const uint8_t*>(input1_data.data()),
+          input1_data.size() * sizeof(int32_t)),
+      "setting INPUT1 data");
+
+  tc::InferOptions options("simple");
+  options.request_id = "http-1";
+
+  tc::InferResult* result_raw = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(&result_raw, options, {input0.get(), input1.get()}),
+      "running inference");
+  std::unique_ptr<tc::InferResult> result(result_raw);
+  FAIL_IF_ERR(result->RequestStatus(), "inference response status");
+
+  const uint8_t* out0_buf = nullptr;
+  size_t out0_size = 0;
+  FAIL_IF_ERR(result->RawData("OUTPUT0", &out0_buf, &out0_size), "OUTPUT0");
+  const uint8_t* out1_buf = nullptr;
+  size_t out1_size = 0;
+  FAIL_IF_ERR(result->RawData("OUTPUT1", &out1_buf, &out1_size), "OUTPUT1");
+  if (out0_size != 16 * sizeof(int32_t) || out1_size != 16 * sizeof(int32_t)) {
+    std::cerr << "error: unexpected output sizes " << out0_size << "/"
+              << out1_size << std::endl;
+    return 1;
+  }
+
+  const int32_t* sums = reinterpret_cast<const int32_t*>(out0_buf);
+  const int32_t* diffs = reinterpret_cast<const int32_t*>(out1_buf);
+  for (int i = 0; i < 16; ++i) {
+    if (sums[i] != input0_data[i] + input1_data[i] ||
+        diffs[i] != input0_data[i] - input1_data[i]) {
+      std::cerr << "error: wrong result at " << i << ": " << sums[i] << ", "
+                << diffs[i] << std::endl;
+      return 1;
+    }
+    std::cout << input0_data[i] << " + " << input1_data[i] << " = " << sums[i]
+              << "   " << input0_data[i] << " - " << input1_data[i] << " = "
+              << diffs[i] << std::endl;
+  }
+
+  std::cout << "PASS : simple_http_infer_client" << std::endl;
+  return 0;
+}

@@ -103,6 +103,57 @@ def test_bytes_model_roundtrip_and_version_route(core):
             assert out["OUTPUT1"] == ["10"] * 16
 
 
+def test_sync_stream_server_death_raises_typed_error():
+    """Server PROCESS dies mid-SSE (kill -9, no terminal chunk): the
+    iterator raises InferenceServerException (the client's typed
+    contract), not a raw urllib3 error. An in-process server.stop() is
+    too gentle — in-flight handler threads run to completion — so the
+    server lives in a subprocess the test kills."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    import client_tpu.http as httpclient
+    from client_tpu.utils import InferenceServerException
+
+    script = (
+        "import sys; sys.path.insert(0, {repo!r})\n"
+        "from client_tpu.models import default_model_zoo\n"
+        "from client_tpu.server import HttpInferenceServer, ServerCore\n"
+        "import time\n"
+        "s = HttpInferenceServer(ServerCore(default_model_zoo())).start()\n"
+        "print('PORT', s.port, flush=True)\n"
+        "time.sleep(600)\n"
+    ).format(repo=str(Path(__file__).resolve().parent.parent))
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script], stdout=subprocess.PIPE, text=True,
+        env=env,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("PORT"), line
+        url = f"127.0.0.1:{line.split()[1]}"
+        with httpclient.InferenceServerClient(url) as client:
+            stream = client.generate_stream(
+                "repeat_int32",
+                {"IN": list(range(10)), "DELAY": [0] + [400] * 9},
+            )
+            first = next(stream)
+            assert first["OUT"] == 0
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            with pytest.raises(InferenceServerException):
+                for _ in stream:
+                    pass
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+
+
 def test_aio_frontend_same_mapping(core):
     import asyncio
 

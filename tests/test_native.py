@@ -645,6 +645,35 @@ def test_native_example_programs(grpc_server, binary):
     assert "0 + 1 = 1" in proc.stdout
 
 
+def test_native_example_http_infer(server):
+    """The libcurl HTTP twin of the basic GRPC example."""
+    path = BUILD / "simple_http_infer_client"
+    assert path.exists(), "simple_http_infer_client not built"
+    proc = subprocess.run(
+        [str(path), "-u", server.url], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+    assert "PASS : simple_http_infer_client" in proc.stdout
+    assert "0 + 1 = 1" in proc.stdout
+
+
+def test_native_example_ensemble_image(vision_grpc_server):
+    """Raw image in, server-side pipeline (preprocess -> densenet),
+    ranked classification out — no client-side preprocessing."""
+    path = BUILD / "ensemble_image_client"
+    assert path.exists(), "ensemble_image_client not built"
+    proc = subprocess.run(
+        [str(path), "-u", vision_grpc_server.url, "-c", "3"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+    assert "PASS : ensemble_image_client" in proc.stdout
+    assert "class_" in proc.stdout
+
+
 def test_native_example_sequence_stream(grpc_server):
     """Two interleaved stateful sequences on one bi-di stream; the example
     verifies per-sequence running sums itself."""
@@ -679,11 +708,13 @@ def test_native_example_async_stream(grpc_server):
 
 @pytest.fixture(scope="module")
 def vision_grpc_server():
-    from client_tpu.models.vision import DenseNetModel
+    from client_tpu.models.ensemble import build_image_ensemble
     from client_tpu.server import GrpcInferenceServer, ServerCore
 
-    model = DenseNetModel(num_classes=16, width=8)
-    with GrpcInferenceServer(ServerCore([model])) as s:
+    # the full image pipeline: preprocess + densenet_onnx + ensemble_image
+    with GrpcInferenceServer(
+        ServerCore(build_image_ensemble(num_classes=16, width=8))
+    ) as s:
         yield s
 
 
