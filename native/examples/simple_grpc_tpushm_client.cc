@@ -1,0 +1,163 @@
+// TPU shared-memory inference from the native GRPC client — the
+// accelerator data plane. Role parity with the reference's
+// src/c++/examples/simple_grpc_cudashm_client.cc: inputs are written into
+// a device-backed region, outputs land in another, and the wire carries
+// only tensor METADATA (name/shape/region offsets) — the payload never
+// rides the request body. On TPU the handles are base64-JSON
+// (Python-interoperable) instead of CUDA IPC handles; colocated regions
+// never leave HBM.
+//
+// Build: part of the normal native build (cmake -S native -B native/build).
+// Run:   simple_grpc_tpushm_client [-u host:port]
+//        (default URL from $CLIENT_TPU_TEST_GRPC_URL, else 127.0.0.1:8001)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "client_tpu/common.h"
+#include "client_tpu/grpc_client.h"
+#include "client_tpu/tpu_shm.h"
+
+namespace tc = client_tpu;
+
+#define FAIL_IF_ERR(X, MSG)                                                  \
+  do {                                                                       \
+    const tc::Error err = (X);                                               \
+    if (!err.IsOk()) {                                                       \
+      std::cerr << "error: " << (MSG) << ": " << err.Message() << std::endl; \
+      return 1;                                                              \
+    }                                                                        \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "127.0.0.1:8001";
+  if (const char* env = std::getenv("CLIENT_TPU_TEST_GRPC_URL")) {
+    url = env;
+  }
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-u") == 0 && i + 1 < argc) {
+      url = argv[++i];
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url),
+      "unable to create grpc client");
+
+  // one region for both inputs (offsets 0 and 64), one for both outputs
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+  tc::TpuShmRegion* input_region_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::TpuShmRegion::Create(
+          &input_region_raw, "example_tpushm_in", 2 * kTensorBytes),
+      "creating input region");
+  std::unique_ptr<tc::TpuShmRegion> input_region(input_region_raw);
+  tc::TpuShmRegion* output_region_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::TpuShmRegion::Create(
+          &output_region_raw, "example_tpushm_out", 2 * kTensorBytes),
+      "creating output region");
+  std::unique_ptr<tc::TpuShmRegion> output_region(output_region_raw);
+
+  int32_t input0_data[16], input1_data[16];
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+    input1_data[i] = 1;
+  }
+  FAIL_IF_ERR(
+      input_region->Write(input0_data, kTensorBytes, 0), "writing INPUT0");
+  FAIL_IF_ERR(
+      input_region->Write(input1_data, kTensorBytes, kTensorBytes),
+      "writing INPUT1");
+
+  // register via the serialized raw handle — the same handle a Python
+  // client_tpu.utils.tpu_shared_memory region round-trips
+  FAIL_IF_ERR(
+      client->RegisterTpuSharedMemory(
+          "example_tpushm_in", input_region->RawHandle(), 0,
+          2 * kTensorBytes),
+      "registering input region");
+  FAIL_IF_ERR(
+      client->RegisterTpuSharedMemory(
+          "example_tpushm_out", output_region->RawHandle(), 0,
+          2 * kTensorBytes),
+      "registering output region");
+
+  tc::InferInput* input0_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0_raw, "INPUT0", {1, 16}, "INT32"),
+      "creating INPUT0");
+  std::unique_ptr<tc::InferInput> input0(input0_raw);
+  FAIL_IF_ERR(
+      input0->SetSharedMemory("example_tpushm_in", kTensorBytes, 0),
+      "INPUT0 region ref");
+  tc::InferInput* input1_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1_raw, "INPUT1", {1, 16}, "INT32"),
+      "creating INPUT1");
+  std::unique_ptr<tc::InferInput> input1(input1_raw);
+  FAIL_IF_ERR(
+      input1->SetSharedMemory(
+          "example_tpushm_in", kTensorBytes, kTensorBytes),
+      "INPUT1 region ref");
+
+  tc::InferRequestedOutput* output0_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0_raw, "OUTPUT0"),
+      "creating OUTPUT0");
+  std::unique_ptr<tc::InferRequestedOutput> output0(output0_raw);
+  FAIL_IF_ERR(
+      output0->SetSharedMemory("example_tpushm_out", kTensorBytes, 0),
+      "OUTPUT0 region ref");
+  tc::InferRequestedOutput* output1_raw = nullptr;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output1_raw, "OUTPUT1"),
+      "creating OUTPUT1");
+  std::unique_ptr<tc::InferRequestedOutput> output1(output1_raw);
+  FAIL_IF_ERR(
+      output1->SetSharedMemory(
+          "example_tpushm_out", kTensorBytes, kTensorBytes),
+      "OUTPUT1 region ref");
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result_raw = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(
+          &result_raw, options, {input0.get(), input1.get()},
+          {output0.get(), output1.get()}),
+      "running inference");
+  std::unique_ptr<tc::InferResult> result(result_raw);
+  FAIL_IF_ERR(result->RequestStatus(), "inference response status");
+
+  // results are read from the OUTPUT region, not the response body
+  int32_t sums[16], diffs[16];
+  FAIL_IF_ERR(
+      output_region->Read(sums, kTensorBytes, 0), "reading OUTPUT0");
+  FAIL_IF_ERR(
+      output_region->Read(diffs, kTensorBytes, kTensorBytes),
+      "reading OUTPUT1");
+  for (int i = 0; i < 16; ++i) {
+    if (sums[i] != input0_data[i] + input1_data[i] ||
+        diffs[i] != input0_data[i] - input1_data[i]) {
+      std::cerr << "error: wrong result at " << i << ": " << sums[i] << ", "
+                << diffs[i] << std::endl;
+      return 1;
+    }
+    std::cout << input0_data[i] << " + " << input1_data[i] << " = " << sums[i]
+              << "   " << input0_data[i] << " - " << input1_data[i] << " = "
+              << diffs[i] << std::endl;
+  }
+
+  FAIL_IF_ERR(
+      client->UnregisterTpuSharedMemory(""), "unregistering regions");
+  std::cout << "PASS : simple_grpc_tpushm_client" << std::endl;
+  return 0;
+}

@@ -133,6 +133,12 @@ def test_sync_stream_server_death_raises_typed_error():
         env=env,
     )
     try:
+        import select
+
+        # deadline on startup: a wedged child (the dead-tunnel mode hangs
+        # even CPU jax) must fail the test, not hang the suite
+        ready, _, _ = select.select([proc.stdout], [], [], 120)
+        assert ready, "server subprocess did not start within 120s"
         line = proc.stdout.readline().strip()
         assert line.startswith("PORT"), line
         url = f"127.0.0.1:{line.split()[1]}"

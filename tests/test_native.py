@@ -629,7 +629,8 @@ def test_native_default_headers_on_the_wire(grpc_server):
 
 
 @pytest.mark.parametrize(
-    "binary", ["simple_grpc_infer_client", "simple_grpc_shm_client"]
+    "binary", ["simple_grpc_infer_client", "simple_grpc_shm_client",
+               "simple_grpc_tpushm_client"]
 )
 def test_native_example_programs(grpc_server, binary):
     path = BUILD / binary

@@ -30,16 +30,19 @@ pytestmark = pytest.mark.soak
 
 SOAK_SECONDS = float(os.environ.get("CLIENT_TPU_SOAK_SECONDS", "60"))
 SAMPLE_EVERY = max(SOAK_SECONDS / 60.0, 1.0)
-# Sustained growth budget. Long runs assert leak-scale (64 KB/min): the
-# r05 instrumented 3600 s grpc_stream capture (SOAK_STREAM_r05.json,
+# Sustained growth budget. Runs >= 1800 s assert leak-scale (64 KB/min):
+# the r05 instrumented 3600 s grpc_stream capture (SOAK_STREAM_r05.json,
 # BASELINE.md "Round 5") pinned all growth to warmup + glibc retention of
 # freed chunks — tracemalloc flat (101 KB/hr), mallinfo2 in-use bounded
-# (713 KB/hr, sign-flipping tail) — with worst post-trim slope 24.9 and
-# arena-pinned raw tail 0.4 KB/min, so 64 is 2.2x the worst honest
-# steady-state reading. Short CI smokes keep the old 512 headroom: a 60 s
-# window is mostly transport warmup ramp.
+# (713 KB/hr, sign-flipping tail). The warmup is a fixed few MB, so the
+# final-third slope amortizes with duration — measured post-trim:
+# 106 KB/min at 600 s (SOAK_r05, tail-300s already 34), 41 at 1800 s
+# (SOAK_r04), 25 at 3600 s (SOAK_STREAM_r05) — hence 64 (2.6x the hour
+# reading) only once the window is unambiguously post-warmup; shorter
+# runs keep the 512 warmup headroom and rely on the tail assert below
+# for the steady-state claim.
 MAX_SLOPE_KB_PER_MIN = float(os.environ.get(
-    "CLIENT_TPU_SOAK_MAX_SLOPE", "512" if SOAK_SECONDS < 480 else "64"))
+    "CLIENT_TPU_SOAK_MAX_SLOPE", "512" if SOAK_SECONDS < 1800 else "64"))
 
 REPO = Path(__file__).resolve().parent.parent
 RESULTS: dict = {}
