@@ -222,6 +222,10 @@ def servers():
 @pytest.fixture(scope="module", autouse=True)
 def _dump_results(servers):
     yield
+    if not RESULTS:
+        # a run that exercised no _soak rows (e.g. only the probe-tool
+        # smoke) must not rewrite a committed artifact's config block
+        return
     out = REPO / os.environ.get("CLIENT_TPU_SOAK_OUT", "SOAK_r03.json")
     existing = {}
     if out.exists():
@@ -378,6 +382,32 @@ def test_soak_tpu_shm_churn(servers):
                 client.unregister_tpu_shared_memory("soak_tpu")
                 tpushm.destroy_shared_memory_region(region)
         _soak("tpu_shm_churn", step)
+
+
+def test_soak_stream_probe_tool(tmp_path):
+    """The instrumented attribution tool (tools/soak_stream_probe.py) keeps
+    working end-to-end: both phases produce samples with every metric
+    series and computed slopes. Short phases — this pins the harness, not
+    the numbers (SOAK_STREAM_r05.json is the committed measurement)."""
+    out = tmp_path / "probe_smoke.json"
+    proc = subprocess.run(
+        [sys.executable, "tools/soak_stream_probe.py",
+         "--seconds", "65", "--ab-seconds", "65", "--out", str(out)],
+        capture_output=True, text=True, timeout=500, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    data = json.loads(out.read_text())
+    for phase in ("default_arenas", "arena_max_1"):
+        p = data[phase]
+        assert "error" not in p, (phase, p.get("error"), proc.stderr[-800:])
+        assert p["iters"] > 0 and not p["errors"], p.get("errors")
+        assert len(p["samples"]) >= 3
+        for key in ("rss_raw_kb", "rss_trimmed_kb", "malloc_in_use_kb",
+                    "tracemalloc_kb"):
+            assert key in p["samples"][0], key
+            assert key in p["slopes"], key
+        assert p["tracemalloc_top"]
+    assert data["arena_max_1"]["arena_max"] == "1"
 
 
 NATIVE_BENCH = REPO / "native" / "build" / "native_bench"
