@@ -28,7 +28,13 @@ from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
 from ..utils import InferenceServerException
 from ._infer_result import InferResult
-from ._utils import build_infer_body, compress_body, decompress_body, raise_if_error
+from ._utils import (
+    build_infer_body,
+    compress_body,
+    decompress_body,
+    parse_sse_event,
+    raise_if_error,
+)
 
 
 class _Response:
@@ -624,20 +630,23 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise InferenceServerException(
                     f"unexpected generate_stream status {resp.status}")
             buf = b""
+
+            def events_in(segment: bytes):
+                for line in segment.splitlines():
+                    line = line.strip()
+                    if line.startswith(b"data:"):
+                        yield parse_sse_event(line[len(b"data:"):].strip())
+
             try:
                 for chunk in resp.stream(8192, decode_content=True):
                     buf += chunk
                     while b"\n\n" in buf:
                         event_raw, buf = buf.split(b"\n\n", 1)
-                        for line in event_raw.splitlines():
-                            line = line.strip()
-                            if line.startswith(b"data:"):
-                                event = json.loads(
-                                    line[len(b"data:"):].strip())
-                                if set(event) == {"error"}:
-                                    raise InferenceServerException(
-                                        event["error"])
-                                yield event
+                        yield from events_in(event_raw)
+                # a final event whose terminating blank line never arrived
+                # (server closed after flushing a partial frame) must not
+                # be silently dropped — parse it or raise typed
+                yield from events_in(buf)
             except urllib3.exceptions.HTTPError as e:
                 # server died mid-stream etc. — keep the client's typed
                 # exception contract (the aio twin wraps ClientError)

@@ -140,3 +140,25 @@ def raise_if_error(status: int, body: bytes) -> None:
     if msg is None:
         msg = body.decode("utf-8", errors="replace") if body else f"HTTP {status}"
     raise InferenceServerException(msg=msg, status=str(status))
+
+
+def parse_sse_event(payload: bytes):
+    """Decode one generate-extension SSE ``data:`` payload.
+
+    Shared by the sync and aio clients so hostile-input handling cannot
+    drift between them: non-JSON and JSON-but-not-an-object payloads raise
+    the typed client exception, and an in-band ``{"error": msg}`` event
+    raises with the server's message.
+    """
+    try:
+        event = json.loads(payload)
+    except ValueError as e:
+        raise InferenceServerException(
+            f"malformed generate_stream event: {payload[:120]!r}") from e
+    if not isinstance(event, dict):
+        raise InferenceServerException(
+            f"malformed generate_stream event (not an object): "
+            f"{payload[:120]!r}")
+    if set(event) == {"error"}:
+        raise InferenceServerException(event["error"])
+    return event

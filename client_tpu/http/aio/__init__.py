@@ -19,7 +19,12 @@ from ..._tensor import InferInput, InferRequestedOutput
 from ...utils import InferenceServerException
 from .._client import InferenceServerClient as _SyncClient
 from .._infer_result import InferResult
-from .._utils import build_infer_body, compress_body, raise_if_error
+from .._utils import (
+    build_infer_body,
+    compress_body,
+    parse_sse_event,
+    raise_if_error,
+)
 
 __all__ = [
     "InferInput",
@@ -350,9 +355,6 @@ class InferenceServerClient(InferenceServerClientBase):
                     line = raw_line.strip()
                     if not line.startswith(b"data:"):
                         continue
-                    event = json.loads(line[len(b"data:"):].strip())
-                    if set(event) == {"error"}:
-                        raise InferenceServerException(event["error"])
-                    yield event
+                    yield parse_sse_event(line[len(b"data:"):].strip())
         except aiohttp.ClientError as e:
             raise InferenceServerException(f"connection error: {e}") from e

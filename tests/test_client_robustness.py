@@ -52,6 +52,20 @@ class _EvilHandler(http.server.BaseHTTPRequestHandler):
                 200, header + b"\x01\x00\x00\x00",
                 {"Inference-Header-Content-Length": str(len(header))},
             )
+        elif mode == "malformed_sse":
+            # a valid SSE stream whose second event is not JSON
+            body = (b"data: {\"model_name\":\"m\",\"OUT\":1}\n\n"
+                    b"data: {this is not json}\n\n")
+            self._respond(200, body, {"Content-Type": "text/event-stream"})
+        elif mode == "nondict_sse":
+            # JSON but not an object: set(5) would be a raw TypeError
+            self._respond(200, b"data: 5\n\n",
+                          {"Content-Type": "text/event-stream"})
+        elif mode == "truncated_sse":
+            # final event flushed without its terminating blank line
+            body = (b"data: {\"model_name\":\"m\",\"OUT\":1}\n\n"
+                    b"data: {\"model_name\":\"m\",\"OUT\":2}")
+            self._respond(200, body, {"Content-Type": "text/event-stream"})
 
     do_GET = do_POST
 
@@ -100,6 +114,40 @@ def test_truncated_binary_output(evil_server):
         # the declared binary size exceeds the body: rejected at parse time
         with pytest.raises(InferenceServerException, match="beyond the body"):
             _infer(c)
+
+
+def test_malformed_sse_event_raises_typed_error(evil_server):
+    """A hostile generate_stream peer emitting non-JSON SSE events must
+    surface the typed client exception after the good events, not a raw
+    json.JSONDecodeError mid-iteration."""
+    _EvilHandler.mode = "malformed_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    with httpclient.InferenceServerClient(url) as c:
+        seen = []
+        with pytest.raises(InferenceServerException, match="malformed"):
+            for event in c.generate_stream("m", {"IN": [1]}):
+                seen.append(event)
+        assert seen == [{"model_name": "m", "OUT": 1}]
+
+
+def test_nondict_sse_event_raises_typed_error(evil_server):
+    """JSON-but-not-an-object events ('data: 5') must raise the typed
+    exception, not a raw TypeError from set(event)."""
+    _EvilHandler.mode = "nondict_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    with httpclient.InferenceServerClient(url) as c:
+        with pytest.raises(InferenceServerException, match="not an object"):
+            list(c.generate_stream("m", {"IN": [1]}))
+
+
+def test_truncated_sse_final_event_not_dropped(evil_server):
+    """A final event that arrives without its terminating blank line
+    (server closed after a partial flush) is parsed, not silently lost."""
+    _EvilHandler.mode = "truncated_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    with httpclient.InferenceServerClient(url) as c:
+        events = list(c.generate_stream("m", {"IN": [1]}))
+        assert [e["OUT"] for e in events] == [1, 2]
 
 
 def test_negative_binary_data_size_rejected():

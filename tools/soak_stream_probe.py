@@ -226,7 +226,11 @@ def main() -> int:
             child_env = dict(env)
             if arena_max is not None:
                 child_env["MALLOC_ARENA_MAX"] = arena_max
-            tmp = os.path.join(ROOT, f".soak_child_{name}.json")
+            # beside the artifact, pid-suffixed: a pytest smoke run and a
+            # real long capture must never read each other's child output
+            tmp = os.path.join(
+                os.path.dirname(os.path.abspath(args.out)) or ROOT,
+                f".soak_child_{name}_{os.getpid()}.json")
             print(json.dumps({"phase": name, "seconds": seconds}),
                   file=sys.stderr, flush=True)
             proc = subprocess.run(
