@@ -332,6 +332,24 @@ RunSuite(const char* suite, const std::string& url)
   CHECK_OK(tc::CloseSharedMemory(fd), "shm close");
   CHECK_OK(tc::UnlinkSharedMemoryRegion(key), "shm unlink");
 
+  // -- model control: unload -> not ready -> load -> serves again ------
+  // (reference cc_client_test LoadModel/UnloadModel coverage; uses a
+  // model no other section touches so suite order never matters)
+  {
+    CHECK_OK(client->UnloadModel("identity_bf16"), "UnloadModel");
+    bool bf16_ready = true;
+    CHECK_OK(
+        client->IsModelReady(&bf16_ready, "identity_bf16"),
+        "IsModelReady after unload");
+    CHECK_TRUE(!bf16_ready, "identity_bf16 must be unloaded");
+    CHECK_OK(client->LoadModel("identity_bf16"), "LoadModel");
+    bf16_ready = false;
+    CHECK_OK(
+        client->IsModelReady(&bf16_ready, "identity_bf16"),
+        "IsModelReady after load");
+    CHECK_TRUE(bf16_ready, "identity_bf16 must be ready again");
+  }
+
   // -- statistics ------------------------------------------------------
   tc::Json stats;
   CHECK_OK(
