@@ -103,6 +103,54 @@ def test_bytes_model_roundtrip_and_version_route(core):
             assert out["OUTPUT1"] == ["10"] * 16
 
 
+def test_generate_composes_with_sequence_api(core):
+    """The 'parameters' passthrough lets /generate drive STATEFUL models:
+    a client can step decoder_lm token by token with sequence_id/start/end
+    in the payload — the generate extension composes with the sequence
+    API rather than being stateless-only."""
+    import client_tpu.http as httpclient
+    from client_tpu.models.decoder import TinyDecoderModel
+    from client_tpu.server import HttpInferenceServer
+
+    ref = TinyDecoderModel(seed=0)
+    ref._ensure_built()
+
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(
+            server.url, network_timeout=300.0
+        ) as client:
+            def step(tokens, start, end):
+                out = client.generate(
+                    "decoder_lm", {"TOKENS": [tokens]},
+                    parameters={"sequence_id": 4242,
+                                "sequence_start": start,
+                                "sequence_end": end},
+                )
+                return out["NEXT_TOKEN"]
+
+            toks = [step([1, 2, 3], True, False)]
+            for i in range(3):
+                toks.append(step([toks[-1]], False, i == 2))
+
+    # greedy tokens must match the in-process decoder exactly
+    expected = []
+    import numpy as np
+
+    caches, pos = ref._fresh_cache(), 0
+    logits = None
+    for t in [1, 2, 3]:
+        logits, caches = ref._step_fn(ref._params, caches, int(t), pos)
+        pos += 1
+    nxt = int(np.asarray(logits).argmax())
+    expected.append(nxt)
+    for _ in range(3):
+        logits, caches = ref._step_fn(ref._params, caches, nxt, pos)
+        pos += 1
+        nxt = int(np.asarray(logits).argmax())
+        expected.append(nxt)
+    assert toks == expected
+
+
 def test_sync_stream_server_death_raises_typed_error():
     """Server PROCESS dies mid-SSE (kill -9, no terminal chunk): the
     iterator raises InferenceServerException (the client's typed
