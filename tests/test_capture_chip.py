@@ -44,6 +44,65 @@ def test_smoke_capture_produces_all_sections(tmp_path):
     assert gp["sequence_c4"]["errors"] == 0
 
 
+def test_capture_report_renders_all_sections(tmp_path):
+    """tools/capture_report.py turns a capture artifact into the
+    BASELINE-ready markdown; every section renders and failed sections are
+    listed, not dropped."""
+    from tools.capture_report import render
+
+    capture = {
+        "captured_utc": "2026-01-01T00:00:00+00:00",
+        "probe": {"platform": "tpu"},
+        "sections": {
+            "chip_bench": {"ok": True, "data": {
+                "platform": "tpu", "peak_bf16_tflops": 197.0,
+                "dispatch_overhead_ms": 60.0,
+                "matmul_bf16": {"n": 4096, "ms_per_matmul_blocked": 4.9,
+                                "tflops_blocked": 28.3,
+                                "ms_per_matmul_pipelined": 1.16,
+                                "tflops": 118.8}}},
+            "flash_sweep": {"ok": True, "data": {
+                "shape": [4, 2048, 8, 128], "mosaic_compiled": True,
+                "best": {"block_q": 256, "block_k": 128,
+                         "ms_per_call": 5.0, "tflops": 13.7},
+                "exactness": {"max_abs_diff": 0.01, "tol": 0.05,
+                              "ok": True}}},
+            "decode_attn": {"ok": True, "data": {
+                "mosaic_compiled": True,
+                "exactness": {"ok": True, "cases": [{}, {}]},
+                "latency": [
+                    {"batch": 8, "heads": 8, "max_len": 128, "fill": 127,
+                     "pallas_ms": 0.4, "einsum_ms": 1.2,
+                     "pallas_speedup": 3.0}]}},
+            "genai_perf": {"ok": True, "data": {
+                "decoupled_c1": {"sessions": 8, "errors": 0,
+                                 "ttft_ms": {"p50": 70.0},
+                                 "inter_token_ms": {"p50": 61.0},
+                                 "output_tokens_per_sec": 16.0,
+                                 "requests_per_sec": 1.0}}},
+            "bench": {"ok": False, "error": "section timed out after 2400s"},
+        },
+    }
+    text = render(capture)
+    assert "Platform: **tpu** (4/5 sections ok)" in text
+    assert "| 4096 | 4.90 | 28.3 | 1.16 | 118.8 | 0.603 |" in text
+    assert "**256×128**" in text
+    assert 'default `attention_impl="pallas"`' in text
+    assert "| decoupled | 1 | 8 | 70.00 | 61.00 | 16.0 | 1.00 | 0 |" in text
+    assert "- bench: section timed out" in text
+    # CLI writes a file
+    path = tmp_path / "cap.json"
+    path.write_text(json.dumps(capture))
+    out_md = tmp_path / "report.md"
+    proc = subprocess.run(
+        [sys.executable, "tools/capture_report.py", str(path), "-o",
+         str(out_md)],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert out_md.read_text() == text
+
+
 def test_watch_mode_logs_and_captures_on_green(tmp_path, monkeypatch):
     """--watch loop contract (VERDICT-r4 #2): every probe attempt is
     appended to the JSONL log; the first green probe triggers exactly one

@@ -1,0 +1,184 @@
+"""Render a CHIP_CAPTURE_*.json into BASELINE-ready markdown tables.
+
+The capture artifact is the measurement of record; this makes folding it
+into BASELINE.md mechanical instead of hand-transcribed (the round-3
+failure mode: session numbers cited without a committed artifact, the
+"provenance split"). Run on whatever capture exists:
+
+    python tools/capture_report.py CHIP_CAPTURE_2026-XX-XX.json [-o out.md]
+
+Sections rendered (each skipped gracefully if its capture section failed):
+matmul MFU (blocked vs pipelined), flash-attention sweep best config,
+decode-attention exactness + pallas/einsum crossover, LLM serving-mode
+comparison (decoupled vs generate-SSE vs sequence-batched), and the
+data-plane headline from the bench section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt(value, nd=2):
+    if value is None:
+        return "—"
+    if isinstance(value, float):
+        return f"{value:.{nd}f}"
+    return str(value)
+
+
+def render(capture: dict) -> str:
+    out = []
+    sections = capture.get("sections", {})
+    probe = capture.get("probe", {})
+    out.append(f"## Chip capture {capture.get('captured_utc', '?')}")
+    out.append("")
+    platform = probe.get("platform")
+    for section in sections.values():
+        if section.get("ok") and isinstance(section.get("data"), dict):
+            platform = section["data"].get("platform", platform)
+            break
+    ok_count = sum(1 for s in sections.values() if s.get("ok"))
+    out.append(f"Platform: **{platform or 'unknown'}** "
+               f"({ok_count}/{len(sections)} sections ok)")
+    out.append("")
+
+    cb = sections.get("chip_bench", {})
+    if cb.get("ok"):
+        data = cb["data"]
+        peak = data.get("peak_bf16_tflops")
+        out.append("### MXU matmul (bf16)")
+        out.append("")
+        out.append("| N | blocked ms | blocked TF/s | pipelined ms | "
+                   "pipelined TF/s | MFU |")
+        out.append("|---|---|---|---|---|---|")
+        matmul = data.get("matmul_bf16") or []
+        if isinstance(matmul, dict):
+            matmul = [matmul]
+        for row in matmul:
+            tflops = row.get("tflops")
+            mfu = (tflops / peak) if (peak and tflops) else None
+            out.append(
+                f"| {row.get('n')} | {_fmt(row.get('ms_per_matmul_blocked'))} "
+                f"| {_fmt(row.get('tflops_blocked'), 1)} "
+                f"| {_fmt(row.get('ms_per_matmul_pipelined'))} "
+                f"| {_fmt(tflops, 1)} | {_fmt(mfu, 3)} |")
+        out.append("")
+        out.append(f"Dispatch overhead: "
+                   f"{_fmt(data.get('dispatch_overhead_ms'), 3)} ms/dispatch")
+        out.append("")
+
+    fs = sections.get("flash_sweep", {})
+    if fs.get("ok"):
+        data = fs["data"]
+        best = data.get("best") or {}
+        exact = data.get("exactness") or {}
+        out.append("### Flash attention block sweep")
+        out.append("")
+        out.append(
+            f"Shape {data.get('shape')}, mosaic_compiled="
+            f"{data.get('mosaic_compiled')}: best block_q×block_k = "
+            f"**{best.get('block_q')}×{best.get('block_k')}** at "
+            f"{_fmt(best.get('ms_per_call'), 3)} ms "
+            f"({_fmt(best.get('tflops'), 2)} TF/s); exactness "
+            f"max_abs_diff={_fmt(exact.get('max_abs_diff'), 6)} "
+            f"(tol {exact.get('tol')}, ok={exact.get('ok')})")
+        out.append("")
+
+    da = sections.get("decode_attn", {})
+    if da.get("ok"):
+        data = da["data"]
+        exact = data.get("exactness") or {}
+        out.append("### Flash-decoding kernel (single-query KV-cache)")
+        out.append("")
+        out.append(f"mosaic_compiled={data.get('mosaic_compiled')}, "
+                   f"exactness ok={exact.get('ok')} "
+                   f"over {len(exact.get('cases', []))} cases")
+        out.append("")
+        out.append("| batch | heads | max_len | fill | pallas ms | "
+                   "einsum ms | pallas speedup |")
+        out.append("|---|---|---|---|---|---|---|")
+        latency = data.get("latency") or []
+        if isinstance(latency, dict):
+            latency = [latency]
+        for row in latency:
+            out.append(
+                f"| {row.get('batch')} | {row.get('heads')} "
+                f"| {row.get('max_len')} | {row.get('fill')} "
+                f"| {_fmt(row.get('pallas_ms'), 3)} "
+                f"| {_fmt(row.get('einsum_ms'), 3)} "
+                f"| {_fmt(row.get('pallas_speedup'), 2)}x |")
+        out.append("")
+        rows = latency
+        if rows:
+            faster = [r for r in rows if (r.get("pallas_speedup") or 0) > 1.0]
+            out.append(
+                f"Serving-default evidence: pallas faster on "
+                f"{len(faster)}/{len(rows)} measured shapes → default "
+                f"`attention_impl=\""
+                f"{'pallas' if len(faster) > len(rows) / 2 else 'einsum'}\"`"
+                f" on this platform.")
+            out.append("")
+
+    gp = sections.get("genai_perf", {})
+    if gp.get("ok"):
+        data = gp["data"]
+        out.append("### LLM serving modes (TTFT / ITL / token throughput)")
+        out.append("")
+        out.append("| mode | conc | sessions | ttft p50 ms | itl p50 ms | "
+                   "tok/s | req/s | err |")
+        out.append("|---|---|---|---|---|---|---|---|")
+        for key in sorted(data):
+            row = data[key]
+            mode, _, conc = key.rpartition("_c")
+            out.append(
+                f"| {mode} | {conc} | {row.get('sessions')} "
+                f"| {_fmt(row.get('ttft_ms', {}).get('p50'))} "
+                f"| {_fmt(row.get('inter_token_ms', {}).get('p50'))} "
+                f"| {_fmt(row.get('output_tokens_per_sec'), 1)} "
+                f"| {_fmt(row.get('requests_per_sec'))} "
+                f"| {row.get('errors')} |")
+        out.append("")
+
+    bench = sections.get("bench", {})
+    if bench.get("ok"):
+        data = bench["data"]
+        out.append("### Data-plane headline (bench.py)")
+        out.append("")
+        out.append(f"{data.get('metric')}: **{data.get('value')} "
+                   f"{data.get('unit')}** ({data.get('vs_baseline')}x vs "
+                   f"wire)")
+        out.append("")
+
+    failed = {name: s.get("error") for name, s in sections.items()
+              if not s.get("ok")}
+    if failed:
+        out.append("### Failed sections")
+        out.append("")
+        for name, error in failed.items():
+            out.append(f"- {name}: {error}")
+        out.append("")
+    return "\n".join(out)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("capture", help="CHIP_CAPTURE_*.json path")
+    parser.add_argument("-o", "--out", default=None,
+                        help="write markdown here (default stdout)")
+    args = parser.parse_args()
+    with open(args.capture) as f:
+        capture = json.load(f)
+    text = render(capture)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
