@@ -125,6 +125,29 @@ def _sse_event(obj: Any) -> bytes:
     return b"data: " + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n"
 
 
+def _generate_once(core, model_name: str, model_version: str,
+                   core_req: Dict[str, Any]) -> Dict[str, Any]:
+    """One-shot /generate semantics, shared by both frontends: pull at most
+    TWO responses — a second yield already proves the generation belongs on
+    /generate_stream, and closing there (rather than list()-ing a possibly
+    minutes-long generation to throw it away) frees the model and the
+    worker thread immediately."""
+    import itertools
+
+    gen = core.infer_stream(model_name, model_version, core_req)
+    try:
+        responses = list(itertools.islice(gen, 2))
+    finally:
+        gen.close()
+    if len(responses) != 1:
+        detail = ("no response" if not responses
+                  else "more than one; use /generate_stream")
+        raise InferError(
+            f"generate expects exactly one response but model "
+            f"'{model_name}' produced {detail}", 400)
+    return _generate_event(responses[0])
+
+
 def _decode_input(entry: Dict[str, Any], tail: memoryview, cursor: int) -> Tuple[Dict[str, Any], int]:
     """Convert one JSON input descriptor (+binary tail slice) to the core shape."""
     params = entry.get("parameters", {})
@@ -480,27 +503,13 @@ class _Handler(BaseHTTPRequestHandler):
     ):
         # generate extension (reference: tritonserver extension_generate);
         # the aio frontend serves the same routes — shared helpers above
-        import itertools
-
         payload = json.loads(body) if body else {}
         core_req = _generate_core_request(
             self.core.model(model_name, model_version), payload)
         if not stream:
-            gen = self.core.infer_stream(model_name, model_version, core_req)
-            try:
-                # at most TWO pulls: a second response already proves this
-                # belongs on /generate_stream — don't run a long generation
-                # to completion just to 400 it
-                responses = list(itertools.islice(gen, 2))
-            finally:
-                gen.close()
-            if len(responses) != 1:
-                detail = ("no response" if not responses
-                          else "more than one; use /generate_stream")
-                return self._send_json(
-                    {"error": "generate expects exactly one response but "
-                              f"model '{model_name}' produced {detail}"}, 400)
-            return self._send_json(_generate_event(responses[0]))
+            return self._send_json(
+                _generate_once(self.core, model_name, model_version,
+                               core_req))
 
         gen = self.core.infer_stream(model_name, model_version, core_req)
         try:

@@ -9,7 +9,6 @@ frontend.
 from __future__ import annotations
 
 import asyncio
-import itertools
 import json
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -22,6 +21,7 @@ from .http_server import (
     _FAMILY,
     _generate_core_request,
     _generate_event,
+    _generate_once,
     _sse_event,
     encode_infer_response,
     parse_infer_request,
@@ -162,29 +162,12 @@ class AioHttpInferenceServer:
                 core_req = _generate_core_request(
                     core.model(name, version), payload)
                 loop = asyncio.get_running_loop()
-
-                def run():
-                    # pull at most TWO responses: a second yield already
-                    # proves this generation belongs on /generate_stream,
-                    # and closing there (rather than list()-ing a possibly
-                    # minutes-long generation to throw it away) frees the
-                    # model and the worker thread immediately
-                    gen = core.infer_stream(name, version, core_req)
-                    try:
-                        return list(itertools.islice(gen, 2))
-                    finally:
-                        gen.close()
-
-                responses = await loop.run_in_executor(self._executor, run)
+                event = await loop.run_in_executor(
+                    self._executor,
+                    _generate_once, core, name, version, core_req)
             except Exception as e:
                 return _error_response(e)
-            if len(responses) != 1:
-                detail = ("no response" if not responses
-                          else "more than one; use /generate_stream")
-                return _json_response(
-                    {"error": f"generate expects exactly one response but "
-                              f"model '{name}' produced {detail}"}, 400)
-            return _json_response(_generate_event(responses[0]))
+            return _json_response(event)
 
         async def generate_stream_route(request):
             name = request.match_info["name"]

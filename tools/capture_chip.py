@@ -136,38 +136,39 @@ def watch(args):
     deadline = time.monotonic() + args.watch_max_hours * 3600.0
     interval_s = args.watch * 60.0
     attempt = 0
-    while True:
-        attempt += 1
-        t0 = time.monotonic()
-        stamp = datetime.datetime.now(datetime.timezone.utc)
-        # single attempt per cycle: the loop IS the retry policy
-        res = probe(attempts=1)
+
+    def log(entry: dict, utc: str = "") -> None:
         entry = {
-            "utc": stamp.isoformat(timespec="seconds"),
-            "attempt": attempt,
-            "ok": bool(res.get("ok")),
-            "probe_seconds": round(time.monotonic() - t0, 1),
+            "utc": utc or datetime.datetime.now(datetime.timezone.utc)
+            .isoformat(timespec="seconds"),
+            **entry,
         }
-        for key in ("platform", "hung_at", "failed_at", "error"):
-            if key in res:
-                entry[key] = res[key]
         with open(log_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
         print(json.dumps(entry), file=sys.stderr, flush=True)
+
+    while True:
+        attempt += 1
+        t0 = time.monotonic()
+        # stamp when the attempt STARTED (a hung probe returns ~2 min later)
+        started_utc = datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds")
+        # single attempt per cycle: the loop IS the retry policy
+        res = probe(attempts=1)
+        log({
+            "attempt": attempt,
+            "ok": bool(res.get("ok")),
+            "probe_seconds": round(time.monotonic() - t0, 1),
+            **{key: res[key]
+               for key in ("platform", "hung_at", "failed_at", "error")
+               if key in res},
+        }, utc=started_utc)
         if res.get("ok"):
             rc = run_capture(args, probe_result=res)
-            with open(log_path, "a") as f:
-                f.write(json.dumps({
-                    "utc": datetime.datetime.now(datetime.timezone.utc)
-                    .isoformat(timespec="seconds"),
-                    "event": "capture_done", "rc": rc}) + "\n")
+            log({"event": "capture_done", "rc": rc})
             return rc
         if time.monotonic() >= deadline:
-            with open(log_path, "a") as f:
-                f.write(json.dumps({
-                    "utc": datetime.datetime.now(datetime.timezone.utc)
-                    .isoformat(timespec="seconds"),
-                    "event": "watch_expired", "attempts": attempt}) + "\n")
+            log({"event": "watch_expired", "attempts": attempt})
             print(json.dumps({"ok": False, "reason": "watch expired",
                               "attempts": attempt, "log": log_path}))
             return 1

@@ -111,14 +111,14 @@ def render(capture: dict) -> str:
                 f"| {_fmt(row.get('einsum_ms'), 3)} "
                 f"| {_fmt(row.get('pallas_speedup'), 2)}x |")
         out.append("")
-        rows = latency
-        if rows:
-            faster = [r for r in rows if (r.get("pallas_speedup") or 0) > 1.0]
+        if latency:
+            faster = [r for r in latency
+                      if (r.get("pallas_speedup") or 0) > 1.0]
             out.append(
                 f"Serving-default evidence: pallas faster on "
-                f"{len(faster)}/{len(rows)} measured shapes → default "
+                f"{len(faster)}/{len(latency)} measured shapes → default "
                 f"`attention_impl=\""
-                f"{'pallas' if len(faster) > len(rows) / 2 else 'einsum'}\"`"
+                f"{'pallas' if len(faster) > len(latency) / 2 else 'einsum'}\"`"
                 f" on this platform.")
             out.append("")
 
@@ -147,9 +147,9 @@ def render(capture: dict) -> str:
         data = bench["data"]
         out.append("### Data-plane headline (bench.py)")
         out.append("")
-        out.append(f"{data.get('metric')}: **{data.get('value')} "
-                   f"{data.get('unit')}** ({data.get('vs_baseline')}x vs "
-                   f"wire)")
+        out.append(f"{data.get('metric')}: **{_fmt(data.get('value'), 3)} "
+                   f"{data.get('unit')}** ({_fmt(data.get('vs_baseline'), 1)}x "
+                   f"vs wire)")
         out.append("")
 
     failed = {name: s.get("error") for name, s in sections.items()
