@@ -6,6 +6,11 @@ client libraries (triton-inference-server/client), designed TPU-first:
 - ``client_tpu.http`` / ``client_tpu.grpc``: sync, callback-async, asyncio and
   bi-directional streaming clients for the KServe v2 protocol (HTTP/REST and
   GRPC), including the full server-management surface.
+- ``client_tpu.resilience``: transport-agnostic retry/backoff + circuit
+  breaker policies every frontend runs under (``configure_resilience``),
+  with idempotency-aware fault classification and GRPC stream
+  auto-reconnect; ``client_tpu.testing.chaos`` is the fault-injection
+  proxy that proves them end-to-end (docs/resilience.md).
 - ``client_tpu.utils``: Triton<->numpy dtype mapping with *native* bfloat16
   (via ml_dtypes), BYTES/BF16 wire serialization.
 - ``client_tpu.utils.shared_memory``: POSIX system shared memory data plane.

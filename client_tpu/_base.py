@@ -46,14 +46,32 @@ class BasicAuth(InferenceServerClientPlugin):
 
 
 class InferenceServerClientBase:
-    """Holds the (single) registered plugin and applies it before network ops."""
+    """Holds the (single) registered plugin and applies it before network ops,
+    plus the shared resilience hook every frontend routes its transport
+    through (see ``client_tpu.resilience``)."""
 
     def __init__(self):
         self._plugin: Optional[InferenceServerClientPlugin] = None
+        self._resilience = None  # Optional[resilience.ResiliencePolicy]
 
     def _call_plugin(self, request: Request) -> None:
         if self._plugin is not None:
             self._plugin(request)
+
+    # -- resilience ---------------------------------------------------------
+    def configure_resilience(self, policy) -> "InferenceServerClientBase":
+        """Install a ``resilience.ResiliencePolicy`` (or None to clear) that
+        every network operation of this client runs under. Pay-for-what-you-
+        use: with no policy configured the transport paths are untouched."""
+        self._resilience = policy
+        return self
+
+    def resilience_policy(self):
+        return self._resilience
+
+    def _resilience_for(self, override):
+        """The effective policy for one request (per-request override hook)."""
+        return override if override is not None else self._resilience
 
     def register_plugin(self, plugin: InferenceServerClientPlugin) -> None:
         if plugin is None:

@@ -130,7 +130,13 @@ class GenAiPerfRunner:
         """One generate-extension SSE stream over HTTP — the transport the
         reference genai-perf drives against tritonserver's
         extension_generate endpoints. Same metrics as decoupled mode; the
-        per-token gap now includes SSE framing + chunked HTTP delivery."""
+        per-token gap now includes SSE framing + chunked HTTP delivery.
+
+        Fully-consumed streams release their connection back to the pool
+        (generate_stream's exhausted path), so per-session TTFT measures
+        the protocol, not a fresh TCP handshake — keeping the committed
+        decoupled-vs-generate comparison fair against the long-lived GRPC
+        stream modes (only abandoned/error sessions pay a reconnect)."""
         inputs: Dict[str, Any] = {
             "TOKENS": self._prompt(rng).tolist(),
             "MAX_TOKENS": self.output_tokens,

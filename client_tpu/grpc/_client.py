@@ -14,6 +14,7 @@ server; a stock tritonserver can still be fed tpu regions through
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import grpc
@@ -28,7 +29,7 @@ from ._infer import (
     from_infer_parameter,
     to_grpc_compression,
 )
-from ._stream import _InferStream
+from ._stream import _InferStream, _ReconnectingStream
 from ._wire import decode_message, encode_message
 
 INT32_MAX = 2**31 - 1
@@ -173,18 +174,53 @@ class InferenceServerClient(InferenceServerClientBase):
         headers: Optional[Dict[str, str]] = None,
         client_timeout: Optional[float] = None,
         compression_algorithm: Optional[str] = None,
+        idempotent: bool = True,
+        resilience=None,
     ) -> Dict[str, Any]:
         if self._verbose:
             print(f"{method}, metadata {headers or {}}\n{request}")
-        try:
-            response = self._callable(method)(
-                request,
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-                compression=to_grpc_compression(compression_algorithm),
-            )
-        except grpc.RpcError as e:
-            raise _to_exception(e) from e
+        policy = self._resilience_for(resilience)
+        budget = client_timeout
+        per_attempt = None
+        if policy is not None and policy.retry is not None:
+            per_attempt = policy.retry.per_attempt_timeout_s
+            if budget is None:
+                # the policy's total deadline must bound in-flight attempts
+                # too, not only backoff sleeps
+                budget = policy.retry.total_deadline_s
+        deadline = time.monotonic() + budget if budget is not None else None
+
+        def attempt() -> Dict[str, Any]:
+            attempt_timeout = client_timeout
+            if deadline is not None:
+                # re-attempts get the REMAINING budget, not a fresh timeout
+                attempt_timeout = deadline - time.monotonic()
+                if attempt_timeout <= 0:
+                    raise InferenceServerException(
+                        "Deadline Exceeded",
+                        status="StatusCode.DEADLINE_EXCEEDED")
+            if per_attempt is not None:
+                attempt_timeout = (
+                    per_attempt if attempt_timeout is None
+                    else min(attempt_timeout, per_attempt))
+            try:
+                return self._callable(method)(
+                    request,
+                    metadata=self._metadata(headers),
+                    timeout=attempt_timeout,
+                    compression=to_grpc_compression(compression_algorithm),
+                )
+            except grpc.RpcError as e:
+                raise _to_exception(e) from e
+
+        if policy is None:
+            response = attempt()
+        else:
+            # UNAVAILABLE/RESOURCE_EXHAUSTED re-attempt under the policy;
+            # non-idempotent sequence infers only on never-sent connect
+            # failures (classify_fault reads the status details)
+            response = policy.execute(
+                attempt, idempotent=idempotent, timeout_s=client_timeout)
         if self._verbose:
             print(response)
         return response
@@ -384,6 +420,7 @@ class InferenceServerClient(InferenceServerClientBase):
         headers: Optional[Dict[str, str]] = None,
         parameters: Optional[Dict[str, Any]] = None,
         compression_algorithm: Optional[str] = None,
+        resilience=None,
     ) -> InferResult:
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
@@ -393,7 +430,8 @@ class InferenceServerClient(InferenceServerClientBase):
         )
         timers.capture(RequestTimers.SEND_START)
         response = self._call(
-            "ModelInfer", request, headers, client_timeout, compression_algorithm
+            "ModelInfer", request, headers, client_timeout, compression_algorithm,
+            idempotent=sequence_id == 0, resilience=resilience,
         )
         timers.capture(RequestTimers.SEND_END)
         timers.capture(RequestTimers.RECV_START)
@@ -456,20 +494,50 @@ class InferenceServerClient(InferenceServerClientBase):
         stream_timeout: Optional[float] = None,
         headers: Optional[Dict[str, str]] = None,
         compression_algorithm: Optional[str] = None,
+        auto_reconnect: bool = False,
+        resilience=None,
     ) -> None:
-        """Open the bidi stream; ``callback(result, error)`` per response."""
+        """Open the bidi stream; ``callback(result, error)`` per response.
+
+        ``auto_reconnect=True`` (requires a resilience policy with a
+        RetryPolicy, configured on the client or passed here) makes the
+        stream survive transport death: the bidi call is re-established
+        with backoff and the callback receives a
+        ``resilience.StreamReconnected`` event (as the result). In-flight
+        idempotent requests are re-sent; in-flight sequence requests are
+        NEVER silently re-sent — their ids arrive in the event's
+        ``abandoned_request_ids`` (see docs/resilience.md)."""
         with self._stream_lock:
             if self._stream is not None:
                 raise InferenceServerException(
                     "cannot start a stream: one is already active; stop it first"
                 )
-            stream = _InferStream(callback, self._verbose)
-            stream.start(
-                self._callable("ModelStreamInfer", streaming=True),
-                self._metadata(headers),
-                stream_timeout,
-                compression=to_grpc_compression(compression_algorithm),
-            )
+            compression = to_grpc_compression(compression_algorithm)
+            if auto_reconnect:
+                def open_inner(cb):
+                    inner = _InferStream(cb, self._verbose)
+                    # metadata computed per (re)open: the registered plugin
+                    # must re-stamp auth headers on every reconnect, or an
+                    # hours-later reconnect goes out with an expired token
+                    inner.start(
+                        self._callable("ModelStreamInfer", streaming=True),
+                        self._metadata(headers), stream_timeout,
+                        compression=compression,
+                    )
+                    return inner
+
+                stream = _ReconnectingStream(
+                    open_inner, callback, self._resilience_for(resilience),
+                    self._verbose,
+                )
+                stream.start()
+            else:
+                stream = _InferStream(callback, self._verbose)
+                stream.start(
+                    self._callable("ModelStreamInfer", streaming=True),
+                    self._metadata(headers), stream_timeout,
+                    compression=compression,
+                )
             self._stream = stream
 
     def async_stream_infer(
@@ -500,7 +568,9 @@ class InferenceServerClient(InferenceServerClientBase):
             request.setdefault("parameters", {})[
                 "triton_enable_empty_final_response"
             ] = {"bool_param": True}
-        stream.enqueue(request)
+        # sequence requests carry server-side state transitions and must
+        # never be silently re-sent by a reconnecting stream
+        stream.enqueue(request, idempotent=sequence_id == 0)
 
     def stop_stream(self, cancel_requests: bool = False) -> None:
         with self._stream_lock:

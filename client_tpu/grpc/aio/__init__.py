@@ -6,6 +6,7 @@ grpc.aio re-implementation over the same schema-driven wire codec
 
 from __future__ import annotations
 
+import time
 from typing import Any, AsyncIterator, Dict, List, Optional, Sequence
 
 import grpc
@@ -112,17 +113,46 @@ class InferenceServerClient(InferenceServerClientBase):
 
     async def _call(
         self, method, request, headers=None, client_timeout=None,
-        compression_algorithm=None,
+        compression_algorithm=None, idempotent=True, resilience=None,
     ):
-        try:
-            return await self._callable(method)(
-                request,
-                metadata=self._metadata(headers),
-                timeout=client_timeout,
-                compression=to_grpc_compression(compression_algorithm),
-            )
-        except grpc.aio.AioRpcError as e:
-            raise _to_exception(e) from e
+        policy = self._resilience_for(resilience)
+        budget = client_timeout
+        per_attempt = None
+        if policy is not None and policy.retry is not None:
+            per_attempt = policy.retry.per_attempt_timeout_s
+            if budget is None:
+                # the policy's total deadline must bound in-flight attempts
+                # too, not only backoff sleeps
+                budget = policy.retry.total_deadline_s
+        deadline = time.monotonic() + budget if budget is not None else None
+
+        async def attempt():
+            attempt_timeout = client_timeout
+            if deadline is not None:
+                # re-attempts get the REMAINING budget, not a fresh timeout
+                attempt_timeout = deadline - time.monotonic()
+                if attempt_timeout <= 0:
+                    raise InferenceServerException(
+                        "Deadline Exceeded",
+                        status="StatusCode.DEADLINE_EXCEEDED")
+            if per_attempt is not None:
+                attempt_timeout = (
+                    per_attempt if attempt_timeout is None
+                    else min(attempt_timeout, per_attempt))
+            try:
+                return await self._callable(method)(
+                    request,
+                    metadata=self._metadata(headers),
+                    timeout=attempt_timeout,
+                    compression=to_grpc_compression(compression_algorithm),
+                )
+            except grpc.aio.AioRpcError as e:
+                raise _to_exception(e) from e
+
+        if policy is None:
+            return await attempt()
+        return await policy.execute_async(
+            attempt, idempotent=idempotent, timeout_s=client_timeout)
 
     # -- surface (async twins of the sync client) ---------------------------
     async def is_server_live(self, headers=None, client_timeout=None) -> bool:
@@ -272,13 +302,15 @@ class InferenceServerClient(InferenceServerClientBase):
         headers: Optional[Dict[str, str]] = None,
         parameters: Optional[Dict[str, Any]] = None,
         compression_algorithm: Optional[str] = None,
+        resilience=None,
     ) -> InferResult:
         request = build_infer_request(
             model_name, inputs, model_version, outputs, request_id,
             sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
         )
         response = await self._call(
-            "ModelInfer", request, headers, client_timeout, compression_algorithm
+            "ModelInfer", request, headers, client_timeout, compression_algorithm,
+            idempotent=sequence_id == 0, resilience=resilience,
         )
         return InferResult(response)
 

@@ -26,9 +26,15 @@ import urllib3
 
 from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
+from ..resilience import (
+    RETRYABLE_HTTP_STATUSES,
+    RetryableStatusError,
+    connect_only_policy,
+)
 from ..utils import InferenceServerException
 from ._infer_result import InferResult
 from ._utils import (
+    SSEDecoder,
     build_infer_body,
     compress_body,
     decompress_body,
@@ -132,6 +138,9 @@ class InferenceServerClient(InferenceServerClientBase):
         self._executor_lock = threading.Lock()
         self._infer_stat = InferStat()
         self._max_retries = max(0, max_retries)
+        # legacy knob as a policy: connect-only retries, no breaker; a
+        # configure_resilience() policy takes precedence when installed
+        self._legacy_policy = connect_only_policy(self._max_retries)
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
@@ -167,6 +176,8 @@ class InferenceServerClient(InferenceServerClientBase):
         query_params: Optional[Dict[str, Any]] = None,
         timeout: Optional[float] = None,
         timers: Optional[RequestTimers] = None,
+        idempotent: bool = True,
+        resilience=None,
     ):
         """Issue one HTTP request; returns the response with the body read.
 
@@ -174,71 +185,111 @@ class InferenceServerClient(InferenceServerClientBase):
         ``resp.data`` is always the plain payload. When ``timers`` is given,
         SEND_END is captured once response headers arrive and RECV_START/END
         bracket the body read.
+
+        The request runs under the client's resilience policy (or the
+        per-request ``resilience`` override): connect failures are always
+        re-attemptable; in-flight resets and shed-load statuses
+        (408/429/502/503/504) only when ``idempotent`` — sequence infers
+        must never be silently re-sent after the bytes may have landed.
         """
-        hdrs = dict(headers or {})
-        request = Request(hdrs)
-        self._call_plugin(request)
         uri = "/" + path
         if query_params:
             uri += "?" + urlencode(query_params)
-        if self._verbose:
-            print(f"{method} {uri}, headers {request.headers}")
-        kwargs: Dict[str, Any] = dict(headers=request.headers, preload_content=False)
+        policy = self._resilience_for(resilience) or self._legacy_policy
+        kwargs: Dict[str, Any] = dict(preload_content=False)
         if body is not None:
             kwargs["body"] = body
-        if timeout is not None:
-            kwargs["timeout"] = urllib3.Timeout(connect=timeout, read=timeout)
-        resp = None
-        attempts_left = self._max_retries
-        # retry backoff must respect the caller's deadline, not just each
-        # attempt's socket timeout
-        deadline = time.monotonic() + timeout if timeout is not None else None
-        try:
-            while True:
+        budget = timeout
+        per_attempt = None
+        if policy is not None and policy.retry is not None:
+            per_attempt = policy.retry.per_attempt_timeout_s
+            if budget is None:
+                # the policy's total deadline must bound in-flight attempts
+                # too, not only backoff sleeps
+                budget = policy.retry.total_deadline_s
+        deadline = time.monotonic() + budget if budget is not None else None
+        if timeout is None and per_attempt is not None:
+            kwargs["timeout"] = urllib3.Timeout(
+                connect=per_attempt, read=per_attempt)
+        retry_statuses = policy is not None and policy.retry_http_statuses
+
+        def attempt() -> _Response:
+            # plugin runs per attempt: a token-refreshing plugin must be
+            # able to stamp a FRESH credential on every retry
+            request = Request(dict(headers or {}))
+            self._call_plugin(request)
+            kwargs["headers"] = request.headers
+            if self._verbose:
+                print(f"{method} {uri}, headers {request.headers}")
+            if deadline is not None:
+                # each re-attempt gets only the REMAINING budget, not a
+                # fresh full timeout — the caller's deadline is total
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise InferenceServerException(
+                        "Deadline Exceeded", status="499")
+                if per_attempt is not None:
+                    remaining = min(remaining, per_attempt)
+                kwargs["timeout"] = urllib3.Timeout(
+                    connect=remaining, read=remaining)
+            resp = None
+            try:
                 try:
                     resp = self._pool.request(method, uri, **kwargs)
-                    break
                 except urllib3.exceptions.NewConnectionError as e:
                     # must precede TimeoutError: NewConnectionError subclasses
                     # ConnectTimeoutError in urllib3, but "refused" isn't
-                    # "timed out". Connect failures never reached the server,
-                    # so they are the one class safe to retry.
-                    backoff = min(0.05 * (self._max_retries - attempts_left + 1), 0.5)
-                    if attempts_left <= 0 or (
-                        deadline is not None
-                        and time.monotonic() + backoff >= deadline
-                    ):
-                        raise InferenceServerException(
-                            f"connection error: {e}"
-                        ) from e
-                    attempts_left -= 1
-                    if self._verbose:
-                        print(f"retrying after connect failure ({attempts_left} left)")
-                    time.sleep(backoff)
-            if timers is not None:
-                timers.capture(RequestTimers.SEND_END)
-                timers.capture(RequestTimers.RECV_START)
-            data = resp.read(decode_content=True)
-            if timers is not None:
-                timers.capture(RequestTimers.RECV_END)
-        except urllib3.exceptions.TimeoutError as e:
-            raise InferenceServerException("Deadline Exceeded", status="499") from e
-        except urllib3.exceptions.HTTPError as e:
-            raise InferenceServerException(f"connection error: {e}") from e
-        finally:
-            if resp is not None:
-                resp.release_conn()
+                    # "timed out". classify_fault sees the cause type and
+                    # files this under the connect domain (always safe).
+                    raise InferenceServerException(
+                        f"connection error: {e}") from e
+                if timers is not None:
+                    timers.capture(RequestTimers.SEND_END)
+                    timers.capture(RequestTimers.RECV_START)
+                data = resp.read(decode_content=True)
+                if timers is not None:
+                    timers.capture(RequestTimers.RECV_END)
+            except urllib3.exceptions.TimeoutError as e:
+                raise InferenceServerException(
+                    "Deadline Exceeded", status="499") from e
+            except urllib3.exceptions.HTTPError as e:
+                raise InferenceServerException(f"connection error: {e}") from e
+            finally:
+                if resp is not None:
+                    resp.release_conn()
+            if self._verbose:
+                print(f"-> {resp.status}, headers {dict(resp.headers)}")
+            out = _Response(resp.status, resp.headers, data)
+            if retry_statuses and str(resp.status) in RETRYABLE_HTTP_STATUSES:
+                raise RetryableStatusError(resp.status, out)
+            return out
+
+        if policy is None:
+            return attempt()
+        on_retry = None
         if self._verbose:
-            print(f"-> {resp.status}, headers {dict(resp.headers)}")
-        return _Response(resp.status, resp.headers, data)
+            def on_retry(n, exc, delay):
+                print(f"retrying after attempt {n + 1} failed ({exc}); "
+                      f"backoff {delay:.3f}s")
+        try:
+            return policy.execute(
+                attempt, idempotent=idempotent, timeout_s=timeout,
+                on_retry=on_retry,
+            )
+        except RetryableStatusError as e:
+            # attempts exhausted on a shed-load status: hand the original
+            # response back so callers keep the plain raise_if_error path
+            return e.response
 
     def _get(self, path, headers=None, query_params=None):
         return self._request("GET", path, headers=headers, query_params=query_params)
 
-    def _post(self, path, body=b"", headers=None, query_params=None, timeout=None, timers=None):
+    def _post(self, path, body=b"", headers=None, query_params=None, timeout=None,
+              timers=None, idempotent=True, resilience=None):
         return self._request(
             "POST", path, body=body, headers=headers, query_params=query_params,
-            timeout=timeout, timers=timers,
+            timeout=timeout, timers=timers, idempotent=idempotent,
+            resilience=resilience,
         )
 
     @staticmethod
@@ -485,8 +536,13 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm: Optional[str] = None,
         response_compression_algorithm: Optional[str] = None,
         parameters: Optional[Dict[str, Any]] = None,
+        resilience=None,
     ) -> InferResult:
-        """Run a synchronous inference."""
+        """Run a synchronous inference.
+
+        ``resilience``: per-request ``ResiliencePolicy`` override. Sequence
+        requests (``sequence_id != 0``) are non-idempotent: only
+        never-sent connect failures are retried for them."""
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
         body, json_size = build_infer_body(
@@ -520,6 +576,8 @@ class InferenceServerClient(InferenceServerClientBase):
             query_params,
             timeout=client_timeout,
             timers=timers,
+            idempotent=sequence_id == 0,
+            resilience=resilience,
         )
         # urllib3 already decoded any Content-Encoding; resp.data is plain.
         raise_if_error(resp.status, resp.data)
@@ -619,6 +677,7 @@ class InferenceServerClient(InferenceServerClientBase):
             )
         except urllib3.exceptions.HTTPError as e:
             raise InferenceServerException(f"connection error: {e}") from e
+        exhausted = False
         try:
             if resp.status != 200:
                 try:
@@ -629,30 +688,30 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise_if_error(resp.status, data)
                 raise InferenceServerException(
                     f"unexpected generate_stream status {resp.status}")
-            buf = b""
-
-            def events_in(segment: bytes):
-                for line in segment.splitlines():
-                    line = line.strip()
-                    if line.startswith(b"data:"):
-                        yield parse_sse_event(line[len(b"data:"):].strip())
-
+            # SSEDecoder: CRLF-framed servers stream event-by-event (a bare
+            # \n\n split would buffer them to EOF), multi-line data: fields
+            # join per the SSE spec, and a final event whose terminating
+            # blank line never arrived is flushed, not dropped
+            decoder = SSEDecoder()
             try:
                 for chunk in resp.stream(8192, decode_content=True):
-                    buf += chunk
-                    while b"\n\n" in buf:
-                        event_raw, buf = buf.split(b"\n\n", 1)
-                        yield from events_in(event_raw)
-                # a final event whose terminating blank line never arrived
-                # (server closed after flushing a partial frame) must not
-                # be silently dropped — parse it or raise typed
-                yield from events_in(buf)
+                    for payload in decoder.feed(chunk):
+                        yield parse_sse_event(payload)
+                for payload in decoder.flush():
+                    yield parse_sse_event(payload)
             except urllib3.exceptions.HTTPError as e:
                 # server died mid-stream etc. — keep the client's typed
                 # exception contract (the aio twin wraps ClientError)
                 raise InferenceServerException(
                     f"connection error: {e}") from e
+            exhausted = True
         finally:
-            # close (not release): an abandoned stream must tear the
-            # connection down so the server sees the disconnect
-            resp.close()
+            if exhausted:
+                # fully-drained chunked body: the connection is reusable —
+                # back to the pool, so per-session TTFT doesn't pay a fresh
+                # TCP handshake (genai_perf generate-mode bias)
+                resp.release_conn()
+            else:
+                # close (not release): an abandoned stream must tear the
+                # connection down so the server sees the disconnect
+                resp.close()

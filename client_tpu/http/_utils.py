@@ -142,6 +142,78 @@ def raise_if_error(status: int, body: bytes) -> None:
     raise InferenceServerException(msg=msg, status=str(status))
 
 
+class SSEDecoder:
+    """Incremental SSE event-stream decoder shared by the sync and aio
+    generate_stream clients (so framing behavior cannot drift between them).
+
+    Spec-compliant framing: events end at a blank line under LF *or* CRLF
+    framing (``\\r?\\n\\r?\\n``), and multiple ``data:`` lines within one
+    event are joined with ``\\n`` per the SSE spec before parsing. Events
+    are size-unbounded (the buffer grows to the event) — large streamed
+    tensors must not hit a line-length ceiling. ``feed`` returns the
+    ``data`` payload of each event completed by the chunk; ``flush``
+    drains a final event whose terminating blank line never arrived
+    (server closed after a partial flush).
+    """
+
+    __slots__ = ("_buf", "_scan")
+
+    def __init__(self):
+        self._buf = b""
+        self._scan = 0  # resume boundary search here (avoid re-scanning)
+
+    @staticmethod
+    def _event_payload(raw: bytes) -> Optional[bytes]:
+        datas = []
+        for line in raw.split(b"\n"):
+            line = line.rstrip(b"\r")
+            if line.startswith(b"data:"):
+                value = line[len(b"data:"):]
+                if value.startswith(b" "):  # spec: strip ONE leading space
+                    value = value[1:]
+                datas.append(value.strip())
+        if not datas:
+            return None
+        return b"\n".join(datas)
+
+    def feed(self, chunk: bytes) -> List[bytes]:
+        self._buf += chunk
+        payloads: List[bytes] = []
+        while True:
+            # find the earliest \n\n / \r\n\r\n / \n\r\n / \r\n\n boundary
+            i = self._buf.find(b"\n", self._scan)
+            boundary = None
+            while i != -1:
+                rest = self._buf[i + 1:i + 3]
+                if rest.startswith(b"\n"):
+                    boundary = (i, i + 2)
+                    break
+                if rest.startswith(b"\r\n"):
+                    boundary = (i, i + 3)
+                    break
+                if rest in (b"", b"\r"):
+                    # possible boundary split across chunks: wait for more
+                    break
+                i = self._buf.find(b"\n", i + 1)
+            if boundary is None:
+                # nothing conclusive: resume next feed just before the tail
+                # (a boundary can span at most 3 trailing bytes)
+                self._scan = max(0, len(self._buf) - 3)
+                return payloads
+            end, nxt = boundary
+            raw, self._buf = self._buf[:end], self._buf[nxt:]
+            self._scan = 0
+            payload = self._event_payload(raw)
+            if payload is not None:
+                payloads.append(payload)
+
+    def flush(self) -> List[bytes]:
+        """Parse a final unterminated event; must not silently drop it."""
+        raw, self._buf, self._scan = self._buf, b"", 0
+        payload = self._event_payload(raw)
+        return [payload] if payload is not None else []
+
+
 def parse_sse_event(payload: bytes):
     """Decode one generate-extension SSE ``data:`` payload.
 

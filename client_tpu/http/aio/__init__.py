@@ -8,7 +8,9 @@ differs.
 
 from __future__ import annotations
 
+import asyncio
 import json
+import time
 from typing import Any, Dict, List, Optional, Sequence
 from urllib.parse import quote
 
@@ -16,10 +18,12 @@ import aiohttp
 
 from ..._base import InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
+from ...resilience import RETRYABLE_HTTP_STATUSES, RetryableStatusError
 from ...utils import InferenceServerException
 from .._client import InferenceServerClient as _SyncClient
 from .._infer_result import InferResult
 from .._utils import (
+    SSEDecoder,
     build_infer_body,
     compress_body,
     parse_sse_event,
@@ -74,29 +78,71 @@ class InferenceServerClient(InferenceServerClientBase):
         headers: Optional[Dict[str, str]] = None,
         query_params: Optional[Dict[str, Any]] = None,
         timeout: Optional[float] = None,
+        idempotent: bool = True,
+        resilience=None,
     ):
-        hdrs = dict(headers or {})
-        request = Request(hdrs)
-        self._call_plugin(request)
+        """One HTTP round trip under the client's resilience policy (same
+        idempotency contract as the sync twin: in-flight failures and
+        shed-load statuses re-attempt only for idempotent requests)."""
         url = f"{self._base}/{path}"
-        if self._verbose:
-            print(f"{method} {url}, headers {request.headers}")
-        kwargs: Dict[str, Any] = dict(headers=request.headers, params=query_params)
+        policy = self._resilience_for(resilience)
+        kwargs: Dict[str, Any] = dict(params=query_params)
         if body is not None:
             kwargs["data"] = body
-        if timeout is not None:
-            kwargs["timeout"] = aiohttp.ClientTimeout(total=timeout)
+        budget = timeout
+        per_attempt = None
+        if policy is not None and policy.retry is not None:
+            per_attempt = policy.retry.per_attempt_timeout_s
+            if budget is None:
+                # the policy's total deadline must bound in-flight attempts
+                # too, not only backoff sleeps
+                budget = policy.retry.total_deadline_s
+        deadline = time.monotonic() + budget if budget is not None else None
+        if timeout is None and per_attempt is not None:
+            kwargs["timeout"] = aiohttp.ClientTimeout(total=per_attempt)
+        retry_statuses = policy is not None and policy.retry_http_statuses
+
+        async def attempt():
+            # plugin runs per attempt: a token-refreshing plugin must be
+            # able to stamp a FRESH credential on every retry
+            request = Request(dict(headers or {}))
+            self._call_plugin(request)
+            kwargs["headers"] = request.headers
+            if self._verbose:
+                print(f"{method} {url}, headers {request.headers}")
+            if deadline is not None:
+                # re-attempts get the REMAINING budget, not a fresh timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise InferenceServerException(
+                        "Deadline Exceeded", status="499")
+                if per_attempt is not None:
+                    remaining = min(remaining, per_attempt)
+                kwargs["timeout"] = aiohttp.ClientTimeout(total=remaining)
+            try:
+                async with self._session.request(method, url, **kwargs) as resp:
+                    data = await resp.read()
+                    if self._verbose:
+                        print(f"-> {resp.status}")
+                    out = resp.status, dict(resp.headers), data
+            except (TimeoutError, asyncio.TimeoutError) as e:
+                # aiohttp raises TimeoutError on ClientTimeout(total=) expiry
+                # (asyncio.TimeoutError is a distinct class before 3.11)
+                raise InferenceServerException(
+                    "Deadline Exceeded", status="499") from e
+            except aiohttp.ClientError as e:
+                raise InferenceServerException(f"connection error: {e}") from e
+            if retry_statuses and str(out[0]) in RETRYABLE_HTTP_STATUSES:
+                raise RetryableStatusError(out[0], out)
+            return out
+
+        if policy is None:
+            return await attempt()
         try:
-            async with self._session.request(method, url, **kwargs) as resp:
-                data = await resp.read()
-                if self._verbose:
-                    print(f"-> {resp.status}")
-                return resp.status, dict(resp.headers), data
-        except TimeoutError as e:
-            # aiohttp raises plain TimeoutError on ClientTimeout(total=) expiry
-            raise InferenceServerException("Deadline Exceeded", status="499") from e
-        except aiohttp.ClientError as e:
-            raise InferenceServerException(f"connection error: {e}") from e
+            return await policy.execute_async(
+                attempt, idempotent=idempotent, timeout_s=timeout)
+        except RetryableStatusError as e:
+            return e.response
 
     async def _get_json(self, path, headers=None, query_params=None):
         status, _, data = await self._request("GET", path, None, headers, query_params)
@@ -259,6 +305,7 @@ class InferenceServerClient(InferenceServerClientBase):
         request_compression_algorithm: Optional[str] = None,
         response_compression_algorithm: Optional[str] = None,
         parameters: Optional[Dict[str, Any]] = None,
+        resilience=None,
     ) -> InferResult:
         body, json_size = build_infer_body(
             inputs, outputs, request_id, sequence_id, sequence_start,
@@ -279,7 +326,9 @@ class InferenceServerClient(InferenceServerClientBase):
         if model_version:
             uri += f"/versions/{model_version}"
         status, resp_headers, data = await self._request(
-            "POST", uri + "/infer", body, hdrs, query_params, timeout=client_timeout
+            "POST", uri + "/infer", body, hdrs, query_params,
+            timeout=client_timeout, idempotent=sequence_id == 0,
+            resilience=resilience,
         )
         raise_if_error(status, data)  # aiohttp auto-decodes Content-Encoding
         header_length = resp_headers.get("Inference-Header-Content-Length")
@@ -351,10 +400,15 @@ class InferenceServerClient(InferenceServerClientBase):
                     # an empty stream with no error at all
                     raise InferenceServerException(
                         f"unexpected generate_stream status {resp.status}")
-                async for raw_line in resp.content:
-                    line = raw_line.strip()
-                    if not line.startswith(b"data:"):
-                        continue
-                    yield parse_sse_event(line[len(b"data:"):].strip())
+                # chunked reads through the shared SSEDecoder (same framing
+                # as the sync client): no 64 KiB StreamReader line ceiling
+                # for large streamed tensors, CRLF event framing streams
+                # instead of buffering to EOF, multi-line data: fields join
+                decoder = SSEDecoder()
+                async for chunk in resp.content.iter_chunked(8192):
+                    for payload in decoder.feed(chunk):
+                        yield parse_sse_event(payload)
+                for payload in decoder.flush():
+                    yield parse_sse_event(payload)
         except aiohttp.ClientError as e:
             raise InferenceServerException(f"connection error: {e}") from e

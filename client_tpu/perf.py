@@ -48,6 +48,28 @@ def _random_tensor(datatype: str, shape: List[int], rng) -> np.ndarray:
     return rng.standard_normal(shape).astype(np_dtype)
 
 
+def _parse_chaos_fault(spec: str):
+    """``--chaos`` spec -> a testing.chaos.Fault (None = clean proxy)."""
+    from .testing.chaos import Fault
+
+    if spec in ("", "none"):
+        return None
+    kind, _, arg = spec.partition(":")
+    if kind == "latency":
+        return Fault("latency", latency_s=float(arg or 0.001))
+    if kind == "reset":
+        return Fault("reset", after_bytes=int(arg or 0))
+    if kind == "stall":
+        return Fault("stall", after_bytes=int(arg or 0))
+    if kind == "flap":
+        return Fault("flap", every=int(arg or 2))
+    if kind == "blackhole":
+        return Fault("blackhole")
+    raise ValueError(
+        f"unknown --chaos spec {spec!r} "
+        "(none|latency:S|reset:N|stall:N|flap:K|blackhole)")
+
+
 class PerfRunner:
     """Drives one (concurrency, shared-memory-mode) measurement."""
 
@@ -60,25 +82,59 @@ class PerfRunner:
         shape_overrides: Optional[Dict[str, List[int]]] = None,
         batch_size: int = 0,
         seed: int = 0,
+        retries: int = 0,
+        chaos: Optional[str] = None,
     ):
+        """``retries``: arm a resilience policy (RetryPolicy with
+        ``retries``+1 attempts) on every measurement client — benchmarks
+        the pay-for-what-you-use overhead of the policy path. ``chaos``:
+        route measurement traffic through an in-process fault-injection
+        proxy (``client_tpu.testing.chaos``); spec is ``none`` (proxy
+        only), ``latency:S``, ``reset:N``, ``stall:N``, ``flap:K`` or
+        ``blackhole``. Control/probe traffic always goes direct."""
         self.url = url
+        self._direct_url = url
         self.protocol = protocol
         self.model_name = model_name
         self.shared_memory = shared_memory
         self.shape_overrides = shape_overrides or {}
         self.batch_size = batch_size
         self.rng = np.random.default_rng(seed)
+        self.retries = max(0, retries)
+        self._proxy = None
         if protocol in ("native", "native-grpc") and shared_memory == "system":
             raise ValueError("native protocols support --shared-memory none|tpu")
         if protocol == "native-grpc-async" and shared_memory != "none":
             raise ValueError("native-grpc-async supports --shared-memory none")
-        self._client_mod = self._import_client_mod()
-        self._metadata = self._fetch_metadata()
-        self._tensors = self._generate_tensors()
-        # shm modes place outputs in regions too; probe once over the wire
-        # to learn output byte sizes (perf_analyzer's output-shared-memory
-        # sizing, derived instead of flag-supplied)
-        self._output_sizes = self._probe_output_sizes() if shared_memory != "none" else {}
+        if self.retries and protocol.startswith("native"):
+            raise ValueError(
+                "--retries requires a python frontend (http|grpc): the native "
+                "clients have no resilience hook")
+        if chaos is not None:
+            from .testing.chaos import ChaosProxy
+
+            fault = _parse_chaos_fault(chaos)  # validate BEFORE binding
+            host, _, port = url.partition(":")
+            self._proxy = ChaosProxy(host, int(port)).start()
+            self._proxy.fault = fault
+            self.url = self._proxy.url
+        try:
+            self._client_mod = self._import_client_mod()
+            self._metadata = self._fetch_metadata()
+            self._tensors = self._generate_tensors()
+            # shm modes place outputs in regions too; probe once over the
+            # wire to learn output byte sizes (perf_analyzer's
+            # output-shared-memory sizing, derived instead of flag-supplied)
+            self._output_sizes = (
+                self._probe_output_sizes() if shared_memory != "none" else {})
+        except Exception:
+            self.close()  # don't leak the proxy listener on init failure
+            raise
+
+    def close(self) -> None:
+        if self._proxy is not None:
+            self._proxy.stop()
+            self._proxy = None
 
     def _import_client_mod(self):
         if self.protocol in ("http", "native"):
@@ -97,17 +153,26 @@ class PerfRunner:
 
             return NativeGrpcClient(self.url)
         if self.protocol == "http":
-            return self._client_mod.InferenceServerClient(self.url, concurrency=concurrency)
-        return self._client_mod.InferenceServerClient(self.url)
+            client = self._client_mod.InferenceServerClient(
+                self.url, concurrency=concurrency)
+        else:
+            client = self._client_mod.InferenceServerClient(self.url)
+        if self.retries:
+            from .resilience import ResiliencePolicy, RetryPolicy
+
+            client.configure_resilience(ResiliencePolicy(
+                retry=RetryPolicy(max_attempts=self.retries + 1)))
+        return client
 
     def _control_client(self):
         """(client, module) for metadata/probing: the protocol's own python
-        client, except native (whose C API is a data-plane surface) -> http."""
+        client, except native (whose C API is a data-plane surface) -> http.
+        Always dials the server directly (never the chaos proxy)."""
         if self.protocol in ("grpc", "native-grpc", "native-grpc-async"):
             import client_tpu.grpc as mod
         else:
             import client_tpu.http as mod
-        return mod.InferenceServerClient(self.url), mod
+        return mod.InferenceServerClient(self._direct_url), mod
 
     def _fetch_metadata(self) -> Dict[str, Any]:
         client, _ = self._control_client()
@@ -649,6 +714,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument("-f", "--format", choices=("table", "json"), default="table")
     parser.add_argument("--warmup-requests", type=int, default=10)
+    parser.add_argument(
+        "--retries", type=int, default=0,
+        help="arm a resilience RetryPolicy with N re-attempts on every "
+             "measurement client (benchmarks the policy-path overhead)",
+    )
+    parser.add_argument(
+        "--chaos", default=None,
+        help="route measurement traffic through the in-process fault "
+             "proxy: none|latency:S|reset:N|stall:N|flap:K|blackhole "
+             "(none = clean proxy, for topology-identical baselines)",
+    )
     args = parser.parse_args(argv)
 
     parts = [int(x) for x in args.concurrency_range.split(":")]
@@ -663,29 +739,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     runner = PerfRunner(
         args.url, args.protocol, args.model_name, args.shared_memory,
         shape_overrides, args.batch_size,
+        retries=args.retries, chaos=args.chaos,
     )
-    if args.warmup_requests:
-        runner.run(1, args.warmup_requests)
+    try:
+        if args.warmup_requests:
+            runner.run(1, args.warmup_requests)
 
-    results = []
-    if args.request_rate_range is not None:
-        rparts = [float(x) for x in args.request_rate_range.split(":")]
-        rstart = rparts[0]
-        rend = rparts[1] if len(rparts) > 1 else rstart
-        rstep = rparts[2] if len(rparts) > 2 else 1.0
-        if rstep <= 0:
-            # match the closed-loop path, where range() rejects step=0
-            raise ValueError("--request-rate-range step must be > 0")
-        rate = rstart
-        while rate <= rend + 1e-9:
-            results.append(runner.run_rate(
-                rate, args.measurement_requests,
-                distribution=args.request_distribution,
-                pool_size=args.rate_pool_size))
-            rate += rstep
-    else:
-        for concurrency in range(start, end + 1, step):
-            results.append(runner.run(concurrency, args.measurement_requests))
+        results = []
+        if args.request_rate_range is not None:
+            rparts = [float(x) for x in args.request_rate_range.split(":")]
+            rstart = rparts[0]
+            rend = rparts[1] if len(rparts) > 1 else rstart
+            rstep = rparts[2] if len(rparts) > 2 else 1.0
+            if rstep <= 0:
+                # match the closed-loop path, where range() rejects step=0
+                raise ValueError("--request-rate-range step must be > 0")
+            rate = rstart
+            while rate <= rend + 1e-9:
+                results.append(runner.run_rate(
+                    rate, args.measurement_requests,
+                    distribution=args.request_distribution,
+                    pool_size=args.rate_pool_size))
+                rate += rstep
+        else:
+            for concurrency in range(start, end + 1, step):
+                results.append(runner.run(concurrency, args.measurement_requests))
+    finally:
+        runner.close()
 
     if args.format == "json":
         print(json.dumps(results))

@@ -301,7 +301,14 @@ class _Handlers(grpc.GenericRpcHandler):
                         else grpc.StatusCode.INTERNAL
                     )
                     context.abort(code, str(e))
-                yield {"error_message": str(e)}  # in-band (default semantics)
+                # in-band (default semantics); the request id rides in the
+                # otherwise-empty infer_response so clients can attribute
+                # the error to the exact request (reconnecting streams
+                # retire its pending entry precisely instead of guessing)
+                out: Dict[str, Any] = {"error_message": str(e)}
+                if request.get("id"):
+                    out["infer_response"] = {"id": request["id"]}
+                yield out
 
     # -- repository ----------------------------------------------------------
     def _repository_index(self, request, context):

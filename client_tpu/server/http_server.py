@@ -512,16 +512,15 @@ class _Handler(BaseHTTPRequestHandler):
                                core_req))
 
         gen = self.core.infer_stream(model_name, model_version, core_req)
-        try:
-            first = next(gen, None)
-        except BaseException:
-            gen.close()
-            raise  # pre-stream failure -> proper HTTP status via do_POST
 
-        # committed to a stream: chunked SSE, one event per response. Once
-        # the headers are out NOTHING may escape to do_POST's handler (its
-        # JSON error response would land mid-chunked-body and corrupt the
-        # framing) — every failure below is handled here.
+        # committed to a stream: chunked SSE, one event per response. The
+        # 200 + event-stream headers go out BEFORE the first response is
+        # computed, so header-timeout intermediaries see a live connection
+        # through a slow first token; a pre-first-response failure becomes
+        # an in-band error event. Once the headers are out NOTHING may
+        # escape to do_POST's handler (its JSON error response would land
+        # mid-chunked-body and corrupt the framing) — every failure below
+        # is handled here.
         def chunk(data: bytes) -> None:
             self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
 
@@ -531,7 +530,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
-            item = first
+            self.wfile.flush()  # headers on the wire before next(gen) blocks
+            item = None
+            try:
+                item = next(gen, None)
+            except Exception as e:
+                chunk(_sse_event({"error": str(e)}))
             while item is not None:
                 chunk(_sse_event(_generate_event(item)))
                 try:

@@ -1,0 +1,7 @@
+"""Test-support subsystems shipped with the framework (importable by user
+test suites, not only this repo's): currently the chaos fault-injection
+proxy that proves the resilience layer end-to-end."""
+
+from .chaos import ChaosProxy, Fault
+
+__all__ = ["ChaosProxy", "Fault"]

@@ -1,0 +1,315 @@
+"""In-process TCP fault-injection proxy (toxiproxy-style).
+
+A localhost TCP proxy that sits between a client and a real server and
+injects transport faults on command, so ``tests/test_resilience.py`` can
+prove retry / circuit-breaker / stream-reconnect behavior against live
+HTTP and GRPC servers instead of mocks. Works for any byte protocol —
+it never parses what it forwards.
+
+Fault vocabulary (see :class:`Fault`):
+
+- ``latency``   — delay every forwarded chunk by ``latency_s``.
+- ``reset``     — hard TCP reset (RST via SO_LINGER 0) once ``after_bytes``
+  total bytes have crossed the proxy in either direction. ``after_bytes=0``
+  resets immediately after accept (connect succeeds, then dies).
+- ``blackhole`` — accept, read and discard client bytes, never connect
+  upstream, never answer (exercises read-timeout paths).
+- ``stall``     — forward the request, deliver ``after_bytes`` of the
+  response, then stop forwarding while holding the socket open
+  (partial-write-then-stall).
+- ``flap``      — reset at accept on every ``every``-th connection
+  (connection flapping).
+
+``Fault.limit`` bounds how many connections a fault is applied to
+(``None`` = unlimited) — set ``limit=1`` to fault exactly the first
+connection and let retries through, or clear ``proxy.fault = None`` to
+heal. ``reset_active()`` RSTs currently-established connections (kills a
+live GRPC stream mid-flight).
+
+Usage::
+
+    proxy = ChaosProxy("127.0.0.1", server.port).start()
+    client = InferenceServerClient(proxy.url)
+    proxy.fault = Fault("reset", after_bytes=64, limit=1)
+    ...
+    proxy.stop()
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ChaosProxy", "Fault"]
+
+_KINDS = ("latency", "reset", "blackhole", "stall", "flap")
+
+
+class Fault:
+    """One fault rule applied to connections accepted while it is set."""
+
+    def __init__(
+        self,
+        kind: str,
+        after_bytes: int = 0,
+        latency_s: float = 0.0,
+        every: int = 1,
+        limit: Optional[int] = None,
+    ):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} (one of {_KINDS})")
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        self.kind = kind
+        self.after_bytes = after_bytes
+        self.latency_s = latency_s
+        self.every = every
+        self.limit = limit
+        self._applied = 0
+        self._lock = threading.Lock()
+
+    def claim(self, conn_index: int) -> bool:
+        """Whether this connection (1-based accept index) gets the fault."""
+        with self._lock:
+            if self.limit is not None and self._applied >= self.limit:
+                return False
+            if conn_index % self.every != 0:
+                return False
+            self._applied += 1
+            return True
+
+    def __repr__(self) -> str:
+        return (f"Fault({self.kind!r}, after_bytes={self.after_bytes}, "
+                f"latency_s={self.latency_s}, every={self.every}, "
+                f"limit={self.limit})")
+
+
+def _rst_close(sock: socket.socket) -> None:
+    """Close with an RST instead of FIN (SO_LINGER onoff=1, linger=0)."""
+    try:
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class _Connection:
+    """One proxied connection: two pump threads + shared fault state."""
+
+    def __init__(self, proxy: "ChaosProxy", client: socket.socket,
+                 fault: Optional[Fault]):
+        self.proxy = proxy
+        self.client = client
+        self.fault = fault
+        self.upstream: Optional[socket.socket] = None
+        self.total_bytes = 0
+        self._lock = threading.Lock()
+        self._dead = False
+        self._threads: List[threading.Thread] = []
+
+    def run(self) -> None:
+        fault = self.fault
+        if fault is not None and fault.kind == "flap":
+            self.proxy._note_fault()
+            _rst_close(self.client)
+            return
+        if fault is not None and fault.kind == "blackhole":
+            self.proxy._note_fault()
+            # own thread: swallowing this client until it gives up must not
+            # block the accept loop (later connections would stall unproxied)
+            t = threading.Thread(
+                target=self._blackhole, name="chaos_blackhole", daemon=True)
+            self._threads.append(t)
+            t.start()
+            return
+        try:
+            self.upstream = socket.create_connection(
+                (self.proxy.upstream_host, self.proxy.upstream_port),
+                timeout=10,
+            )
+            self.upstream.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # short poll timeout, NOT blocking recv: a pump blocked in
+            # recv() pins the fd in the kernel, deferring kill()'s RST
+            # until data arrives — which for an idle connection is never
+            self.upstream.settimeout(0.2)
+            self.client.settimeout(0.2)
+        except OSError:
+            _rst_close(self.client)
+            return
+        if fault is not None:
+            self.proxy._note_fault()
+        for src, dst, direction in (
+            (self.client, self.upstream, "c2s"),
+            (self.upstream, self.client, "s2c"),
+        ):
+            t = threading.Thread(
+                target=self._pump, args=(src, dst, direction),
+                name=f"chaos_{direction}", daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def _blackhole(self) -> None:
+        self.client.settimeout(0.2)
+        try:
+            while not self._dead:
+                try:
+                    if not self.client.recv(65536):
+                        break
+                except socket.timeout:
+                    continue
+        except OSError:
+            pass
+        finally:
+            _rst_close(self.client)
+
+    def _pump(self, src: socket.socket, dst: socket.socket, direction: str) -> None:
+        fault = self.fault
+        try:
+            while True:
+                try:
+                    data = src.recv(65536)
+                except socket.timeout:
+                    if self._dead:
+                        return
+                    continue
+                while self.proxy.pause_forwarding and not self._dead:
+                    time.sleep(0.005)  # freeze established flows on command
+                if self._dead:
+                    return
+                if not data:
+                    try:
+                        dst.shutdown(socket.SHUT_WR)  # propagate half-close
+                    except OSError:
+                        pass
+                    return
+                if fault is not None and fault.kind == "latency":
+                    time.sleep(fault.latency_s)
+                if fault is not None and fault.kind == "reset":
+                    with self._lock:
+                        self.total_bytes += len(data)
+                        tripped = self.total_bytes >= fault.after_bytes
+                    if tripped:
+                        self.kill()
+                        return
+                if fault is not None and fault.kind == "stall" and direction == "s2c":
+                    with self._lock:
+                        budget = fault.after_bytes - self.total_bytes
+                        self.total_bytes += len(data)
+                    if budget <= 0:
+                        # hold the socket open, forward nothing more
+                        while not self._dead:
+                            time.sleep(0.05)
+                        return
+                    data = data[:budget]
+                dst.sendall(data)
+        except OSError:
+            self.kill()
+
+    def kill(self) -> None:
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+        _rst_close(self.client)
+        if self.upstream is not None:
+            _rst_close(self.upstream)
+
+
+class ChaosProxy:
+    """A localhost TCP proxy with runtime-injectable faults.
+
+    ``fault`` may be swapped at any time; it applies to connections
+    accepted from then on (use :meth:`reset_active` to also kill
+    already-established ones). Thread-per-pump keeps it simple and is
+    plenty for test traffic.
+    """
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 listen_port: int = 0):
+        self.upstream_host = upstream_host
+        self.upstream_port = upstream_port
+        self.fault: Optional[Fault] = None
+        # freeze established connections (bytes buffer, nothing forwarded)
+        # without killing them — pairs with reset_active() to make in-flight
+        # requests provably un-delivered before the connection dies
+        self.pause_forwarding = False
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", listen_port))
+        self._listener.listen(128)
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: List[_Connection] = []
+        self.stats: Dict[str, int] = {"connections": 0, "faulted": 0}
+
+    @property
+    def port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    @property
+    def url(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "ChaosProxy":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chaos_accept", daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.reset_active()
+
+    def __enter__(self) -> "ChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def reset_active(self) -> None:
+        """RST every currently-established proxied connection (kills live
+        streams mid-flight; new connections are unaffected)."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            conn.kill()
+
+    def heal(self) -> None:
+        """Clear the fault rule; subsequent connections pass through clean."""
+        self.fault = None
+
+    def _note_fault(self) -> None:
+        with self._lock:
+            self.stats["faulted"] += 1
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self.stats["connections"] += 1
+                index = self.stats["connections"]
+            fault = self.fault
+            if fault is not None and not fault.claim(index):
+                fault = None
+            conn = _Connection(self, client, fault)
+            with self._lock:
+                self._conns = [c for c in self._conns if not c._dead]
+                self._conns.append(conn)
+            conn.run()

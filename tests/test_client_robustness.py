@@ -2,9 +2,11 @@
 the reference's mocked-transport tests, test_inference_server_client.py:48-117,
 taken further: a live socket returning malformed payloads)."""
 
+import asyncio
 import http.server
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -65,6 +67,33 @@ class _EvilHandler(http.server.BaseHTTPRequestHandler):
             # final event flushed without its terminating blank line
             body = (b"data: {\"model_name\":\"m\",\"OUT\":1}\n\n"
                     b"data: {\"model_name\":\"m\",\"OUT\":2}")
+            self._respond(200, body, {"Content-Type": "text/event-stream"})
+        elif mode == "crlf_sse":
+            # spec-compliant CRLF framing + a multi-line data: field; the
+            # first event is flushed 1.5s before the second so a client
+            # that only splits on \n\n visibly buffers to EOF instead of
+            # streaming
+            part1 = (b"data: {\"model_name\":\"m\",\r\n"
+                     b"data: \"OUT\": 1}\r\n\r\n")
+            part2 = b"data: {\"OUT\": 2}\r\n\r\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(part1), part1))
+            self.wfile.flush()
+            time.sleep(1.5)
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(part2), part2))
+            self.wfile.write(b"0\r\n\r\n")
+        elif mode == "oversized_sse":
+            # one event far beyond aiohttp's 64 KiB StreamReader line limit
+            big = json.dumps({"model_name": "m", "OUT": "x" * 200_000}).encode()
+            body = b"data: " + big + b"\n\ndata: {\"OUT\": 2}\n\n"
+            self._respond(200, body, {"Content-Type": "text/event-stream"})
+        elif mode == "oversized_malformed_sse":
+            # oversized AND non-JSON: must raise the typed client
+            # exception, never a raw ValueError from a line-length ceiling
+            body = b"data: " + b"{notjson " * 30_000 + b"\n\n"
             self._respond(200, body, {"Content-Type": "text/event-stream"})
 
     do_GET = do_POST
@@ -148,6 +177,86 @@ def test_truncated_sse_final_event_not_dropped(evil_server):
     with httpclient.InferenceServerClient(url) as c:
         events = list(c.generate_stream("m", {"IN": [1]}))
         assert [e["OUT"] for e in events] == [1, 2]
+
+
+def _aio_collect_events(url, model="m"):
+    """Drive the aio client's generate_stream against the evil server."""
+    import client_tpu.http.aio as aioclient
+
+    async def run():
+        events = []
+        async with aioclient.InferenceServerClient(url) as c:
+            async for event in c.generate_stream(model, {"IN": [1]}):
+                events.append((event, time.monotonic()))
+        return events
+
+    return asyncio.run(run())
+
+
+def test_crlf_sse_streams_instead_of_buffering_sync(evil_server):
+    """CRLF-framed events must stream as they arrive (a \\n\\n-only split
+    buffers the whole stream to EOF), and multi-line data: fields join
+    per the SSE spec."""
+    _EvilHandler.mode = "crlf_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    with httpclient.InferenceServerClient(url) as c:
+        t0 = time.monotonic()
+        arrivals = [(e, time.monotonic())
+                    for e in c.generate_stream("m", {"IN": [1]})]
+    assert [e for e, _ in arrivals] == [
+        {"model_name": "m", "OUT": 1}, {"OUT": 2}]
+    # the first event arrived well before the server's 1.5s pre-EOF stall
+    # ended (wide margin: absolute latency on a loaded runner stays < 1s)
+    assert arrivals[0][1] - t0 < 1.0, "CRLF events buffered until EOF"
+
+
+def test_crlf_sse_streams_instead_of_buffering_aio(evil_server):
+    _EvilHandler.mode = "crlf_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    t0 = time.monotonic()
+    arrivals = _aio_collect_events(url)
+    assert [e for e, _ in arrivals] == [
+        {"model_name": "m", "OUT": 1}, {"OUT": 2}]
+    assert arrivals[0][1] - t0 < 1.0, "CRLF events buffered until EOF"
+
+
+def test_oversized_sse_event_sync(evil_server):
+    """Events are size-unbounded: a 200 KB tensor event parses fine."""
+    _EvilHandler.mode = "oversized_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    with httpclient.InferenceServerClient(url) as c:
+        events = list(c.generate_stream("m", {"IN": [1]}))
+    assert len(events) == 2
+    assert events[0]["OUT"] == "x" * 200_000
+    assert events[1]["OUT"] == 2
+
+
+def test_oversized_sse_event_aio(evil_server):
+    """The aio client used to hit aiohttp's 64 KiB line ceiling (raw
+    ValueError); chunked reads through the shared decoder parse any size."""
+    _EvilHandler.mode = "oversized_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    events = [e for e, _ in _aio_collect_events(url)]
+    assert len(events) == 2
+    assert events[0]["OUT"] == "x" * 200_000
+    assert events[1]["OUT"] == 2
+
+
+def test_oversized_malformed_sse_typed_error_sync(evil_server):
+    _EvilHandler.mode = "oversized_malformed_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    with httpclient.InferenceServerClient(url) as c:
+        with pytest.raises(InferenceServerException, match="malformed"):
+            list(c.generate_stream("m", {"IN": [1]}))
+
+
+def test_oversized_malformed_sse_typed_error_aio(evil_server):
+    """Typed exception, never a raw ValueError, for hostile oversized
+    events on the aio client."""
+    _EvilHandler.mode = "oversized_malformed_sse"
+    url = f"127.0.0.1:{evil_server.server_address[1]}"
+    with pytest.raises(InferenceServerException, match="malformed"):
+        _aio_collect_events(url)
 
 
 def test_negative_binary_data_size_rejected():
