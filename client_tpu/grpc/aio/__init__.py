@@ -14,6 +14,7 @@ import grpc.aio
 
 from ..._base import InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
+from ...resilience import AttemptBudget
 from ...utils import InferenceServerException
 from .. import _messages as M
 from .._client import INT32_MAX, KeepAliveOptions, _to_exception
@@ -116,29 +117,11 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm=None, idempotent=True, resilience=None,
     ):
         policy = self._resilience_for(resilience)
-        budget = client_timeout
-        per_attempt = None
-        if policy is not None and policy.retry is not None:
-            per_attempt = policy.retry.per_attempt_timeout_s
-            if budget is None:
-                # the policy's total deadline must bound in-flight attempts
-                # too, not only backoff sleeps
-                budget = policy.retry.total_deadline_s
-        deadline = time.monotonic() + budget if budget is not None else None
+        budget = AttemptBudget(policy, client_timeout)
 
         async def attempt():
-            attempt_timeout = client_timeout
-            if deadline is not None:
-                # re-attempts get the REMAINING budget, not a fresh timeout
-                attempt_timeout = deadline - time.monotonic()
-                if attempt_timeout <= 0:
-                    raise InferenceServerException(
-                        "Deadline Exceeded",
-                        status="StatusCode.DEADLINE_EXCEEDED")
-            if per_attempt is not None:
-                attempt_timeout = (
-                    per_attempt if attempt_timeout is None
-                    else min(attempt_timeout, per_attempt))
+            attempt_timeout = budget.attempt_timeout_s(
+                status="StatusCode.DEADLINE_EXCEEDED")
             try:
                 return await self._callable(method)(
                     request,

@@ -28,6 +28,7 @@ from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
 from ..resilience import (
     RETRYABLE_HTTP_STATUSES,
+    AttemptBudget,
     RetryableStatusError,
     connect_only_policy,
 )
@@ -199,18 +200,7 @@ class InferenceServerClient(InferenceServerClientBase):
         kwargs: Dict[str, Any] = dict(preload_content=False)
         if body is not None:
             kwargs["body"] = body
-        budget = timeout
-        per_attempt = None
-        if policy is not None and policy.retry is not None:
-            per_attempt = policy.retry.per_attempt_timeout_s
-            if budget is None:
-                # the policy's total deadline must bound in-flight attempts
-                # too, not only backoff sleeps
-                budget = policy.retry.total_deadline_s
-        deadline = time.monotonic() + budget if budget is not None else None
-        if timeout is None and per_attempt is not None:
-            kwargs["timeout"] = urllib3.Timeout(
-                connect=per_attempt, read=per_attempt)
+        budget = AttemptBudget(policy, timeout)
         retry_statuses = policy is not None and policy.retry_http_statuses
 
         def attempt() -> _Response:
@@ -221,15 +211,8 @@ class InferenceServerClient(InferenceServerClientBase):
             kwargs["headers"] = request.headers
             if self._verbose:
                 print(f"{method} {uri}, headers {request.headers}")
-            if deadline is not None:
-                # each re-attempt gets only the REMAINING budget, not a
-                # fresh full timeout — the caller's deadline is total
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise InferenceServerException(
-                        "Deadline Exceeded", status="499")
-                if per_attempt is not None:
-                    remaining = min(remaining, per_attempt)
+            remaining = budget.attempt_timeout_s(status="499")
+            if remaining is not None:
                 kwargs["timeout"] = urllib3.Timeout(
                     connect=remaining, read=remaining)
             resp = None

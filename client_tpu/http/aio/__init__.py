@@ -18,7 +18,11 @@ import aiohttp
 
 from ..._base import InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
-from ...resilience import RETRYABLE_HTTP_STATUSES, RetryableStatusError
+from ...resilience import (
+    RETRYABLE_HTTP_STATUSES,
+    AttemptBudget,
+    RetryableStatusError,
+)
 from ...utils import InferenceServerException
 from .._client import InferenceServerClient as _SyncClient
 from .._infer_result import InferResult
@@ -89,17 +93,7 @@ class InferenceServerClient(InferenceServerClientBase):
         kwargs: Dict[str, Any] = dict(params=query_params)
         if body is not None:
             kwargs["data"] = body
-        budget = timeout
-        per_attempt = None
-        if policy is not None and policy.retry is not None:
-            per_attempt = policy.retry.per_attempt_timeout_s
-            if budget is None:
-                # the policy's total deadline must bound in-flight attempts
-                # too, not only backoff sleeps
-                budget = policy.retry.total_deadline_s
-        deadline = time.monotonic() + budget if budget is not None else None
-        if timeout is None and per_attempt is not None:
-            kwargs["timeout"] = aiohttp.ClientTimeout(total=per_attempt)
+        budget = AttemptBudget(policy, timeout)
         retry_statuses = policy is not None and policy.retry_http_statuses
 
         async def attempt():
@@ -110,14 +104,8 @@ class InferenceServerClient(InferenceServerClientBase):
             kwargs["headers"] = request.headers
             if self._verbose:
                 print(f"{method} {url}, headers {request.headers}")
-            if deadline is not None:
-                # re-attempts get the REMAINING budget, not a fresh timeout
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise InferenceServerException(
-                        "Deadline Exceeded", status="499")
-                if per_attempt is not None:
-                    remaining = min(remaining, per_attempt)
+            remaining = budget.attempt_timeout_s(status="499")
+            if remaining is not None:
                 kwargs["timeout"] = aiohttp.ClientTimeout(total=remaining)
             try:
                 async with self._session.request(method, url, **kwargs) as resp:

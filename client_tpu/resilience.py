@@ -42,6 +42,7 @@ __all__ = [
     "TRANSIENT",
     "TIMEOUT",
     "FATAL",
+    "AttemptBudget",
     "CircuitBreaker",
     "CircuitOpenError",
     "ResiliencePolicy",
@@ -403,6 +404,45 @@ class StreamReconnected:
         )
 
 
+class AttemptBudget:
+    """Shared deadline arithmetic for the frontends' retrying request
+    wrappers: derives the total budget (the caller's explicit timeout,
+    else the retry policy's total deadline — which must bound in-flight
+    attempts too, not only backoff sleeps) and clamps every re-attempt to
+    the REMAINING budget and the policy's per-attempt timeout, so a
+    re-attempt never gets a fresh full timeout."""
+
+    __slots__ = ("per_attempt_s", "deadline")
+
+    def __init__(self, policy: Optional["ResiliencePolicy"],
+                 timeout_s: Optional[float] = None):
+        budget = timeout_s
+        self.per_attempt_s: Optional[float] = None
+        if policy is not None and policy.retry is not None:
+            self.per_attempt_s = policy.retry.per_attempt_timeout_s
+            if budget is None:
+                budget = policy.retry.total_deadline_s
+        self.deadline = (
+            time.monotonic() + budget if budget is not None else None)
+
+    def attempt_timeout_s(self, status: str = "499") -> Optional[float]:
+        """Timeout for the next attempt: the remaining total budget clamped
+        to the per-attempt timeout, or None when both are unbounded. Raises
+        a typed Deadline Exceeded (with the transport's ``status`` code)
+        when the budget is already spent, so the engine never launches a
+        doomed attempt."""
+        remaining = None
+        if self.deadline is not None:
+            remaining = self.deadline - time.monotonic()
+            if remaining <= 0:
+                raise InferenceServerException(
+                    "Deadline Exceeded", status=status)
+        if self.per_attempt_s is not None:
+            remaining = (self.per_attempt_s if remaining is None
+                         else min(remaining, self.per_attempt_s))
+        return remaining
+
+
 class ResiliencePolicy:
     """Retry + circuit-breaker composition with sync and asyncio engines.
 
@@ -463,7 +503,11 @@ class ResiliencePolicy:
         if exc is None:
             breaker.record(True)
         elif isinstance(exc, CircuitOpenError):
-            pass  # a (nested) fast-fail never touched the endpoint
+            # a (nested) fast-fail never touched the endpoint, so there is
+            # no outcome to record — but if op() raised it while OUR breaker
+            # was half-open, the admitted probe slot must be released or the
+            # breaker wedges (half-open has no time-based escape)
+            breaker.abort_probe()
         elif self.classify(exc) in (CONNECT, TRANSIENT, TIMEOUT):
             breaker.record(False)
         else:

@@ -604,6 +604,31 @@ def test_half_open_probe_released_on_base_exception():
     assert breaker.state == CircuitBreaker.CLOSED
 
 
+def test_half_open_probe_released_on_nested_fast_fail():
+    """op() raising CircuitOpenError (e.g. a second policy's open breaker
+    fast-failing inside the op) while OUR breaker is half-open must release
+    the admitted probe slot — otherwise no outcome is ever recorded and the
+    breaker wedges in half-open forever."""
+    t = [0.0]
+    breaker = CircuitBreaker(
+        failure_threshold=0.5, window=4, min_calls=2, recovery_time_s=5.0,
+        clock=lambda: t[0])
+    policy = ResiliencePolicy(breaker=breaker)
+    breaker.record(False)
+    breaker.record(False)
+    assert breaker.state == CircuitBreaker.OPEN
+    t[0] = 6.0
+
+    def nested_fast_fail():
+        raise CircuitOpenError("inner endpoint's breaker is open")
+
+    with pytest.raises(CircuitOpenError):
+        policy.execute(nested_fast_fail)
+    assert breaker.state == CircuitBreaker.HALF_OPEN
+    policy.execute(lambda: 1)  # slot was released: next probe admitted
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
 def test_reattempt_timeout_clamped_to_remaining_deadline(http_server):
     """Re-attempts get only the REMAINING deadline budget — a stalled
     endpoint must not let retries run ~Nx the caller's client_timeout."""

@@ -226,7 +226,10 @@ def _dump_results(servers):
         # a run that exercised no _soak rows (e.g. only the probe-tool
         # smoke) must not rewrite a committed artifact's config block
         return
-    out = REPO / os.environ.get("CLIENT_TPU_SOAK_OUT", "SOAK_r03.json")
+    # default to a gitignored scratch file: committed round artifacts
+    # (SOAK_rNN.json) are historical records and must only be rewritten by
+    # deliberately pointing CLIENT_TPU_SOAK_OUT at them
+    out = REPO / os.environ.get("CLIENT_TPU_SOAK_OUT", "SOAK_latest.json")
     existing = {}
     if out.exists():
         try:
