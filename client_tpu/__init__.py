@@ -11,6 +11,10 @@ client libraries (triton-inference-server/client), designed TPU-first:
   with idempotency-aware fault classification and GRPC stream
   auto-reconnect; ``client_tpu.testing.chaos`` is the fault-injection
   proxy that proves them end-to-end (docs/resilience.md).
+- ``client_tpu.pool``: health-aware multi-endpoint pool over all four
+  frontends — active ready-probing + passive outlier ejection, routing
+  policies with per-endpoint circuit breakers, shared-deadline failover
+  (sequence requests are never silently re-sent), and hedged requests.
 - ``client_tpu.utils``: Triton<->numpy dtype mapping with *native* bfloat16
   (via ml_dtypes), BYTES/BF16 wire serialization.
 - ``client_tpu.utils.shared_memory``: POSIX system shared memory data plane.

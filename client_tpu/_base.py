@@ -70,7 +70,13 @@ class InferenceServerClientBase:
         return self._resilience
 
     def _resilience_for(self, override):
-        """The effective policy for one request (per-request override hook)."""
+        """The effective policy for one request (per-request override hook).
+
+        ``override=False`` explicitly bypasses the configured policy — the
+        health-probe paths use it so a probe observes the endpoint itself,
+        never an open circuit breaker's fast-fail."""
+        if override is False:
+            return None
         return override if override is not None else self._resilience
 
     def register_plugin(self, plugin: InferenceServerClientPlugin) -> None:

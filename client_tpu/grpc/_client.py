@@ -21,7 +21,7 @@ import grpc
 
 from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
-from ..resilience import AttemptBudget
+from ..resilience import FATAL, AttemptBudget, classify_fault
 from ..utils import InferenceServerException
 from . import _messages as M
 from ._infer import (
@@ -209,11 +209,29 @@ class InferenceServerClient(InferenceServerClientBase):
         return response
 
     # -- health / metadata -------------------------------------------------
-    def is_server_live(self, headers=None, client_timeout=None) -> bool:
-        return bool(self._call("ServerLive", {}, headers, client_timeout).get("live", False))
+    def _health(self, method, field, headers, client_timeout, probe: bool) -> bool:
+        """Shared ServerLive/ServerReady call. Default: transport failures
+        raise (the typed UNAVAILABLE/DEADLINE_EXCEEDED from ``_call``) so
+        callers can distinguish "server said no" from "could not ask".
+        ``probe=True`` maps connect/transient/timeout-class failures to
+        False and bypasses the configured resilience policy — the pool's
+        health poller must observe the endpoint, never a breaker fast-fail."""
+        try:
+            resp = self._call(method, {}, headers, client_timeout,
+                              resilience=False if probe else None)
+        except InferenceServerException as e:
+            if probe and classify_fault(e) != FATAL:
+                return False
+            raise
+        return bool(resp.get(field, False))
 
-    def is_server_ready(self, headers=None, client_timeout=None) -> bool:
-        return bool(self._call("ServerReady", {}, headers, client_timeout).get("ready", False))
+    def is_server_live(self, headers=None, client_timeout=None,
+                       probe: bool = False) -> bool:
+        return self._health("ServerLive", "live", headers, client_timeout, probe)
+
+    def is_server_ready(self, headers=None, client_timeout=None,
+                        probe: bool = False) -> bool:
+        return self._health("ServerReady", "ready", headers, client_timeout, probe)
 
     def is_model_ready(self, model_name, model_version="", headers=None, client_timeout=None) -> bool:
         # transport errors propagate (matching the HTTP client and the

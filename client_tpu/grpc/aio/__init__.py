@@ -14,7 +14,7 @@ import grpc.aio
 
 from ..._base import InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
-from ...resilience import AttemptBudget
+from ...resilience import FATAL, AttemptBudget, classify_fault
 from ...utils import InferenceServerException
 from .. import _messages as M
 from .._client import INT32_MAX, KeepAliveOptions, _to_exception
@@ -138,11 +138,29 @@ class InferenceServerClient(InferenceServerClientBase):
             attempt, idempotent=idempotent, timeout_s=client_timeout)
 
     # -- surface (async twins of the sync client) ---------------------------
-    async def is_server_live(self, headers=None, client_timeout=None) -> bool:
-        return bool((await self._call("ServerLive", {}, headers, client_timeout)).get("live", False))
+    async def _health(self, method, field, headers, client_timeout,
+                      probe: bool) -> bool:
+        """Async twin of the sync client's ``_health``: transport failures
+        raise by default; ``probe=True`` maps connect/transient/timeout-class
+        failures to False and bypasses the resilience policy."""
+        try:
+            resp = await self._call(method, {}, headers, client_timeout,
+                                    resilience=False if probe else None)
+        except InferenceServerException as e:
+            if probe and classify_fault(e) != FATAL:
+                return False
+            raise
+        return bool(resp.get(field, False))
 
-    async def is_server_ready(self, headers=None, client_timeout=None) -> bool:
-        return bool((await self._call("ServerReady", {}, headers, client_timeout)).get("ready", False))
+    async def is_server_live(self, headers=None, client_timeout=None,
+                             probe: bool = False) -> bool:
+        return await self._health(
+            "ServerLive", "live", headers, client_timeout, probe)
+
+    async def is_server_ready(self, headers=None, client_timeout=None,
+                              probe: bool = False) -> bool:
+        return await self._health(
+            "ServerReady", "ready", headers, client_timeout, probe)
 
     async def is_model_ready(self, model_name, model_version="", headers=None, client_timeout=None) -> bool:
         resp = await self._call(

@@ -27,9 +27,11 @@ import urllib3
 from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
 from ..resilience import (
+    FATAL,
     RETRYABLE_HTTP_STATUSES,
     AttemptBudget,
     RetryableStatusError,
+    classify_fault,
     connect_only_policy,
 )
 from ..utils import InferenceServerException
@@ -196,7 +198,10 @@ class InferenceServerClient(InferenceServerClientBase):
         uri = "/" + path
         if query_params:
             uri += "?" + urlencode(query_params)
-        policy = self._resilience_for(resilience) or self._legacy_policy
+        if resilience is False:  # explicit bypass (health probes): raw, even past the legacy knob
+            policy = None
+        else:
+            policy = self._resilience_for(resilience) or self._legacy_policy
         kwargs: Dict[str, Any] = dict(preload_content=False)
         if body is not None:
             kwargs["body"] = body
@@ -281,11 +286,39 @@ class InferenceServerClient(InferenceServerClientBase):
         return json.loads(resp.data) if resp.data else {}
 
     # -- health / metadata -------------------------------------------------
-    def is_server_live(self, headers=None, query_params=None) -> bool:
-        return self._get("v2/health/live", headers, query_params).status == 200
+    def _health(self, path, headers, query_params, probe: bool,
+                client_timeout: Optional[float]) -> bool:
+        """Shared live/ready GET. Default semantics match the reference:
+        transport failures (connection refused, resets, timeouts) RAISE —
+        callers distinguish "server said not ready" from "could not ask".
+        ``probe=True`` is the health-poller mode: connect/transient/timeout
+        -class failures return False instead (a dead endpoint is not ready),
+        and the request bypasses any configured resilience policy so the
+        probe observes the endpoint, never a breaker's fast-fail. FATAL
+        (application/protocol) errors still raise."""
+        try:
+            resp = self._request(
+                "GET", path, headers=headers, query_params=query_params,
+                timeout=client_timeout,
+                resilience=False if probe else None,
+            )
+        except InferenceServerException as e:
+            if probe and classify_fault(e) != FATAL:
+                return False
+            raise
+        return resp.status == 200
 
-    def is_server_ready(self, headers=None, query_params=None) -> bool:
-        return self._get("v2/health/ready", headers, query_params).status == 200
+    def is_server_live(self, headers=None, query_params=None,
+                       probe: bool = False,
+                       client_timeout: Optional[float] = None) -> bool:
+        return self._health(
+            "v2/health/live", headers, query_params, probe, client_timeout)
+
+    def is_server_ready(self, headers=None, query_params=None,
+                        probe: bool = False,
+                        client_timeout: Optional[float] = None) -> bool:
+        return self._health(
+            "v2/health/ready", headers, query_params, probe, client_timeout)
 
     def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
         path = f"v2/models/{quote(model_name)}"

@@ -19,9 +19,11 @@ import aiohttp
 from ..._base import InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
 from ...resilience import (
+    FATAL,
     RETRYABLE_HTTP_STATUSES,
     AttemptBudget,
     RetryableStatusError,
+    classify_fault,
 )
 from ...utils import InferenceServerException
 from .._client import InferenceServerClient as _SyncClient
@@ -143,13 +145,35 @@ class InferenceServerClient(InferenceServerClientBase):
         return json.loads(data) if data else {}
 
     # -- health / metadata -------------------------------------------------
-    async def is_server_live(self, headers=None, query_params=None) -> bool:
-        status, _, _ = await self._request("GET", "v2/health/live", None, headers, query_params)
+    async def _health(self, path, headers, query_params, probe: bool,
+                      client_timeout: Optional[float]) -> bool:
+        """Shared live/ready GET; same contract as the sync twin: transport
+        failures raise by default, ``probe=True`` maps connect/transient/
+        timeout-class failures to False and bypasses the resilience policy
+        (health pollers must observe the endpoint, not an open breaker)."""
+        try:
+            status, _, _ = await self._request(
+                "GET", path, None, headers, query_params,
+                timeout=client_timeout,
+                resilience=False if probe else None,
+            )
+        except InferenceServerException as e:
+            if probe and classify_fault(e) != FATAL:
+                return False
+            raise
         return status == 200
 
-    async def is_server_ready(self, headers=None, query_params=None) -> bool:
-        status, _, _ = await self._request("GET", "v2/health/ready", None, headers, query_params)
-        return status == 200
+    async def is_server_live(self, headers=None, query_params=None,
+                             probe: bool = False,
+                             client_timeout: Optional[float] = None) -> bool:
+        return await self._health(
+            "v2/health/live", headers, query_params, probe, client_timeout)
+
+    async def is_server_ready(self, headers=None, query_params=None,
+                              probe: bool = False,
+                              client_timeout: Optional[float] = None) -> bool:
+        return await self._health(
+            "v2/health/ready", headers, query_params, probe, client_timeout)
 
     async def is_model_ready(self, model_name, model_version="", headers=None, query_params=None) -> bool:
         path = f"v2/models/{quote(model_name)}"

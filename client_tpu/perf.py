@@ -84,6 +84,9 @@ class PerfRunner:
         seed: int = 0,
         retries: int = 0,
         chaos: Optional[str] = None,
+        endpoints: Optional[List[str]] = None,
+        hedge: bool = False,
+        hedge_delay_s: Optional[float] = None,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -91,7 +94,12 @@ class PerfRunner:
         route measurement traffic through an in-process fault-injection
         proxy (``client_tpu.testing.chaos``); spec is ``none`` (proxy
         only), ``latency:S``, ``reset:N``, ``stall:N``, ``flap:K`` or
-        ``blackhole``. Control/probe traffic always goes direct."""
+        ``blackhole``. Control/probe traffic always goes direct.
+        ``endpoints``: N replica urls — measurement clients become
+        health-aware ``PoolClient``s (``client_tpu.pool``) over them;
+        ``url`` stays the control-plane address. ``hedge`` arms hedged
+        requests on the pool (``hedge_delay_s`` pins the hedge delay;
+        default is the rolling p95)."""
         self.url = url
         self._direct_url = url
         self.protocol = protocol
@@ -101,6 +109,9 @@ class PerfRunner:
         self.batch_size = batch_size
         self.rng = np.random.default_rng(seed)
         self.retries = max(0, retries)
+        self.endpoints = list(endpoints) if endpoints else None
+        self.hedge = hedge
+        self.hedge_delay_s = hedge_delay_s
         self._proxy = None
         if protocol in ("native", "native-grpc") and shared_memory == "system":
             raise ValueError("native protocols support --shared-memory none|tpu")
@@ -110,6 +121,20 @@ class PerfRunner:
             raise ValueError(
                 "--retries requires a python frontend (http|grpc): the native "
                 "clients have no resilience hook")
+        if self.endpoints and protocol not in ("http", "grpc"):
+            raise ValueError(
+                "--endpoints requires a python frontend (http|grpc): the "
+                "pool wraps the python clients")
+        if self.endpoints and shared_memory != "none":
+            raise ValueError(
+                "--endpoints requires --shared-memory none: regions would "
+                "register on one replica while infers route to all of them")
+        if self.endpoints and chaos is not None:
+            raise ValueError(
+                "--chaos proxies a single url; with --endpoints, stand up "
+                "one ChaosProxy per replica instead (tools/bench_pool.py)")
+        if self.hedge and not self.endpoints:
+            raise ValueError("--hedge requires --endpoints")
         if chaos is not None:
             from .testing.chaos import ChaosProxy
 
@@ -152,6 +177,8 @@ class PerfRunner:
             from client_tpu.native import NativeGrpcClient
 
             return NativeGrpcClient(self.url)
+        if self.endpoints:
+            return self._make_pool_client(concurrency)
         if self.protocol == "http":
             client = self._client_mod.InferenceServerClient(
                 self.url, concurrency=concurrency)
@@ -163,6 +190,34 @@ class PerfRunner:
             client.configure_resilience(ResiliencePolicy(
                 retry=RetryPolicy(max_attempts=self.retries + 1)))
         return client
+
+    def _make_pool_client(self, concurrency: int):
+        from .pool import HedgePolicy, PoolClient
+        from .resilience import RetryPolicy
+
+        factory = None
+        if self.protocol == "http":
+            mod = self._client_mod
+
+            def factory(url):
+                return mod.InferenceServerClient(url, concurrency=concurrency)
+
+        hedge = None
+        if self.hedge:
+            hedge = HedgePolicy(delay_s=self.hedge_delay_s)
+        endpoint_retry = (
+            RetryPolicy(max_attempts=self.retries + 1) if self.retries else None)
+        return PoolClient(
+            self.endpoints,
+            protocol=self.protocol,
+            client_factory=factory,
+            health_interval_s=0.5,
+            endpoint_retry=endpoint_retry,
+            hedge=hedge,
+            # primary + hedge both ride the executor: size it so the full
+            # worker concurrency never queues behind hedge threads
+            hedge_executor_workers=max(8, 2 * concurrency),
+        )
 
     def _control_client(self):
         """(client, module) for metadata/probing: the protocol's own python
@@ -725,6 +780,21 @@ def main(argv: Optional[List[str]] = None) -> int:
              "proxy: none|latency:S|reset:N|stall:N|flap:K|blackhole "
              "(none = clean proxy, for topology-identical baselines)",
     )
+    parser.add_argument(
+        "--endpoints", default=None,
+        help="comma-separated replica urls: measurement clients become "
+             "health-aware PoolClients over them (-u stays the "
+             "control-plane address; see client_tpu.pool)",
+    )
+    parser.add_argument(
+        "--hedge", action="store_true",
+        help="arm hedged requests on the pool (requires --endpoints)",
+    )
+    parser.add_argument(
+        "--hedge-delay", type=float, default=None,
+        help="hedge delay in seconds (default: rolling p95 of recent "
+             "latencies)",
+    )
     args = parser.parse_args(argv)
 
     parts = [int(x) for x in args.concurrency_range.split(":")]
@@ -740,6 +810,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.url, args.protocol, args.model_name, args.shared_memory,
         shape_overrides, args.batch_size,
         retries=args.retries, chaos=args.chaos,
+        endpoints=[u.strip() for u in args.endpoints.split(",") if u.strip()]
+        if args.endpoints else None,
+        hedge=args.hedge, hedge_delay_s=args.hedge_delay,
     )
     try:
         if args.warmup_requests:

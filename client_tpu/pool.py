@@ -1,0 +1,1487 @@
+"""Health-aware multi-endpoint pool: failover, hedging, outlier ejection.
+
+PR 1's resilience layer makes a *single* endpoint survivable; production
+deployments front a fleet of replica servers and need the client to keep
+working when one of them dies, degrades, or drains. This module is that
+layer: an :class:`EndpointPool` (the transport-free health/routing engine)
+plus :class:`PoolClient` / :class:`AioPoolClient` wrappers exposing the
+familiar ``InferenceServerClient`` API over N server URLs — constructible
+over all four frontends (HTTP sync/aio, GRPC sync/aio)::
+
+    from client_tpu.pool import PoolClient
+
+    client = PoolClient(["10.0.0.1:8000", "10.0.0.2:8000"], protocol="http")
+    client.infer("simple", inputs)          # routed, failed over, hedged
+    client.endpoint_stats()                 # per-endpoint snapshot
+
+What the pool provides:
+
+- **Active health probing** — a background prober calls each endpoint's
+  ``is_server_ready(probe=True)`` (the KServe v2 ready endpoint in
+  probe mode: connect-class failures return ``False`` instead of raising)
+  every ``health_interval_s``; an unready endpoint stops receiving traffic
+  until the probe succeeds again. A *draining* replica (ready flipped
+  false, still serving) is routed away from before its socket disappears.
+- **Passive outlier ejection** — ``resilience.classify_fault`` outcomes
+  feed per-endpoint consecutive-failure counters; ``eject_after``
+  consecutive transport failures eject the endpoint for an exponentially
+  growing window (``base_ejection_s * multiplier^k``, capped at
+  ``max_ejection_s``), Envoy-style. At most ``ceil(N/2)`` replicas are
+  ever ejected at once — the pool degrades before it self-blinds.
+- **Routing policies** — ``round_robin``, ``least_outstanding``, and
+  ``weighted`` (smooth weighted round-robin over static weights), each
+  honoring health, ejection, and the per-endpoint
+  :class:`~client_tpu.resilience.CircuitBreaker`: an endpoint whose
+  breaker is open is never selected; a half-open endpoint receives
+  exactly the probes its breaker admits.
+- **Transparent failover** — one shared
+  :class:`~client_tpu.resilience.AttemptBudget` deadline across replicas;
+  re-attempts obey PR 1's idempotency rule: a sequence request
+  (``sequence_id != 0``) whose in-flight attempt died is NEVER silently
+  re-sent to another replica — a typed :class:`SequenceAbandoned` event
+  is delivered to ``on_event`` and the original error raises.
+- **Hedged requests** — for idempotent infers with hedging armed, the
+  request is issued to a second replica after a hedge delay (default:
+  the rolling p95 of recent pool latencies, plus injectable-rng jitter);
+  the first success wins and the loser is cancelled (true cancellation
+  on asyncio, best-effort on threads). Sequence requests never hedge.
+
+GRPC bidi streams are NOT pooled: ``start_stream`` selects one endpoint
+and PINS the stream there — ``async_stream_infer`` / ``stop_stream``
+route to that same endpoint until the stream stops (use
+``auto_reconnect`` from PR 1 for same-endpoint stream recovery).
+
+Server-side *state* is fleet state: registration/admin mutators
+(``register_*`` / ``unregister_*`` / ``load_model`` / ``unload_model`` /
+``update_*`` settings, plus client plugins) are BROADCAST to every
+endpoint instead of landing on one arbitrary replica; read-only calls
+delegate to a single healthy endpoint under the failover engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import math
+import random
+import threading
+import time
+from collections import deque
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .resilience import (
+    CONNECT,
+    FATAL,
+    TIMEOUT,
+    TRANSIENT,
+    AttemptBudget,
+    CircuitBreaker,
+    CircuitOpenError,
+    ResiliencePolicy,
+    RetryPolicy,
+    classify_fault,
+)
+from .utils import InferenceServerException
+
+__all__ = [
+    "ROUND_ROBIN",
+    "LEAST_OUTSTANDING",
+    "WEIGHTED",
+    "AioPoolClient",
+    "EndpointEjected",
+    "EndpointHealthChanged",
+    "EndpointPool",
+    "EndpointReadmitted",
+    "HedgePolicy",
+    "NoEndpointAvailableError",
+    "PoolClient",
+    "SequenceAbandoned",
+]
+
+ROUND_ROBIN = "round_robin"
+LEAST_OUTSTANDING = "least_outstanding"
+WEIGHTED = "weighted"
+_ROUTING_POLICIES = (ROUND_ROBIN, LEAST_OUTSTANDING, WEIGHTED)
+
+
+class NoEndpointAvailableError(InferenceServerException):
+    """Every endpoint is ejected/unhealthy/breaker-open (or excluded)."""
+
+    def __init__(self, msg: str = "no endpoint available in the pool"):
+        super().__init__(msg, status="POOL_EXHAUSTED")
+
+
+# -- typed pool events --------------------------------------------------------
+class PoolEvent:
+    """Base for events delivered to the pool's ``on_event`` callback."""
+
+    __slots__ = ("url",)
+
+    def __init__(self, url: str):
+        self.url = url
+
+    def __repr__(self) -> str:
+        fields = ", ".join(
+            f"{name}={getattr(self, name)!r}"
+            for cls in type(self).__mro__ for name in getattr(cls, "__slots__", ())
+        )
+        return f"{type(self).__name__}({fields})"
+
+
+class EndpointEjected(PoolEvent):
+    """Passive outlier ejection fired for ``url``."""
+
+    __slots__ = ("window_s", "consecutive_failures", "ejection_count")
+
+    def __init__(self, url, window_s, consecutive_failures, ejection_count):
+        super().__init__(url)
+        self.window_s = window_s
+        self.consecutive_failures = consecutive_failures
+        self.ejection_count = ejection_count
+
+
+class EndpointReadmitted(PoolEvent):
+    """An ejected endpoint's window expired (or it proved itself healthy)."""
+
+    __slots__ = ()
+
+
+class EndpointHealthChanged(PoolEvent):
+    """The active ready-probe flipped this endpoint's health."""
+
+    __slots__ = ("healthy",)
+
+    def __init__(self, url, healthy: bool):
+        super().__init__(url)
+        self.healthy = healthy
+
+
+class SequenceAbandoned(PoolEvent):
+    """A non-idempotent (sequence) request failed in flight: the pool did
+    NOT re-send it to another replica (the server may already have applied
+    its state transition). The application owns re-driving the sequence.
+    Delivered to ``on_event``; the original transport error still raises."""
+
+    __slots__ = ("request_id", "sequence_id", "cause")
+
+    def __init__(self, url, request_id: str, sequence_id: int,
+                 cause: BaseException):
+        super().__init__(url)
+        self.request_id = request_id
+        self.sequence_id = sequence_id
+        self.cause = cause
+
+
+class HedgePolicy:
+    """When and how to hedge an idempotent infer.
+
+    ``delay_s=None`` (default) uses the pool's rolling p95 of recent infer
+    latencies — the canonical "hedge after the tail begins" setting; until
+    ``min_latency_samples`` latencies are recorded, ``fallback_delay_s``
+    is used. ``jitter_frac`` multiplies the delay by ``1 + U(0, frac)``
+    drawn from the injectable ``rng`` (deterministic under a seeded rng)
+    so synchronized clients don't hedge in lockstep. ``max_hedges`` bounds
+    extra in-flight copies per request (1 = primary + one hedge)."""
+
+    def __init__(
+        self,
+        delay_s: Optional[float] = None,
+        fallback_delay_s: float = 0.05,
+        jitter_frac: float = 0.1,
+        max_hedges: int = 1,
+        min_latency_samples: int = 8,
+        rng: Optional[random.Random] = None,
+    ):
+        if max_hedges < 1:
+            raise ValueError("max_hedges must be >= 1")
+        self.delay_s = delay_s
+        self.fallback_delay_s = fallback_delay_s
+        self.jitter_frac = jitter_frac
+        self.max_hedges = max_hedges
+        self.min_latency_samples = min_latency_samples
+        self.rng = rng
+
+    def delay(self, rolling_p95_s: Optional[float],
+              rng: Optional[random.Random] = None) -> float:
+        base = self.delay_s
+        if base is None:
+            base = (rolling_p95_s if rolling_p95_s is not None
+                    else self.fallback_delay_s)
+        r = self.rng or rng
+        if self.jitter_frac and r is not None:
+            base *= 1.0 + r.uniform(0.0, self.jitter_frac)
+        return base
+
+
+class EndpointState:
+    """One replica: its client, breaker-backed policy, and outlier state.
+
+    All mutable fields are guarded by the owning pool's lock."""
+
+    __slots__ = (
+        "url", "client", "policy", "weight", "outstanding", "healthy",
+        "consecutive_failures", "ejected", "ejected_until", "ejection_count",
+        "last_ejection_end", "_wrr_current",
+    )
+
+    def __init__(self, url: str, client: Any, policy: ResiliencePolicy,
+                 weight: float = 1.0):
+        self.url = url
+        self.client = client
+        self.policy = policy  # breaker + per-endpoint ResilienceStats
+        self.weight = weight
+        self.outstanding = 0
+        self.healthy = True
+        self.consecutive_failures = 0
+        self.ejected = False
+        self.ejected_until = 0.0
+        self.ejection_count = 0
+        self.last_ejection_end = 0.0
+        self._wrr_current = 0.0
+
+
+class EndpointPool:
+    """The transport-free engine: selection, health, and outlier ejection.
+
+    Thread-safe; shared by the sync and asyncio pool clients. Events are
+    emitted OUTSIDE the internal lock (the callback may call back into
+    the pool)."""
+
+    def __init__(
+        self,
+        endpoints: Sequence[EndpointState],
+        routing: str = ROUND_ROBIN,
+        eject_after: int = 3,
+        base_ejection_s: float = 1.0,
+        ejection_multiplier: float = 2.0,
+        max_ejection_s: float = 30.0,
+        ejection_decay_s: float = 60.0,
+        latency_window: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+        on_event: Optional[Callable[[PoolEvent], None]] = None,
+    ):
+        if not endpoints:
+            raise ValueError("pool needs at least one endpoint")
+        if routing not in _ROUTING_POLICIES:
+            raise ValueError(
+                f"unknown routing policy {routing!r} (one of {_ROUTING_POLICIES})")
+        if eject_after < 1:
+            raise ValueError("eject_after must be >= 1")
+        self.endpoints: List[EndpointState] = list(endpoints)
+        self.routing = routing
+        self.eject_after = eject_after
+        self.base_ejection_s = base_ejection_s
+        self.ejection_multiplier = ejection_multiplier
+        self.max_ejection_s = max_ejection_s
+        self.ejection_decay_s = ejection_decay_s
+        # at most ceil(N/2) replicas may ever be ejected at once: the pool
+        # must degrade (keep trying suspect replicas) before it self-blinds
+        self.max_ejected = math.ceil(len(self.endpoints) / 2)
+        self._clock = clock
+        self._on_event = on_event
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._latencies: deque = deque(maxlen=latency_window)
+
+    # -- events --------------------------------------------------------------
+    def emit(self, event: PoolEvent) -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(event)
+        except Exception:
+            pass  # an observer must never break the data path
+
+    def _emit_all(self, events: List[PoolEvent]) -> None:
+        for event in events:
+            self.emit(event)
+
+    # -- selection -----------------------------------------------------------
+    def _readmit_expired(self, now: float, events: List[PoolEvent]) -> None:
+        for ep in self.endpoints:
+            if ep.ejected and now >= ep.ejected_until:
+                ep.ejected = False
+                ep.consecutive_failures = 0
+                events.append(EndpointReadmitted(ep.url))
+
+    def _eligible(self, ep: EndpointState) -> bool:
+        if ep.ejected or not ep.healthy:
+            return False
+        breaker = ep.policy.breaker
+        return breaker is None or breaker.would_admit()
+
+    def _pick(self, candidates: List[EndpointState]) -> EndpointState:
+        if len(candidates) == 1:
+            return candidates[0]
+        if self.routing == LEAST_OUTSTANDING:
+            least = min(ep.outstanding for ep in candidates)
+            candidates = [ep for ep in candidates if ep.outstanding == least]
+            # ties rotate so idle pools still spread load
+        elif self.routing == WEIGHTED:
+            # smooth weighted round-robin (nginx algorithm): deterministic,
+            # interleaves instead of bursting onto the heaviest endpoint
+            total = sum(ep.weight for ep in candidates)
+            for ep in candidates:
+                ep._wrr_current += ep.weight
+            best = max(candidates, key=lambda e: e._wrr_current)
+            best._wrr_current -= total
+            return best
+        idx = self._rr % len(candidates)
+        self._rr += 1
+        return candidates[idx]
+
+    def select(self, exclude: Sequence[EndpointState] = ()) -> EndpointState:
+        """Pick an endpoint under the routing policy, honoring health,
+        ejection windows, and breaker admission. ``exclude`` lists
+        endpoints already tried by this call's failover loop. When no
+        eligible endpoint remains, panic-routes to a non-excluded endpoint
+        whose breaker would still admit (degraded beats unavailable);
+        raises :class:`NoEndpointAvailableError` when even that is empty."""
+        events: List[PoolEvent] = []
+        excluded = set(map(id, exclude))
+        with self._lock:
+            now = self._clock()
+            self._readmit_expired(now, events)
+            candidates = [
+                ep for ep in self.endpoints
+                if id(ep) not in excluded and self._eligible(ep)
+            ]
+            if not candidates:
+                # panic tier: ignore health/ejection, still skip endpoints
+                # whose breaker would fast-fail without touching a socket
+                candidates = [
+                    ep for ep in self.endpoints
+                    if id(ep) not in excluded
+                    and (ep.policy.breaker is None
+                         or ep.policy.breaker.would_admit())
+                ]
+            picked = self._pick(candidates) if candidates else None
+        self._emit_all(events)
+        if picked is None:
+            raise NoEndpointAvailableError()
+        return picked
+
+    # -- accounting ----------------------------------------------------------
+    def begin(self, ep: EndpointState) -> None:
+        with self._lock:
+            ep.outstanding += 1
+
+    def done(self, ep: EndpointState) -> None:
+        with self._lock:
+            ep.outstanding = max(0, ep.outstanding - 1)
+
+    def record_success(self, ep: EndpointState,
+                       latency_s: Optional[float] = None) -> None:
+        events: List[PoolEvent] = []
+        with self._lock:
+            ep.consecutive_failures = 0
+            if ep.ejected:
+                # proved itself (panic routing landed here and succeeded):
+                # readmit early rather than waiting out the window
+                ep.ejected = False
+                events.append(EndpointReadmitted(ep.url))
+            if latency_s is not None:
+                self._latencies.append(latency_s)
+        self._emit_all(events)
+
+    def record_failure(self, ep: EndpointState, domain: str) -> None:
+        """Feed one transport-level failure (connect/transient/timeout —
+        FATAL application errors prove delivery and belong in
+        :meth:`record_success`) into the outlier detector."""
+        if domain not in (CONNECT, TRANSIENT, TIMEOUT):
+            return
+        events: List[PoolEvent] = []
+        with self._lock:
+            ep.consecutive_failures += 1
+            if ep.consecutive_failures < self.eject_after or ep.ejected:
+                pass
+            else:
+                now = self._clock()
+                already = sum(
+                    1 for e in self.endpoints
+                    if e.ejected and e.ejected_until > now)
+                if already < self.max_ejected:
+                    if (ep.last_ejection_end
+                            and now - ep.last_ejection_end > self.ejection_decay_s):
+                        ep.ejection_count = 0  # long-healthy: forgive history
+                    window = min(
+                        self.base_ejection_s
+                        * (self.ejection_multiplier ** ep.ejection_count),
+                        self.max_ejection_s,
+                    )
+                    ep.ejected = True
+                    ep.ejected_until = now + window
+                    ep.last_ejection_end = ep.ejected_until
+                    ep.ejection_count += 1
+                    events.append(EndpointEjected(
+                        ep.url, window, ep.consecutive_failures,
+                        ep.ejection_count))
+        self._emit_all(events)
+
+    def set_health(self, ep: EndpointState, healthy: bool) -> None:
+        events: List[PoolEvent] = []
+        with self._lock:
+            if ep.healthy != healthy:
+                ep.healthy = healthy
+                events.append(EndpointHealthChanged(ep.url, healthy))
+        self._emit_all(events)
+
+    # -- introspection -------------------------------------------------------
+    def latency_p95(self, min_samples: int = 8) -> Optional[float]:
+        with self._lock:
+            if len(self._latencies) < min_samples:
+                return None
+            ordered = sorted(self._latencies)
+        return ordered[min(int(len(ordered) * 0.95), len(ordered) - 1)]
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-endpoint state + the per-endpoint ResilienceStats counters."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            now = self._clock()
+            for i, ep in enumerate(self.endpoints):
+                breaker = ep.policy.breaker
+                ejected = ep.ejected and ep.ejected_until > now
+                key = ep.url if ep.url not in out else f"{ep.url}#{i}"
+                out[key] = {
+                    "healthy": ep.healthy,
+                    "ejected": ejected,
+                    "ejected_for_s": round(max(0.0, ep.ejected_until - now), 3)
+                    if ejected else 0.0,
+                    "consecutive_failures": ep.consecutive_failures,
+                    "ejection_count": ep.ejection_count,
+                    "outstanding": ep.outstanding,
+                    "weight": ep.weight,
+                    "breaker_state": breaker.state if breaker is not None else None,
+                    "resilience": ep.policy.stats.as_dict(),
+                }
+        return out
+
+
+# the four frontends' infer() signatures share this positional prefix;
+# folding positionals into kwargs keeps PoolClient a drop-in replacement
+# for code that calls e.g. client.infer("m", inputs, "2")
+_INFER_POSITIONALS = (
+    "model_version", "outputs", "request_id", "sequence_id",
+    "sequence_start", "sequence_end", "priority", "timeout",
+    "client_timeout", "headers",
+)
+
+
+def _fold_infer_args(args, kwargs):
+    if len(args) > len(_INFER_POSITIONALS):
+        raise TypeError(
+            "too many positional arguments to pooled infer(); the frontends "
+            f"diverge after {_INFER_POSITIONALS[-1]!r} — pass the rest by "
+            "keyword")
+    for name, value in zip(_INFER_POSITIONALS, args):
+        if name in kwargs:
+            raise TypeError(f"infer() got multiple values for argument {name!r}")
+        kwargs[name] = value
+    return kwargs
+
+
+def _default_client_factory(protocol: str, aio: bool):
+    if protocol == "http":
+        if aio:
+            import client_tpu.http.aio as mod
+        else:
+            import client_tpu.http as mod
+    elif protocol == "grpc":
+        if aio:
+            import client_tpu.grpc.aio as mod
+        else:
+            import client_tpu.grpc as mod
+    else:
+        raise ValueError(f"unknown protocol {protocol!r} (http|grpc)")
+    return mod.InferenceServerClient
+
+
+class _PoolClientBase:
+    """Construction + bookkeeping shared by the sync and asyncio wrappers."""
+
+    _AIO = False
+
+    def __init__(
+        self,
+        urls: Sequence[str],
+        protocol: str = "http",
+        client_factory: Optional[Callable[[str], Any]] = None,
+        routing: str = ROUND_ROBIN,
+        weights: Optional[Sequence[float]] = None,
+        health_interval_s: Optional[float] = 1.0,
+        probe_timeout_s: float = 1.0,
+        eject_after: int = 3,
+        base_ejection_s: float = 1.0,
+        ejection_multiplier: float = 2.0,
+        max_ejection_s: float = 30.0,
+        ejection_decay_s: float = 60.0,
+        breaker_factory: Optional[Callable[[], Optional[CircuitBreaker]]] = None,
+        endpoint_retry: Optional[RetryPolicy] = None,
+        max_failover_attempts: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
+        per_attempt_timeout_s: Optional[float] = None,
+        hedge: Optional[HedgePolicy] = None,
+        hedge_executor_workers: Optional[int] = None,
+        rng: Optional[random.Random] = None,
+        on_event: Optional[Callable[[PoolEvent], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        """``urls``: N ``host:port`` replica addresses. ``client_factory``
+        overrides the per-endpoint client constructor (receives the url);
+        default builds the ``protocol`` frontend (sync or aio to match this
+        wrapper). ``weights`` pairs with ``routing="weighted"``.
+        ``endpoint_retry`` arms in-endpoint retries BEFORE failover kicks
+        in (default None: failover across replicas IS the retry).
+        ``hedge``: a :class:`HedgePolicy` (idempotent infers only); on the
+        sync client every hedged attempt (primary included) runs on a
+        shared thread pool, so size ``hedge_executor_workers`` to at least
+        ``caller_threads * (1 + max_hedges)`` when driving the pool from
+        many threads (default: ``max(8, 4 * N)``).
+        ``health_interval_s=None`` disables the active prober."""
+        urls = list(urls)
+        if not urls:
+            raise ValueError("pool needs at least one url")
+        if routing not in _ROUTING_POLICIES:
+            raise ValueError(
+                f"unknown routing policy {routing!r} (one of {_ROUTING_POLICIES})")
+        if weights is not None and len(weights) != len(urls):
+            raise ValueError("weights must pair 1:1 with urls")
+        if weights is None:
+            weights = [1.0] * len(urls)
+        if client_factory is None:
+            client_factory = _default_client_factory(protocol, self._AIO)
+        if breaker_factory is None:
+            breaker_factory = CircuitBreaker
+        endpoints: List[EndpointState] = []
+        try:
+            for url, weight in zip(urls, weights):
+                policy = ResiliencePolicy(
+                    retry=endpoint_retry, breaker=breaker_factory())
+                client = client_factory(url)
+                # every call through this client now runs under the
+                # endpoint's breaker and is counted in its stats
+                client.configure_resilience(policy)
+                endpoints.append(EndpointState(url, client, policy, weight))
+        except Exception:
+            self._abandon(endpoints)
+            raise
+        try:
+            self.pool = EndpointPool(
+                endpoints,
+                routing=routing,
+                eject_after=eject_after,
+                base_ejection_s=base_ejection_s,
+                ejection_multiplier=ejection_multiplier,
+                max_ejection_s=max_ejection_s,
+                ejection_decay_s=ejection_decay_s,
+                clock=clock,
+                on_event=on_event,
+            )
+        except Exception:
+            self._abandon(endpoints)
+            raise
+        self._hedge = hedge
+        self._hedge_executor_workers = (
+            hedge_executor_workers
+            if hedge_executor_workers is not None
+            else max(8, 4 * len(urls)))
+        self._rng = rng or random.Random()
+        self._health_interval_s = health_interval_s or None
+        self._probe_timeout_s = probe_timeout_s
+        self._max_failover_attempts = max_failover_attempts or len(urls)
+        if default_deadline_s is not None or per_attempt_timeout_s is not None:
+            self._budget_policy: Optional[ResiliencePolicy] = ResiliencePolicy(
+                retry=RetryPolicy(
+                    max_attempts=1,
+                    total_deadline_s=default_deadline_s,
+                    per_attempt_timeout_s=per_attempt_timeout_s,
+                ))
+        else:
+            self._budget_policy = None
+        # sequence affinity: server-side sequence state (KV caches, CORRID
+        # slots) is replica-local, so every request of one sequence must
+        # land on the SAME endpoint; pins live until sequence_end (or until
+        # the sequence is abandoned). "established" = at least one request
+        # of the sequence reached the pinned replica.
+        self._seq_lock = threading.Lock()
+        self._seq_pins: Dict[int, EndpointState] = {}
+        self._seq_established: set = set()
+        # backoff schedule for re-attempting a PINNED replica (a sequence
+        # has exactly one legal endpoint, so zero-delay retries would burn
+        # every attempt inside a sub-second connect blip)
+        self._seq_backoff_policy = RetryPolicy(
+            initial_backoff_s=0.05, max_backoff_s=0.5, rng=self._rng)
+        self._closed = False
+
+    @staticmethod
+    def _abandon(endpoints: List[EndpointState]) -> None:
+        for ep in endpoints:
+            try:
+                close = ep.client.close
+            except AttributeError:
+                continue
+            try:
+                result = close()
+                if hasattr(result, "close"):  # unawaited coroutine
+                    result.close()
+            except Exception:
+                pass
+
+    # method-name prefixes whose calls mutate SERVER-side (or client-side)
+    # state: these broadcast to every endpoint — registering a shm region
+    # or loading a model on one arbitrary replica while infers route to
+    # all of them would be a trap
+    _BROADCAST_PREFIXES = (
+        "register_", "unregister_", "load_model", "unload_model", "update_",
+    )
+
+    def configure_resilience(self, policy):
+        raise InferenceServerException(
+            "PoolClient owns each endpoint's resilience policy (breaker + "
+            "stats); configure endpoint_retry= / breaker_factory= at pool "
+            "construction instead")
+
+    @classmethod
+    def _is_broadcast(cls, name: str) -> bool:
+        return any(name.startswith(p) for p in cls._BROADCAST_PREFIXES)
+
+    # -- shared helpers ------------------------------------------------------
+    def endpoint_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-endpoint snapshot: health, ejection, breaker state,
+        outstanding count, and the endpoint's ResilienceStats counters."""
+        return self.pool.snapshot()
+
+    def _record_attempt_failure(self, ep: EndpointState,
+                                exc: BaseException) -> str:
+        """Feed one failed attempt into the outlier detector; returns the
+        fault domain ('' for a fast-fail that never touched the wire)."""
+        if isinstance(exc, CircuitOpenError):
+            return ""  # nothing was sent; the breaker already knows
+        domain = classify_fault(exc)
+        if domain == FATAL:
+            # an application error proves the transport delivered the
+            # request — for ejection purposes that is a success
+            self.pool.record_success(ep)
+        else:
+            self.pool.record_failure(ep, domain)
+        return domain
+
+    def _sequence_event(self, ep: EndpointState, request_id: str,
+                        sequence_id: int, exc: BaseException) -> None:
+        self.pool.emit(SequenceAbandoned(ep.url, request_id, sequence_id, exc))
+
+    # -- sequence affinity helpers -------------------------------------------
+    def _seq_endpoint(self, sequence_id: int,
+                      exclude: Sequence[EndpointState] = ()) -> EndpointState:
+        with self._seq_lock:
+            ep = self._seq_pins.get(sequence_id)
+        if ep is not None:
+            return ep
+        # select OUTSIDE _seq_lock: selection emits pool events whose
+        # callbacks may re-enter the sequence path (non-reentrant lock)
+        candidate = self.pool.select(exclude=exclude)
+        with self._seq_lock:
+            return self._seq_pins.setdefault(sequence_id, candidate)
+
+    def _seq_backoff_s(self, attempt: int, budget: AttemptBudget) -> float:
+        """Backoff before re-attempting the PINNED replica: the shared
+        RetryPolicy full-jitter schedule (seeded-rng deterministic),
+        clamped to the remaining budget."""
+        delay = self._seq_backoff_policy.backoff_s(attempt)
+        if budget.deadline is not None:
+            delay = min(delay, max(0.0, budget.deadline - time.monotonic()))
+        return delay
+
+    def _seq_mark_established(self, sequence_id: int) -> None:
+        with self._seq_lock:
+            self._seq_established.add(sequence_id)
+
+    def _seq_unpin(self, sequence_id: int) -> None:
+        with self._seq_lock:
+            self._seq_pins.pop(sequence_id, None)
+            self._seq_established.discard(sequence_id)
+
+    def _seq_repin_allowed(self, sequence_id: int) -> bool:
+        """A connect failure provably never reached the server: if NO
+        request of this sequence has landed yet, there is no replica-local
+        state and the pin may move; once established, the pin is fixed."""
+        with self._seq_lock:
+            return sequence_id not in self._seq_established
+
+
+class PoolClient(_PoolClientBase):
+    """Synchronous pool wrapper over the HTTP or GRPC sync frontend.
+
+    Exposes the full ``InferenceServerClient`` surface: ``infer`` runs the
+    failover/hedging engine; every other client method is delegated to a
+    selected endpoint under the same failover loop (admin/health calls are
+    idempotent by nature)."""
+
+    _AIO = False
+
+    def __init__(self, urls, **kwargs):
+        super().__init__(urls, **kwargs)
+        self._executor_lock = threading.Lock()
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._stream_lock = threading.Lock()
+        self._stream_ep: Optional[EndpointState] = None
+        self._probe_stop = threading.Event()
+        self._probe_threads: List[threading.Thread] = []
+        if self._health_interval_s:
+            # one persistent thread per endpoint: concurrent (a blackholed
+            # endpoint never delays another's probe) with no per-tick
+            # thread churn
+            self._probe_threads = [
+                threading.Thread(
+                    target=self._probe_loop, args=(ep,),
+                    name=f"client_tpu_pool_probe_{i}", daemon=True)
+                for i, ep in enumerate(self.pool.endpoints)
+            ]
+            for t in self._probe_threads:
+                t.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._probe_stop.set()
+        for t in self._probe_threads:
+            t.join(timeout=self._probe_timeout_s + 5)
+        with self._executor_lock:
+            if self._executor is not None:
+                self._executor.shutdown(wait=False)
+                self._executor = None
+        for ep in self.pool.endpoints:
+            try:
+                ep.client.close()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "PoolClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- active health probing ----------------------------------------------
+    def _probe_one(self, ep: EndpointState) -> None:
+        try:
+            ok = ep.client.is_server_ready(
+                probe=True, client_timeout=self._probe_timeout_s)
+        except Exception:
+            ok = False  # FATAL probe answer: endpoint is up but broken
+        self.pool.set_health(ep, ok)
+
+    def _probe_loop(self, ep: EndpointState) -> None:
+        while not self._probe_stop.wait(self._health_interval_s):
+            self._probe_one(ep)
+
+    # -- failover engine ------------------------------------------------------
+    def _execute(self, op, idempotent: bool = True,
+                 timeout_s: Optional[float] = None,
+                 request_id: str = "", sequence_id: int = 0,
+                 record_latency: bool = False):
+        """Run ``op(client, remaining_timeout)`` against the pool: one
+        shared deadline budget, at most ``max_failover_attempts`` distinct
+        replicas, idempotency-gated re-sends. ``record_latency`` feeds the
+        hedge-delay p95 window — infers only, so fast admin/metadata calls
+        don't drag the window down and trigger spurious hedges."""
+        budget = AttemptBudget(self._budget_policy, timeout_s)
+        tried: List[EndpointState] = []
+        last: Optional[BaseException] = None
+        while len(tried) < self._max_failover_attempts:
+            try:
+                remaining = budget.attempt_timeout_s()
+            except InferenceServerException as deadline_exc:
+                if last is not None:
+                    raise deadline_exc from last
+                raise
+            try:
+                ep = self.pool.select(exclude=tried)
+            except NoEndpointAvailableError:
+                if last is not None:
+                    raise last
+                raise
+            tried.append(ep)
+            self.pool.begin(ep)
+            t0 = time.monotonic()
+            try:
+                result = op(ep.client, remaining)
+            except CircuitOpenError as e:
+                last = e  # raced an opening breaker; nothing was sent
+                continue
+            except Exception as e:
+                domain = self._record_attempt_failure(ep, e)
+                if domain == FATAL:
+                    raise  # the server answered; failover cannot help
+                last = e
+                if domain in (TRANSIENT, TIMEOUT) and not idempotent:
+                    self._sequence_event(ep, request_id, sequence_id, e)
+                    raise
+                continue
+            finally:
+                self.pool.done(ep)
+            self.pool.record_success(
+                ep, time.monotonic() - t0 if record_latency else None)
+            return result
+        assert last is not None
+        raise last
+
+    # -- inference -------------------------------------------------------------
+    def infer(self, model_name: str, inputs, *args, **kwargs):
+        """Pool-routed ``infer`` (positional arguments follow the
+        frontends' shared prefix). Sequence requests (``sequence_id != 0``)
+        PIN to one endpoint — replica-local sequence state must not
+        scatter — are NEVER hedged, re-attempt only never-sent connect
+        failures (moving the pin only while the sequence has no
+        server-side state yet), and an in-flight death surfaces a
+        :class:`SequenceAbandoned` event plus the original error."""
+        kwargs = _fold_infer_args(args, kwargs)
+        sequence_id = kwargs.get("sequence_id", 0)
+        timeout_s = kwargs.get("client_timeout")
+        request_id = kwargs.get("request_id", "")
+        if sequence_id:
+            return self._sequence_infer(model_name, inputs, kwargs)
+        if self._hedge is not None:
+            return self._hedged_infer(model_name, inputs, kwargs, timeout_s)
+
+        def op(client, remaining):
+            kw = dict(kwargs)
+            if remaining is not None:
+                kw["client_timeout"] = remaining
+            return client.infer(model_name, inputs, **kw)
+
+        return self._execute(
+            op, idempotent=True, timeout_s=timeout_s,
+            request_id=request_id, sequence_id=sequence_id,
+            record_latency=True)
+
+    def _sequence_infer(self, model_name: str, inputs, kwargs):
+        """Affinity-pinned sequence request: every request of one sequence
+        lands on the pinned replica. Connect failures re-attempt (the pin
+        moves only while the sequence has no established server state);
+        in-flight deaths abandon the sequence — never silently re-sent."""
+        sequence_id = kwargs["sequence_id"]
+        request_id = kwargs.get("request_id", "")
+        budget = AttemptBudget(self._budget_policy, kwargs.get("client_timeout"))
+        tried: List[EndpointState] = []
+        last: Optional[BaseException] = None
+        for _ in range(self._max_failover_attempts):
+            try:
+                remaining = budget.attempt_timeout_s()
+            except InferenceServerException as deadline_exc:
+                if last is not None:
+                    raise deadline_exc from last
+                raise
+            ep = self._seq_endpoint(sequence_id, exclude=tried)
+            if ep not in tried:
+                tried.append(ep)
+            self.pool.begin(ep)
+            t0 = time.monotonic()
+            try:
+                kw = dict(kwargs)
+                if remaining is not None:
+                    kw["client_timeout"] = remaining
+                result = ep.client.infer(model_name, inputs, **kw)
+            except CircuitOpenError as e:
+                last = e  # nothing was sent; the pinned replica is retried
+                time.sleep(self._seq_backoff_s(len(tried), budget))
+                continue
+            except Exception as e:
+                domain = self._record_attempt_failure(ep, e)
+                if domain == FATAL:
+                    raise
+                last = e
+                if domain == CONNECT:
+                    if self._seq_repin_allowed(sequence_id):
+                        # no request of this sequence ever landed: there is
+                        # no replica-local state, the pin may move
+                        self._seq_unpin(sequence_id)
+                    else:
+                        # one legal endpoint: back off instead of burning
+                        # every attempt inside a sub-second connect blip
+                        time.sleep(self._seq_backoff_s(len(tried), budget))
+                    continue
+                # transient/timeout: the request may have reached the
+                # replica — the sequence state is unknowable, abandon it
+                self._sequence_event(ep, request_id, sequence_id, e)
+                self._seq_unpin(sequence_id)
+                raise
+            finally:
+                self.pool.done(ep)
+            self.pool.record_success(ep, time.monotonic() - t0)
+            self._seq_mark_established(sequence_id)
+            if kwargs.get("sequence_end"):
+                self._seq_unpin(sequence_id)
+            return result
+        assert last is not None
+        raise last
+
+    def _get_executor(self) -> ThreadPoolExecutor:
+        with self._executor_lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self._hedge_executor_workers,
+                    thread_name_prefix="client_tpu_pool_hedge")
+            return self._executor
+
+    def _hedged_infer(self, model_name, inputs, kwargs,
+                      timeout_s: Optional[float]):
+        """Primary + up to ``max_hedges`` staggered copies on distinct
+        replicas; first success wins, losers are cancelled best-effort
+        (a thread-borne attempt that already started runs to completion
+        in the background and still records its outcome)."""
+        budget = AttemptBudget(self._budget_policy, timeout_s)
+        hedge = self._hedge
+        pool = self.pool
+        executor = self._get_executor()
+        tried: List[EndpointState] = []
+        failures: List[BaseException] = []
+        futures: List[Any] = []
+
+        def attempt(ep, remaining):
+            pool.begin(ep)
+            t0 = time.monotonic()
+            try:
+                kw = dict(kwargs)
+                if remaining is not None:
+                    kw["client_timeout"] = remaining
+                result = ep.client.infer(model_name, inputs, **kw)
+            except Exception as e:
+                self._record_attempt_failure(ep, e)
+                raise
+            finally:
+                pool.done(ep)
+            pool.record_success(ep, time.monotonic() - t0)
+            return result
+
+        def spawn():
+            remaining = budget.attempt_timeout_s()  # raises once spent
+            ep = pool.select(exclude=tried)
+            tried.append(ep)
+            futures.append(executor.submit(attempt, ep, remaining))
+
+        max_attempts = max(self._max_failover_attempts, 1 + hedge.max_hedges)
+        spawn()
+        hedges_left = hedge.max_hedges
+        hedge_at = time.monotonic() + hedge.delay(
+            pool.latency_p95(hedge.min_latency_samples), self._rng)
+        while True:
+            timeout = None
+            if hedges_left > 0:
+                timeout = max(0.0, hedge_at - time.monotonic())
+            done, _ = wait(futures, timeout=timeout,
+                           return_when=FIRST_COMPLETED)
+            for f in done:
+                futures.remove(f)
+                try:
+                    result = f.result()
+                except Exception as e:
+                    if (not isinstance(e, CircuitOpenError)
+                            and classify_fault(e) == FATAL):
+                        for p in futures:
+                            p.cancel()
+                        raise  # the server answered; racing more copies won't help
+                    failures.append(e)
+                else:
+                    for p in futures:
+                        p.cancel()
+                    return result
+            firing = hedges_left > 0 and time.monotonic() >= hedge_at
+            if futures and not firing:
+                continue
+            # need a fresh attempt: the hedge timer fired, or every
+            # in-flight attempt has failed (failover inside the hedge path)
+            if len(tried) >= max_attempts:
+                if futures:
+                    hedges_left = 0
+                    continue
+                raise failures[-1]
+            try:
+                spawn()
+            except (NoEndpointAvailableError, InferenceServerException) as e:
+                if futures:
+                    hedges_left = 0  # nothing to hedge to; ride out in-flight
+                    continue
+                if failures:
+                    raise failures[-1] from e
+                raise
+            if firing:
+                hedges_left -= 1
+                hedge_at = time.monotonic() + hedge.delay(
+                    pool.latency_p95(hedge.min_latency_samples), self._rng)
+
+    # -- streaming (HTTP generate extension) ----------------------------------
+    def generate_stream(self, *args, **kwargs):
+        """Pool-routed SSE generate stream. The endpoint's ``outstanding``
+        count stays held until the stream is exhausted (or abandoned), so
+        ``least_outstanding`` routing sees long-lived generations — a bare
+        delegation would release the slot as soon as the iterator is
+        returned, before a single event streamed."""
+        ep = self.pool.select()
+        inner = ep.client.generate_stream(*args, **kwargs)  # lazy: no I/O yet
+
+        def stream():
+            # begin/done pair with actual iteration (the underlying client
+            # generator only issues the request on first next); a returned-
+            # but-never-iterated stream holds no slot
+            self.pool.begin(ep)
+            ok = True
+            try:
+                yield from inner
+            except Exception as e:
+                ok = False
+                self._record_attempt_failure(ep, e)
+                raise
+            finally:
+                # abandonment closes the generator -> GeneratorExit runs
+                # this too, releasing the outstanding slot
+                self.pool.done(ep)
+                if ok:
+                    self.pool.record_success(ep)
+
+        return stream()
+
+    # -- streaming (GRPC): pinned to ONE endpoint -----------------------------
+    def start_stream(self, *args, **kwargs):
+        """Open a bidi stream on ONE selected endpoint and pin it there:
+        stream state lives on a single client, so ``async_stream_infer`` /
+        ``stop_stream`` route to the same endpoint until the stream stops
+        (combine with ``auto_reconnect=True`` for same-endpoint recovery).
+        Streams are never failed over — sequence state is server-local."""
+        with self._stream_lock:
+            if self._stream_ep is not None:
+                raise InferenceServerException(
+                    "cannot start a stream: one is already active; stop it first")
+            ep = self.pool.select()
+            result = ep.client.start_stream(*args, **kwargs)
+            self._stream_ep = ep
+            return result
+
+    def async_stream_infer(self, *args, **kwargs):
+        with self._stream_lock:
+            ep = self._stream_ep
+        if ep is None:
+            raise InferenceServerException(
+                "stream not available: call start_stream first")
+        return ep.client.async_stream_infer(*args, **kwargs)
+
+    def stop_stream(self, *args, **kwargs):
+        with self._stream_lock:
+            ep = self._stream_ep
+        if ep is None:
+            return None
+        try:
+            return ep.client.stop_stream(*args, **kwargs)
+        finally:
+            # release the pin even when stop raised: the grpc client clears
+            # its own stream state before closing, so a retried start_stream
+            # must not stay wedged behind a stale pin
+            with self._stream_lock:
+                if self._stream_ep is ep:
+                    self._stream_ep = None
+
+    # -- generic surface delegation -------------------------------------------
+    def _broadcast(self, name: str, args, kwargs):
+        """Apply a state-mutating method to EVERY endpoint; every endpoint
+        is attempted even if one fails, then the first failure raises."""
+        first_exc: Optional[BaseException] = None
+        result = None
+        for ep in self.pool.endpoints:
+            try:
+                result = getattr(ep.client, name)(*args, **kwargs)
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+        return result
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        probe = getattr(self.pool.endpoints[0].client, name, None)
+        if not callable(probe):
+            raise AttributeError(
+                f"{type(self).__name__} has no attribute {name!r}")
+
+        if self._is_broadcast(name):
+            def call(*args, **kwargs):
+                return self._broadcast(name, args, kwargs)
+        else:
+            def call(*args, **kwargs):
+                def op(client, _remaining):
+                    return getattr(client, name)(*args, **kwargs)
+                return self._execute(op, idempotent=True)
+
+        call.__name__ = name
+        return call
+
+
+class AioPoolClient(_PoolClientBase):
+    """Asyncio twin of :class:`PoolClient` over the aio HTTP/GRPC frontends.
+
+    The health prober runs as an asyncio task, started lazily on the first
+    pooled call (or explicitly via :meth:`start`); hedged attempts are
+    asyncio tasks, so the losing hedge is truly cancelled mid-flight."""
+
+    _AIO = True
+
+    def __init__(self, urls, **kwargs):
+        super().__init__(urls, **kwargs)
+        self._probe_task = None
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self) -> "AioPoolClient":
+        self._ensure_prober()
+        return self
+
+    def _ensure_prober(self) -> None:
+        if (self._probe_task is None and self._health_interval_s
+                and not self._closed):
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return  # no loop yet; the next in-loop call starts it
+            self._probe_task = loop.create_task(self._probe_loop())
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except BaseException:
+                pass
+            self._probe_task = None
+        for ep in self.pool.endpoints:
+            try:
+                await ep.client.close()
+            except Exception:
+                pass
+
+    async def __aenter__(self) -> "AioPoolClient":
+        self._ensure_prober()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- active health probing ----------------------------------------------
+    async def _probe_one(self, ep: EndpointState) -> None:
+        try:
+            ok = await ep.client.is_server_ready(
+                probe=True, client_timeout=self._probe_timeout_s)
+        except Exception:
+            ok = False
+        self.pool.set_health(ep, ok)
+
+    async def _probe_once(self) -> None:
+        # concurrent (see the sync twin): one hung endpoint must not
+        # delay every other endpoint's probe by probe_timeout_s
+        await asyncio.gather(
+            *(self._probe_one(ep) for ep in self.pool.endpoints))
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._health_interval_s)
+            await self._probe_once()
+
+    # -- failover engine ------------------------------------------------------
+    async def _execute(self, op, idempotent: bool = True,
+                       timeout_s: Optional[float] = None,
+                       request_id: str = "", sequence_id: int = 0,
+                       record_latency: bool = False):
+        self._ensure_prober()
+        budget = AttemptBudget(self._budget_policy, timeout_s)
+        tried: List[EndpointState] = []
+        last: Optional[BaseException] = None
+        while len(tried) < self._max_failover_attempts:
+            try:
+                remaining = budget.attempt_timeout_s()
+            except InferenceServerException as deadline_exc:
+                if last is not None:
+                    raise deadline_exc from last
+                raise
+            try:
+                ep = self.pool.select(exclude=tried)
+            except NoEndpointAvailableError:
+                if last is not None:
+                    raise last
+                raise
+            tried.append(ep)
+            self.pool.begin(ep)
+            t0 = time.monotonic()
+            try:
+                result = await op(ep.client, remaining)
+            except CircuitOpenError as e:
+                last = e
+                continue
+            except Exception as e:
+                domain = self._record_attempt_failure(ep, e)
+                if domain == FATAL:
+                    raise
+                last = e
+                if domain in (TRANSIENT, TIMEOUT) and not idempotent:
+                    self._sequence_event(ep, request_id, sequence_id, e)
+                    raise
+                continue
+            finally:
+                self.pool.done(ep)
+            self.pool.record_success(
+                ep, time.monotonic() - t0 if record_latency else None)
+            return result
+        assert last is not None
+        raise last
+
+    # -- inference -------------------------------------------------------------
+    async def infer(self, model_name: str, inputs, *args, **kwargs):
+        """Pool-routed async ``infer`` (same affinity/idempotency/hedging
+        contract as the sync twin)."""
+        kwargs = _fold_infer_args(args, kwargs)
+        sequence_id = kwargs.get("sequence_id", 0)
+        timeout_s = kwargs.get("client_timeout")
+        request_id = kwargs.get("request_id", "")
+        if sequence_id:
+            return await self._sequence_infer(model_name, inputs, kwargs)
+        if self._hedge is not None:
+            return await self._hedged_infer(
+                model_name, inputs, kwargs, timeout_s)
+
+        async def op(client, remaining):
+            kw = dict(kwargs)
+            if remaining is not None:
+                kw["client_timeout"] = remaining
+            return await client.infer(model_name, inputs, **kw)
+
+        return await self._execute(
+            op, idempotent=True, timeout_s=timeout_s,
+            request_id=request_id, sequence_id=sequence_id,
+            record_latency=True)
+
+    async def _sequence_infer(self, model_name: str, inputs, kwargs):
+        """Async twin of the sync affinity-pinned sequence path."""
+        self._ensure_prober()
+        sequence_id = kwargs["sequence_id"]
+        request_id = kwargs.get("request_id", "")
+        budget = AttemptBudget(self._budget_policy, kwargs.get("client_timeout"))
+        tried: List[EndpointState] = []
+        last: Optional[BaseException] = None
+        for _ in range(self._max_failover_attempts):
+            try:
+                remaining = budget.attempt_timeout_s()
+            except InferenceServerException as deadline_exc:
+                if last is not None:
+                    raise deadline_exc from last
+                raise
+            ep = self._seq_endpoint(sequence_id, exclude=tried)
+            if ep not in tried:
+                tried.append(ep)
+            self.pool.begin(ep)
+            t0 = time.monotonic()
+            try:
+                kw = dict(kwargs)
+                if remaining is not None:
+                    kw["client_timeout"] = remaining
+                result = await ep.client.infer(model_name, inputs, **kw)
+            except CircuitOpenError as e:
+                last = e
+                await asyncio.sleep(self._seq_backoff_s(len(tried), budget))
+                continue
+            except Exception as e:
+                domain = self._record_attempt_failure(ep, e)
+                if domain == FATAL:
+                    raise
+                last = e
+                if domain == CONNECT:
+                    if self._seq_repin_allowed(sequence_id):
+                        self._seq_unpin(sequence_id)
+                    else:
+                        await asyncio.sleep(
+                            self._seq_backoff_s(len(tried), budget))
+                    continue
+                self._sequence_event(ep, request_id, sequence_id, e)
+                self._seq_unpin(sequence_id)
+                raise
+            finally:
+                self.pool.done(ep)
+            self.pool.record_success(ep, time.monotonic() - t0)
+            self._seq_mark_established(sequence_id)
+            if kwargs.get("sequence_end"):
+                self._seq_unpin(sequence_id)
+            return result
+        assert last is not None
+        raise last
+
+    # -- streaming (HTTP generate extension) ----------------------------------
+    def generate_stream(self, *args, **kwargs):
+        """Pool-routed async SSE generate stream; the endpoint's
+        ``outstanding`` slot is held for the life of the iteration (see
+        the sync twin)."""
+        self._ensure_prober()  # streaming-only pools still need health
+        ep = self.pool.select()
+        inner = ep.client.generate_stream(*args, **kwargs)  # lazy: no I/O yet
+
+        async def stream():
+            self._ensure_prober()  # called outside a loop? start it here
+            self.pool.begin(ep)
+            ok = True
+            try:
+                async for item in inner:
+                    yield item
+            except Exception as e:
+                ok = False
+                self._record_attempt_failure(ep, e)
+                raise
+            finally:
+                self.pool.done(ep)
+                if ok:
+                    self.pool.record_success(ep)
+
+        return stream()
+
+    async def _hedged_infer(self, model_name, inputs, kwargs,
+                            timeout_s: Optional[float]):
+        self._ensure_prober()
+        budget = AttemptBudget(self._budget_policy, timeout_s)
+        hedge = self._hedge
+        pool = self.pool
+        tried: List[EndpointState] = []
+        failures: List[BaseException] = []
+        tasks: "set" = set()
+
+        async def attempt(ep, remaining):
+            pool.begin(ep)
+            t0 = time.monotonic()
+            try:
+                kw = dict(kwargs)
+                if remaining is not None:
+                    kw["client_timeout"] = remaining
+                result = await ep.client.infer(model_name, inputs, **kw)
+            except asyncio.CancelledError:
+                raise  # the losing hedge: no outcome to record
+            except Exception as e:
+                self._record_attempt_failure(ep, e)
+                raise
+            finally:
+                pool.done(ep)
+            pool.record_success(ep, time.monotonic() - t0)
+            return result
+
+        def spawn():
+            remaining = budget.attempt_timeout_s()
+            ep = pool.select(exclude=tried)
+            tried.append(ep)
+            tasks.add(asyncio.ensure_future(attempt(ep, remaining)))
+
+        async def cancel_pending():
+            for t in tasks:
+                t.cancel()
+            for t in tasks:
+                try:
+                    await t
+                except BaseException:
+                    pass
+
+        max_attempts = max(self._max_failover_attempts, 1 + hedge.max_hedges)
+        spawn()
+        hedges_left = hedge.max_hedges
+        hedge_at = time.monotonic() + hedge.delay(
+            pool.latency_p95(hedge.min_latency_samples), self._rng)
+        try:
+            while True:
+                timeout = None
+                if hedges_left > 0:
+                    timeout = max(0.0, hedge_at - time.monotonic())
+                done, _ = await asyncio.wait(
+                    tasks, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    tasks.discard(t)
+                    try:
+                        result = t.result()
+                    except Exception as e:
+                        if (not isinstance(e, CircuitOpenError)
+                                and classify_fault(e) == FATAL):
+                            await cancel_pending()
+                            raise
+                        failures.append(e)
+                    else:
+                        await cancel_pending()
+                        return result
+                firing = hedges_left > 0 and time.monotonic() >= hedge_at
+                if tasks and not firing:
+                    continue
+                if len(tried) >= max_attempts:
+                    if tasks:
+                        hedges_left = 0
+                        continue
+                    raise failures[-1]
+                try:
+                    spawn()
+                except (NoEndpointAvailableError, InferenceServerException) as e:
+                    if tasks:
+                        hedges_left = 0
+                        continue
+                    if failures:
+                        raise failures[-1] from e
+                    raise
+                if firing:
+                    hedges_left -= 1
+                    hedge_at = time.monotonic() + hedge.delay(
+                        pool.latency_p95(hedge.min_latency_samples), self._rng)
+        except asyncio.CancelledError:
+            # external cancellation (wait_for timeout, caller teardown):
+            # the in-flight attempts must die with the caller, not keep
+            # loading replicas in the background
+            await cancel_pending()
+            raise
+
+    # -- generic surface delegation -------------------------------------------
+    async def _broadcast(self, name: str, args, kwargs):
+        """Async twin of the sync broadcast: every endpoint is attempted
+        even if one fails, then the first failure raises. Handles the sync
+        methods the aio clients inherit (register_plugin etc.)."""
+        first_exc: Optional[BaseException] = None
+        result = None
+        for ep in self.pool.endpoints:
+            try:
+                result = getattr(ep.client, name)(*args, **kwargs)
+                if inspect.isawaitable(result):
+                    result = await result
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+        return result
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        probe = getattr(self.pool.endpoints[0].client, name, None)
+        if not callable(probe):
+            raise AttributeError(
+                f"{type(self).__name__} has no attribute {name!r}")
+
+        if self._is_broadcast(name):
+            async def call(*args, **kwargs):
+                return await self._broadcast(name, args, kwargs)
+        else:
+            async def call(*args, **kwargs):
+                async def op(client, _remaining):
+                    # the aio clients inherit a few sync methods from the
+                    # shared base (plugins); awaiting their None would throw
+                    result = getattr(client, name)(*args, **kwargs)
+                    if inspect.isawaitable(result):
+                        result = await result
+                    return result
+                return await self._execute(op, idempotent=True)
+
+        call.__name__ = name
+        return call

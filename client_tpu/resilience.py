@@ -332,6 +332,24 @@ class CircuitBreaker:
                     self._state = self.OPEN
                     self._opened_at = self._clock()
 
+    def would_admit(self) -> bool:
+        """Non-mutating peek: would :meth:`allow` admit a call right now?
+
+        Unlike ``allow`` this takes no probe slot and performs no state
+        transition, so selection layers (the endpoint pool) can skip an
+        endpoint whose breaker would fast-fail without consuming the
+        half-open probe budget. Inherently racy under concurrency — the
+        admitting ``allow`` remains the authority and callers must still
+        handle :class:`CircuitOpenError`."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                # an elapsed recovery window means allow() would half-open
+                # and admit the first probe
+                return self._clock() >= self._opened_at + self.recovery_time_s
+            return self._probes_in_flight < self.half_open_max_probes
+
     def abort_probe(self) -> None:
         """Release an admitted probe slot without recording an outcome
         (the attempt was interrupted, e.g. cancellation/KeyboardInterrupt —

@@ -5,12 +5,15 @@ development::
 
     python -m client_tpu.serve --http-port 8000 --grpc-port 8001 [--vision]
 
-Ctrl-C stops it.
+Ctrl-C stops it immediately; SIGTERM drains gracefully — ``v2/health/ready``
+/ ``ServerReady`` flip to not-ready first (so multi-endpoint pools route
+away), in-flight requests finish, then the listeners close.
 """
 
 from __future__ import annotations
 
 import argparse
+import signal
 import sys
 import time
 from typing import List, Optional
@@ -95,14 +98,44 @@ def main(argv: Optional[List[str]] = None) -> int:
         servers.append(grpc_srv)
         print(f"GRPC  server listening on {grpc_srv.url}")
     print(f"models: {', '.join(m.name for m in models)}")
+
+    class _Drain(Exception):
+        pass
+
+    def on_sigterm(signum, frame):
+        # disarm: systemd/k8s stop sequences often deliver repeat SIGTERMs;
+        # a second one must not abort the graceful close already underway
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        raise _Drain()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    draining = False
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         pass
+    except _Drain:
+        draining = True
     finally:
+        # shutdown is underway: further signals must not abort it mid-stop
+        # (the finally also guarantees every server stops on ANY exit path)
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        if draining:
+            # graceful: flip ready everywhere FIRST so pool probes route
+            # away, then let each frontend finish in-flight work and close
+            print("SIGTERM: draining (ready -> not-ready, finishing in-flight)")
+            core.ready = False
+            time.sleep(1.0)
         for s in servers:
-            s.stop()
+            try:
+                if draining:
+                    s.close(grace_s=0.0)
+                else:
+                    s.stop()
+            except Exception as e:
+                print(f"error stopping {type(s).__name__}: {e}")
     return 0
 
 

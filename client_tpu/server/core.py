@@ -287,6 +287,10 @@ class ServerCore:
             "log_format": "default",
         }
         self.live = True
+        # ready is the DRAINABLE half of health: frontends flip it false on
+        # drain/close so pool ready-probes route away while in-flight
+        # requests still complete (live stays true until the process exits)
+        self.ready = True
         # rolling per-request trace records, populated when trace_level
         # includes TIMESTAMPS (Triton writes these to trace_file; we keep a
         # ring buffer and mirror to trace_file when one is configured)

@@ -8,6 +8,7 @@ method handlers bound to the schema-driven wire codec — the server twin of
 
 from __future__ import annotations
 
+import time
 from concurrent import futures
 from typing import Any, Dict, List, Optional
 
@@ -182,7 +183,9 @@ class _Handlers(grpc.GenericRpcHandler):
         return {"live": self._core.live}
 
     def _server_ready(self, request, context):
-        return {"ready": self._core.live}
+        # drainable: drain()/close() flips core.ready so pool ready-probes
+        # route away while in-flight RPCs still complete
+        return {"ready": self._core.live and self._core.ready}
 
     def _model_ready(self, request, context):
         return {
@@ -470,8 +473,25 @@ class GrpcInferenceServer:
         self._server.start()
         return self
 
+    def drain(self, grace_s: float = 0.0) -> None:
+        """Flip ``ServerReady`` to false and wait ``grace_s`` so pool
+        ready-probes route away before the port closes. The server keeps
+        serving (including ready-racing requests) during the window. Note:
+        ``core`` may be shared by several frontends; draining one drains
+        them all."""
+        self.core.ready = False
+        if grace_s > 0:
+            time.sleep(grace_s)
+
     def stop(self, grace: Optional[float] = 1.0) -> None:
         self._server.stop(grace).wait()
+
+    def close(self, grace_s: float = 0.5) -> None:
+        """Graceful shutdown: drain, wait for pollers to route away, let
+        in-flight RPCs finish (grpc's own stop grace), then release the
+        port. SIGTERM handlers should call this, not ``stop``."""
+        self.drain(grace_s)
+        self.stop(grace=10.0)
 
     def __enter__(self) -> "GrpcInferenceServer":
         return self.start()

@@ -12,6 +12,7 @@ import gzip
 import json
 import re
 import threading
+import time
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
@@ -366,6 +367,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- GET ---------------------------------------------------------------
     def do_GET(self):
+        self.server.request_began()
+        try:
+            self._route_get()
+        finally:
+            self.server.request_ended()
+
+    def _route_get(self):
         core = self.core
         path = self.path.split("?", 1)[0]
         try:
@@ -374,7 +382,9 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/v2/health/live":
                 return self._send(200 if core.live else 503)
             if path == "/v2/health/ready":
-                return self._send(200 if core.live else 503)
+                # ready is drainable: close()/drain() flips core.ready so
+                # pool probes route away before the listener disappears
+                return self._send(200 if (core.live and core.ready) else 503)
             if path == "/v2/models/stats":
                 return self._send_json(core.statistics())
             if path == "/v2/trace/setting":
@@ -405,6 +415,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- POST --------------------------------------------------------------
     def do_POST(self):
+        self.server.request_began()
+        try:
+            self._route_post()
+        finally:
+            self.server.request_ended()
+
+    def _route_post(self):
         core = self.core
         path = self.path.split("?", 1)[0]
         try:
@@ -563,6 +580,37 @@ class _Handler(BaseHTTPRequestHandler):
             gen.close()
 
 
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer + an in-flight request counter, so graceful
+    drain can wait for outstanding requests instead of guessing.
+
+    stdlib default listen backlog is 5; bursts of concurrent connections
+    get RST'd without ``request_queue_size`` raised."""
+
+    request_queue_size = 128
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+
+    def request_began(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+            self._idle.clear()
+
+    def request_ended(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._idle.set()
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        return self._idle.wait(timeout)
+
+
 class HttpInferenceServer:
     """An in-process threaded v2 HTTP server bound to localhost.
 
@@ -572,18 +620,14 @@ class HttpInferenceServer:
         server.start()
         client = InferenceServerClient(server.url)
         ...
-        server.stop()
+        server.stop()        # immediate
+        # or: server.close() # graceful: drain ready, finish in-flight
     """
 
     def __init__(self, core: ServerCore, port: int = 0, verbose: bool = False):
         self.core = core
         handler = type("BoundHandler", (_Handler,), {"core": core})
-        # stdlib default listen backlog is 5; bursts of concurrent
-        # connections get RST'd without this (subclass: no global mutation)
-        server_cls = type(
-            "BoundHTTPServer", (ThreadingHTTPServer,), {"request_queue_size": 128}
-        )
-        self._httpd = server_cls(("127.0.0.1", port), handler)
+        self._httpd = _TrackingHTTPServer(("127.0.0.1", port), handler)
         self._httpd.verbose = verbose
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
@@ -603,8 +647,32 @@ class HttpInferenceServer:
         self._thread.start()
         return self
 
+    def drain(self, grace_s: float = 0.0) -> None:
+        """Flip ``v2/health/ready`` to 503 (``core.ready = False``) and wait
+        ``grace_s`` so pool ready-probes route traffic away BEFORE the
+        listener disappears. The server keeps answering everything else —
+        including requests that race the probe window. Note: ``core`` may
+        be shared by several frontends; draining one drains them all."""
+        self.core.ready = False
+        if grace_s > 0:
+            time.sleep(grace_s)
+
     def stop(self) -> None:
+        """Immediate shutdown (in-flight requests may be cut); the graceful
+        path is :meth:`close`."""
         self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+    def close(self, grace_s: float = 0.5) -> None:
+        """Graceful shutdown: drain (ready -> 503), wait ``grace_s`` for
+        health pollers to route away, finish in-flight requests, then close
+        the listener. SIGTERM handlers should call this, not ``stop``."""
+        self.drain(grace_s)
+        self._httpd.shutdown()
+        # finish in-flight requests before tearing the listener down
+        self._httpd.wait_idle(timeout=10)
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._httpd.server_close()

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
@@ -71,8 +72,14 @@ class AioHttpInferenceServer:
         async def live(request):
             return web.Response(status=200 if core.live else 503)
 
+        async def ready(request):
+            # drainable: close()/drain() flips core.ready so pool probes
+            # route away before the listener disappears
+            return web.Response(
+                status=200 if (core.live and core.ready) else 503)
+
         r.add_get("/v2/health/live", live)
-        r.add_get("/v2/health/ready", live)
+        r.add_get("/v2/health/ready", ready)
 
         async def server_metadata(request):
             return _json_response(core.server_metadata())
@@ -360,13 +367,30 @@ class AioHttpInferenceServer:
             raise RuntimeError("aio http server failed to start")
         return self
 
+    def drain(self, grace_s: float = 0.0) -> None:
+        """Flip ``v2/health/ready`` to 503 and wait ``grace_s`` so pool
+        ready-probes route away before the listener disappears; everything
+        else keeps serving through the window. Note: ``core`` may be shared
+        by several frontends; draining one drains them all."""
+        self.core.ready = False
+        if grace_s > 0:
+            time.sleep(grace_s)
+
     def stop(self) -> None:
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
+            # run() finishes with runner.cleanup(), which itself waits for
+            # in-flight aiohttp handlers before closing the listener
             self._thread.join(timeout=10)
             self._thread = None
         self._executor.shutdown(wait=False)
+
+    def close(self, grace_s: float = 0.5) -> None:
+        """Graceful shutdown: drain, wait for pollers to route away, finish
+        in-flight handlers, then close. SIGTERM handlers should call this."""
+        self.drain(grace_s)
+        self.stop()
 
     def __enter__(self) -> "AioHttpInferenceServer":
         return self.start()

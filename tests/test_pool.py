@@ -1,0 +1,1055 @@
+"""Multi-endpoint pool end-to-end + engine units.
+
+Proves the ISSUE acceptance criteria: (a) with 3 replicas and one killed
+mid-run the pool completes the workload with zero client-visible errors,
+ejects the dead replica, and re-admits it after recovery — on a sync AND
+an aio frontend; (b) probe-mode health semantics are uniform across all
+four frontends; (c) routing policies honor ejection windows and circuit
+breakers (open endpoint never selected, half-open probed exactly once);
+(d) hedged requests cut tail latency under a slow replica and never fire
+for sequence requests; (e) a draining replica is routed away from without
+a single request error.
+"""
+
+import asyncio
+import random
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu._base import InferenceServerClientBase
+from client_tpu.models import default_model_zoo
+from client_tpu.pool import (
+    LEAST_OUTSTANDING,
+    ROUND_ROBIN,
+    WEIGHTED,
+    AioPoolClient,
+    EndpointEjected,
+    EndpointPool,
+    EndpointState,
+    HedgePolicy,
+    NoEndpointAvailableError,
+    PoolClient,
+    SequenceAbandoned,
+)
+from client_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    ResiliencePolicy,
+)
+from client_tpu.server import (
+    AioHttpInferenceServer,
+    GrpcInferenceServer,
+    HttpInferenceServer,
+    ServerCore,
+)
+from client_tpu.testing import ChaosProxy, Fault
+from client_tpu.utils import InferenceServerException
+
+SEEDED_RNG = lambda: random.Random(0xC11E)  # noqa: E731
+
+
+# -- helpers ------------------------------------------------------------------
+def _simple_inputs(mod):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = mod.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+    in1 = mod.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+    return a + b, [in0, in1]
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _connect_error():
+    try:
+        raise ConnectionRefusedError("refused")
+    except ConnectionRefusedError as e:
+        raise InferenceServerException("connection error: refused") from e
+
+
+def _transient_error():
+    try:
+        raise ConnectionResetError("reset")
+    except ConnectionResetError as e:
+        raise InferenceServerException("connection error: reset") from e
+
+
+class StubClient(InferenceServerClientBase):
+    """A scriptable endpoint client: ``behavior(**kwargs)`` returns the
+    result or raises; calls run under the pool-configured resilience
+    policy exactly like the real frontends."""
+
+    def __init__(self, url, behavior=None):
+        super().__init__()
+        self.url = url
+        self.behavior = behavior or (lambda **kw: "ok")
+        self.calls = []
+        self.ready = True
+
+    def infer(self, model_name, inputs=None, **kwargs):
+        self.calls.append(dict(kwargs))
+        idempotent = kwargs.get("sequence_id", 0) == 0
+        op = lambda: self.behavior(**kwargs)  # noqa: E731
+        if self._resilience is not None:
+            return self._resilience.execute(op, idempotent=idempotent)
+        return op()
+
+    def is_server_ready(self, probe=False, client_timeout=None, **kw):
+        return self.ready
+
+    def register_system_shared_memory(self, name, key, byte_size, **kw):
+        self.calls.append(("register", name))
+
+    def close(self):
+        pass
+
+
+def _stub_pool(behaviors, **kwargs):
+    """PoolClient over StubClients; behaviors maps url -> behavior."""
+    urls = list(behaviors)
+    stubs = {}
+
+    def factory(url):
+        stubs[url] = StubClient(url, behaviors[url])
+        return stubs[url]
+
+    kwargs.setdefault("health_interval_s", None)
+    kwargs.setdefault("rng", SEEDED_RNG())
+    client = PoolClient(urls, client_factory=factory, **kwargs)
+    return client, stubs
+
+
+@pytest.fixture()
+def http_replicas():
+    cores = [ServerCore(default_model_zoo()) for _ in range(3)]
+    servers = [HttpInferenceServer(c).start() for c in cores]
+    proxies = [ChaosProxy("127.0.0.1", s.port).start() for s in servers]
+    yield servers, proxies, cores
+    for p in proxies:
+        p.stop()
+    for s in servers:
+        s.stop()
+
+
+# -- (a) chaos: one replica killed mid-run, zero client-visible errors --------
+@pytest.mark.chaos_smoke
+def test_pool_survives_killed_replica_sync_http(http_replicas):
+    servers, proxies, _ = http_replicas
+    expected, inputs = _simple_inputs(httpclient)
+    events = []
+    client = PoolClient(
+        [p.url for p in proxies], protocol="http",
+        health_interval_s=0.05, probe_timeout_s=0.5,
+        eject_after=2, base_ejection_s=0.3, rng=SEEDED_RNG(),
+        on_event=events.append,
+    )
+    victim_url = proxies[0].url
+    try:
+        errors = []
+        for i in range(60):
+            if i == 15:  # kill replica 0 mid-run: RST everything
+                proxies[0].fault = Fault("reset", after_bytes=0)
+                proxies[0].reset_active()
+            if i == 35:
+                proxies[0].heal()
+            try:
+                result = client.infer("simple", inputs, client_timeout=10.0)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), expected)
+            except Exception as e:  # pragma: no cover - the assertion target
+                errors.append(f"request {i}: {e}")
+            time.sleep(0.01)
+        assert errors == [], errors
+
+        # the dead replica was taken out of rotation (health probe and/or
+        # passive ejection — both feed the same availability gate)
+        assert any(
+            isinstance(e, EndpointEjected) or (
+                getattr(e, "healthy", None) is False)
+            for e in events
+        ), events
+
+        # ... and re-admitted after recovery: it serves traffic again
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.endpoint_stats()[victim_url]["healthy"]:
+                break
+            time.sleep(0.05)
+        assert client.endpoint_stats()[victim_url]["healthy"], \
+            client.endpoint_stats()
+        before = client.endpoint_stats()[victim_url]["resilience"]["calls"]
+        for _ in range(12):
+            client.infer("simple", inputs, client_timeout=10.0)
+        after = client.endpoint_stats()[victim_url]["resilience"]["calls"]
+        assert after > before, "recovered replica received no traffic"
+    finally:
+        client.close()
+
+
+@pytest.mark.chaos_smoke
+def test_pool_survives_killed_replica_aio_http(http_replicas):
+    servers, proxies, _ = http_replicas
+    import client_tpu.http.aio as aioclient
+
+    expected, inputs = _simple_inputs(aioclient)
+    victim_url = proxies[0].url
+
+    async def run():
+        client = AioPoolClient(
+            [p.url for p in proxies], protocol="http",
+            health_interval_s=0.05, probe_timeout_s=0.5,
+            eject_after=2, base_ejection_s=0.3, rng=SEEDED_RNG(),
+        )
+        async with client:
+            errors = []
+            for i in range(60):
+                if i == 15:
+                    proxies[0].fault = Fault("reset", after_bytes=0)
+                    proxies[0].reset_active()
+                if i == 35:
+                    proxies[0].heal()
+                try:
+                    result = await client.infer(
+                        "simple", inputs, client_timeout=10.0)
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUTPUT0"), expected)
+                except Exception as e:  # pragma: no cover
+                    errors.append(f"request {i}: {e}")
+                await asyncio.sleep(0.01)
+            assert errors == [], errors
+
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if client.endpoint_stats()[victim_url]["healthy"]:
+                    break
+                await asyncio.sleep(0.05)
+            assert client.endpoint_stats()[victim_url]["healthy"]
+            before = client.endpoint_stats()[victim_url]["resilience"]["calls"]
+            for _ in range(12):
+                await client.infer("simple", inputs, client_timeout=10.0)
+            after = client.endpoint_stats()[victim_url]["resilience"]["calls"]
+            assert after > before, "recovered replica received no traffic"
+
+    asyncio.run(run())
+
+
+@pytest.mark.chaos_smoke
+def test_pool_failover_blackholed_replica(http_replicas):
+    """A blackholed (accept-then-hang) replica: the in-flight timeout is
+    classified TIMEOUT and the idempotent infer fails over within the
+    shared deadline — zero visible errors."""
+    servers, proxies, _ = http_replicas
+    expected, inputs = _simple_inputs(httpclient)
+    client = PoolClient(
+        [p.url for p in proxies], protocol="http",
+        health_interval_s=0.05, probe_timeout_s=0.3,
+        per_attempt_timeout_s=0.5,  # a hung attempt must not eat the budget
+        rng=SEEDED_RNG(),
+    )
+    try:
+        proxies[1].fault = Fault("blackhole")
+        proxies[1].reset_active()
+        for _ in range(12):
+            result = client.infer("simple", inputs, client_timeout=3.0)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), expected)
+        snap = client.endpoint_stats()
+        assert snap[proxies[1].url]["healthy"] is False
+    finally:
+        client.close()
+
+
+def test_pool_grpc_sync_and_aio_failover():
+    """GRPC frontends: a pool over one dead URL + one live server serves
+    every request (construction proof for the remaining two frontends)."""
+    import client_tpu.grpc.aio as aiogrpc
+
+    core = ServerCore(default_model_zoo())
+    dead = f"127.0.0.1:{_dead_port()}"
+    with GrpcInferenceServer(core) as server:
+        expected, inputs = _simple_inputs(grpcclient)
+        client = PoolClient(
+            [dead, server.url], protocol="grpc",
+            health_interval_s=None, rng=SEEDED_RNG(),
+        )
+        try:
+            for _ in range(6):
+                result = client.infer("simple", inputs, client_timeout=10.0)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), expected)
+            snap = client.endpoint_stats()
+            assert snap[server.url]["resilience"]["calls"] >= 1
+        finally:
+            client.close()
+
+        _, ainputs = _simple_inputs(aiogrpc)
+
+        async def run():
+            client = AioPoolClient(
+                [dead, server.url], protocol="grpc",
+                health_interval_s=None, rng=SEEDED_RNG(),
+            )
+            async with client:
+                for _ in range(6):
+                    result = await client.infer(
+                        "simple", ainputs, client_timeout=10.0)
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUTPUT0"), expected)
+
+        asyncio.run(run())
+
+
+# -- (b) probe-mode health semantics, all four frontends ----------------------
+@pytest.mark.chaos_smoke
+def test_probe_mode_http_sync():
+    url = f"127.0.0.1:{_dead_port()}"
+    with httpclient.InferenceServerClient(url) as client:
+        assert client.is_server_live(probe=True) is False
+        assert client.is_server_ready(probe=True) is False
+        with pytest.raises(InferenceServerException):
+            client.is_server_live()  # default contract: transport raises
+
+
+def test_probe_mode_http_aio():
+    import client_tpu.http.aio as aioclient
+
+    url = f"127.0.0.1:{_dead_port()}"
+
+    async def run():
+        async with aioclient.InferenceServerClient(url) as client:
+            assert await client.is_server_live(probe=True) is False
+            assert await client.is_server_ready(probe=True) is False
+            with pytest.raises(InferenceServerException):
+                await client.is_server_live()
+
+    asyncio.run(run())
+
+
+def test_probe_mode_grpc_sync():
+    url = f"127.0.0.1:{_dead_port()}"
+    with grpcclient.InferenceServerClient(url) as client:
+        assert client.is_server_live(probe=True, client_timeout=2.0) is False
+        assert client.is_server_ready(probe=True, client_timeout=2.0) is False
+        with pytest.raises(InferenceServerException):
+            client.is_server_live(client_timeout=2.0)
+
+
+def test_probe_mode_grpc_aio():
+    import client_tpu.grpc.aio as aiogrpc
+
+    url = f"127.0.0.1:{_dead_port()}"
+
+    async def run():
+        async with aiogrpc.InferenceServerClient(url) as client:
+            assert await client.is_server_live(
+                probe=True, client_timeout=2.0) is False
+            assert await client.is_server_ready(
+                probe=True, client_timeout=2.0) is False
+            with pytest.raises(InferenceServerException):
+                await client.is_server_live(client_timeout=2.0)
+
+    asyncio.run(run())
+
+
+def test_probe_bypasses_open_breaker():
+    """A probe must observe the endpoint, not the breaker: with the
+    client's breaker wedged open, probe=True still answers from the
+    live server instead of fast-failing."""
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        breaker = CircuitBreaker(min_calls=1, recovery_time_s=3600.0)
+        breaker.record(False)
+        assert breaker.state == CircuitBreaker.OPEN
+        with httpclient.InferenceServerClient(server.url) as client:
+            client.configure_resilience(ResiliencePolicy(breaker=breaker))
+            with pytest.raises(CircuitOpenError):
+                client.is_server_ready()  # normal path fast-fails
+            assert client.is_server_ready(probe=True) is True
+
+
+# -- (c) routing x ejection x breaker -----------------------------------------
+def _bare_endpoints(n, clock, breaker_factory=lambda: None, weights=None):
+    eps = []
+    for i in range(n):
+        policy = ResiliencePolicy(breaker=breaker_factory())
+        weight = weights[i] if weights else 1.0
+        eps.append(EndpointState(f"ep{i}", client=None, policy=policy,
+                                 weight=weight))
+    return eps
+
+
+def test_round_robin_cycles_and_skips_ejected():
+    t = [0.0]
+    eps = _bare_endpoints(3, lambda: t[0])
+    pool = EndpointPool(eps, routing=ROUND_ROBIN, eject_after=1,
+                        base_ejection_s=5.0, clock=lambda: t[0])
+    picks = [pool.select().url for _ in range(6)]
+    assert sorted(picks[:3]) == ["ep0", "ep1", "ep2"]
+    assert picks[:3] == picks[3:]
+    pool.record_failure(eps[1], "transient")
+    assert eps[1].ejected
+    picks = {pool.select().url for _ in range(6)}
+    assert picks == {"ep0", "ep2"}
+    t[0] = 6.0  # window expires -> re-admitted
+    picks = {pool.select().url for _ in range(6)}
+    assert picks == {"ep0", "ep1", "ep2"}
+    assert not eps[1].ejected
+
+
+def test_least_outstanding_prefers_idle():
+    t = [0.0]
+    eps = _bare_endpoints(3, lambda: t[0])
+    pool = EndpointPool(eps, routing=LEAST_OUTSTANDING, clock=lambda: t[0])
+    pool.begin(eps[0])
+    pool.begin(eps[0])
+    pool.begin(eps[1])
+    assert pool.select().url == "ep2"
+    pool.begin(eps[2])
+    # ep1 and ep2 tie at 1 outstanding; ep0 (2) never picked
+    picks = {pool.select().url for _ in range(4)}
+    assert "ep0" not in picks and picks <= {"ep1", "ep2"}
+
+
+def test_weighted_static_weights_distribution():
+    t = [0.0]
+    eps = _bare_endpoints(3, lambda: t[0], weights=[3.0, 1.0, 1.0])
+    pool = EndpointPool(eps, routing=WEIGHTED, clock=lambda: t[0])
+    counts = {"ep0": 0, "ep1": 0, "ep2": 0}
+    for _ in range(50):
+        counts[pool.select().url] += 1
+    assert counts == {"ep0": 30, "ep1": 10, "ep2": 10}  # smooth WRR is exact
+
+
+def test_ejection_windows_grow_exponentially_and_decay():
+    t = [0.0]
+    eps = _bare_endpoints(2, lambda: t[0])
+    pool = EndpointPool(eps, eject_after=1, base_ejection_s=1.0,
+                        ejection_multiplier=2.0, max_ejection_s=3.0,
+                        ejection_decay_s=10.0, clock=lambda: t[0])
+    windows = []
+    pool._on_event = lambda e: windows.append(e.window_s) \
+        if isinstance(e, EndpointEjected) else None
+    for k in range(4):
+        pool.record_failure(eps[0], "connect")
+        assert eps[0].ejected
+        t[0] = eps[0].ejected_until  # serve out the window
+        pool.select()  # triggers lazy re-admission
+    assert windows == [1.0, 2.0, 3.0, 3.0]  # 1, 2, capped at 3
+    # a long-healthy endpoint is forgiven: decay resets the exponent
+    t[0] += 20.0
+    pool.record_failure(eps[0], "connect")
+    assert windows[-1] == 1.0
+
+
+def test_ejection_capped_at_half_the_pool():
+    """At most ceil(N/2) replicas may be ejected at once: with N=3 the
+    third failing endpoint keeps taking traffic (degraded beats blind)."""
+    t = [0.0]
+    eps = _bare_endpoints(3, lambda: t[0])
+    pool = EndpointPool(eps, eject_after=1, base_ejection_s=60.0,
+                        clock=lambda: t[0])
+    pool.record_failure(eps[0], "transient")
+    pool.record_failure(eps[1], "transient")
+    assert eps[0].ejected and eps[1].ejected
+    pool.record_failure(eps[2], "transient")
+    assert not eps[2].ejected, "cap breached: the whole pool went dark"
+    assert pool.select().url == "ep2"
+
+
+def test_open_breaker_endpoint_not_selected_by_any_policy():
+    for routing in (ROUND_ROBIN, LEAST_OUTSTANDING, WEIGHTED):
+        t = [0.0]
+        breakers = [CircuitBreaker(min_calls=1, recovery_time_s=100.0,
+                                   clock=lambda: t[0]) for _ in range(3)]
+        it = iter(breakers)
+        eps = _bare_endpoints(3, lambda: t[0],
+                              breaker_factory=lambda: next(it))
+        pool = EndpointPool(eps, routing=routing, clock=lambda: t[0])
+        breakers[0].record(False)  # open ep0's breaker
+        assert breakers[0].state == CircuitBreaker.OPEN
+        picks = {pool.select().url for _ in range(10)}
+        assert "ep0" not in picks, f"routing={routing} selected an open breaker"
+
+
+@pytest.mark.chaos_smoke
+def test_half_open_probe_routed_exactly_once():
+    """After recovery_time_s the endpoint's breaker half-opens: exactly one
+    request is routed there as the probe; while it is in flight the pool
+    must not send a second one."""
+    release = threading.Event()
+    in_probe = threading.Event()
+
+    def blocked_ok(**kw):
+        in_probe.set()
+        release.wait(timeout=10)
+        return "ok"
+
+    client, stubs = _stub_pool(
+        {"only": blocked_ok},
+        breaker_factory=lambda: CircuitBreaker(
+            min_calls=1, recovery_time_s=0.1),
+        eject_after=1000,  # isolate the breaker from outlier ejection
+    )
+    try:
+        ep = client.pool.endpoints[0]
+        ep.policy.breaker.record(False)
+        assert ep.policy.breaker.state == CircuitBreaker.OPEN
+        # while open (recovery pending), no routing policy selects it
+        with pytest.raises(NoEndpointAvailableError):
+            client.infer("m", [])
+        time.sleep(0.15)  # recovery elapsed -> half-open admits ONE probe
+
+        box = {}
+
+        def probe_request():
+            try:
+                box["result"] = client.infer("m", [])
+            except Exception as e:  # pragma: no cover
+                box["error"] = e
+
+        t = threading.Thread(target=probe_request)
+        t.start()
+        assert in_probe.wait(timeout=5), "half-open probe was never routed"
+        # probe in flight: a concurrent request must NOT reach the endpoint
+        with pytest.raises(NoEndpointAvailableError):
+            client.infer("m", [])
+        assert len(stubs["only"].calls) == 1, "second request hit half-open"
+        release.set()
+        t.join(timeout=5)
+        assert box.get("result") == "ok"
+        assert ep.policy.breaker.state == CircuitBreaker.CLOSED
+        assert client.infer("m", []) == "ok"  # circuit closed, traffic flows
+    finally:
+        release.set()
+        client.close()
+
+
+# -- failover semantics -------------------------------------------------------
+def test_failover_on_connect_failure_even_for_sequences():
+    """Connect failures are provably never-sent: even a sequence request
+    fails over to the next replica."""
+    calls = []
+
+    def dead(**kw):
+        calls.append("dead")
+        _connect_error()
+
+    client, stubs = _stub_pool({"dead": dead, "live": lambda **kw: "ok"})
+    try:
+        assert client.infer("m", [], sequence_id=7) == "ok"
+        assert calls == ["dead"]
+    finally:
+        client.close()
+
+
+def test_sequence_never_resent_after_inflight_failure():
+    """A transient in-flight death of a sequence request must NOT fail
+    over — the typed SequenceAbandoned event is delivered and the original
+    error raises. The second replica never sees the request."""
+    events = []
+
+    def flaky(**kw):
+        _transient_error()
+
+    client, stubs = _stub_pool(
+        {"flaky": flaky, "live": lambda **kw: "ok"},
+        routing=ROUND_ROBIN, on_event=events.append,
+    )
+    try:
+        # force the first pick deterministically onto the flaky endpoint
+        client.pool.endpoints[1].healthy = False
+        with pytest.raises(InferenceServerException, match="reset"):
+            client.infer("m", [], sequence_id=9001, request_id="seq-1")
+        abandoned = [e for e in events if isinstance(e, SequenceAbandoned)]
+        assert len(abandoned) == 1
+        assert abandoned[0].request_id == "seq-1"
+        assert abandoned[0].sequence_id == 9001
+        assert abandoned[0].url == "flaky"
+        assert stubs["live"].calls == [], "sequence was silently re-sent"
+
+        # the idempotent twin DOES fail over
+        client.pool.endpoints[1].healthy = True
+        assert client.infer("m", [], request_id="idem-1") in ("ok",)
+    finally:
+        client.close()
+
+
+def test_sequence_requests_pin_to_one_endpoint():
+    """Replica-local sequence state must not scatter: every request of one
+    sequence lands on the SAME endpoint; sequence_end releases the pin."""
+    client, stubs = _stub_pool(
+        {"a": lambda **kw: "ok", "b": lambda **kw: "ok"})
+    try:
+        client.infer("m", [], sequence_id=7, sequence_start=True)
+        for _ in range(3):
+            client.infer("m", [], sequence_id=7)
+        client.infer("m", [], sequence_id=7, sequence_end=True)
+        counts = {u: len(s.calls) for u, s in stubs.items()}
+        # round-robin would have alternated; affinity keeps all 5 together
+        assert sorted(counts.values()) == [0, 5], counts
+        assert 7 not in client._seq_pins  # end released the pin
+    finally:
+        client.close()
+
+
+def test_established_sequence_retries_same_endpoint_on_connect_failure():
+    """Once a sequence has server-side state, a connect failure re-attempts
+    the SAME replica (the state lives there) instead of failing over."""
+    state = {"fail_next": False}
+
+    def flaky_a(**kw):
+        if state["fail_next"]:
+            state["fail_next"] = False
+            _connect_error()
+        return "ok"
+
+    client, stubs = _stub_pool(
+        {"a": flaky_a, "b": lambda **kw: "ok"})
+    try:
+        client.infer("m", [], sequence_id=9, sequence_start=True)  # pins 'a'
+        assert len(stubs["a"].calls) == 1
+        state["fail_next"] = True
+        client.infer("m", [], sequence_id=9)  # connect fail -> retry 'a'
+        assert len(stubs["a"].calls) == 3  # start + failed + retried
+        assert stubs["b"].calls == [], "established sequence moved replicas"
+    finally:
+        client.close()
+
+
+def test_pooled_infer_accepts_positional_args():
+    """Drop-in signature: the frontends' shared positional prefix works."""
+    client, stubs = _stub_pool({"a": lambda **kw: "ok"})
+    try:
+        assert client.infer("m", [], "", None, "rid-1") == "ok"
+        assert stubs["a"].calls[-1]["request_id"] == "rid-1"
+        with pytest.raises(TypeError, match="multiple values"):
+            client.infer("m", [], "", request_id="x", model_version="2")
+    finally:
+        client.close()
+
+
+def test_generate_stream_holds_outstanding_until_exhausted():
+    """least_outstanding must see long-lived generate streams: the slot is
+    held across iteration, not released at iterator creation."""
+    class GenStub(StubClient):
+        def generate_stream(self, *a, **kw):
+            self.calls.append(("gen",))
+            def g():
+                yield {"x": 1}
+                yield {"x": 2}
+            return g()
+
+    stubs = {}
+
+    def factory(url):
+        stubs[url] = GenStub(url)
+        return stubs[url]
+
+    client = PoolClient(["only"], client_factory=factory,
+                        health_interval_s=None, rng=SEEDED_RNG())
+    try:
+        ep = client.pool.endpoints[0]
+        it = client.generate_stream("m", {})
+        assert ep.outstanding == 0  # lazy: nothing issued yet
+        first = next(it)
+        assert first == {"x": 1}
+        assert ep.outstanding == 1, "slot released while stream still open"
+        assert list(it) == [{"x": 2}]
+        assert ep.outstanding == 0
+        # abandonment also releases the slot (GeneratorExit path)
+        it2 = client.generate_stream("m", {})
+        next(it2)
+        assert ep.outstanding == 1
+        it2.close()
+        assert ep.outstanding == 0
+    finally:
+        client.close()
+
+
+def test_hedged_infer_aio_external_cancel_cleans_up():
+    """wait_for-cancelling a hedged infer must cancel the in-flight
+    attempts instead of leaving them loading replicas in the background."""
+    class SlowAioStub(StubClient):
+        async def infer(self, model_name, inputs=None, **kwargs):
+            self.calls.append(dict(kwargs))
+            await asyncio.sleep(5.0)
+            return "slow"
+
+    async def run():
+        stubs = {}
+
+        def factory(url):
+            stubs[url] = SlowAioStub(url)
+            return stubs[url]
+
+        client = AioPoolClient(
+            ["a", "b"], client_factory=factory,
+            health_interval_s=None, rng=SEEDED_RNG(),
+            hedge=HedgePolicy(delay_s=0.02, jitter_frac=0.0),
+        )
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(client.infer("m", []), timeout=0.1)
+        # both the primary and the fired hedge were cancelled and released
+        assert all(ep.outstanding == 0 for ep in client.pool.endpoints), \
+            [(ep.url, ep.outstanding) for ep in client.pool.endpoints]
+
+    asyncio.run(run())
+
+
+def test_shared_deadline_bounds_failover_chain():
+    """One AttemptBudget spans all replicas: a pool of slow-failing
+    endpoints must stop at the caller's client_timeout, not N x timeout."""
+    def slow_fail(**kw):
+        time.sleep(0.2)
+        _transient_error()
+
+    client, _ = _stub_pool(
+        {f"ep{i}": slow_fail for i in range(4)})
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(InferenceServerException):
+            client.infer("m", [], client_timeout=0.3)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, f"failover chain ignored the shared deadline: {elapsed:.2f}s"
+    finally:
+        client.close()
+
+
+def test_fatal_error_raises_without_failover():
+    """An application (FATAL) error proves the server answered: no
+    failover, no ejection counting."""
+    def app_error(**kw):
+        raise InferenceServerException("no such model", status="400")
+
+    client, stubs = _stub_pool(
+        {"a": app_error, "b": lambda **kw: "ok"})
+    try:
+        client.pool.endpoints[1].healthy = False  # force pick 'a'
+        with pytest.raises(InferenceServerException, match="no such model"):
+            client.infer("m", [])
+        assert stubs["b"].calls == []
+        assert client.pool.endpoints[0].consecutive_failures == 0
+    finally:
+        client.close()
+
+
+# -- (d) hedging --------------------------------------------------------------
+@pytest.mark.chaos_smoke
+def test_hedged_infer_cuts_slow_replica_tail():
+    """Primary pinned (by weight) to a slow replica: the hedge fires after
+    delay_s, lands on the fast replica, and the call returns well under
+    the slow latency. Both replicas saw the request."""
+    def slow(**kw):
+        time.sleep(0.5)
+        return "slow"
+
+    client, stubs = _stub_pool(
+        {"slow": slow, "fast": lambda **kw: "fast"},
+        routing=WEIGHTED, weights=[1.0, 0.0],
+        hedge=HedgePolicy(delay_s=0.05, jitter_frac=0.0),
+    )
+    try:
+        t0 = time.monotonic()
+        result = client.infer("m", [])
+        elapsed = time.monotonic() - t0
+        assert result == "fast"
+        assert elapsed < 0.4, f"hedge did not cut the tail: {elapsed:.2f}s"
+        assert len(stubs["slow"].calls) == 1
+        assert len(stubs["fast"].calls) == 1
+    finally:
+        client.close()
+
+
+def test_hedge_never_fires_for_sequences():
+    def slow(**kw):
+        time.sleep(0.2)
+        return "slow"
+
+    client, stubs = _stub_pool(
+        {"slow": slow, "fast": lambda **kw: "fast"},
+        routing=WEIGHTED, weights=[1.0, 0.0],
+        hedge=HedgePolicy(delay_s=0.01, jitter_frac=0.0),
+    )
+    try:
+        result = client.infer("m", [], sequence_id=5)
+        assert result == "slow"
+        assert stubs["fast"].calls == [], "a sequence request was hedged"
+    finally:
+        client.close()
+
+
+def test_hedge_failover_when_primary_dies():
+    """The hedged path still fails over: a primary that dies before the
+    hedge timer is replaced immediately rather than waiting."""
+    def dead(**kw):
+        _connect_error()
+
+    client, stubs = _stub_pool(
+        {"dead": dead, "live": lambda **kw: "ok"},
+        routing=WEIGHTED, weights=[1.0, 0.0],
+        hedge=HedgePolicy(delay_s=5.0, jitter_frac=0.0),
+    )
+    try:
+        t0 = time.monotonic()
+        assert client.infer("m", []) == "ok"
+        assert time.monotonic() - t0 < 2.0, "waited for the hedge timer"
+    finally:
+        client.close()
+
+
+def test_hedged_infer_aio_cancels_loser():
+    """Asyncio hedging truly cancels the losing attempt."""
+    cancelled = asyncio.Event()
+
+    class SlowAioStub(StubClient):
+        async def infer(self, model_name, inputs=None, **kwargs):
+            self.calls.append(dict(kwargs))
+            try:
+                await asyncio.sleep(5.0)
+            except asyncio.CancelledError:
+                cancelled.set()
+                raise
+            return "slow"
+
+    class FastAioStub(StubClient):
+        async def infer(self, model_name, inputs=None, **kwargs):
+            self.calls.append(dict(kwargs))
+            return "fast"
+
+    async def run():
+        stubs = {}
+
+        def factory(url):
+            cls = SlowAioStub if url == "slow" else FastAioStub
+            stubs[url] = cls(url)
+            return stubs[url]
+
+        client = AioPoolClient(
+            ["slow", "fast"], client_factory=factory,
+            routing=WEIGHTED, weights=[1.0, 0.0],
+            health_interval_s=None, rng=SEEDED_RNG(),
+            hedge=HedgePolicy(delay_s=0.02, jitter_frac=0.0),
+        )
+        result = await client.infer("m", [])
+        assert result == "fast"
+        await asyncio.wait_for(cancelled.wait(), timeout=2.0)
+        # cancelled loser released its outstanding slot
+        assert client.pool.endpoints[0].outstanding == 0
+
+    asyncio.run(run())
+
+
+def test_hedge_delay_rolling_p95_and_seeded_jitter():
+    t = [0.0]
+    eps = _bare_endpoints(1, lambda: t[0])
+    pool = EndpointPool(eps, clock=lambda: t[0])
+    assert pool.latency_p95() is None  # not enough samples yet
+    for ms in range(1, 101):
+        pool.record_success(eps[0], ms / 1000.0)
+    p95 = pool.latency_p95()
+    assert 0.090 <= p95 <= 0.100
+    hedge = HedgePolicy(jitter_frac=0.1)
+    rng_a, rng_b = random.Random(42), random.Random(42)
+    da = [hedge.delay(p95, rng_a) for _ in range(5)]
+    db = [hedge.delay(p95, rng_b) for _ in range(5)]
+    assert da == db, "hedge jitter is not deterministic under a seeded rng"
+    assert all(p95 <= d <= p95 * 1.1 for d in da)
+    # no latency history: the fallback delay is used
+    fresh = EndpointPool(_bare_endpoints(1, lambda: 0.0))
+    assert hedge.delay(fresh.latency_p95(), random.Random(1)) <= \
+        hedge.fallback_delay_s * 1.1
+
+
+# -- (e) graceful drain -------------------------------------------------------
+@pytest.mark.chaos_smoke
+def test_draining_replica_ejected_without_errors():
+    """The drain regression: close() flips ready -> the pool's ready-probe
+    routes away -> the listener closes. A continuous workload sees ZERO
+    errors across the whole drain."""
+    cores = [ServerCore(default_model_zoo()) for _ in range(2)]
+    servers = [HttpInferenceServer(c).start() for c in cores]
+    expected, inputs = _simple_inputs(httpclient)
+    client = PoolClient(
+        [s.url for s in servers], protocol="http",
+        health_interval_s=0.05, probe_timeout_s=0.5, rng=SEEDED_RNG(),
+    )
+    errors = []
+    stop = threading.Event()
+
+    def workload():
+        while not stop.is_set():
+            try:
+                result = client.infer("simple", inputs, client_timeout=5.0)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), expected)
+            except Exception as e:  # pragma: no cover
+                errors.append(str(e))
+            time.sleep(0.005)
+
+    worker = threading.Thread(target=workload)
+    worker.start()
+    try:
+        time.sleep(0.3)  # steady state across both replicas
+        servers[0].close(grace_s=0.4)  # drain: ready 503 -> probe window -> stop
+        time.sleep(0.5)  # workload continues against the survivor
+        snap = client.endpoint_stats()
+        assert snap[servers[0].url]["healthy"] is False, snap
+    finally:
+        stop.set()
+        worker.join(timeout=10)
+        client.close()
+        servers[0].stop()
+        servers[1].stop()
+    assert errors == [], errors
+
+
+def test_drain_flips_ready_on_all_three_servers():
+    """drain() flips ready (not live) on the threaded-HTTP, aio-HTTP and
+    GRPC frontends while requests keep serving."""
+    # threaded HTTP
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            assert client.is_server_ready()
+            server.drain()
+            assert client.is_server_ready() is False
+            assert client.is_server_live()
+            expected, inputs = _simple_inputs(httpclient)
+            result = client.infer("simple", inputs)  # still serving
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), expected)
+
+    # aio HTTP frontend (probed with the sync client: same wire surface)
+    core = ServerCore(default_model_zoo())
+    with AioHttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            assert client.is_server_ready()
+            server.drain()
+            assert client.is_server_ready() is False
+            assert client.is_server_live()
+
+    # GRPC
+    core = ServerCore(default_model_zoo())
+    with GrpcInferenceServer(core) as server:
+        with grpcclient.InferenceServerClient(server.url) as client:
+            assert client.is_server_ready()
+            server.drain()
+            assert client.is_server_ready() is False
+            assert client.is_server_live()
+            expected, inputs = _simple_inputs(grpcclient)
+            result = client.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), expected)
+
+
+# -- misc surface -------------------------------------------------------------
+def test_pool_delegates_full_client_surface(http_replicas):
+    """Non-infer methods ride the same failover engine."""
+    servers, proxies, _ = http_replicas
+    client = PoolClient(
+        [p.url for p in proxies], protocol="http",
+        health_interval_s=None, rng=SEEDED_RNG(),
+    )
+    try:
+        assert client.is_server_live()
+        md = client.get_model_metadata("simple")
+        assert md["name"] == "simple"
+        # a dead replica does not break the admin surface either
+        proxies[0].fault = Fault("reset", after_bytes=0)
+        proxies[0].reset_active()
+        for _ in range(6):
+            assert client.is_server_live()
+        with pytest.raises(AttributeError):
+            client.not_a_client_method
+    finally:
+        client.close()
+
+
+def test_pool_grpc_stream_pins_to_one_endpoint():
+    """Streams are single-endpoint state: start_stream pins, subsequent
+    stream calls route to the SAME endpoint, stop_stream releases the pin."""
+    import queue
+
+    cores = [ServerCore(default_model_zoo()) for _ in range(2)]
+    servers = [GrpcInferenceServer(c).start() for c in cores]
+    client = PoolClient([s.url for s in servers], protocol="grpc",
+                        health_interval_s=None, rng=SEEDED_RNG())
+    try:
+        events: "queue.Queue" = queue.Queue()
+        client.start_stream(lambda r, e: events.put((r, e)))
+        with pytest.raises(InferenceServerException, match="already active"):
+            client.start_stream(lambda r, e: None)
+        _, inputs = _simple_inputs(grpcclient)
+        for i in range(4):
+            client.async_stream_infer("simple", inputs, request_id=f"r{i}")
+        got = set()
+        for _ in range(4):
+            result, error = events.get(timeout=30)
+            assert error is None, error
+            got.add(result.get_response()["id"])
+        assert got == {f"r{i}" for i in range(4)}
+        client.stop_stream()
+        with pytest.raises(InferenceServerException, match="not available"):
+            client.async_stream_infer("simple", inputs)
+        client.start_stream(lambda r, e: events.put((r, e)))  # pin released
+        client.stop_stream()
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_stateful_methods_broadcast_to_all_endpoints():
+    """register_*/load_model/update_* mutate fleet state: they must land
+    on EVERY replica, not one arbitrary pick."""
+    client, stubs = _stub_pool(
+        {"a": lambda **kw: "ok", "b": lambda **kw: "ok"})
+    try:
+        client.register_system_shared_memory("region0", "/region0", 64)
+        assert ("register", "region0") in stubs["a"].calls
+        assert ("register", "region0") in stubs["b"].calls
+        # pool owns per-endpoint policies: rebinding one would corrupt it
+        with pytest.raises(InferenceServerException, match="owns"):
+            client.configure_resilience(ResiliencePolicy())
+    finally:
+        client.close()
+
+
+def test_aio_pool_delegates_inherited_sync_methods(http_replicas):
+    """The aio clients inherit sync methods (plugins) from the shared base;
+    delegation must not await their plain return values."""
+    servers, proxies, _ = http_replicas
+    from client_tpu._base import BasicAuth
+
+    async def run():
+        client = AioPoolClient(
+            [p.url for p in proxies], protocol="http",
+            health_interval_s=None, rng=SEEDED_RNG(),
+        )
+        async with client:
+            await client.register_plugin(BasicAuth("u", "p"))  # broadcast, sync
+            for ep in client.pool.endpoints:
+                assert ep.client.plugin() is not None
+            assert await client.is_server_live()  # async delegation still fine
+            await client.unregister_plugin()
+
+    asyncio.run(run())
+
+
+def test_pool_validates_construction():
+    with pytest.raises(ValueError):
+        PoolClient([])
+    with pytest.raises(ValueError):
+        PoolClient(["a:1"], routing="fastest")  # unknown policy
+    with pytest.raises(ValueError):
+        PoolClient(["a:1", "b:1"], weights=[1.0])  # weights mismatch
+    with pytest.raises(ValueError):
+        HedgePolicy(max_hedges=0)

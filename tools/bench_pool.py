@@ -1,0 +1,133 @@
+"""Generate BENCH_POOL.json: the pool-layer cost/benefit artifact.
+
+Two questions, answered against live in-process servers:
+
+1. **Armed-pool overhead at N=1** — the same workload through a bare
+   ``InferenceServerClient`` vs a ``PoolClient`` wrapping ONE url (health
+   prober on, breaker armed): the per-request cost of the selection /
+   accounting / budget machinery when nothing is failing.
+2. **Hedging under an injected slow replica** — a 2-replica pool where one
+   replica sits behind a ChaosProxy ``latency`` fault: p99 with and
+   without hedged requests. Round-robin sends half the requests into the
+   slow replica; the hedge (fixed 5 ms delay) re-issues them to the fast
+   one and takes the first success.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_pool.py [-o BENCH_POOL.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_POOL.json")
+    parser.add_argument("--requests", type=int, default=600)
+    parser.add_argument("--slow-requests", type=int, default=300)
+    parser.add_argument("--latency-s", type=float, default=0.02,
+                        help="per-chunk proxy delay for the slow replica")
+    parser.add_argument("--hedge-delay-s", type=float, default=0.005)
+    args = parser.parse_args()
+
+    from client_tpu.models import default_model_zoo
+    from client_tpu.perf import PerfRunner
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing import ChaosProxy, Fault
+
+    out = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "note": (
+            "armed-pool N=1 vs bare client (same server, same workload), "
+            "then 2-replica pool with one replica behind a ChaosProxy "
+            "latency fault: p99 with and without hedging"
+        ),
+    }
+
+    server_a = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    server_b = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    proxy_b = ChaosProxy("127.0.0.1", server_b.port).start()
+    try:
+        # -- 1: armed-pool overhead at N=1 --------------------------------
+        # bare -> pool -> bare again: the second bare run bounds the
+        # container's run-to-run noise floor, so the overhead delta can be
+        # read against it instead of being mistaken for signal
+        def measure(endpoints=None):
+            runner = PerfRunner(server_a.url, "http", "simple",
+                                endpoints=endpoints)
+            try:
+                runner.run(1, 50)  # warmup
+                return runner.run(1, args.requests)
+            finally:
+                runner.close()
+
+        out["bare_client"] = measure()
+        out["pool_n1"] = measure(endpoints=[server_a.url])
+        out["bare_client_rerun"] = measure()
+
+        bare_avgs = [out["bare_client"]["latency_ms"]["avg"],
+                     out["bare_client_rerun"]["latency_ms"]["avg"]]
+        bare_avg = sum(bare_avgs) / 2
+        pool_avg = out["pool_n1"]["latency_ms"]["avg"]
+        out["pool_n1_overhead_us_per_call"] = round(
+            (pool_avg - bare_avg) * 1000.0, 2)
+        out["ab_noise_floor_us"] = round(
+            abs(bare_avgs[0] - bare_avgs[1]) * 1000.0, 2)
+        out["pool_n1_overhead_pct_of_p50"] = round(
+            100.0 * (pool_avg - bare_avg)
+            / max(out["bare_client"]["latency_ms"]["p50"], 1e-9), 2)
+
+        # -- 2: tail latency under a slow replica, hedged vs not ----------
+        proxy_b.fault = Fault("latency", latency_s=args.latency_s)
+        endpoints = [server_a.url, proxy_b.url]
+
+        unhedged = PerfRunner(server_a.url, "http", "simple",
+                              endpoints=endpoints)
+        try:
+            out["slow_replica_unhedged"] = unhedged.run(1, args.slow_requests)
+        finally:
+            unhedged.close()
+
+        hedged = PerfRunner(server_a.url, "http", "simple",
+                            endpoints=endpoints, hedge=True,
+                            hedge_delay_s=args.hedge_delay_s)
+        try:
+            out["slow_replica_hedged"] = hedged.run(1, args.slow_requests)
+        finally:
+            hedged.close()
+
+        p99_un = out["slow_replica_unhedged"]["latency_ms"]["p99"]
+        p99_he = out["slow_replica_hedged"]["latency_ms"]["p99"]
+        out["hedge_config"] = {
+            "slow_replica_latency_s": args.latency_s,
+            "hedge_delay_s": args.hedge_delay_s,
+            "routing": "round_robin over [fast, slow]",
+        }
+        out["hedge_p99_improvement"] = {
+            "unhedged_p99_ms": p99_un,
+            "hedged_p99_ms": p99_he,
+            "speedup_x": round(p99_un / max(p99_he, 1e-9), 2),
+        }
+    finally:
+        proxy_b.stop()
+        server_a.stop()
+        server_b.stop()
+
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
