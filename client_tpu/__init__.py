@@ -15,6 +15,11 @@ client libraries (triton-inference-server/client), designed TPU-first:
   frontends — active ready-probing + passive outlier ejection, routing
   policies with per-endpoint circuit breakers, shared-deadline failover
   (sequence requests are never silently re-sent), and hedged requests.
+- ``client_tpu.observe``: client-side observability — request-phase span
+  tracing with sampling and Chrome trace dumps, a Prometheus/JSON metrics
+  registry fed by the resilience + pool event streams, and W3C
+  ``traceparent`` propagation joined to server-side access records and a
+  ``/metrics`` endpoint (docs/observability.md).
 - ``client_tpu.utils``: Triton<->numpy dtype mapping with *native* bfloat16
   (via ml_dtypes), BYTES/BF16 wire serialization.
 - ``client_tpu.utils.shared_memory``: POSIX system shared memory data plane.

@@ -53,10 +53,32 @@ class InferenceServerClientBase:
     def __init__(self):
         self._plugin: Optional[InferenceServerClientPlugin] = None
         self._resilience = None  # Optional[resilience.ResiliencePolicy]
+        self._telemetry = None  # Optional[observe.Telemetry]
 
     def _call_plugin(self, request: Request) -> None:
         if self._plugin is not None:
             self._plugin(request)
+
+    # -- observability -------------------------------------------------------
+    def configure_telemetry(self, telemetry) -> "InferenceServerClientBase":
+        """Install an ``observe.Telemetry`` (or None to clear) that every
+        inference of this client reports into: request-phase spans, a
+        ``traceparent`` header/metadata key on the wire, and the pre-wired
+        metrics. Pay-for-what-you-use: with no telemetry configured the
+        transport paths check one attribute and do nothing else."""
+        self._telemetry = telemetry
+        return self
+
+    def telemetry(self):
+        return self._telemetry
+
+    def _obs_begin(self, frontend: str, model: str):
+        """A request span when telemetry is configured, else None — the
+        single hot-path gate all four frontends share."""
+        tel = self._telemetry
+        if tel is None:
+            return None
+        return tel.begin(frontend, model)
 
     # -- resilience ---------------------------------------------------------
     def configure_resilience(self, policy) -> "InferenceServerClientBase":

@@ -21,6 +21,7 @@ import grpc
 
 from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
+from ..observe import TRACEPARENT_HEADER
 from ..resilience import FATAL, AttemptBudget, classify_fault
 from ..utils import InferenceServerException
 from . import _messages as M
@@ -177,6 +178,7 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
         idempotent: bool = True,
         resilience=None,
+        span=None,
     ) -> Dict[str, Any]:
         if self._verbose:
             print(f"{method}, metadata {headers or {}}\n{request}")
@@ -196,14 +198,37 @@ class InferenceServerClient(InferenceServerClientBase):
             except grpc.RpcError as e:
                 raise _to_exception(e) from e
 
+        run_attempt = attempt
+        on_retry = None
+        if span is not None:
+            def run_attempt():
+                t_a = time.perf_counter_ns()
+                try:
+                    result = attempt()
+                except BaseException:
+                    span.phase("attempt", t_a, time.perf_counter_ns())
+                    raise
+                end = time.perf_counter_ns()
+                span.phase("attempt", t_a, end)
+                # unary call: send/server/first-byte are not separable, so
+                # the SUCCESSFUL attempt is the ttfb window (a retried
+                # request must not fold failed attempts + backoff into it)
+                span.phase("ttfb", t_a, end)
+                return result
+
+            def on_retry(n, exc, delay):
+                span.event("retry", attempt=n, backoff_s=round(delay, 6),
+                           error=type(exc).__name__)
+
         if policy is None:
-            response = attempt()
+            response = run_attempt()
         else:
             # UNAVAILABLE/RESOURCE_EXHAUSTED re-attempt under the policy;
             # non-idempotent sequence infers only on never-sent connect
             # failures (classify_fault reads the status details)
             response = policy.execute(
-                attempt, idempotent=idempotent, timeout_s=client_timeout)
+                run_attempt, idempotent=idempotent, timeout_s=client_timeout,
+                on_retry=on_retry)
         if self._verbose:
             print(response)
         return response
@@ -423,23 +448,40 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
         resilience=None,
     ) -> InferResult:
+        span = self._obs_begin("grpc", model_name)
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
-        request = build_infer_request(
-            model_name, inputs, model_version, outputs, request_id,
-            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
-        )
-        timers.capture(RequestTimers.SEND_START)
-        response = self._call(
-            "ModelInfer", request, headers, client_timeout, compression_algorithm,
-            idempotent=sequence_id == 0, resilience=resilience,
-        )
-        timers.capture(RequestTimers.SEND_END)
-        timers.capture(RequestTimers.RECV_START)
-        result = InferResult(response)
-        timers.capture(RequestTimers.RECV_END)
+        try:
+            request = build_infer_request(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+            )
+            hdrs = headers
+            if span is not None:
+                hdrs = dict(headers or {})
+                hdrs[TRACEPARENT_HEADER] = span.traceparent()
+                span.phase("serialize", span.start_ns,
+                           time.perf_counter_ns())
+            timers.capture(RequestTimers.SEND_START)
+            response = self._call(
+                "ModelInfer", request, hdrs, client_timeout, compression_algorithm,
+                idempotent=sequence_id == 0, resilience=resilience, span=span,
+            )
+            timers.capture(RequestTimers.SEND_END)
+            timers.capture(RequestTimers.RECV_START)
+            result = InferResult(response)
+            timers.capture(RequestTimers.RECV_END)
+        except BaseException as e:
+            if span is not None:
+                self._telemetry.finish(span, error=e)
+            raise
         timers.capture(RequestTimers.REQUEST_END)
         self._infer_stat.update(timers)
+        if span is not None:
+            span.phase("deserialize",
+                       timers.get(RequestTimers.RECV_START),
+                       timers.get(RequestTimers.RECV_END))
+            self._telemetry.finish(span)
         return result
 
     def async_infer(

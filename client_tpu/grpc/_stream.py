@@ -335,6 +335,12 @@ class _ReconnectingStream:
         if closed_late:
             new_inner.close()
             return
+        observer = getattr(self._policy, "observer", None)
+        if observer is not None:
+            try:
+                observer.on_stream_reconnect()
+            except Exception:
+                pass
         # event BEFORE the resends hit the wire: the app learns which ids
         # are being re-sent before the new reader thread can deliver any of
         # their responses (the new stream carries no requests until below)

@@ -14,6 +14,7 @@ import grpc.aio
 
 from ..._base import InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
+from ...observe import TRACEPARENT_HEADER
 from ...resilience import FATAL, AttemptBudget, classify_fault
 from ...utils import InferenceServerException
 from .. import _messages as M
@@ -115,6 +116,7 @@ class InferenceServerClient(InferenceServerClientBase):
     async def _call(
         self, method, request, headers=None, client_timeout=None,
         compression_algorithm=None, idempotent=True, resilience=None,
+        span=None,
     ):
         policy = self._resilience_for(resilience)
         budget = AttemptBudget(policy, client_timeout)
@@ -132,10 +134,33 @@ class InferenceServerClient(InferenceServerClientBase):
             except grpc.aio.AioRpcError as e:
                 raise _to_exception(e) from e
 
+        run_attempt = attempt
+        on_retry = None
+        if span is not None:
+            async def run_attempt():
+                t_a = time.perf_counter_ns()
+                try:
+                    result = await attempt()
+                except BaseException:
+                    span.phase("attempt", t_a, time.perf_counter_ns())
+                    raise
+                end = time.perf_counter_ns()
+                span.phase("attempt", t_a, end)
+                # unary call: the SUCCESSFUL attempt is the ttfb window (a
+                # retried request must not fold failed attempts + backoff
+                # into it)
+                span.phase("ttfb", t_a, end)
+                return result
+
+            def on_retry(n, exc, delay):
+                span.event("retry", attempt=n, backoff_s=round(delay, 6),
+                           error=type(exc).__name__)
+
         if policy is None:
-            return await attempt()
+            return await run_attempt()
         return await policy.execute_async(
-            attempt, idempotent=idempotent, timeout_s=client_timeout)
+            run_attempt, idempotent=idempotent, timeout_s=client_timeout,
+            on_retry=on_retry)
 
     # -- surface (async twins of the sync client) ---------------------------
     async def _health(self, method, field, headers, client_timeout,
@@ -305,15 +330,32 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
         resilience=None,
     ) -> InferResult:
-        request = build_infer_request(
-            model_name, inputs, model_version, outputs, request_id,
-            sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
-        )
-        response = await self._call(
-            "ModelInfer", request, headers, client_timeout, compression_algorithm,
-            idempotent=sequence_id == 0, resilience=resilience,
-        )
-        return InferResult(response)
+        span = self._obs_begin("grpc_aio", model_name)
+        try:
+            request = build_infer_request(
+                model_name, inputs, model_version, outputs, request_id,
+                sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
+            )
+            hdrs = headers
+            if span is not None:
+                hdrs = dict(headers or {})
+                hdrs[TRACEPARENT_HEADER] = span.traceparent()
+                span.phase("serialize", span.start_ns, time.perf_counter_ns())
+            response = await self._call(
+                "ModelInfer", request, hdrs, client_timeout, compression_algorithm,
+                idempotent=sequence_id == 0, resilience=resilience, span=span,
+            )
+            if span is not None:
+                t_deser = time.perf_counter_ns()
+            result = InferResult(response)
+        except BaseException as e:
+            if span is not None:
+                self._telemetry.finish(span, error=e)
+            raise
+        if span is not None:
+            span.phase("deserialize", t_deser, time.perf_counter_ns())
+            self._telemetry.finish(span)
+        return result
 
     async def stream_infer(
         self,

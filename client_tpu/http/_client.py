@@ -26,6 +26,7 @@ import urllib3
 
 from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
+from ..observe import TRACEPARENT_HEADER
 from ..resilience import (
     FATAL,
     RETRYABLE_HTTP_STATUSES,
@@ -181,6 +182,7 @@ class InferenceServerClient(InferenceServerClientBase):
         timers: Optional[RequestTimers] = None,
         idempotent: bool = True,
         resilience=None,
+        span=None,
     ):
         """Issue one HTTP request; returns the response with the body read.
 
@@ -221,6 +223,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 kwargs["timeout"] = urllib3.Timeout(
                     connect=remaining, read=remaining)
             resp = None
+            t_send = time.perf_counter_ns() if span is not None else 0
             try:
                 try:
                     resp = self._pool.request(method, uri, **kwargs)
@@ -231,10 +234,17 @@ class InferenceServerClient(InferenceServerClientBase):
                     # files this under the connect domain (always safe).
                     raise InferenceServerException(
                         f"connection error: {e}") from e
+                if span is not None:
+                    # per ATTEMPT (a retried request must not fold its
+                    # predecessors' failures + backoff into ttfb)
+                    t_hdrs = time.perf_counter_ns()
+                    span.phase("ttfb", t_send, t_hdrs)
                 if timers is not None:
                     timers.capture(RequestTimers.SEND_END)
                     timers.capture(RequestTimers.RECV_START)
                 data = resp.read(decode_content=True)
+                if span is not None:
+                    span.phase("recv", t_hdrs, time.perf_counter_ns())
                 if timers is not None:
                     timers.capture(RequestTimers.RECV_END)
             except urllib3.exceptions.TimeoutError as e:
@@ -252,16 +262,32 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise RetryableStatusError(resp.status, out)
             return out
 
+        run_attempt = attempt
+        if span is not None:
+            def run_attempt():
+                # retry-attempt sub-span: each resilient attempt shows up
+                # as its own interval in the trace timeline
+                t_a = time.perf_counter_ns()
+                try:
+                    return attempt()
+                finally:
+                    span.phase("attempt", t_a, time.perf_counter_ns())
+
         if policy is None:
-            return attempt()
+            return run_attempt()
         on_retry = None
-        if self._verbose:
+        if self._verbose or span is not None:
             def on_retry(n, exc, delay):
-                print(f"retrying after attempt {n + 1} failed ({exc}); "
-                      f"backoff {delay:.3f}s")
+                if span is not None:
+                    span.event("retry", attempt=n,
+                               backoff_s=round(delay, 6),
+                               error=type(exc).__name__)
+                if self._verbose:
+                    print(f"retrying after attempt {n + 1} failed ({exc}); "
+                          f"backoff {delay:.3f}s")
         try:
             return policy.execute(
-                attempt, idempotent=idempotent, timeout_s=timeout,
+                run_attempt, idempotent=idempotent, timeout_s=timeout,
                 on_retry=on_retry,
             )
         except RetryableStatusError as e:
@@ -273,11 +299,11 @@ class InferenceServerClient(InferenceServerClientBase):
         return self._request("GET", path, headers=headers, query_params=query_params)
 
     def _post(self, path, body=b"", headers=None, query_params=None, timeout=None,
-              timers=None, idempotent=True, resilience=None):
+              timers=None, idempotent=True, resilience=None, span=None):
         return self._request(
             "POST", path, body=body, headers=headers, query_params=query_params,
             timeout=timeout, timers=timers, idempotent=idempotent,
-            resilience=resilience,
+            resilience=resilience, span=span,
         )
 
     @staticmethod
@@ -559,51 +585,66 @@ class InferenceServerClient(InferenceServerClientBase):
         ``resilience``: per-request ``ResiliencePolicy`` override. Sequence
         requests (``sequence_id != 0``) are non-idempotent: only
         never-sent connect failures are retried for them."""
+        span = self._obs_begin("http", model_name)
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
-        body, json_size = build_infer_body(
-            inputs,
-            outputs,
-            request_id,
-            sequence_id,
-            sequence_start,
-            sequence_end,
-            priority,
-            timeout,
-            parameters,
-        )
-        hdrs = dict(headers or {})
-        body, encoding = compress_body(body, request_compression_algorithm)
-        if encoding:
-            hdrs["Content-Encoding"] = encoding
-        if response_compression_algorithm in ("gzip", "deflate"):
-            hdrs["Accept-Encoding"] = response_compression_algorithm
-        if json_size is not None:
-            hdrs["Inference-Header-Content-Length"] = str(json_size)
-            hdrs["Content-Type"] = "application/octet-stream"
-        else:
-            hdrs["Content-Type"] = "application/json"
+        try:
+            body, json_size = build_infer_body(
+                inputs,
+                outputs,
+                request_id,
+                sequence_id,
+                sequence_start,
+                sequence_end,
+                priority,
+                timeout,
+                parameters,
+            )
+            hdrs = dict(headers or {})
+            body, encoding = compress_body(body, request_compression_algorithm)
+            if encoding:
+                hdrs["Content-Encoding"] = encoding
+            if response_compression_algorithm in ("gzip", "deflate"):
+                hdrs["Accept-Encoding"] = response_compression_algorithm
+            if json_size is not None:
+                hdrs["Inference-Header-Content-Length"] = str(json_size)
+                hdrs["Content-Type"] = "application/octet-stream"
+            else:
+                hdrs["Content-Type"] = "application/json"
+            if span is not None:
+                hdrs[TRACEPARENT_HEADER] = span.traceparent()
+                span.phase("serialize", span.start_ns,
+                           time.perf_counter_ns())
 
-        timers.capture(RequestTimers.SEND_START)
-        resp = self._post(
-            self._infer_uri(model_name, model_version),
-            body,
-            hdrs,
-            query_params,
-            timeout=client_timeout,
-            timers=timers,
-            idempotent=sequence_id == 0,
-            resilience=resilience,
-        )
-        # urllib3 already decoded any Content-Encoding; resp.data is plain.
-        raise_if_error(resp.status, resp.data)
-        header_length = resp.headers.get("Inference-Header-Content-Length")
-        result = InferResult.from_response_body(
-            resp.data, int(header_length) if header_length is not None else None
-        )
-        result._response_headers = dict(resp.headers)  # e.g. endpoint-load-metrics
+            timers.capture(RequestTimers.SEND_START)
+            resp = self._post(
+                self._infer_uri(model_name, model_version),
+                body,
+                hdrs,
+                query_params,
+                timeout=client_timeout,
+                timers=timers,
+                idempotent=sequence_id == 0,
+                resilience=resilience,
+                span=span,
+            )
+            # urllib3 already decoded any Content-Encoding; resp.data is plain.
+            raise_if_error(resp.status, resp.data)
+            t_deser = time.perf_counter_ns() if span is not None else 0
+            header_length = resp.headers.get("Inference-Header-Content-Length")
+            result = InferResult.from_response_body(
+                resp.data, int(header_length) if header_length is not None else None
+            )
+            result._response_headers = dict(resp.headers)  # e.g. endpoint-load-metrics
+        except BaseException as e:
+            if span is not None:
+                self._telemetry.finish(span, error=e)
+            raise
         timers.capture(RequestTimers.REQUEST_END)
         self._infer_stat.update(timers)
+        if span is not None:
+            span.phase("deserialize", t_deser, time.perf_counter_ns())
+            self._telemetry.finish(span)
         if self._verbose:
             print(result.get_response())
         return result

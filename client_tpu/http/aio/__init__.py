@@ -18,6 +18,7 @@ import aiohttp
 
 from ..._base import InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
+from ...observe import TRACEPARENT_HEADER
 from ...resilience import (
     FATAL,
     RETRYABLE_HTTP_STATUSES,
@@ -86,6 +87,7 @@ class InferenceServerClient(InferenceServerClientBase):
         timeout: Optional[float] = None,
         idempotent: bool = True,
         resilience=None,
+        span=None,
     ):
         """One HTTP round trip under the client's resilience policy (same
         idempotency contract as the sync twin: in-flight failures and
@@ -110,8 +112,15 @@ class InferenceServerClient(InferenceServerClientBase):
             if remaining is not None:
                 kwargs["timeout"] = aiohttp.ClientTimeout(total=remaining)
             try:
+                t_send = time.perf_counter_ns() if span is not None else 0
                 async with self._session.request(method, url, **kwargs) as resp:
+                    if span is not None:
+                        # headers arrived: request issue -> first byte
+                        t_recv = time.perf_counter_ns()
+                        span.phase("ttfb", t_send, t_recv)
                     data = await resp.read()
+                    if span is not None:
+                        span.phase("recv", t_recv, time.perf_counter_ns())
                     if self._verbose:
                         print(f"-> {resp.status}")
                     out = resp.status, dict(resp.headers), data
@@ -126,11 +135,26 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise RetryableStatusError(out[0], out)
             return out
 
+        run_attempt = attempt
+        if span is not None:
+            async def run_attempt():
+                t_a = time.perf_counter_ns()
+                try:
+                    return await attempt()
+                finally:
+                    span.phase("attempt", t_a, time.perf_counter_ns())
+
         if policy is None:
-            return await attempt()
+            return await run_attempt()
+        on_retry = None
+        if span is not None:
+            def on_retry(n, exc, delay):
+                span.event("retry", attempt=n, backoff_s=round(delay, 6),
+                           error=type(exc).__name__)
         try:
             return await policy.execute_async(
-                attempt, idempotent=idempotent, timeout_s=timeout)
+                run_attempt, idempotent=idempotent, timeout_s=timeout,
+                on_retry=on_retry)
         except RetryableStatusError as e:
             return e.response
 
@@ -319,35 +343,48 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters: Optional[Dict[str, Any]] = None,
         resilience=None,
     ) -> InferResult:
-        body, json_size = build_infer_body(
-            inputs, outputs, request_id, sequence_id, sequence_start,
-            sequence_end, priority, timeout, parameters,
-        )
-        hdrs = dict(headers or {})
-        body, encoding = compress_body(body, request_compression_algorithm)
-        if encoding:
-            hdrs["Content-Encoding"] = encoding
-        if response_compression_algorithm in ("gzip", "deflate"):
-            hdrs["Accept-Encoding"] = response_compression_algorithm
-        if json_size is not None:
-            hdrs["Inference-Header-Content-Length"] = str(json_size)
-            hdrs["Content-Type"] = "application/octet-stream"
-        else:
-            hdrs["Content-Type"] = "application/json"
-        uri = f"v2/models/{quote(model_name)}"
-        if model_version:
-            uri += f"/versions/{model_version}"
-        status, resp_headers, data = await self._request(
-            "POST", uri + "/infer", body, hdrs, query_params,
-            timeout=client_timeout, idempotent=sequence_id == 0,
-            resilience=resilience,
-        )
-        raise_if_error(status, data)  # aiohttp auto-decodes Content-Encoding
-        header_length = resp_headers.get("Inference-Header-Content-Length")
-        result = InferResult.from_response_body(
-            data, int(header_length) if header_length is not None else None
-        )
-        result._response_headers = resp_headers  # e.g. endpoint-load-metrics
+        span = self._obs_begin("http_aio", model_name)
+        try:
+            body, json_size = build_infer_body(
+                inputs, outputs, request_id, sequence_id, sequence_start,
+                sequence_end, priority, timeout, parameters,
+            )
+            hdrs = dict(headers or {})
+            body, encoding = compress_body(body, request_compression_algorithm)
+            if encoding:
+                hdrs["Content-Encoding"] = encoding
+            if response_compression_algorithm in ("gzip", "deflate"):
+                hdrs["Accept-Encoding"] = response_compression_algorithm
+            if json_size is not None:
+                hdrs["Inference-Header-Content-Length"] = str(json_size)
+                hdrs["Content-Type"] = "application/octet-stream"
+            else:
+                hdrs["Content-Type"] = "application/json"
+            if span is not None:
+                hdrs[TRACEPARENT_HEADER] = span.traceparent()
+                span.phase("serialize", span.start_ns, time.perf_counter_ns())
+            uri = f"v2/models/{quote(model_name)}"
+            if model_version:
+                uri += f"/versions/{model_version}"
+            status, resp_headers, data = await self._request(
+                "POST", uri + "/infer", body, hdrs, query_params,
+                timeout=client_timeout, idempotent=sequence_id == 0,
+                resilience=resilience, span=span,
+            )
+            raise_if_error(status, data)  # aiohttp auto-decodes Content-Encoding
+            t_deser = time.perf_counter_ns() if span is not None else 0
+            header_length = resp_headers.get("Inference-Header-Content-Length")
+            result = InferResult.from_response_body(
+                data, int(header_length) if header_length is not None else None
+            )
+            result._response_headers = resp_headers  # e.g. endpoint-load-metrics
+        except BaseException as e:
+            if span is not None:
+                self._telemetry.finish(span, error=e)
+            raise
+        if span is not None:
+            span.phase("deserialize", t_deser, time.perf_counter_ns())
+            self._telemetry.finish(span)
         if self._verbose:
             print(result.get_response())
         return result

@@ -1,0 +1,988 @@
+"""Client-side observability: request-phase tracing, metrics, propagation.
+
+The reference client can only *configure* server-side tracing
+(``update_trace_settings``) — the client itself is a black box, which is
+exactly where production debugging of a KServe v2 data plane happens (is
+the latency in serialize, connect, TTFB, or deserialize?). This module is
+the consumer for the structured events PR 1/PR 2 already emit (retry
+callbacks, breaker transitions, ``PoolEvent``s) and the phase timers the
+frontends already capture:
+
+- :class:`Tracer` + :class:`RequestSpan` — a monotonic per-request phase
+  timeline (queue → serialize → connect/acquire → send → first-byte →
+  recv → deserialize, plus retry-attempt and hedge sub-spans) with
+  ``always`` / ``ratio`` / ``slow``-only sampling and a ring buffer of
+  recent traces dumpable as Chrome ``trace_event`` JSON
+  (``chrome://tracing`` / Perfetto load it directly).
+- :class:`MetricsRegistry` — counters, gauges and fixed-bucket latency
+  histograms with lock-cheap hot-path increments, rendered as Prometheus
+  text exposition (``prometheus_text``) or a JSON snapshot
+  (``snapshot``).
+- W3C trace context propagation — :func:`format_traceparent` /
+  :func:`parse_traceparent`; every frontend injects a ``traceparent``
+  header (HTTP) or metadata key (GRPC) when a telemetry object is
+  configured, and the in-repo servers honor it by recording a
+  server-side access record joined on the same trace id (see
+  ``ServerCore.access_records`` and the servers' ``/metrics`` route).
+- :class:`Telemetry` — the facade a client/pool/policy shares via
+  ``InferenceServerClientBase.configure_telemetry``: pre-wired
+  request/error/retry/breaker/ejection/hedge metrics fed by the existing
+  resilience and pool event streams.
+
+Pay-for-what-you-use: with no telemetry configured the frontends' hot
+paths check one attribute and do nothing else (~0 overhead); with
+telemetry enabled the per-call cost is bounded by a handful of
+pre-resolved label lookups and one registry-lock critical section (the
+committed ``BENCH_OBSERVE.json`` holds the measured numbers).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import re
+import random
+import threading
+import time
+import weakref
+from bisect import bisect_right
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS_S",
+    "TRACEPARENT_HEADER",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RequestSpan",
+    "Telemetry",
+    "Tracer",
+    "format_traceparent",
+    "make_span_id",
+    "make_trace_id",
+    "parse_traceparent",
+]
+
+# -- W3C trace context --------------------------------------------------------
+TRACEPARENT_HEADER = "traceparent"
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+_id_rng = random.Random()  # module-level: ids must differ across Telemetry objects
+
+
+def make_trace_id(rng: Optional[random.Random] = None) -> str:
+    """A 16-byte lowercase-hex W3C trace id (never all-zero)."""
+    r = rng or _id_rng
+    return f"{r.getrandbits(128) or 1:032x}"
+
+
+def make_span_id(rng: Optional[random.Random] = None) -> str:
+    """An 8-byte lowercase-hex W3C span (parent) id (never all-zero)."""
+    r = rng or _id_rng
+    return f"{r.getrandbits(64) or 1:016x}"
+
+
+def format_traceparent(trace_id: str, span_id: str, sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def parse_traceparent(value: Optional[str]):
+    """``(trace_id, parent_span_id, sampled)`` or None when malformed.
+
+    Per the W3C spec: version ``ff`` and all-zero trace/span ids are
+    invalid; unknown flag bits are ignored beyond the sampled bit."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id, bool(int(flags, 16) & 1)
+
+
+# -- metrics ------------------------------------------------------------------
+# Fixed latency buckets (seconds): 100 µs .. 10 s, roughly 1-2.5-5 decades —
+# wide enough for localhost shm round trips and cold-compile outliers alike.
+DEFAULT_LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus sample value: integers without a trailing .0."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Series:
+    """One labeled time series. Mutations take the registry's shared lock
+    (one uncontended acquire per op — "lock-cheap"); the ``_``-prefixed
+    unlocked primitives exist so :meth:`Telemetry.finish` can batch a whole
+    request's updates under a single acquire."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def _inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = value
+
+    def _set(self, value: float) -> None:
+        self.value = value
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def get(self) -> float:
+        return self.value  # single-slot read: no lock needed
+
+
+class _HistogramSeries:
+    """One labeled histogram: cumulative-on-render fixed buckets + sum/count."""
+
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, lock: threading.Lock, buckets: Sequence[float]):
+        self._lock = lock
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._observe(value)
+
+    def _observe(self, value: float) -> None:
+        self.counts[bisect_right(self.buckets, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile by linear interpolation inside the owning
+        bucket (the usual histogram_quantile estimate). Values beyond the
+        last finite edge clamp to it."""
+        with self._lock:
+            counts = list(self.counts)
+            total = self.count
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        lower = 0.0
+        for i, edge in enumerate(self.buckets):
+            prev = cum
+            cum += counts[i]
+            if cum >= rank:
+                frac = (rank - prev) / max(counts[i], 1)
+                return lower + (edge - lower) * min(max(frac, 0.0), 1.0)
+            lower = edge
+        return self.buckets[-1] if self.buckets else lower
+
+
+class _Metric:
+    """Shared labeled-family machinery for the three instrument kinds."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str,
+                 labelnames: Sequence[str]):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for label in labelnames:
+            if not _LABEL_RE.match(label):
+                raise ValueError(f"invalid label name {label!r}")
+        self._registry = registry
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    def _new_series(self):
+        raise NotImplementedError
+
+    def labels(self, *values) -> Any:
+        """The series for one label-value tuple (created on first use and
+        cached — callers are expected to hold on to hot series)."""
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {key}")
+        series = self._series.get(key)
+        if series is None:
+            with self._registry._lock:
+                series = self._series.get(key)
+                if series is None:
+                    series = self._new_series()
+                    self._series[key] = series
+        return series
+
+    def _default(self):
+        """The unlabeled series (metrics declared with no label names)."""
+        return self.labels()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_series(self):
+        return _Series(self._registry._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def get(self) -> float:
+        return self._default().get()
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_series(self):
+        return _Series(self._registry._lock)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def get(self) -> float:
+        return self._default().get()
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help, labelnames,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S):
+        super().__init__(registry, name, help, labelnames)
+        edges = tuple(sorted(float(b) for b in buckets))
+        if not edges:
+            raise ValueError("histogram needs at least one bucket edge")
+        if len(set(edges)) != len(edges):
+            raise ValueError("histogram bucket edges must be distinct")
+        self.buckets = edges
+
+    def _new_series(self):
+        return _HistogramSeries(self._registry._lock, self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def quantile(self, q: float) -> float:
+        return self._default().quantile(q)
+
+
+class MetricsRegistry:
+    """A process-local metric registry with Prometheus + JSON exporters.
+
+    Instruments are created idempotently (asking for an existing name
+    returns the existing instrument; a kind/label mismatch raises).
+    ``add_collector`` registers a callback run before every export — the
+    pool uses it to refresh per-endpoint gauges at scrape time instead of
+    on the data path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: List[Callable[[], None]] = []
+
+    def _instrument(self, cls, name, help, labelnames, **kwargs) -> Any:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} already registered with a "
+                        f"different kind or labels")
+                return existing
+        metric = cls(self, name, help, labelnames, **kwargs)
+        with self._lock:
+            return self._metrics.setdefault(name, metric)
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._instrument(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._instrument(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S,
+                  ) -> Histogram:
+        return self._instrument(
+            Histogram, name, help, labelnames, buckets=buckets)
+
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:  # outside the lock: collectors set gauges
+            try:
+                fn()
+            except Exception:
+                pass  # an exporter must never break on a sick collector
+
+    # -- exporters -----------------------------------------------------------
+    @staticmethod
+    def _labels_text(labelnames, key, extra: str = "") -> str:
+        parts = [f'{n}="{_escape_label(v)}"' for n, v in zip(labelnames, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4). Histogram buckets are
+        cumulative and ``+Inf``-terminated, with ``_sum``/``_count``."""
+        self._run_collectors()
+        lines: List[str] = []
+        with self._lock:
+            for metric in self._metrics.values():
+                if not metric._series:
+                    continue
+                if metric.help:
+                    lines.append(f"# HELP {metric.name} {metric.help}")
+                lines.append(f"# TYPE {metric.name} {metric.kind}")
+                for key in sorted(metric._series):
+                    series = metric._series[key]
+                    if metric.kind == "histogram":
+                        cum = 0
+                        for edge, n in zip(series.buckets, series.counts):
+                            cum += n
+                            labels = self._labels_text(
+                                metric.labelnames, key,
+                                f'le="{_fmt_value(edge)}"')
+                            lines.append(
+                                f"{metric.name}_bucket{labels} {cum}")
+                        labels = self._labels_text(
+                            metric.labelnames, key, 'le="+Inf"')
+                        lines.append(
+                            f"{metric.name}_bucket{labels} {series.count}")
+                        base = self._labels_text(metric.labelnames, key)
+                        lines.append(
+                            f"{metric.name}_sum{base} "
+                            f"{_fmt_value(series.sum)}")
+                        lines.append(f"{metric.name}_count{base} "
+                                     f"{series.count}")
+                    else:
+                        labels = self._labels_text(metric.labelnames, key)
+                        lines.append(
+                            f"{metric.name}{labels} "
+                            f"{_fmt_value(series.value)}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, Any]:
+        """A JSON-ready snapshot (plain dict/list/str/number values only, so
+        ``json.loads(json.dumps(snapshot)) == snapshot``)."""
+        self._run_collectors()
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for metric in self._metrics.values():
+                series_out = []
+                for key in sorted(metric._series):
+                    series = metric._series[key]
+                    labels = dict(zip(metric.labelnames, key))
+                    if metric.kind == "histogram":
+                        cum = 0
+                        buckets = []
+                        for edge, n in zip(series.buckets, series.counts):
+                            cum += n
+                            buckets.append({"le": edge, "count": cum})
+                        buckets.append({"le": "+Inf", "count": series.count})
+                        series_out.append({
+                            "labels": labels,
+                            "count": series.count,
+                            "sum": series.sum,
+                            "buckets": buckets,
+                        })
+                    else:
+                        series_out.append(
+                            {"labels": labels, "value": series.value})
+                out[metric.name] = {
+                    "kind": metric.kind,
+                    "help": metric.help,
+                    "series": series_out,
+                }
+        return out
+
+
+# -- tracing ------------------------------------------------------------------
+# Canonical phase vocabulary (what each transport can observe of it):
+#   queue       time waiting for a worker/slot before the request is built
+#   serialize   request body/tensor marshaling
+#   connect     TCP/TLS/channel establishment (when separable)
+#   send        request bytes on the wire (when separable from ttfb)
+#   ttfb        request issued -> first response byte (HTTP: headers;
+#               GRPC unary: the whole call, send+server+receive)
+#   recv        response body read
+#   deserialize response unmarshaling into InferResult
+#   attempt     one resilient attempt (sub-span; repeated under retries)
+REQUEST_PHASES = (
+    "queue", "serialize", "connect", "send", "ttfb", "recv", "deserialize",
+    "attempt",
+)
+
+
+class RequestSpan:
+    """One client request's span: ids, phase intervals, point events.
+
+    ``phase(name, start_ns, end_ns)`` appends an interval (monotonic
+    ``time.perf_counter_ns`` values); ``event(name, **attrs)`` appends a
+    point annotation (retries, hedges, reconnects). Both are plain list
+    appends — cheap enough for the hot path. ``events`` and ``tid`` are
+    populated lazily (most requests have no point events, and the thread
+    id is only needed when the span is retained for a trace dump)."""
+
+    __slots__ = ("trace_id", "span_id", "frontend", "model", "op",
+                 "start_ns", "end_ns", "phases", "events", "sampled",
+                 "error", "tid")
+
+    def __init__(self, trace_id: str, span_id: str, frontend: str,
+                 model: str, op: str, sampled: bool):
+        # end_ns / events / error / tid are set lazily off the hot path
+        # (finish, event(), trace retention); readers use getattr defaults
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.frontend = frontend
+        self.model = model
+        self.op = op
+        self.start_ns = time.perf_counter_ns()
+        self.phases: List[Tuple[str, int, int]] = []
+        self.sampled = sampled
+
+    def phase(self, name: str, start_ns: int, end_ns: int) -> None:
+        self.phases.append((name, start_ns, end_ns))
+
+    def event(self, name: str, **attrs) -> None:
+        events = getattr(self, "events", None)
+        if events is None:
+            events = self.events = []
+        events.append((name, time.perf_counter_ns(), attrs or None))
+
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id, self.sampled)
+
+    def duration_s(self) -> float:
+        end = getattr(self, "end_ns", 0) or time.perf_counter_ns()
+        return (end - self.start_ns) * 1e-9
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "frontend": self.frontend,
+            "model": self.model,
+            "op": self.op,
+            "start_ns": self.start_ns,
+            "end_ns": getattr(self, "end_ns", 0),
+            "duration_ms": round(self.duration_s() * 1e3, 6),
+            "error": getattr(self, "error", None),
+            "phases": [
+                {"name": n, "start_ns": s, "end_ns": e,
+                 "duration_ms": round((e - s) / 1e6, 6)}
+                for n, s, e in self.phases
+            ],
+            "events": [
+                {"name": n, "ns": ts, **(attrs or {})}
+                for n, ts, attrs in (getattr(self, "events", None) or ())
+            ],
+        }
+
+
+class Tracer:
+    """Ring buffer of recently finished request spans + dump formats."""
+
+    def __init__(self, capacity: int = 256):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, capacity))
+        self.dropped = 0
+
+    def keep(self, span: RequestSpan) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(span)
+
+    def recent(self, count: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            spans = list(self._ring)
+        if count is not None:
+            spans = spans[-count:]
+        return [s.as_dict() for s in spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Chrome ``trace_event`` JSON (load in chrome://tracing/Perfetto):
+        one complete ("X") event per request span, nested complete events
+        per phase, instant ("i") events for retries/hedges."""
+        with self._lock:
+            spans = list(self._ring)
+        events: List[Dict[str, Any]] = []
+        for span in spans:
+            name = f"{span.op} {span.model}".strip()
+            end_ns = getattr(span, "end_ns", 0) or span.start_ns
+            tid = getattr(span, "tid", 0)
+            error = getattr(span, "error", None)
+            args: Dict[str, Any] = {
+                "trace_id": span.trace_id, "span_id": span.span_id,
+            }
+            if error:
+                args["error"] = error
+            events.append({
+                "name": name, "cat": span.frontend, "ph": "X",
+                "ts": span.start_ns / 1e3,
+                "dur": max(end_ns - span.start_ns, 0) / 1e3,
+                "pid": 1, "tid": tid, "args": args,
+            })
+            for pname, s, e in span.phases:
+                events.append({
+                    "name": pname, "cat": "phase", "ph": "X",
+                    "ts": s / 1e3, "dur": max(e - s, 0) / 1e3,
+                    "pid": 1, "tid": tid,
+                })
+            for ename, ts, attrs in (getattr(span, "events", None) or ()):
+                events.append({
+                    "name": ename, "cat": "event", "ph": "i",
+                    "ts": ts / 1e3, "s": "t", "pid": 1, "tid": tid,
+                    "args": attrs or {},
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump_json(self) -> str:
+        return json.dumps(self.chrome_trace(), separators=(",", ":"))
+
+
+# -- the facade ---------------------------------------------------------------
+_SAMPLE_MODES = ("always", "ratio", "slow", "off")
+
+
+class _FrontendBinding:
+    """Pre-resolved hot-path series for one frontend label value, so
+    ``Telemetry.finish`` does dict lookups instead of label resolution."""
+
+    __slots__ = ("requests", "request_seconds", "phase_series")
+
+    def __init__(self, tel: "Telemetry", frontend: str):
+        self.requests = tel.requests_total.labels(frontend)
+        self.request_seconds = tel.request_seconds.labels(frontend)
+        self.phase_series: Dict[str, _HistogramSeries] = {
+            name: tel.phase_seconds.labels(frontend, name)
+            for name in REQUEST_PHASES
+        }
+
+
+class Telemetry:
+    """One telemetry object shared by frontends, pools and policies.
+
+    ``sample``: which finished spans the tracer ring retains — ``always``,
+    ``ratio`` (keep ``sample_ratio`` of requests, decided at span start so
+    the traceparent sampled flag matches), ``slow`` (keep only requests
+    slower than ``slow_threshold_s``), or ``off`` (metrics only). Metrics
+    are always recorded; sampling gates only trace retention.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        sample: str = "always",
+        sample_ratio: float = 0.01,
+        slow_threshold_s: float = 0.25,
+        trace_capacity: int = 256,
+        rng: Optional[random.Random] = None,
+    ):
+        if sample not in _SAMPLE_MODES:
+            raise ValueError(
+                f"unknown sample mode {sample!r} (one of {_SAMPLE_MODES})")
+        self.registry = registry or MetricsRegistry()
+        self.tracer = Tracer(trace_capacity)
+        self.sample = sample
+        self.sample_ratio = sample_ratio
+        self.slow_threshold_s = slow_threshold_s
+        self._rng = rng or random.Random()
+        reg = self.registry
+        # -- pre-wired client instruments ------------------------------------
+        self.requests_total = reg.counter(
+            "client_tpu_requests_total",
+            "Requests finished (success or error) per frontend",
+            ("frontend",))
+        self.request_errors_total = reg.counter(
+            "client_tpu_request_errors_total",
+            "Requests finished with an error, by fault domain",
+            ("frontend", "domain"))
+        self.request_seconds = reg.histogram(
+            "client_tpu_request_seconds",
+            "End-to-end client request latency", ("frontend",))
+        self.phase_seconds = reg.histogram(
+            "client_tpu_phase_seconds",
+            "Per-phase client latency (serialize/ttfb/recv/deserialize/...)",
+            ("frontend", "phase"))
+        self.retries_total = reg.counter(
+            "client_tpu_retries_total",
+            "Resilient re-attempts across all policies")
+        self.fast_fails_total = reg.counter(
+            "client_tpu_breaker_fast_fails_total",
+            "Requests shed by an open circuit breaker")
+        self.breaker_transitions_total = reg.counter(
+            "client_tpu_breaker_transitions_total",
+            "Circuit breaker state transitions", ("state",))
+        self.stream_reconnects_total = reg.counter(
+            "client_tpu_stream_reconnects_total",
+            "GRPC bidi stream auto-reconnects")
+        self.pool_ejections_total = reg.counter(
+            "client_tpu_pool_ejections_total",
+            "Passive outlier ejections per endpoint", ("url",))
+        self.pool_readmissions_total = reg.counter(
+            "client_tpu_pool_readmissions_total",
+            "Ejection-window expiries / proven-healthy readmissions",
+            ("url",))
+        self.pool_health_changes_total = reg.counter(
+            "client_tpu_pool_health_changes_total",
+            "Active ready-probe health flips per endpoint", ("url",))
+        self.pool_sequence_abandoned_total = reg.counter(
+            "client_tpu_pool_sequence_abandoned_total",
+            "Sequence requests abandoned mid-flight (never re-sent)",
+            ("url",))
+        self.hedges_fired_total = reg.counter(
+            "client_tpu_hedges_fired_total",
+            "Hedge copies issued to a second replica")
+        self.hedge_wins_total = reg.counter(
+            "client_tpu_hedge_wins_total",
+            "Requests won by a hedge copy (not the primary)")
+        self.hedge_losses_total = reg.counter(
+            "client_tpu_hedge_losses_total",
+            "Requests where the primary beat an in-flight hedge")
+        self._bindings: Dict[str, _FrontendBinding] = {}
+        self._pools: List[Any] = []
+        self._pools_lock = threading.Lock()
+        self._pool_gauges: Optional[Dict[str, Gauge]] = None
+        # -- hot-path fast lanes ---------------------------------------------
+        # mode flags instead of string compares; cheap unique ids: span ids
+        # are a random 64-bit base xor a GIL-atomic counter, trace ids a
+        # random 64-bit hex prefix + the counter (W3C needs uniqueness and
+        # non-zero; the per-object random prefix keeps ids distinct across
+        # processes without paying getrandbits(128)+format per request)
+        self._sample_ratio_mode = sample == "ratio"
+        self._sample_slow_mode = sample == "slow"
+        self._sample_off = sample == "off"
+        self._trace_prefix = f"{self._rng.getrandbits(64) or 1:016x}"
+        # itertools.count.__next__ is a single C call: each concurrent
+        # caller receives a DISTINCT value (a python `seq += 1; read seq`
+        # pair could hand two threads the same id)
+        self._next_seq = itertools.count(1).__next__
+        # finished spans queue here (lock-free GIL-atomic deque appends) and
+        # fold into the counters/histograms on the SCRAPER's thread (via
+        # the collector below) — the request path never pays the histogram
+        # math. _FOLD_BACKLOG bounds memory when nothing scrapes: past it,
+        # the unlucky request folds the backlog inline (amortized, rare).
+        self._pending: deque = deque()
+        self.registry.add_collector(self._fold_pending)
+
+    _FOLD_BACKLOG = 32768
+
+    # -- span lifecycle ------------------------------------------------------
+    def begin(self, frontend: str, model: str = "",
+              op: str = "infer") -> RequestSpan:
+        """Open a request span. The sampled flag reflects ``ratio`` mode at
+        start time (``slow`` keeps the flag set: the decision happens at
+        finish, and servers record access on any traceparent)."""
+        sampled = True
+        if self._sample_ratio_mode:
+            sampled = self._rng.random() < self.sample_ratio
+        elif self._sample_off:
+            sampled = False
+        suffix = f"{self._next_seq():016x}"
+        # one client span per trace, so the span id can reuse the trace
+        # suffix: unique within the trace (trivially) and across this
+        # object's traces (the counter), never all-zero (seq starts at 1)
+        return RequestSpan(
+            self._trace_prefix + suffix, suffix,
+            frontend, model, op, sampled)
+
+    def _binding(self, frontend: str) -> _FrontendBinding:
+        binding = self._bindings.get(frontend)
+        if binding is None:
+            binding = _FrontendBinding(self, frontend)
+            self._bindings[frontend] = binding
+        return binding
+
+    def finish(self, span: Optional[RequestSpan],
+               error: Optional[BaseException] = None) -> None:
+        """Close the span. The hot path is one timestamp, the trace-ring
+        decision, and a lock-free deque append; the counter/histogram fold
+        is deferred to scrape time (or amortized once the backlog passes
+        ``_FOLD_BACKLOG``). This is the per-request overhead
+        BENCH_OBSERVE.json measures."""
+        if span is None:
+            return
+        end_ns = span.end_ns = time.perf_counter_ns()
+        total_s = (end_ns - span.start_ns) * 1e-9
+        if error is not None:
+            from .resilience import classify_fault  # no import cycle: lazy
+
+            span.error = f"{type(error).__name__}: {error}"[:256]
+            pending = (span, total_s, classify_fault(error))
+        else:
+            pending = (span, total_s, None)
+        self._pending.append(pending)
+        if self._sample_slow_mode:
+            if total_s >= self.slow_threshold_s:
+                span.tid = threading.get_ident()
+                self.tracer.keep(span)
+        elif span.sampled:
+            span.tid = threading.get_ident()
+            self.tracer.keep(span)
+        if len(self._pending) >= self._FOLD_BACKLOG:
+            self._fold_pending()
+
+    def _fold_pending(self) -> None:
+        """Drain finished spans into the metric series. Runs at scrape time
+        (registry collector), at the amortization threshold, or on demand;
+        concurrent folders are safe — ``popleft`` hands each record to
+        exactly one of them."""
+        pending = self._pending
+        if not pending:
+            return
+        lock = self.registry._lock
+        while True:
+            try:
+                span, total_s, domain = pending.popleft()
+            except IndexError:
+                return
+            binding = self._binding(span.frontend)
+            err_series = None
+            if domain is not None:
+                err_series = self.request_errors_total.labels(
+                    span.frontend, domain)
+            phases = span.phases
+            phase_series = binding.phase_series
+            for name, _, _ in phases:  # rare: non-canonical phase name
+                if name not in phase_series:
+                    phase_series[name] = self.phase_seconds.labels(
+                        span.frontend, name)
+            req_hist = binding.request_seconds
+            with lock:
+                binding.requests.value += 1
+                req_hist.counts[
+                    bisect_right(req_hist.buckets, total_s)] += 1
+                req_hist.sum += total_s
+                req_hist.count += 1
+                if err_series is not None:
+                    err_series.value += 1
+                for name, s, e in phases:
+                    seconds = (e - s) * 1e-9
+                    if seconds < 0.0:
+                        seconds = 0.0
+                    h = phase_series[name]
+                    h.counts[bisect_right(h.buckets, seconds)] += 1
+                    h.sum += seconds
+                    h.count += 1
+
+    # -- resilience observer protocol (duck-typed from resilience.py) --------
+    def on_retry(self, attempt: int, exc: BaseException,
+                 delay_s: float) -> None:
+        self.retries_total.inc()
+
+    def on_fast_fail(self) -> None:
+        self.fast_fails_total.inc()
+
+    def on_breaker_transition(self, state: str) -> None:
+        self.breaker_transitions_total.labels(state).inc()
+
+    def on_stream_reconnect(self) -> None:
+        self.stream_reconnects_total.inc()
+
+    def on_hedge_fired(self) -> None:
+        self.hedges_fired_total.inc()
+
+    def on_hedge_result(self, hedge_won: bool) -> None:
+        (self.hedge_wins_total if hedge_won
+         else self.hedge_losses_total).inc()
+
+    def attach(self, policy) -> Any:
+        """Wire a ``resilience.ResiliencePolicy`` (and its breaker) into
+        this telemetry object; returns the policy for chaining."""
+        policy.observer = self
+        breaker = getattr(policy, "breaker", None)
+        if breaker is not None:
+            breaker.on_transition = self.on_breaker_transition
+        return policy
+
+    # -- pool bridge ---------------------------------------------------------
+    def pool_observer(self, chain: Optional[Callable[[Any], None]] = None,
+                      ) -> Callable[[Any], None]:
+        """An ``on_event`` callback for ``client_tpu.pool`` that counts
+        each typed pool event exactly once, then forwards to ``chain``.
+        Matches on type name so this module never imports the pool."""
+        counters = {
+            "EndpointEjected": self.pool_ejections_total,
+            "EndpointReadmitted": self.pool_readmissions_total,
+            "EndpointHealthChanged": self.pool_health_changes_total,
+            "SequenceAbandoned": self.pool_sequence_abandoned_total,
+        }
+
+        def observe(event) -> None:
+            try:
+                counter = counters.get(type(event).__name__)
+                if counter is not None:
+                    counter.labels(event.url).inc()
+            finally:
+                if chain is not None:
+                    chain(event)
+
+        return observe
+
+    def register_pool(self, pool) -> None:
+        """Expose a pool's per-endpoint stats (health, ejection, breaker
+        state, outstanding, resilience counters) as gauges refreshed at
+        scrape time via a registry collector — one Prometheus scrape shows
+        ejections, half-open probes and hedge win/loss together.
+
+        Pools are held by weak reference: a long-lived Telemetry shared
+        across PoolClient create/close cycles must not pin dead pools (and
+        their endpoint clients) in memory or keep scraping them."""
+        with self._pools_lock:
+            first = self._pool_gauges is None
+            if first:
+                reg = self.registry
+                self._pool_gauges = {
+                    "healthy": reg.gauge(
+                        "client_tpu_pool_endpoint_healthy",
+                        "Active ready-probe verdict (1 healthy)", ("url",)),
+                    "ejected": reg.gauge(
+                        "client_tpu_pool_endpoint_ejected",
+                        "Outlier-ejection state (1 ejected)", ("url",)),
+                    "outstanding": reg.gauge(
+                        "client_tpu_pool_endpoint_outstanding",
+                        "In-flight requests per endpoint", ("url",)),
+                    "consecutive_failures": reg.gauge(
+                        "client_tpu_pool_endpoint_consecutive_failures",
+                        "Consecutive transport failures", ("url",)),
+                    "ejection_count": reg.gauge(
+                        "client_tpu_pool_endpoint_ejection_count",
+                        "Lifetime ejections per endpoint", ("url",)),
+                    "breaker_state": reg.gauge(
+                        "client_tpu_pool_endpoint_breaker_state",
+                        "Breaker state (0 closed, 1 half-open, 2 open)",
+                        ("url",)),
+                    "resilience": reg.gauge(
+                        "client_tpu_pool_endpoint_resilience",
+                        "Per-endpoint ResilienceStats counters",
+                        ("url", "counter")),
+                }
+            self._pools.append(weakref.ref(pool))
+            if first:
+                self.registry.add_collector(self._collect_pools)
+
+    def _collect_pools(self) -> None:
+        _BREAKER_STATE = {"closed": 0, "half_open": 1, "open": 2}
+        with self._pools_lock:
+            refs = list(self._pools)
+            gauges = self._pool_gauges
+        if gauges is None:
+            return
+        dead = []
+        for ref in refs:
+            pool = ref()
+            if pool is None:
+                dead.append(ref)
+                continue
+            try:
+                snapshot = pool.snapshot()
+            except Exception:
+                continue  # one sick pool must not break the whole scrape
+            for url, stats in snapshot.items():
+                gauges["healthy"].labels(url).set(
+                    1.0 if stats["healthy"] else 0.0)
+                gauges["ejected"].labels(url).set(
+                    1.0 if stats["ejected"] else 0.0)
+                gauges["outstanding"].labels(url).set(stats["outstanding"])
+                gauges["consecutive_failures"].labels(url).set(
+                    stats["consecutive_failures"])
+                gauges["ejection_count"].labels(url).set(
+                    stats["ejection_count"])
+                state = stats.get("breaker_state")
+                if state is not None:
+                    gauges["breaker_state"].labels(url).set(
+                        _BREAKER_STATE.get(state, -1))
+                for name, value in stats.get("resilience", {}).items():
+                    gauges["resilience"].labels(url, name).set(value)
+        if dead:
+            with self._pools_lock:
+                for ref in dead:
+                    try:
+                        self._pools.remove(ref)
+                    except ValueError:
+                        pass
+
+    # -- introspection -------------------------------------------------------
+    def flush(self) -> None:
+        """Fold any pending finished spans into the metric series now.
+        Exporters (``prometheus_text``/``snapshot``) do this implicitly;
+        call it before reading instrument objects directly."""
+        self._fold_pending()
+
+    def recent_traces(self, count: Optional[int] = None) -> List[Dict[str, Any]]:
+        return self.tracer.recent(count)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        return self.tracer.chrome_trace()
+
+    def dump_json(self) -> str:
+        return self.tracer.dump_json()
+
+    def phase_breakdown(self, percentiles: Sequence[float] = (0.5, 0.99),
+                        ) -> Dict[str, Dict[str, float]]:
+        """Per-phase latency percentiles (ms) computed from the EXACT
+        samples in the trace ring (not histogram-interpolated) — the
+        perf harness emits this under ``--observe``."""
+        samples: Dict[str, List[float]] = {}
+        for trace in self.tracer.recent():
+            for phase in trace["phases"]:
+                samples.setdefault(phase["name"], []).append(
+                    phase["duration_ms"])
+        out: Dict[str, Dict[str, float]] = {}
+        for name, values in sorted(samples.items()):
+            values.sort()
+            row = {"count": len(values),
+                   "avg": round(sum(values) / len(values), 4)}
+            for q in percentiles:
+                idx = min(int(len(values) * q), len(values) - 1)
+                row[f"p{int(q * 100)}"] = round(values[idx], 4)
+            out[name] = row
+        return out

@@ -87,6 +87,8 @@ class PerfRunner:
         endpoints: Optional[List[str]] = None,
         hedge: bool = False,
         hedge_delay_s: Optional[float] = None,
+        observe: bool = False,
+        observe_sample: str = "always",
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -99,7 +101,10 @@ class PerfRunner:
         health-aware ``PoolClient``s (``client_tpu.pool``) over them;
         ``url`` stays the control-plane address. ``hedge`` arms hedged
         requests on the pool (``hedge_delay_s`` pins the hedge delay;
-        default is the rolling p95)."""
+        default is the rolling p95). ``observe``: arm a fresh
+        ``observe.Telemetry`` (sample=always) on every measurement run and
+        append a client-phase p50/p99 breakdown
+        (serialize/send/ttfb/recv/deserialize) to each result row."""
         self.url = url
         self._direct_url = url
         self.protocol = protocol
@@ -112,6 +117,9 @@ class PerfRunner:
         self.endpoints = list(endpoints) if endpoints else None
         self.hedge = hedge
         self.hedge_delay_s = hedge_delay_s
+        self.observe = observe
+        self.observe_sample = observe_sample
+        self._telemetry = None  # fresh per measurement run (see run())
         self._proxy = None
         if protocol in ("native", "native-grpc") and shared_memory == "system":
             raise ValueError("native protocols support --shared-memory none|tpu")
@@ -121,6 +129,10 @@ class PerfRunner:
             raise ValueError(
                 "--retries requires a python frontend (http|grpc): the native "
                 "clients have no resilience hook")
+        if self.observe and protocol.startswith("native"):
+            raise ValueError(
+                "--observe requires a python frontend (http|grpc): the "
+                "native clients have no telemetry hook")
         if self.endpoints and protocol not in ("http", "grpc"):
             raise ValueError(
                 "--endpoints requires a python frontend (http|grpc): the "
@@ -189,6 +201,8 @@ class PerfRunner:
 
             client.configure_resilience(ResiliencePolicy(
                 retry=RetryPolicy(max_attempts=self.retries + 1)))
+        if self._telemetry is not None:
+            client.configure_telemetry(self._telemetry)
         return client
 
     def _make_pool_client(self, concurrency: int):
@@ -217,6 +231,7 @@ class PerfRunner:
             # primary + hedge both ride the executor: size it so the full
             # worker concurrency never queues behind hedge threads
             hedge_executor_workers=max(8, 2 * concurrency),
+            telemetry=self._telemetry,
         )
 
     def _control_client(self):
@@ -595,8 +610,26 @@ class PerfRunner:
 
         return inputs, outputs or None, cleanup
 
+    def _arm_telemetry(self, measurement_requests: int):
+        """A fresh Telemetry per measurement run (sample=always, ring sized
+        to hold every request) so each result row's phase breakdown covers
+        exactly that run."""
+        if not self.observe:
+            return
+        from .observe import Telemetry
+
+        self._telemetry = Telemetry(
+            sample=self.observe_sample,
+            trace_capacity=max(measurement_requests, 1024))
+
+    def _observe_result(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        if self._telemetry is not None:
+            result["client_phase_ms"] = self._telemetry.phase_breakdown()
+        return result
+
     # -- sweep -------------------------------------------------------------
     def run(self, concurrency: int, measurement_requests: int) -> Dict[str, Any]:
+        self._arm_telemetry(measurement_requests)
         client = self._make_client(concurrency)
         if self.protocol == "native-grpc-async":
             # the shared instance must admit as many RPCs as we have
@@ -626,7 +659,7 @@ class PerfRunner:
 
         lat_sorted = sorted(latencies)
         n = len(lat_sorted)
-        return {
+        return self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
             "shared_memory": self.shared_memory,
@@ -642,7 +675,7 @@ class PerfRunner:
                 "p90": round(1000 * _percentile(lat_sorted, 0.90), 3),
                 "p99": round(1000 * _percentile(lat_sorted, 0.99), 3),
             },
-        }
+        })
 
     def run_rate(self, rate: float, measurement_requests: int,
                  distribution: str = "constant",
@@ -663,6 +696,7 @@ class PerfRunner:
             raise ValueError(f"unknown distribution {distribution!r}")
         schedule = np.concatenate([[0.0], np.cumsum(gaps[:-1])]).tolist()
 
+        self._arm_telemetry(measurement_requests)
         client = self._make_client(pool_size)
         if self.protocol == "native-grpc-async":
             client.set_async_concurrency(pool_size)
@@ -701,7 +735,7 @@ class PerfRunner:
         # (reference threshold: perf_analyzer flags schedule slip; 1 ms
         # separates scheduler jitter from genuine queueing)
         delayed = sum(1 for lag in lag_sorted if lag > 1e-3)
-        return {
+        return self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
             "shared_memory": self.shared_memory,
@@ -725,7 +759,7 @@ class PerfRunner:
                 "p99": round(1000 * _percentile(lag_sorted, 0.99), 3),
             },
             "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
-        }
+        })
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -795,6 +829,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="hedge delay in seconds (default: rolling p95 of recent "
              "latencies)",
     )
+    parser.add_argument(
+        "--observe", action="store_true",
+        help="enable client telemetry (observe.Telemetry, sample=always) "
+             "during measurement and append a client-phase p50/p99 "
+             "breakdown (serialize/ttfb/recv/deserialize) to each result",
+    )
     args = parser.parse_args(argv)
 
     parts = [int(x) for x in args.concurrency_range.split(":")]
@@ -813,6 +853,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         endpoints=[u.strip() for u in args.endpoints.split(",") if u.strip()]
         if args.endpoints else None,
         hedge=args.hedge, hedge_delay_s=args.hedge_delay,
+        observe=args.observe,
     )
     try:
         if args.warmup_requests:

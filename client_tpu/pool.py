@@ -527,6 +527,7 @@ class _PoolClientBase:
         rng: Optional[random.Random] = None,
         on_event: Optional[Callable[[PoolEvent], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        telemetry=None,
     ):
         """``urls``: N ``host:port`` replica addresses. ``client_factory``
         overrides the per-endpoint client constructor (receives the url);
@@ -539,7 +540,12 @@ class _PoolClientBase:
         shared thread pool, so size ``hedge_executor_workers`` to at least
         ``caller_threads * (1 + max_hedges)`` when driving the pool from
         many threads (default: ``max(8, 4 * N)``).
-        ``health_interval_s=None`` disables the active prober."""
+        ``health_interval_s=None`` disables the active prober.
+        ``telemetry``: an ``observe.Telemetry`` shared by the pool and every
+        endpoint client — pool events feed its counters (ejections,
+        readmissions, health flips, hedge win/loss), per-endpoint breakers
+        and retries report through it, endpoint stats surface as gauges at
+        scrape time, and each endpoint client traces request phases."""
         urls = list(urls)
         if not urls:
             raise ValueError("pool needs at least one url")
@@ -554,15 +560,25 @@ class _PoolClientBase:
             client_factory = _default_client_factory(protocol, self._AIO)
         if breaker_factory is None:
             breaker_factory = CircuitBreaker
+        self._telemetry = telemetry
+        if telemetry is not None:
+            # count every typed pool event exactly once, then forward to
+            # the caller's observer (if any)
+            on_event = telemetry.pool_observer(chain=on_event)
         endpoints: List[EndpointState] = []
         try:
             for url, weight in zip(urls, weights):
                 policy = ResiliencePolicy(
                     retry=endpoint_retry, breaker=breaker_factory())
+                if telemetry is not None:
+                    telemetry.attach(policy)  # retries/fast-fails/breaker
                 client = client_factory(url)
                 # every call through this client now runs under the
                 # endpoint's breaker and is counted in its stats
                 client.configure_resilience(policy)
+                if telemetry is not None and hasattr(
+                        client, "configure_telemetry"):
+                    client.configure_telemetry(telemetry)
                 endpoints.append(EndpointState(url, client, policy, weight))
         except Exception:
             self._abandon(endpoints)
@@ -582,6 +598,10 @@ class _PoolClientBase:
         except Exception:
             self._abandon(endpoints)
             raise
+        if telemetry is not None:
+            # per-endpoint health/ejection/breaker/outstanding gauges,
+            # refreshed from pool.snapshot() at scrape time
+            telemetry.register_pool(self.pool)
         self._hedge = hedge
         self._hedge_executor_workers = (
             hedge_executor_workers
@@ -642,6 +662,14 @@ class _PoolClientBase:
             "PoolClient owns each endpoint's resilience policy (breaker + "
             "stats); configure endpoint_retry= / breaker_factory= at pool "
             "construction instead")
+
+    def configure_telemetry(self, telemetry):
+        raise InferenceServerException(
+            "PoolClient wires telemetry through every endpoint at "
+            "construction; pass telemetry= to the pool constructor instead")
+
+    def telemetry(self):
+        return self._telemetry
 
     @classmethod
     def _is_broadcast(cls, name: str) -> bool:
@@ -962,8 +990,12 @@ class PoolClient(_PoolClientBase):
             remaining = budget.attempt_timeout_s()  # raises once spent
             ep = pool.select(exclude=tried)
             tried.append(ep)
-            futures.append(executor.submit(attempt, ep, remaining))
+            future = executor.submit(attempt, ep, remaining)
+            futures.append(future)
+            return future
 
+        tel = self._telemetry
+        hedge_futures: set = set()  # attempts fired BY the hedge timer
         max_attempts = max(self._max_failover_attempts, 1 + hedge.max_hedges)
         spawn()
         hedges_left = hedge.max_hedges
@@ -989,6 +1021,9 @@ class PoolClient(_PoolClientBase):
                 else:
                     for p in futures:
                         p.cancel()
+                    if tel is not None and hedge_futures:
+                        # a hedge raced this request: did it beat the primary?
+                        tel.on_hedge_result(f in hedge_futures)
                     return result
             firing = hedges_left > 0 and time.monotonic() >= hedge_at
             if futures and not firing:
@@ -1001,7 +1036,7 @@ class PoolClient(_PoolClientBase):
                     continue
                 raise failures[-1]
             try:
-                spawn()
+                spawned = spawn()
             except (NoEndpointAvailableError, InferenceServerException) as e:
                 if futures:
                     hedges_left = 0  # nothing to hedge to; ride out in-flight
@@ -1010,6 +1045,9 @@ class PoolClient(_PoolClientBase):
                     raise failures[-1] from e
                 raise
             if firing:
+                hedge_futures.add(spawned)
+                if tel is not None:
+                    tel.on_hedge_fired()
                 hedges_left -= 1
                 hedge_at = time.monotonic() + hedge.delay(
                     pool.latency_p95(hedge.min_latency_samples), self._rng)
@@ -1377,7 +1415,9 @@ class AioPoolClient(_PoolClientBase):
             remaining = budget.attempt_timeout_s()
             ep = pool.select(exclude=tried)
             tried.append(ep)
-            tasks.add(asyncio.ensure_future(attempt(ep, remaining)))
+            task = asyncio.ensure_future(attempt(ep, remaining))
+            tasks.add(task)
+            return task
 
         async def cancel_pending():
             for t in tasks:
@@ -1388,6 +1428,8 @@ class AioPoolClient(_PoolClientBase):
                 except BaseException:
                     pass
 
+        tel = self._telemetry
+        hedge_tasks: set = set()  # attempts fired BY the hedge timer
         max_attempts = max(self._max_failover_attempts, 1 + hedge.max_hedges)
         spawn()
         hedges_left = hedge.max_hedges
@@ -1413,6 +1455,8 @@ class AioPoolClient(_PoolClientBase):
                         failures.append(e)
                     else:
                         await cancel_pending()
+                        if tel is not None and hedge_tasks:
+                            tel.on_hedge_result(t in hedge_tasks)
                         return result
                 firing = hedges_left > 0 and time.monotonic() >= hedge_at
                 if tasks and not firing:
@@ -1423,7 +1467,7 @@ class AioPoolClient(_PoolClientBase):
                         continue
                     raise failures[-1]
                 try:
-                    spawn()
+                    spawned = spawn()
                 except (NoEndpointAvailableError, InferenceServerException) as e:
                     if tasks:
                         hedges_left = 0
@@ -1432,6 +1476,9 @@ class AioPoolClient(_PoolClientBase):
                         raise failures[-1] from e
                     raise
                 if firing:
+                    hedge_tasks.add(spawned)
+                    if tel is not None:
+                        tel.on_hedge_fired()
                     hedges_left -= 1
                     hedge_at = time.monotonic() + hedge.delay(
                         pool.latency_p95(hedge.min_latency_samples), self._rng)

@@ -279,6 +279,10 @@ class CircuitBreaker:
         self.min_calls = min_calls
         self.recovery_time_s = recovery_time_s
         self.half_open_max_probes = half_open_max_probes
+        # observability hook: called with the NEW state name after every
+        # transition, outside the breaker lock (must be fast + non-raising;
+        # observe.Telemetry.attach wires it to a transition counter)
+        self.on_transition: Optional[Callable[[str], None]] = None
         self._clock = clock
         self._lock = threading.Lock()
         self._outcomes: deque = deque(maxlen=window)
@@ -291,46 +295,65 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    def _notify(self, state: Optional[str]) -> None:
+        if state is None or self.on_transition is None:
+            return
+        try:
+            self.on_transition(state)
+        except Exception:
+            pass  # an observer must never break the data path
+
     def allow(self) -> None:
         """Admit one call or raise :class:`CircuitOpenError`."""
-        with self._lock:
-            if self._state == self.CLOSED:
-                return
-            now = self._clock()
-            if self._state == self.OPEN:
-                remaining = self._opened_at + self.recovery_time_s - now
-                if remaining > 0:
+        transition = None
+        try:
+            with self._lock:
+                if self._state == self.CLOSED:
+                    return
+                now = self._clock()
+                if self._state == self.OPEN:
+                    remaining = self._opened_at + self.recovery_time_s - now
+                    if remaining > 0:
+                        raise CircuitOpenError(
+                            f"circuit breaker open; retry in {remaining:.3f}s",
+                            retry_after_s=remaining,
+                        )
+                    self._state = self.HALF_OPEN
+                    self._probes_in_flight = 0
+                    transition = self.HALF_OPEN
+                # HALF_OPEN: admit a bounded number of probes
+                if self._probes_in_flight >= self.half_open_max_probes:
                     raise CircuitOpenError(
-                        f"circuit breaker open; retry in {remaining:.3f}s",
-                        retry_after_s=remaining,
+                        "circuit breaker half-open; probe already in flight",
+                        retry_after_s=self.recovery_time_s,
                     )
-                self._state = self.HALF_OPEN
-                self._probes_in_flight = 0
-            # HALF_OPEN: admit a bounded number of probes
-            if self._probes_in_flight >= self.half_open_max_probes:
-                raise CircuitOpenError(
-                    "circuit breaker half-open; probe already in flight",
-                    retry_after_s=self.recovery_time_s,
-                )
-            self._probes_in_flight += 1
+                self._probes_in_flight += 1
+        finally:
+            self._notify(transition)
 
     def record(self, ok: bool) -> None:
+        transition = None
         with self._lock:
             if self._state == self.HALF_OPEN:
                 self._probes_in_flight = max(0, self._probes_in_flight - 1)
                 if ok:
                     self._state = self.CLOSED
                     self._outcomes.clear()
+                    transition = self.CLOSED
                 else:
                     self._state = self.OPEN
                     self._opened_at = self._clock()
-                return
-            self._outcomes.append(ok)
-            if self._state == self.CLOSED and len(self._outcomes) >= self.min_calls:
-                failures = sum(1 for o in self._outcomes if not o)
-                if failures / len(self._outcomes) >= self.failure_threshold:
-                    self._state = self.OPEN
-                    self._opened_at = self._clock()
+                    transition = self.OPEN
+            else:
+                self._outcomes.append(ok)
+                if (self._state == self.CLOSED
+                        and len(self._outcomes) >= self.min_calls):
+                    failures = sum(1 for o in self._outcomes if not o)
+                    if failures / len(self._outcomes) >= self.failure_threshold:
+                        self._state = self.OPEN
+                        self._opened_at = self._clock()
+                        transition = self.OPEN
+        self._notify(transition)
 
     def would_admit(self) -> bool:
         """Non-mutating peek: would :meth:`allow` admit a call right now?
@@ -361,13 +384,17 @@ class CircuitBreaker:
 
     def reset(self) -> None:
         with self._lock:
+            changed = self._state != self.CLOSED
             self._state = self.CLOSED
             self._outcomes.clear()
             self._probes_in_flight = 0
+        if changed:
+            self._notify(self.CLOSED)
 
 
 class ResilienceStats:
-    """Cumulative counters for one policy object (thread-safe)."""
+    """Cumulative counters for one policy object (thread-safe writes,
+    lock-free reads)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -384,13 +411,16 @@ class ResilienceStats:
             self.fast_fails += fast_fails
 
     def as_dict(self) -> Dict[str, int]:
-        with self._lock:
-            return {
-                "calls": self.calls,
-                "attempts": self.attempts,
-                "retries": self.retries,
-                "fast_fails": self.fast_fails,
-            }
+        # lock-free: each counter is one int slot only ever mutated under
+        # _bump's lock, so a read sees a valid value; the four reads may be
+        # an increment apart, which a metrics scrape tolerates — taking the
+        # lock here would put scrapers on the data path's critical section
+        return {
+            "calls": self.calls,
+            "attempts": self.attempts,
+            "retries": self.retries,
+            "fast_fails": self.fast_fails,
+        }
 
 
 class StreamReconnected:
@@ -485,6 +515,10 @@ class ResiliencePolicy:
         # boundary if attempts run out)
         self.retry_http_statuses = retry_http_statuses
         self.stats = ResilienceStats()
+        # observability hook (duck-typed; see observe.Telemetry.attach):
+        # on_retry(attempt, exc, delay_s) / on_fast_fail() called alongside
+        # the stats counters — must be fast and non-raising
+        self.observer = None
 
     # -- decision core (shared by both engines) -----------------------------
     @staticmethod
@@ -559,6 +593,11 @@ class ResiliencePolicy:
                     self.breaker.allow()
                 except CircuitOpenError:
                     self.stats._bump(fast_fails=1)
+                    if self.observer is not None:
+                        try:
+                            self.observer.on_fast_fail()
+                        except Exception:
+                            pass
                     raise
             self.stats._bump(attempts=1)
             try:
@@ -572,6 +611,11 @@ class ResiliencePolicy:
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 self.stats._bump(retries=1)
+                if self.observer is not None:
+                    try:
+                        self.observer.on_retry(attempt, exc, delay)
+                    except Exception:
+                        pass
                 sleep(delay)
                 attempt += 1
                 continue
@@ -606,6 +650,11 @@ class ResiliencePolicy:
                     self.breaker.allow()
                 except CircuitOpenError:
                     self.stats._bump(fast_fails=1)
+                    if self.observer is not None:
+                        try:
+                            self.observer.on_fast_fail()
+                        except Exception:
+                            pass
                     raise
             self.stats._bump(attempts=1)
             try:
@@ -619,6 +668,11 @@ class ResiliencePolicy:
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 self.stats._bump(retries=1)
+                if self.observer is not None:
+                    try:
+                        self.observer.on_retry(attempt, exc, delay)
+                    except Exception:
+                        pass
                 await asyncio.sleep(delay)
                 attempt += 1
                 continue

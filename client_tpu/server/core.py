@@ -12,6 +12,7 @@ import base64
 import json
 import threading
 import time
+from collections import deque
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, List, Optional
 
@@ -297,6 +298,12 @@ class ServerCore:
         self._traces: List[Dict[str, Any]] = []
         self._trace_seq = 0
         self._trace_candidates = 0
+        # W3C trace-context access records: every request that arrived with
+        # a (valid) traceparent gets a server-side span joined on the same
+        # trace id, so client phase timings and server queue/compute
+        # timings line up (client_tpu.observe; scraped via /metrics)
+        self._access: deque = deque(maxlen=1024)
+        self._metrics_registry = None
         for m in models or []:
             self.add_model(m)
 
@@ -429,6 +436,114 @@ class ServerCore:
     def recent_traces(self, count: int = 100) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._traces[-count:])
+
+    # -- observability (client_tpu.observe counterpart) ----------------------
+    def _observe_access(self, request: Dict[str, Any], model_name: str,
+                        t0: int, t_infer: int, infer_ns: int) -> None:
+        """Record a server-side span for a request that carried a W3C
+        ``traceparent`` (frontends stash the header/metadata value under
+        the reserved ``traceparent`` request key). ``client_span_id`` is
+        the parent id from the header — the client's request span — so one
+        trace id joins client phases to server queue/compute timings."""
+        traceparent = request.get("traceparent")
+        if not traceparent:
+            return
+        from ..observe import make_span_id, parse_traceparent
+
+        parsed = parse_traceparent(traceparent)
+        if parsed is None:
+            return
+        trace_id, client_span_id, _sampled = parsed
+        record = {
+            "trace_id": trace_id,
+            "client_span_id": client_span_id,
+            "server_span_id": make_span_id(),
+            "model_name": model_name,
+            "request_id": request.get("id", ""),
+            # recv -> compute-start: input resolution + batching queue
+            "queue_ns": max(t_infer - t0, 0),
+            "compute_ns": infer_ns,
+            "total_ns": time.perf_counter_ns() - t0,
+            "wall_time_s": time.time(),
+        }
+        with self._lock:
+            self._access.append(record)
+
+    def access_records(self, count: int = 100) -> List[Dict[str, Any]]:
+        """The most recent traceparent-joined server spans (newest last)."""
+        with self._lock:
+            return list(self._access)[-count:]
+
+    def metrics_registry(self):
+        """The server's ``observe.MetricsRegistry`` (created on first use):
+        live/ready gauges plus per-model request/latency series refreshed
+        from the model statistics at scrape time. Both HTTP frontends serve
+        its Prometheus rendering at ``GET /metrics``."""
+        with self._lock:
+            if self._metrics_registry is not None:
+                return self._metrics_registry
+        from ..observe import MetricsRegistry
+
+        reg = MetricsRegistry()
+        live = reg.gauge(
+            "client_tpu_server_live", "Server liveness (1 live)")
+        ready = reg.gauge(
+            "client_tpu_server_ready",
+            "Server readiness (0 while draining; live stays 1)")
+        gauges = {
+            "inference_count": reg.gauge(
+                "client_tpu_server_inference_count",
+                "Inferences completed (batched requests each count)",
+                ("model",)),
+            "execution_count": reg.gauge(
+                "client_tpu_server_execution_count",
+                "Model executions (execution < inference under batching)",
+                ("model",)),
+            "success": reg.gauge(
+                "client_tpu_server_request_success_count",
+                "Successful requests", ("model",)),
+            "fail": reg.gauge(
+                "client_tpu_server_request_fail_count",
+                "Failed requests", ("model",)),
+            "cancel": reg.gauge(
+                "client_tpu_server_request_cancel_count",
+                "Client-cancelled/abandoned streaming requests", ("model",)),
+            "queue_seconds": reg.gauge(
+                "client_tpu_server_queue_seconds",
+                "Cumulative batching-queue wait", ("model",)),
+            "compute_seconds": reg.gauge(
+                "client_tpu_server_compute_seconds",
+                "Cumulative model compute time", ("model",)),
+        }
+        traced = reg.gauge(
+            "client_tpu_server_traced_requests",
+            "Traceparent-joined access records currently buffered")
+
+        def collect():
+            live.set(1.0 if self.live else 0.0)
+            ready.set(1.0 if (self.live and self.ready) else 0.0)
+            for row in self.statistics()["model_stats"]:
+                model = row["name"]
+                gauges["inference_count"].labels(model).set(
+                    row["inference_count"])
+                gauges["execution_count"].labels(model).set(
+                    row["execution_count"])
+                stats = row["inference_stats"]
+                gauges["success"].labels(model).set(stats["success"]["count"])
+                gauges["fail"].labels(model).set(stats["fail"]["count"])
+                gauges["cancel"].labels(model).set(stats["cancel"]["count"])
+                gauges["queue_seconds"].labels(model).set(
+                    stats["queue"]["ns"] / 1e9)
+                gauges["compute_seconds"].labels(model).set(
+                    stats["compute_infer"]["ns"] / 1e9)
+            with self._lock:
+                traced.set(len(self._access))
+
+        reg.add_collector(collect)
+        with self._lock:
+            if self._metrics_registry is None:
+                self._metrics_registry = reg
+            return self._metrics_registry
 
     def orca_report(self, fmt: str, model_name: str = "") -> str:
         """Per-response load metrics in ORCA json or text form."""
@@ -589,6 +704,7 @@ class ServerCore:
                 self._build_response(model, model_version, request, raw)
             )
         self._trace_request(model_name, request, t0, t_infer, infer_ns)
+        self._observe_access(request, model_name, t0, t_infer, infer_ns)
         batch = 1
         if responses and model.effective_max_batch_size():
             first = next(iter(raw_responses[0].values()))
@@ -668,6 +784,7 @@ class ServerCore:
         infer_ns = time.perf_counter_ns() - t_infer
         record(True, infer_ns)
         self._trace_request(model_name, request, t0, t_infer, infer_ns)
+        self._observe_access(request, model_name, t0, t_infer, infer_ns)
 
     def _trace_request(self, model_name: str, request: Dict[str, Any],
                        t0: int, t_infer: int, infer_ns: int) -> None:

@@ -241,9 +241,21 @@ class _Handlers(grpc.GenericRpcHandler):
         return {"config": config}
 
     # -- inference -----------------------------------------------------------
+    @staticmethod
+    def _traceparent_of(context) -> Optional[str]:
+        """The W3C trace-context metadata value, if the client sent one
+        (the GRPC twin of the HTTP frontends' traceparent header)."""
+        for key, value in (context.invocation_metadata() or ()):
+            if key == "traceparent":
+                return value
+        return None
+
     def _model_infer(self, request, context):
         try:
             core_req = _to_core_request(request)
+            traceparent = self._traceparent_of(context)
+            if traceparent:
+                core_req["traceparent"] = traceparent
             responses = self._core.infer(
                 request.get("model_name", ""), request.get("model_version", ""), core_req
             )
@@ -259,10 +271,15 @@ class _Handlers(grpc.GenericRpcHandler):
             key == "triton_grpc_error" and str(value).lower() == "true"
             for key, value in (context.invocation_metadata() or ())
         )
+        traceparent = self._traceparent_of(context)
         for request in request_iterator:
             model_name = request.get("model_name", "")
             try:
                 core_req = _to_core_request(request)
+                if traceparent:
+                    # stream-level metadata: every request on the stream
+                    # joins the same client trace id
+                    core_req["traceparent"] = traceparent
                 want_final = bool(
                     core_req["parameters"].get("triton_enable_empty_final_response")
                 )

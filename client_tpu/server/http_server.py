@@ -379,6 +379,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/v2" or path == "/v2/":
                 return self._send_json(core.server_metadata())
+            if path == "/metrics":
+                # Prometheus scrape target; NOT gated on core.ready — a
+                # scraper must see the drain (ready gauge -> 0), not errors
+                return self._send(
+                    200, core.metrics_registry().prometheus_text().encode(),
+                    {"Content-Type":
+                     "text/plain; version=0.0.4; charset=utf-8"})
             if path == "/v2/health/live":
                 return self._send(200 if core.live else 503)
             if path == "/v2/health/ready":
@@ -495,6 +502,11 @@ class _Handler(BaseHTTPRequestHandler):
         request = parse_infer_request(
             body, int(header_length) if header_length is not None else None
         )
+        traceparent = self.headers.get("traceparent")
+        if traceparent:
+            # W3C trace context: the core attaches a server-side span
+            # joined on this trace id (ServerCore.access_records)
+            request["traceparent"] = traceparent
         requested, binary_default = infer_request_encoding_prefs(request)
         responses = self.core.infer(model_name, model_version, request)
         body_out, json_size = encode_infer_response(
@@ -670,9 +682,13 @@ class HttpInferenceServer:
         health pollers to route away, finish in-flight requests, then close
         the listener. SIGTERM handlers should call this, not ``stop``."""
         self.drain(grace_s)
-        self._httpd.shutdown()
-        # finish in-flight requests before tearing the listener down
+        # finish in-flight requests BEFORE tearing the listener down: while
+        # they drain, the server must keep answering /metrics and the
+        # health routes (live=true, ready=false) — a scraper should see the
+        # drain happen, not connection errors (shutdown() first would stop
+        # accepting while slow in-flight requests were still finishing)
         self._httpd.wait_idle(timeout=10)
+        self._httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._httpd.server_close()

@@ -81,6 +81,17 @@ class AioHttpInferenceServer:
         r.add_get("/v2/health/live", live)
         r.add_get("/v2/health/ready", ready)
 
+        async def metrics(request):
+            # Prometheus scrape target; NOT gated on core.ready — a scraper
+            # must see the drain (ready gauge -> 0), not connection errors
+            return web.Response(
+                body=core.metrics_registry().prometheus_text().encode(),
+                content_type="text/plain",
+                charset="utf-8",
+            )
+
+        r.add_get("/metrics", metrics)
+
         async def server_metadata(request):
             return _json_response(core.server_metadata())
 
@@ -118,6 +129,11 @@ class AioHttpInferenceServer:
                 parsed = parse_infer_request(
                     body, int(header_length) if header_length is not None else None
                 )
+                traceparent = request.headers.get("traceparent")
+                if traceparent:
+                    # W3C trace context: the core attaches a server-side
+                    # span joined on this trace id (access_records)
+                    parsed["traceparent"] = traceparent
                 requested = parsed.get("outputs")
                 binary_default = bool(
                     parsed.get("binary_default")

@@ -1,0 +1,523 @@
+"""Observability subsystem tests.
+
+Covers the ISSUE 3 acceptance surface: exporter conformance (Prometheus
+text parses under a strict grammar, histogram buckets are cumulative and
+``+Inf``-terminated, the JSON snapshot round-trips), traceparent e2e (the
+client span id appears in the threaded + aio + grpc servers' access
+records for the same request), the pool event bridge (an
+``EndpointEjected`` chaos run increments the ejection counter exactly
+once per event), sampling modes, the chrome trace dump, and the
+observability chaos smoke (flap chaos with telemetry on: retry/breaker
+counters non-zero, no metric negative).
+"""
+
+import asyncio
+import json
+import random
+import re
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+import urllib3
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu.models import default_model_zoo
+from client_tpu.observe import (
+    MetricsRegistry,
+    Telemetry,
+    format_traceparent,
+    parse_traceparent,
+)
+from client_tpu.pool import EndpointEjected, PoolClient
+from client_tpu.resilience import (
+    CircuitBreaker,
+    ResiliencePolicy,
+    RetryPolicy,
+)
+from client_tpu.server import (
+    AioHttpInferenceServer,
+    GrpcInferenceServer,
+    HttpInferenceServer,
+    ServerCore,
+)
+from client_tpu.testing import ChaosProxy, Fault
+
+SEEDED_RNG = lambda: random.Random(0x0B5E)  # noqa: E731
+
+
+def _simple_inputs(mod):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = mod.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+    in1 = mod.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+    return a + b, [in0, in1]
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- W3C trace context --------------------------------------------------------
+def test_traceparent_roundtrip_and_rejects():
+    value = format_traceparent("ab" * 16, "cd" * 8, sampled=True)
+    assert value == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    assert parse_traceparent(value) == ("ab" * 16, "cd" * 8, True)
+    trace_id, span_id, sampled = parse_traceparent(
+        format_traceparent("12" * 16, "34" * 8, sampled=False))
+    assert (trace_id, span_id, sampled) == ("12" * 16, "34" * 8, False)
+    for bad in (
+        None, "", "garbage",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # invalid version
+        "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",  # uppercase hex
+        "00-" + "ab" * 15 + "-" + "cd" * 8 + "-01",  # short trace id
+    ):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_span_ids_unique_and_well_formed():
+    tel = Telemetry(rng=SEEDED_RNG())
+    spans = [tel.begin("http", "m") for _ in range(64)]
+    assert len({s.trace_id for s in spans}) == 64
+    assert len({s.span_id for s in spans}) == 64
+    for s in spans:
+        parsed = parse_traceparent(s.traceparent())
+        assert parsed == (s.trace_id, s.span_id, True)
+
+
+def test_span_ids_unique_across_threads():
+    """One Telemetry is shared by thread pools (async_infer, hedges, perf
+    workers): concurrent begin() calls must never mint the same trace id."""
+    tel = Telemetry(sample="off")
+    ids = []
+
+    def worker():
+        for _ in range(500):
+            ids.append(tel.begin("http", "m").trace_id)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ids) == 4000
+    assert len(set(ids)) == 4000, "duplicate trace ids under concurrency"
+
+
+# -- exporter conformance -----------------------------------------------------
+# Prometheus text format 0.0.4: HELP/TYPE comments + sample lines.
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="([^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="([^"\\]|\\.)*")*\})?'
+    r' [-+]?(\d+(\.\d+)?([eE][-+]?\d+)?|\d+e[-+]?\d+)$')
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*$")
+_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)$")
+
+
+def _assert_prometheus_conformant(text: str):
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP"):
+            assert _HELP_RE.match(line), line
+        elif line.startswith("# TYPE"):
+            assert _TYPE_RE.match(line), line
+        else:
+            # +Inf is the one non-numeric token, only legal in a le= label
+            assert _SAMPLE_RE.match(line.replace('le="+Inf"', 'le="inf"')), line
+
+
+def test_prometheus_text_conformance():
+    reg = MetricsRegistry()
+    reg.counter("t_requests_total", "requests", ("frontend",)).labels(
+        "http").inc(3)
+    reg.gauge("t_up", "is up").set(1)
+    hist = reg.histogram("t_seconds", "latency", ("phase",),
+                         buckets=(0.001, 0.01, 0.1))
+    hist.labels("ttfb").observe(0.005)
+    hist.labels("ttfb").observe(0.5)
+    hist.labels('we"ird\nlabel').observe(0.0001)  # escaping path
+    _assert_prometheus_conformant(reg.prometheus_text())
+
+
+def test_histogram_buckets_cumulative_and_inf_terminated():
+    reg = MetricsRegistry()
+    hist = reg.histogram("h_seconds", "h", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        hist.observe(v)
+    lines = reg.prometheus_text().splitlines()
+    buckets = [line for line in lines if line.startswith("h_seconds_bucket")]
+    # cumulative: 2, 3, 4, then the +Inf terminator carrying the total
+    values = [float(line.rsplit(" ", 1)[1]) for line in buckets]
+    assert values == sorted(values), "bucket counts must be cumulative"
+    assert values == [2, 3, 4, 5]
+    assert 'le="+Inf"' in buckets[-1], "last bucket must be +Inf"
+    assert "h_seconds_count 5" in lines
+    assert any(line.startswith("h_seconds_sum ") for line in lines)
+
+
+def test_json_snapshot_roundtrips():
+    reg = MetricsRegistry()
+    reg.counter("s_total", "c", ("kind",)).labels("a").inc(2)
+    reg.gauge("s_gauge", "g").set(-1.5)
+    reg.histogram("s_seconds", "h", buckets=(0.1, 1.0)).observe(0.05)
+    snap = reg.snapshot()
+    assert json.loads(json.dumps(snap)) == snap
+    assert snap["s_total"]["series"][0] == {
+        "labels": {"kind": "a"}, "value": 2.0}
+    hist = snap["s_seconds"]["series"][0]
+    assert hist["count"] == 1 and hist["buckets"][-1]["le"] == "+Inf"
+    assert hist["buckets"][-1]["count"] == 1
+
+
+def test_instruments_idempotent_and_kind_mismatch_raises():
+    reg = MetricsRegistry()
+    c1 = reg.counter("dup_total", "c", ("x",))
+    assert reg.counter("dup_total", "c", ("x",)) is c1
+    with pytest.raises(ValueError):
+        reg.gauge("dup_total", "now a gauge", ("x",))
+    with pytest.raises(ValueError):
+        reg.counter("dup_total", "different labels", ("y",))
+    with pytest.raises(ValueError):
+        reg.counter("bad name!", "nope")
+
+
+def test_histogram_quantile_interpolates():
+    reg = MetricsRegistry()
+    hist = reg.histogram("q_seconds", "h", buckets=(0.1, 0.2, 0.4))
+    for _ in range(50):
+        hist.observe(0.15)  # (0.1, 0.2] bucket
+    q = hist.quantile(0.5)
+    assert 0.1 <= q <= 0.2
+    assert hist.quantile(0.999) <= 0.4
+
+
+# -- telemetry: sampling + traces --------------------------------------------
+def test_metrics_always_recorded_sampling_gates_traces_only():
+    tel = Telemetry(sample="ratio", sample_ratio=0.0, rng=SEEDED_RNG())
+    for _ in range(5):
+        span = tel.begin("http", "m")
+        tel.finish(span)
+    assert tel.recent_traces() == []  # ratio 0: nothing retained
+    tel.flush()
+    assert tel.requests_total.labels("http").get() == 5  # metrics complete
+
+
+def test_ratio_sampling_deterministic_under_seeded_rng():
+    flags_a = [Telemetry(sample="ratio", sample_ratio=0.5,
+                         rng=random.Random(7)).begin("f", "m").sampled
+               for _ in range(1)]
+    tel_a = Telemetry(sample="ratio", sample_ratio=0.5, rng=random.Random(7))
+    tel_b = Telemetry(sample="ratio", sample_ratio=0.5, rng=random.Random(7))
+    fa = [tel_a.begin("f", "m").sampled for _ in range(32)]
+    fb = [tel_b.begin("f", "m").sampled for _ in range(32)]
+    assert fa == fb and True in fa and False in fa
+    assert flags_a  # smoke: single-shot construction also works
+
+
+def test_slow_only_keeps_only_slow_traces():
+    tel = Telemetry(sample="slow", slow_threshold_s=0.05)
+    fast = tel.begin("http", "m")
+    tel.finish(fast)
+    slow = tel.begin("http", "m")
+    slow.start_ns -= int(0.2e9)  # backdate: a 200 ms request
+    tel.finish(slow)
+    kept = tel.recent_traces()
+    assert len(kept) == 1 and kept[0]["span_id"] == slow.span_id
+
+
+def test_chrome_trace_dump_shape():
+    tel = Telemetry()
+    span = tel.begin("grpc", "simple")
+    now = time.perf_counter_ns()
+    span.phase("serialize", now, now + 1_000)
+    span.event("retry", attempt=0)
+    tel.finish(span)
+    dump = json.loads(tel.dump_json())
+    assert "traceEvents" in dump
+    names = {e["name"] for e in dump["traceEvents"]}
+    assert {"infer simple", "serialize", "retry"} <= names
+    complete = [e for e in dump["traceEvents"] if e["ph"] == "X"]
+    assert all(set(e) >= {"name", "ts", "dur", "pid", "tid"}
+               for e in complete)
+    assert any(e["ph"] == "i" for e in dump["traceEvents"])
+
+
+def test_trace_ring_bounded():
+    tel = Telemetry(trace_capacity=4)
+    for _ in range(10):
+        tel.finish(tel.begin("http", "m"))
+    assert len(tel.recent_traces()) == 4
+    assert tel.tracer.dropped == 6
+
+
+# -- resilience observer ------------------------------------------------------
+def test_attach_counts_retries_fast_fails_and_transitions():
+    tel = Telemetry()
+    breaker = CircuitBreaker(
+        failure_threshold=0.5, window=4, min_calls=2, recovery_time_s=30.0)
+    policy = tel.attach(ResiliencePolicy(
+        retry=RetryPolicy(max_attempts=2, initial_backoff_s=0.0,
+                          max_backoff_s=0.0, jitter=False),
+        breaker=breaker,
+    ))
+
+    def boom():
+        raise ConnectionRefusedError("nope")
+
+    # first call: attempt + one retry, both fail -> window [F, F] -> OPEN
+    with pytest.raises(ConnectionRefusedError):
+        policy.execute(boom)
+    # breaker open -> the next call sheds without touching boom()
+    from client_tpu.resilience import CircuitOpenError
+
+    with pytest.raises(CircuitOpenError):
+        policy.execute(boom)
+    assert tel.retries_total.get() == 1
+    assert tel.fast_fails_total.get() == 1
+    assert tel.breaker_transitions_total.labels("open").get() == 1
+    # lock-free stats read still matches
+    assert policy.stats.as_dict()["retries"] == 1
+
+
+# -- traceparent e2e ----------------------------------------------------------
+def test_traceparent_e2e_threaded_http():
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            expected, inputs = _simple_inputs(httpclient)
+            result = client.infer("simple", inputs, request_id="tp-http")
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), expected)
+    trace = tel.recent_traces()[-1]
+    records = [r for r in core.access_records() if r["request_id"] == "tp-http"]
+    assert len(records) == 1
+    record = records[0]
+    assert record["trace_id"] == trace["trace_id"]
+    assert record["client_span_id"] == trace["span_id"]
+    assert record["server_span_id"] != trace["span_id"]
+    assert record["compute_ns"] > 0 and record["total_ns"] > 0
+    phases = {p["name"] for p in trace["phases"]}
+    assert {"serialize", "ttfb", "recv", "deserialize", "attempt"} <= phases
+
+
+def test_traceparent_e2e_aio_pair():
+    import client_tpu.http.aio as aioclient
+
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    server = AioHttpInferenceServer(core).start()
+    try:
+        async def drive():
+            async with aioclient.InferenceServerClient(server.url) as client:
+                client.configure_telemetry(tel)
+                expected, inputs = _simple_inputs(aioclient)
+                result = await client.infer(
+                    "simple", inputs, request_id="tp-aio")
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), expected)
+
+        asyncio.run(drive())
+    finally:
+        server.stop()
+    trace = tel.recent_traces()[-1]
+    records = [r for r in core.access_records() if r["request_id"] == "tp-aio"]
+    assert len(records) == 1
+    assert records[0]["trace_id"] == trace["trace_id"]
+    assert records[0]["client_span_id"] == trace["span_id"]
+    phases = {p["name"] for p in trace["phases"]}
+    assert {"serialize", "ttfb", "recv", "deserialize"} <= phases
+
+
+def test_traceparent_e2e_grpc():
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    with GrpcInferenceServer(core) as server:
+        with grpcclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            expected, inputs = _simple_inputs(grpcclient)
+            result = client.infer("simple", inputs, request_id="tp-grpc")
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), expected)
+    trace = tel.recent_traces()[-1]
+    records = [r for r in core.access_records() if r["request_id"] == "tp-grpc"]
+    assert len(records) == 1
+    assert records[0]["trace_id"] == trace["trace_id"]
+    assert records[0]["client_span_id"] == trace["span_id"]
+
+
+def test_untraced_request_leaves_no_access_record():
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            _, inputs = _simple_inputs(httpclient)
+            client.infer("simple", inputs)  # no telemetry configured
+    assert core.access_records() == []
+
+
+# -- server /metrics ----------------------------------------------------------
+def test_server_metrics_endpoint_threaded_and_aio():
+    http = urllib3.PoolManager()
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            _, inputs = _simple_inputs(httpclient)
+            client.infer("simple", inputs)
+        resp = http.request("GET", f"http://{server.url}/metrics",
+                            retries=False)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.data.decode()
+        _assert_prometheus_conformant(text)
+        assert "client_tpu_server_ready 1" in text
+        assert 'client_tpu_server_inference_count{model="simple"} 1' in text
+
+    core = ServerCore(default_model_zoo())
+    server = AioHttpInferenceServer(core).start()
+    try:
+        resp = http.request("GET", f"http://{server.url}/metrics",
+                            retries=False)
+        assert resp.status == 200
+        _assert_prometheus_conformant(resp.data.decode())
+        assert "client_tpu_server_live 1" in resp.data.decode()
+    finally:
+        server.stop()
+
+
+# -- pool event bridge --------------------------------------------------------
+@pytest.mark.chaos_smoke
+def test_event_bridge_counts_each_ejection_exactly_once():
+    """An EndpointEjected chaos run: the telemetry ejection counter equals
+    the number of EndpointEjected events delivered to the user callback —
+    exactly once per event, with the chained callback still invoked."""
+    core = ServerCore(default_model_zoo())
+    seen = []
+    tel = Telemetry()
+    with HttpInferenceServer(core) as server:
+        dead = f"127.0.0.1:{_dead_port()}"
+        client = PoolClient(
+            [dead, server.url], protocol="http",
+            health_interval_s=None,  # passive-only: ejection must do it
+            eject_after=2, base_ejection_s=30.0,
+            rng=SEEDED_RNG(), telemetry=tel,
+            on_event=seen.append,
+        )
+        try:
+            expected, inputs = _simple_inputs(httpclient)
+            for _ in range(8):
+                result = client.infer("simple", inputs)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), expected)
+        finally:
+            client.close()
+    ejections = [e for e in seen if isinstance(e, EndpointEjected)]
+    assert len(ejections) >= 1
+    assert all(e.url == dead for e in ejections)
+    assert tel.pool_ejections_total.labels(dead).get() == len(ejections)
+    assert tel.pool_ejections_total.labels(server.url).get() == 0
+
+
+def test_pool_endpoint_stats_surface_in_scrape():
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    with HttpInferenceServer(core) as server:
+        client = PoolClient(
+            [server.url], protocol="http", health_interval_s=None,
+            rng=SEEDED_RNG(), telemetry=tel,
+        )
+        try:
+            _, inputs = _simple_inputs(httpclient)
+            client.infer("simple", inputs)
+            text = tel.registry.prometheus_text()
+        finally:
+            client.close()
+    _assert_prometheus_conformant(text)
+    url = server.url
+    assert f'client_tpu_pool_endpoint_healthy{{url="{url}"}} 1' in text
+    assert f'client_tpu_pool_endpoint_ejected{{url="{url}"}} 0' in text
+    assert f'client_tpu_pool_endpoint_breaker_state{{url="{url}"}} 0' in text
+    # the endpoint client traces through the shared telemetry too
+    assert "client_tpu_requests_total" in text
+    assert tel.recent_traces(), "pool endpoint clients must trace requests"
+
+
+# -- observability chaos smoke ------------------------------------------------
+@pytest.mark.chaos_smoke
+@pytest.mark.observe_smoke
+def test_observe_smoke_flap_chaos_counters():
+    """The CI observability smoke (tools/chaos_smoke.sh): flap chaos with
+    telemetry on — retry and breaker counters must be non-zero and no
+    exported metric may go negative."""
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry(sample="always")
+    with HttpInferenceServer(core) as server:
+        proxy = ChaosProxy("127.0.0.1", server.port).start()
+        try:
+            client = httpclient.InferenceServerClient(proxy.url)
+            client.configure_telemetry(tel)
+            tel.attach(client.configure_resilience(ResiliencePolicy(
+                retry=RetryPolicy(max_attempts=4, initial_backoff_s=0.01,
+                                  max_backoff_s=0.05, rng=SEEDED_RNG()),
+                breaker=CircuitBreaker(
+                    failure_threshold=0.5, window=4, min_calls=2,
+                    recovery_time_s=0.2),
+            )).resilience_policy())
+            expected, inputs = _simple_inputs(httpclient)
+            result = client.infer("simple", inputs, client_timeout=5.0)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), expected)
+            completed = 1
+            # flap every new connection, and RST the live keep-alive one so
+            # every reconnect attempt lands in the flap
+            proxy.fault = Fault("flap", every=1)
+            proxy.reset_active()
+            for _ in range(6):
+                try:
+                    client.infer("simple", inputs, client_timeout=5.0)
+                    completed += 1
+                except Exception:
+                    pass  # open-breaker sheds are part of the exercise
+            proxy.heal()
+            time.sleep(0.25)  # recovery window -> half-open probe
+            for _ in range(3):
+                try:
+                    client.infer("simple", inputs, client_timeout=5.0)
+                    completed += 1
+                except Exception:
+                    pass
+            client.close()
+        finally:
+            proxy.stop()
+    assert completed > 0
+    assert tel.retries_total.get() > 0, "flap chaos must drive retries"
+    breaker_activity = (
+        tel.fast_fails_total.get()
+        + sum(series["value"] for series in tel.registry.snapshot()[
+            "client_tpu_breaker_transitions_total"]["series"]))
+    assert breaker_activity > 0, "breaker counters must move under flap"
+    snap = tel.registry.snapshot()
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            for key, value in obj.items():
+                if key in ("value", "count", "sum"):
+                    assert not (isinstance(value, (int, float))
+                                and value < 0), (key, value, obj)
+                walk(value)
+        elif isinstance(obj, list):
+            for item in obj:
+                walk(item)
+
+    walk(snap)

@@ -913,6 +913,64 @@ def test_draining_replica_ejected_without_errors():
     assert errors == [], errors
 
 
+def test_threaded_server_metrics_and_health_respond_while_draining():
+    """Regression: during close() — drain window AND while in-flight
+    requests finish — the threaded server must keep answering /metrics and
+    the health routes on FRESH connections (live=200, ready=503), so a
+    scraper sees the drain happen instead of connection errors. Before the
+    fix the listener shut down before in-flight requests drained."""
+    import urllib3
+
+    core = ServerCore(default_model_zoo())
+    server = HttpInferenceServer(core).start()
+    model = core.model("simple")
+    orig_execute = model.execute
+
+    def slow_execute(inputs, params):
+        time.sleep(0.8)  # holds the in-flight counter through close()
+        return orig_execute(inputs, params)
+
+    model.execute = slow_execute
+    http = urllib3.PoolManager(timeout=urllib3.Timeout(connect=1, read=2))
+    infer_errors = []
+    expected, inputs = _simple_inputs(httpclient)
+
+    def slow_infer():
+        try:
+            with httpclient.InferenceServerClient(server.url) as client:
+                result = client.infer("simple", inputs)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), expected)
+        except Exception as e:  # pragma: no cover
+            infer_errors.append(str(e))
+
+    worker = threading.Thread(target=slow_infer)
+    closer = None
+    try:
+        worker.start()
+        time.sleep(0.2)  # the slow request is in flight
+        closer = threading.Thread(target=server.close, args=(0.05,))
+        closer.start()
+        time.sleep(0.2)  # inside close(): drained, waiting on in-flight
+        base = f"http://{server.url}"
+        live = http.request("GET", base + "/v2/health/live", retries=False)
+        ready = http.request("GET", base + "/v2/health/ready", retries=False)
+        metrics = http.request("GET", base + "/metrics", retries=False)
+        assert live.status == 200
+        assert ready.status == 503, "draining server must be live-not-ready"
+        assert metrics.status == 200
+        text = metrics.data.decode()
+        assert "client_tpu_server_live 1" in text
+        assert "client_tpu_server_ready 0" in text, \
+            "the scrape must show the drain"
+    finally:
+        worker.join(timeout=10)
+        if closer is not None:
+            closer.join(timeout=15)
+        server.stop()
+    assert infer_errors == [], infer_errors
+
+
 def test_drain_flips_ready_on_all_three_servers():
     """drain() flips ready (not live) on the threaded-HTTP, aio-HTTP and
     GRPC frontends while requests keep serving."""

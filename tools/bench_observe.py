@@ -1,0 +1,255 @@
+"""Generate BENCH_OBSERVE.json: the telemetry cost + join-proof artifact.
+
+Three questions, answered against live in-process servers:
+
+1. **Hot-path overhead, microbenchmarked** — the per-call cost of the
+   telemetry span lifecycle in isolation (begin + 4 phase marks + finish,
+   metrics on, tracer on the slow-only path so the ring never writes),
+   versus the disabled path (the single attribute check every frontend
+   performs when no telemetry is configured). This is the honest
+   <2 µs/call acceptance number, decoupled from network noise.
+2. **End-to-end A/B** — the same HTTP workload through a bare client,
+   through a telemetry-armed client (sample=slow: metrics on, tracer off
+   the hot path), and through a bare client again (the rerun bounds the
+   container's run-to-run noise floor, so the delta can be read against
+   it instead of being mistaken for signal).
+3. **Trace join proof** — one traced request per frontend pair (HTTP
+   sync, GRPC sync) showing the client span's phases and the server-side
+   access record joined on the same trace id.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_observe.py [-o BENCH_OBSERVE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def bench_hot_path(n: int = 20_000, repeats: int = 12) -> dict:
+    """µs/call of the enabled telemetry span lifecycle vs the disabled
+    attribute check. min-of-repeats: the container's scheduler noise is
+    bigger than the thing being measured, so the minimum is the honest
+    estimate of the code's cost."""
+    import timeit
+
+    from client_tpu.observe import Telemetry
+
+    # enabled, sampling off the slow path: the trace ring is written only
+    # for requests slower than the threshold, finished spans queue on a
+    # lock-free deque and fold into the histograms on the SCRAPER's thread
+    tel = Telemetry(sample="slow", slow_threshold_s=3600.0)
+    perf_ns = time.perf_counter_ns
+    g = {"tel": tel, "perf_ns": perf_ns}
+
+    def best(stmt: str) -> float:
+        out = []
+        for _ in range(repeats):
+            out.append(timeit.Timer(stmt, globals=g).timeit(n) / n * 1e6)
+            tel._pending.clear()  # keep the backlog fold out of the lane
+        return min(out)
+
+    # the per-request instrumentation: begin + 4 phase marks + finish.
+    # Timestamps are pre-captured (the sync frontends already capture
+    # RequestTimers for InferStat with telemetry OFF, so they are not a
+    # marginal cost there); the fresh-timestamp variant prices the aio
+    # frontends, which capture ns only when telemetry is on.
+    enabled_us = best(
+        "s = tel.begin('http', 'simple')\n"
+        "s.phase('serialize', 1, 2)\n"
+        "s.phase('ttfb', 1, 2)\n"
+        "s.phase('recv', 1, 2)\n"
+        "s.phase('deserialize', 1, 2)\n"
+        "tel.finish(s)")
+    enabled_fresh_ts_us = best(
+        "s = tel.begin('http', 'simple')\n"
+        "t = perf_ns()\n"
+        "s.phase('serialize', t, perf_ns())\n"
+        "s.phase('ttfb', t, perf_ns())\n"
+        "s.phase('recv', t, perf_ns())\n"
+        "s.phase('deserialize', t, perf_ns())\n"
+        "tel.finish(s)")
+    with_traceparent_us = best(
+        "s = tel.begin('http', 'simple')\n"
+        "h = s.traceparent()\n"
+        "s.phase('serialize', 1, 2)\n"
+        "s.phase('ttfb', 1, 2)\n"
+        "s.phase('recv', 1, 2)\n"
+        "s.phase('deserialize', 1, 2)\n"
+        "tel.finish(s)")
+
+    # scrape-side fold cost (runs on the scraper's thread, not the request
+    # path): fill a backlog, time one flush
+    tel._pending.clear()
+    fold_n = min(n, 20_000)  # stay under the inline-fold backlog bound
+    for _ in range(fold_n):
+        s = tel.begin("http", "simple")
+        s.phase("serialize", 1, 2)
+        s.phase("ttfb", 1, 2)
+        s.phase("recv", 1, 2)
+        s.phase("deserialize", 1, 2)
+        tel.finish(s)
+    t0 = time.perf_counter()
+    tel.flush()
+    fold_us = (time.perf_counter() - t0) / fold_n * 1e6
+
+    # the disabled path every frontend runs with no telemetry configured:
+    # one attribute load + None check, then nothing
+    class _Client:
+        _telemetry = None
+
+        def _obs_begin(self, frontend, model):
+            t = self._telemetry
+            if t is None:
+                return None
+            return t.begin(frontend, model)
+
+    g["client"] = _Client()
+    disabled_us = best("client._obs_begin('http', 'simple')")
+
+    return {
+        "calls_per_repeat": n,
+        "repeats": repeats,
+        "enabled_us_per_call": round(enabled_us, 4),
+        "enabled_fresh_timestamps_us_per_call": round(
+            enabled_fresh_ts_us, 4),
+        "enabled_with_traceparent_us_per_call": round(
+            with_traceparent_us, 4),
+        "scrape_side_fold_us_per_record": round(fold_us, 4),
+        "disabled_us_per_call": round(disabled_us, 4),
+        "note": (
+            "enabled = begin + 4 phase marks + finish, slow-only sampling "
+            "(ring off the hot path), histogram fold deferred to the "
+            "scraper's thread; disabled = the frontends' telemetry-is-None "
+            "check"
+        ),
+    }
+
+
+def bench_e2e(requests: int) -> dict:
+    """Bare vs telemetry-armed HTTP client against a live threaded server,
+    with a bare rerun bounding the A/B noise floor."""
+    from client_tpu.models import default_model_zoo
+    from client_tpu.perf import PerfRunner
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    server = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    try:
+        def measure(observe: bool):
+            # sample=slow: the A/B benchmarks metrics-on/tracer-off-hot-path
+            # (the production posture), not ring writes
+            runner = PerfRunner(server.url, "http", "simple",
+                                observe=observe, observe_sample="slow")
+            try:
+                runner.run(1, 50)  # warmup
+                return runner.run(1, requests)
+            finally:
+                runner.close()
+
+        out = {
+            "bare_client": measure(False),
+            "observed_client": measure(True),
+            "bare_client_rerun": measure(False),
+        }
+        bare_avgs = [out["bare_client"]["latency_ms"]["avg"],
+                     out["bare_client_rerun"]["latency_ms"]["avg"]]
+        bare_avg = sum(bare_avgs) / 2
+        observed_avg = out["observed_client"]["latency_ms"]["avg"]
+        out["enabled_overhead_us_per_call"] = round(
+            (observed_avg - bare_avg) * 1000.0, 2)
+        out["ab_noise_floor_us"] = round(
+            abs(bare_avgs[0] - bare_avgs[1]) * 1000.0, 2)
+        return out
+    finally:
+        server.stop()
+
+
+def trace_join() -> dict:
+    """One traced request per frontend pair: client phases + the server's
+    access record joined on the same trace id."""
+    import numpy as np
+
+    import client_tpu.grpc as grpcclient
+    import client_tpu.http as httpclient
+    from client_tpu.models import default_model_zoo
+    from client_tpu.observe import Telemetry
+    from client_tpu.server import (
+        GrpcInferenceServer,
+        HttpInferenceServer,
+        ServerCore,
+    )
+
+    out = {}
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    for proto, mod, server_cls in (
+        ("http", httpclient, HttpInferenceServer),
+        ("grpc", grpcclient, GrpcInferenceServer),
+    ):
+        core = ServerCore(default_model_zoo())
+        server = server_cls(core).start()
+        tel = Telemetry(sample="always")
+        client = mod.InferenceServerClient(server.url).configure_telemetry(tel)
+        try:
+            in0 = mod.InferInput("INPUT0", [1, 16], "INT32")
+            in0.set_data_from_numpy(a)
+            in1 = mod.InferInput("INPUT1", [1, 16], "INT32")
+            in1.set_data_from_numpy(b)
+            client.infer("simple", [in0, in1],
+                         request_id=f"bench-observe-{proto}")
+            trace = tel.recent_traces()[-1]
+            record = core.access_records()[-1]
+            out[proto] = {
+                "client_span": trace,
+                "server_access_record": record,
+                "joined": (record["trace_id"] == trace["trace_id"]
+                           and record["client_span_id"] == trace["span_id"]),
+            }
+        finally:
+            client.close()
+            server.stop()
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_OBSERVE.json")
+    parser.add_argument("--requests", type=int, default=600)
+    parser.add_argument(
+        "--micro-calls", type=int, default=20_000,
+        help="calls per microbench repeat; keep under the telemetry "
+             "inline-fold backlog (32768) so the deferred fold stays on "
+             "the scraper's side of the measurement",
+    )
+    args = parser.parse_args()
+
+    out = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "note": (
+            "telemetry hot-path microbench (the <2 µs/call acceptance "
+            "number), end-to-end A/B vs a bare client with a rerun noise "
+            "floor, and one traced request per frontend pair joined to "
+            "its server-side access record on the same trace id"
+        ),
+        "hot_path": bench_hot_path(args.micro_calls),
+        "e2e": bench_e2e(args.requests),
+        "trace_join": trace_join(),
+    }
+
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,18 @@
 #!/usr/bin/env bash
-# Fast chaos validation: the resilience + pool chaos subset (<60 s), so a
-# resilience-layer change can be smoke-checked without the full suite or
-# the soak tier. The same tests run inside tier-1 (the chaos_smoke marker
-# is registered in pyproject and NOT excluded by addopts).
+# Fast chaos validation: the resilience + pool chaos subset plus the
+# observability smoke (<60 s), so a resilience- or telemetry-layer change
+# can be smoke-checked without the full suite or the soak tier. The same
+# tests run inside tier-1 (the chaos_smoke/observe_smoke markers are
+# registered in pyproject and NOT excluded by addopts).
+#
+# The observability smoke (tests/test_observe.py) runs flap chaos with
+# telemetry on and asserts retry/breaker counters are non-zero and no
+# exported metric goes negative.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest -q -m chaos_smoke \
-    -p no:cacheprovider tests/test_resilience.py tests/test_pool.py "$@"
+exec env JAX_PLATFORMS=cpu python -m pytest -q \
+    -m 'chaos_smoke or observe_smoke' \
+    -p no:cacheprovider \
+    tests/test_resilience.py tests/test_pool.py tests/test_observe.py "$@"
