@@ -80,6 +80,16 @@ class InferenceServerClientBase:
             return None
         return tel.begin(frontend, model)
 
+    def _obs_begin_stream(self, frontend: str, model: str,
+                          op: str = "generate_stream"):
+        """A stream span when telemetry is configured, else None — the
+        streaming twin of ``_obs_begin`` (SSE generate streams and GRPC
+        bidi streams)."""
+        tel = self._telemetry
+        if tel is None:
+            return None
+        return tel.begin_stream(frontend, model, op)
+
     # -- resilience ---------------------------------------------------------
     def configure_resilience(self, policy) -> "InferenceServerClientBase":
         """Install a ``resilience.ResiliencePolicy`` (or None to clear) that

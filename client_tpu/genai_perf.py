@@ -55,9 +55,13 @@ def _summary(values: List[float]) -> Dict[str, float]:
 
 
 class _Session:
-    """Per-session measurement record (all times perf_counter seconds)."""
+    """Per-session measurement record (all times perf_counter seconds).
 
-    __slots__ = ("start", "first", "last", "tokens", "error")
+    ``tel_ttft``/``tel_itl`` (ms) are the StreamSpan-sourced twins of the
+    stopwatch measurements, populated when telemetry is armed."""
+
+    __slots__ = ("start", "first", "last", "tokens", "error",
+                 "tel_ttft", "tel_itl")
 
     def __init__(self):
         self.start = 0.0
@@ -65,6 +69,8 @@ class _Session:
         self.last = 0.0
         self.tokens = 0
         self.error: Optional[str] = None
+        self.tel_ttft: Optional[float] = None
+        self.tel_itl: Optional[float] = None
 
 
 class GenAiPerfRunner:
@@ -72,13 +78,18 @@ class GenAiPerfRunner:
 
     def __init__(self, url: str, model_name: str, mode: str,
                  prompt_tokens: int, output_tokens: int, chunk: int = 1,
-                 vocab: int = 256, seed: int = 0):
+                 vocab: int = 256, seed: int = 0, observe: bool = False):
         if mode not in ("decoupled", "sequence", "generate"):
             raise ValueError(f"unknown mode {mode!r}")
         if output_tokens < 1:
             raise ValueError("output_tokens must be >= 1")
         if prompt_tokens < 1:
             raise ValueError("prompt_tokens must be >= 1")
+        if observe and mode == "sequence":
+            # sequence mode's cleanup send can land a late response after
+            # the session's mark window is read — the stopwatch stays the
+            # only honest source there
+            raise ValueError("--observe supports decoupled/generate modes")
         self.url = url
         self.model_name = model_name
         self.mode = mode
@@ -87,6 +98,14 @@ class GenAiPerfRunner:
         self.chunk = chunk
         self.vocab = vocab
         self.seed = seed
+        self.telemetry = None
+        if observe:
+            from .observe import Telemetry
+
+            # sample=off: per-session readings come straight from the
+            # client's StreamSpan handle; the ring is not needed and a
+            # long sweep must not grow it
+            self.telemetry = Telemetry(sample="off")
 
     # -- one session ---------------------------------------------------------
     def _prompt(self, rng: np.random.Generator) -> np.ndarray:
@@ -105,7 +124,13 @@ class GenAiPerfRunner:
             np.array([self.output_tokens], dtype=np.int32))
         params = {"chunk": self.chunk} if self.chunk != 1 else None
 
+        # telemetry window: the stream's span marks every response; this
+        # session's marks are the ones appended after n0 (sessions run
+        # sequentially per worker stream)
+        span = client.stream_span() if self.telemetry is not None else None
+        n0 = span.chunk_count if span is not None else 0
         sess.start = time.perf_counter()
+        start_ns = time.perf_counter_ns()
         client.async_stream_infer(
             self.model_name, [tokens_in, max_in],
             enable_empty_final_response=True,
@@ -119,11 +144,19 @@ class GenAiPerfRunner:
                 return
             if result.is_final_response() and result.is_null_response():
                 sess.last = sess.last or now
-                return
+                break
             if sess.first is None:
                 sess.first = now
             sess.last = now
             sess.tokens += 1
+        if span is not None and sess.tokens:
+            # marks include the empty final-response frame: the session's
+            # token marks are the first `tokens` entries of its window
+            marks = span.marks_ns()[n0:][:sess.tokens]
+            if marks:
+                sess.tel_ttft = (marks[0] - start_ns) / 1e6
+            if len(marks) > 1:
+                sess.tel_itl = (marks[-1] - marks[0]) / 1e6 / (len(marks) - 1)
 
     def _run_generate_session(self, client, sess: _Session,
                               rng: np.random.Generator) -> None:
@@ -151,6 +184,17 @@ class GenAiPerfRunner:
                 sess.first = now
             sess.last = now
             sess.tokens += 1
+        if self.telemetry is not None:
+            # single source of truth: the session IS one StreamSpan —
+            # TTFT/ITL come from its marks, not this loop's stopwatch
+            span = client.last_stream_span()
+            if span is not None:
+                ttfts = span.ttft_ms_per_attempt()
+                if ttfts:
+                    sess.tel_ttft = ttfts[0]
+                itls = span.itl_values_ms()
+                if itls:
+                    sess.tel_itl = sum(itls) / len(itls)
 
     def _run_sequence_session(self, client, InferInput, sess: _Session,
                               responses: "queue.Queue", sequence_id: int,
@@ -229,8 +273,14 @@ class GenAiPerfRunner:
                     from .http import InferenceServerClient as HttpClient
 
                     client = HttpClient(self.url)
+                    if self.telemetry is not None:
+                        client.configure_telemetry(self.telemetry)
                 else:
                     client = InferenceServerClient(self.url)
+                    if self.telemetry is not None:
+                        # before start_stream: the stream span must exist
+                        # from the first session's first response
+                        client.configure_telemetry(self.telemetry)
                     client.start_stream(
                         lambda result, error: holder["q"].put((result, error)))
             except Exception as e:
@@ -317,7 +367,7 @@ class GenAiPerfRunner:
             if s.tokens > 1:
                 itl_ms.append((s.last - s.first) * 1e3 / (s.tokens - 1))
         total_tokens = sum(s.tokens for s in ok)
-        return {
+        result = {
             "mode": self.mode,
             "model": self.model_name,
             "concurrency": concurrency,
@@ -335,6 +385,46 @@ class GenAiPerfRunner:
             "output_tokens_per_sec": round(total_tokens / wall, 1) if wall else 0.0,
             "requests_per_sec": round(len(ok) / wall, 2) if wall else 0.0,
         }
+        if self.telemetry is not None:
+            self._telemetry_result(result, ok, ttft_ms, itl_ms)
+        return result
+
+    # the stopwatch re-measures what the StreamSpan already recorded: its
+    # only job with telemetry armed is to BOUND the span's numbers. Agree-
+    # ment within the floor validates both; divergence beyond it flags a
+    # broken clock path, not noise.
+    TELEMETRY_NOISE_FLOOR_MS = 2.0
+    TELEMETRY_NOISE_FLOOR_FRAC = 0.10
+
+    def _telemetry_result(self, result: Dict[str, Any], ok: List[_Session],
+                          sw_ttft: List[float], sw_itl: List[float]) -> None:
+        """Emit the StreamSpan-sourced TTFT/ITL as the headline numbers
+        (single source of truth), keep the stopwatch twins for the A/B,
+        and flag divergence beyond the noise floor."""
+        tel_ttft = [s.tel_ttft for s in ok if s.tel_ttft is not None]
+        tel_itl = [s.tel_itl for s in ok if s.tel_itl is not None]
+        if not tel_ttft:
+            result["telemetry_source"] = None
+            return
+        result["telemetry_source"] = "stream_span"
+        result["ttft_ms_stopwatch"] = result["ttft_ms"]
+        result["inter_token_ms_stopwatch"] = result["inter_token_ms"]
+        result["ttft_ms"] = _summary(tel_ttft)
+        result["inter_token_ms"] = _summary(tel_itl)
+        divergence = {}
+        warned = False
+        for key, sw in (("ttft_p50_ms", result["ttft_ms_stopwatch"]),
+                        ("itl_p50_ms", result["inter_token_ms_stopwatch"])):
+            tel_summary = (result["ttft_ms"] if key.startswith("ttft")
+                           else result["inter_token_ms"])
+            delta = round(tel_summary["p50"] - sw["p50"], 3)
+            divergence[key] = delta
+            floor = max(self.TELEMETRY_NOISE_FLOOR_MS,
+                        self.TELEMETRY_NOISE_FLOOR_FRAC * abs(sw["p50"]))
+            if abs(delta) > floor:
+                warned = True
+        result["telemetry_divergence_ms"] = divergence
+        result["telemetry_warning"] = warned
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -363,6 +453,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--chunk", type=int, default=1,
                         help="tokens per device dispatch (decoupled mode)")
     parser.add_argument("--warmup-sessions", type=int, default=2)
+    parser.add_argument(
+        "--observe", action="store_true",
+        help="arm client telemetry and source TTFT/ITL from the "
+             "StreamSpan instead of this tool's stopwatch (both are "
+             "emitted; divergence beyond the noise floor is flagged). "
+             "decoupled/generate modes only")
     parser.add_argument("-f", "--format", choices=("table", "json"),
                         default="table")
     args = parser.parse_args(argv)
@@ -376,13 +472,20 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     runner = GenAiPerfRunner(
         args.url, model, args.mode, args.prompt_tokens, args.output_tokens,
-        chunk=args.chunk)
+        chunk=args.chunk, observe=args.observe)
     if args.warmup_sessions:
         runner.run(1, args.warmup_sessions)
 
     results = []
     for concurrency in range(start, end + 1, step):
         results.append(runner.run(concurrency, args.sessions))
+
+    for r in results:
+        if r.get("telemetry_warning"):
+            print(
+                f"WARNING: concurrency {r['concurrency']}: StreamSpan vs "
+                f"stopwatch divergence beyond the noise floor: "
+                f"{r['telemetry_divergence_ms']}", file=sys.stderr)
 
     if args.format == "json":
         print(json.dumps(results))

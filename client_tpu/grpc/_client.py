@@ -22,7 +22,7 @@ import grpc
 from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
 from .._tensor import InferInput, InferRequestedOutput
 from ..observe import TRACEPARENT_HEADER
-from ..resilience import FATAL, AttemptBudget, classify_fault
+from ..resilience import FATAL, AttemptBudget, StreamReconnected, classify_fault
 from ..utils import InferenceServerException
 from . import _messages as M
 from ._infer import (
@@ -126,6 +126,7 @@ class InferenceServerClient(InferenceServerClientBase):
             self._channel = grpc.insecure_channel(url, options=options)
         self._callables: Dict[str, Callable] = {}
         self._stream: Optional[_InferStream] = None
+        self._stream_span = None  # Optional[observe.StreamSpan]
         self._stream_lock = threading.Lock()
         self._infer_stat = InferStat()
 
@@ -555,32 +556,77 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise InferenceServerException(
                     "cannot start a stream: one is already active; stop it first"
                 )
+            span = self._obs_begin_stream("grpc", "", op="stream")
+            self._stream_span = span
+            if span is not None:
+                # stream-level traceparent: every request on the bidi call
+                # joins this stream's trace in the server access records,
+                # and it survives reconnects (metadata is recomputed per
+                # re-open from this same headers dict)
+                headers = dict(headers or {})
+                headers[TRACEPARENT_HEADER] = span.traceparent()
+                user_callback = callback
+                mark = span.mark
+                tel_ = self._telemetry
+                stream_box: Dict[str, Any] = {}
+
+                def callback(result, error):
+                    # per-response hot path: one branch + one mark; the
+                    # rare paths (reconnect sub-span, error event) stay off
+                    # the token lane
+                    if error is not None:
+                        span.event("stream_error",
+                                   error=type(error).__name__)
+                        # in-band per-request errors leave the bidi call
+                        # healthy; a TERMINAL error (the stream died and
+                        # won't reconnect) must close the span with the
+                        # error now — stop_stream may never be called, and
+                        # its error-less finish would count a clean stream
+                        inner = stream_box.get("stream")
+                        if inner is None or not inner.is_active():
+                            tel_.finish_stream(span, error=error)
+                    elif type(result) is StreamReconnected:
+                        span.reconnect(
+                            abandoned=len(result.abandoned_request_ids),
+                            resent=len(result.resent_request_ids))
+                    else:
+                        mark()
+                    user_callback(result, error)
+
             compression = to_grpc_compression(compression_algorithm)
-            if auto_reconnect:
-                def open_inner(cb):
-                    inner = _InferStream(cb, self._verbose)
-                    # metadata computed per (re)open: the registered plugin
-                    # must re-stamp auth headers on every reconnect, or an
-                    # hours-later reconnect goes out with an expired token
-                    inner.start(
+            try:
+                if auto_reconnect:
+                    def open_inner(cb):
+                        inner = _InferStream(cb, self._verbose)
+                        # metadata computed per (re)open: the registered
+                        # plugin must re-stamp auth headers on every
+                        # reconnect, or an hours-later reconnect goes out
+                        # with an expired token
+                        inner.start(
+                            self._callable("ModelStreamInfer", streaming=True),
+                            self._metadata(headers), stream_timeout,
+                            compression=compression,
+                        )
+                        return inner
+
+                    stream = _ReconnectingStream(
+                        open_inner, callback, self._resilience_for(resilience),
+                        self._verbose,
+                    )
+                    stream.start()
+                else:
+                    stream = _InferStream(callback, self._verbose)
+                    stream.start(
                         self._callable("ModelStreamInfer", streaming=True),
                         self._metadata(headers), stream_timeout,
                         compression=compression,
                     )
-                    return inner
-
-                stream = _ReconnectingStream(
-                    open_inner, callback, self._resilience_for(resilience),
-                    self._verbose,
-                )
-                stream.start()
-            else:
-                stream = _InferStream(callback, self._verbose)
-                stream.start(
-                    self._callable("ModelStreamInfer", streaming=True),
-                    self._metadata(headers), stream_timeout,
-                    compression=compression,
-                )
+            except BaseException as e:
+                if span is not None and self._telemetry is not None:
+                    self._telemetry.finish_stream(span, error=e)
+                raise
+            if span is not None:
+                stream_box["stream"] = stream
             self._stream = stream
 
     def async_stream_infer(
@@ -618,5 +664,17 @@ class InferenceServerClient(InferenceServerClientBase):
     def stop_stream(self, cancel_requests: bool = False) -> None:
         with self._stream_lock:
             stream, self._stream = self._stream, None
+            # the span attribute survives the stop for post-hoc inspection
+            # (stream_span()); a new start_stream replaces it
+            span = self._stream_span
         if stream is not None:
             stream.close(cancel_requests)
+        tel = self._telemetry
+        if span is not None and tel is not None:
+            tel.finish_stream(span)
+
+    def stream_span(self):
+        """The active (or most recently stopped) stream's StreamSpan —
+        None without telemetry. Harnesses read TTFT/inter-chunk marks from
+        it instead of re-measuring with their own stopwatch."""
+        return self._stream_span

@@ -335,24 +335,33 @@ class _ReconnectingStream:
         if closed_late:
             new_inner.close()
             return
+        event = StreamReconnected(
+            attempt=attempt,
+            resent_request_ids=[p.request["id"] for p in resend],
+            abandoned_request_ids=abandoned,
+            cause=error,
+        )
         observer = getattr(self._policy, "observer", None)
         if observer is not None:
+            # exactly-once telemetry bridge: the observer (observe.
+            # Telemetry) counts the reconnect + abandoned sequences here,
+            # BEFORE the user callback can swallow or re-raise on the
+            # event; the traced callback only annotates the span
             try:
-                observer.on_stream_reconnect()
+                observer.on_stream_reconnect(event)
+            except TypeError:
+                # duck-typed observer protocol: a pre-event observer takes
+                # no arguments — its reconnect accounting must keep firing
+                try:
+                    observer.on_stream_reconnect()
+                except Exception:
+                    pass
             except Exception:
                 pass
         # event BEFORE the resends hit the wire: the app learns which ids
         # are being re-sent before the new reader thread can deliver any of
         # their responses (the new stream carries no requests until below)
-        self._callback(
-            StreamReconnected(
-                attempt=attempt,
-                resent_request_ids=[p.request["id"] for p in resend],
-                abandoned_request_ids=abandoned,
-                cause=error,
-            ),
-            None,
-        )
+        self._callback(event, None)
         for pending in resend:
             pending.sent = True  # on the wire the instant the put lands
             try:

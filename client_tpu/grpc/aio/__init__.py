@@ -370,7 +370,18 @@ class InferenceServerClient(InferenceServerClientBase):
         ``build_infer_request`` (model_name, inputs, sequence_id, ...).
         The returned async iterator has a ``cancel()`` via the underlying
         call (raises asyncio.CancelledError in the consumer).
+
+        With telemetry configured the stream is traced as a
+        ``StreamSpan`` (open -> first-response TTFT -> per-response marks
+        -> EOF/cancel/error) and a stream-level ``traceparent`` metadata
+        key joins every request on the call to the server's access
+        records.
         """
+        span = self._obs_begin_stream("grpc_aio", "", op="stream")
+        self._last_stream_span = span
+        if span is not None:
+            headers = dict(headers or {})
+            headers[TRACEPARENT_HEADER] = span.traceparent()
 
         async def request_gen():
             async for kwargs in inputs_iterator:
@@ -392,10 +403,18 @@ class InferenceServerClient(InferenceServerClientBase):
         class _ResponseIterator:
             """Async iterator of (result, error) pairs with ``cancel()``."""
 
-            def __init__(self, rpc_call):
+            def __init__(self, rpc_call, stream_span, telemetry):
                 self._call = rpc_call
+                self._span = stream_span
+                self._telemetry = telemetry
+
+            def _finish(self, error=None, abandoned=False):
+                if self._span is not None and self._telemetry is not None:
+                    self._telemetry.finish_stream(
+                        self._span, error=error, abandoned=abandoned)
 
             def cancel(self) -> bool:
+                self._finish(abandoned=True)
                 return self._call.cancel()
 
             def __aiter__(self):
@@ -406,13 +425,27 @@ class InferenceServerClient(InferenceServerClientBase):
                     response = await self._call.read()
                 except grpc.aio.AioRpcError as e:
                     if e.code() == grpc.StatusCode.CANCELLED:
+                        self._finish(abandoned=True)
                         raise StopAsyncIteration
-                    raise _to_exception(e) from e
+                    err = _to_exception(e)
+                    self._finish(error=err)
+                    raise err from e
                 if response is grpc.aio.EOF:
+                    self._finish()
                     raise StopAsyncIteration
                 err = response.get("error_message")
                 if err:
+                    if self._span is not None:
+                        self._span.event(
+                            "stream_error", error="InferenceServerException")
                     return None, InferenceServerException(err)
+                if self._span is not None:
+                    self._span.mark()
                 return InferResult(response.get("infer_response", {})), None
 
-        return _ResponseIterator(call)
+        return _ResponseIterator(call, span, self._telemetry)
+
+    def stream_span(self):
+        """The most recent ``stream_infer``'s StreamSpan (None without
+        telemetry)."""
+        return getattr(self, "_last_stream_span", None)

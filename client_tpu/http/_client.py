@@ -714,61 +714,101 @@ class InferenceServerClient(InferenceServerClientBase):
         """Iterator over generate-extension SSE events, one dict per
         streamed response. Abandoning the iterator mid-stream closes the
         connection, which the server accounts as a client cancel (the
-        cancel stats bucket), not a success. In-band error events raise."""
+        cancel stats bucket), not a success. In-band error events raise.
+
+        With telemetry configured the stream is traced as a
+        ``StreamSpan`` (open -> first-event TTFT -> per-event marks ->
+        close/error/abandon) and a ``traceparent`` header joins it to the
+        server's access record for the generation."""
         hdrs = dict(headers or {})
+        span = self._obs_begin_stream("http", model_name)
+        self._last_stream_span = span
+        if span is not None:
+            hdrs[TRACEPARENT_HEADER] = span.traceparent()
         request = Request(hdrs)
         self._call_plugin(request)
         uri = "/" + self._generate_path(model_name, model_version, stream=True)
         if query_params:
             uri += "?" + urlencode(query_params)
+        tel = self._telemetry
         try:
-            # no read deadline: generation streams for as long as it
-            # streams (matches the aio twin's ClientTimeout(total=None));
-            # the pool's connect timeout still applies
-            resp = self._pool.request(
-                "POST", uri,
-                body=self._generate_payload(inputs, request_id, parameters),
-                headers=request.headers, preload_content=False,
-                timeout=urllib3.Timeout(
-                    connect=self._timeout.connect_timeout, read=None),
-            )
-        except urllib3.exceptions.HTTPError as e:
-            raise InferenceServerException(f"connection error: {e}") from e
-        exhausted = False
-        try:
-            if resp.status != 200:
-                try:
-                    data = resp.read(decode_content=True)
-                except urllib3.exceptions.HTTPError as e:
-                    raise InferenceServerException(
-                        f"connection error: {e}") from e
-                raise_if_error(resp.status, data)
-                raise InferenceServerException(
-                    f"unexpected generate_stream status {resp.status}")
-            # SSEDecoder: CRLF-framed servers stream event-by-event (a bare
-            # \n\n split would buffer them to EOF), multi-line data: fields
-            # join per the SSE spec, and a final event whose terminating
-            # blank line never arrived is flushed, not dropped
-            decoder = SSEDecoder()
             try:
-                for chunk in resp.stream(8192, decode_content=True):
-                    for payload in decoder.feed(chunk):
-                        yield parse_sse_event(payload)
-                for payload in decoder.flush():
-                    yield parse_sse_event(payload)
+                # no read deadline: generation streams for as long as it
+                # streams (matches the aio twin's ClientTimeout(total=None));
+                # the pool's connect timeout still applies
+                resp = self._pool.request(
+                    "POST", uri,
+                    body=self._generate_payload(
+                        inputs, request_id, parameters),
+                    headers=request.headers, preload_content=False,
+                    timeout=urllib3.Timeout(
+                        connect=self._timeout.connect_timeout, read=None),
+                )
             except urllib3.exceptions.HTTPError as e:
-                # server died mid-stream etc. — keep the client's typed
-                # exception contract (the aio twin wraps ClientError)
                 raise InferenceServerException(
                     f"connection error: {e}") from e
-            exhausted = True
-        finally:
-            if exhausted:
-                # fully-drained chunked body: the connection is reusable —
-                # back to the pool, so per-session TTFT doesn't pay a fresh
-                # TCP handshake (genai_perf generate-mode bias)
-                resp.release_conn()
-            else:
-                # close (not release): an abandoned stream must tear the
-                # connection down so the server sees the disconnect
-                resp.close()
+            exhausted = False
+            try:
+                if resp.status != 200:
+                    try:
+                        data = resp.read(decode_content=True)
+                    except urllib3.exceptions.HTTPError as e:
+                        raise InferenceServerException(
+                            f"connection error: {e}") from e
+                    raise_if_error(resp.status, data)
+                    raise InferenceServerException(
+                        f"unexpected generate_stream status {resp.status}")
+                # SSEDecoder: CRLF-framed servers stream event-by-event (a
+                # bare \n\n split would buffer them to EOF), multi-line
+                # data: fields join per the SSE spec, and a final event
+                # whose terminating blank line never arrived is flushed,
+                # not dropped
+                decoder = SSEDecoder()
+                # mark at parse time (arrival), before the consumer runs;
+                # bound once so the disabled path is a single None check
+                mark = span.mark if span is not None else None
+                try:
+                    for chunk in resp.stream(8192, decode_content=True):
+                        for payload in decoder.feed(chunk):
+                            event = parse_sse_event(payload)
+                            if mark is not None:
+                                mark()
+                            yield event
+                    for payload in decoder.flush():
+                        event = parse_sse_event(payload)
+                        if mark is not None:
+                            mark()
+                        yield event
+                except urllib3.exceptions.HTTPError as e:
+                    # server died mid-stream etc. — keep the client's typed
+                    # exception contract (the aio twin wraps ClientError)
+                    raise InferenceServerException(
+                        f"connection error: {e}") from e
+                exhausted = True
+            finally:
+                if exhausted:
+                    # fully-drained chunked body: the connection is
+                    # reusable — back to the pool, so per-session TTFT
+                    # doesn't pay a fresh TCP handshake (genai_perf
+                    # generate-mode bias)
+                    resp.release_conn()
+                else:
+                    # close (not release): an abandoned stream must tear
+                    # the connection down so the server sees the disconnect
+                    resp.close()
+        except GeneratorExit:
+            if span is not None:
+                tel.finish_stream(span, abandoned=True)
+            raise
+        except BaseException as e:
+            if span is not None:
+                tel.finish_stream(span, error=e)
+            raise
+        if span is not None:
+            tel.finish_stream(span)
+
+    def last_stream_span(self):
+        """The most recent ``generate_stream``'s StreamSpan (None without
+        telemetry) — harnesses read TTFT/ITL from it instead of
+        re-measuring with their own stopwatch."""
+        return getattr(self, "_last_stream_span", None)

@@ -430,34 +430,73 @@ class InferenceServerClient(InferenceServerClientBase):
         """Async iterator over generate-extension SSE events, one dict per
         streamed response. Abandoning the iterator mid-stream closes the
         connection, which the server accounts as a client cancel (the
-        cancel stats bucket), not a success. In-band error events raise."""
+        cancel stats bucket), not a success. In-band error events raise.
+
+        With telemetry configured the stream is traced as a
+        ``StreamSpan`` (open -> first-event TTFT -> per-event marks ->
+        close/error/abandon) and a ``traceparent`` header joins it to the
+        server's access record for the generation."""
         hdrs = dict(headers or {})
+        span = self._obs_begin_stream("http_aio", model_name)
+        self._last_stream_span = span
+        if span is not None:
+            hdrs[TRACEPARENT_HEADER] = span.traceparent()
         request = Request(hdrs)
         self._call_plugin(request)
         url = f"{self._base}/{self._generate_path(model_name, model_version, stream=True)}"
         body = self._generate_payload(inputs, request_id, parameters)
+        tel = self._telemetry
         try:
-            # no total timeout: generation streams for as long as it streams
-            async with self._session.post(
-                url, data=body, headers=request.headers, params=query_params,
-                timeout=aiohttp.ClientTimeout(total=None),
-            ) as resp:
-                if resp.status != 200:
-                    raise_if_error(resp.status, await resp.read())
-                    # 2xx-not-200/3xx from an intermediary: raise_if_error
-                    # is a no-op below 400, and falling through would yield
-                    # an empty stream with no error at all
-                    raise InferenceServerException(
-                        f"unexpected generate_stream status {resp.status}")
-                # chunked reads through the shared SSEDecoder (same framing
-                # as the sync client): no 64 KiB StreamReader line ceiling
-                # for large streamed tensors, CRLF event framing streams
-                # instead of buffering to EOF, multi-line data: fields join
-                decoder = SSEDecoder()
-                async for chunk in resp.content.iter_chunked(8192):
-                    for payload in decoder.feed(chunk):
-                        yield parse_sse_event(payload)
-                for payload in decoder.flush():
-                    yield parse_sse_event(payload)
-        except aiohttp.ClientError as e:
-            raise InferenceServerException(f"connection error: {e}") from e
+            try:
+                # no total timeout: generation streams for as long as it
+                # streams
+                async with self._session.post(
+                    url, data=body, headers=request.headers,
+                    params=query_params,
+                    timeout=aiohttp.ClientTimeout(total=None),
+                ) as resp:
+                    if resp.status != 200:
+                        raise_if_error(resp.status, await resp.read())
+                        # 2xx-not-200/3xx from an intermediary:
+                        # raise_if_error is a no-op below 400, and falling
+                        # through would yield an empty stream with no error
+                        raise InferenceServerException(
+                            f"unexpected generate_stream status {resp.status}")
+                    # chunked reads through the shared SSEDecoder (same
+                    # framing as the sync client): no 64 KiB StreamReader
+                    # line ceiling for large streamed tensors, CRLF event
+                    # framing streams instead of buffering to EOF,
+                    # multi-line data: fields join
+                    decoder = SSEDecoder()
+                    # mark at parse time (arrival), before the consumer
+                    # runs; bound once so the disabled path is a None check
+                    mark = span.mark if span is not None else None
+                    async for chunk in resp.content.iter_chunked(8192):
+                        for payload in decoder.feed(chunk):
+                            event = parse_sse_event(payload)
+                            if mark is not None:
+                                mark()
+                            yield event
+                    for payload in decoder.flush():
+                        event = parse_sse_event(payload)
+                        if mark is not None:
+                            mark()
+                        yield event
+            except aiohttp.ClientError as e:
+                raise InferenceServerException(f"connection error: {e}") from e
+        except GeneratorExit:
+            if span is not None:
+                tel.finish_stream(span, abandoned=True)
+            raise
+        except BaseException as e:
+            if span is not None:
+                tel.finish_stream(span, error=e)
+            raise
+        if span is not None:
+            tel.finish_stream(span)
+
+    def last_stream_span(self):
+        """The most recent ``generate_stream``'s StreamSpan (None without
+        telemetry) — harnesses read TTFT/ITL from it instead of
+        re-measuring with their own stopwatch."""
+        return getattr(self, "_last_stream_span", None)

@@ -28,6 +28,13 @@ frontends already capture:
   ``InferenceServerClientBase.configure_telemetry``: pre-wired
   request/error/retry/breaker/ejection/hedge metrics fed by the existing
   resilience and pool event streams.
+- :class:`StreamSpan` + :class:`WindowedSketch` + :class:`SLO` — the
+  streaming layer: token-level stream tracing (open -> per-attempt TTFT
+  -> per-chunk marks -> close/error/reconnect; the hot path is one
+  timestamp append per chunk), sliding-window quantile sketches merged
+  at scrape time into ``ttft_ms``/``itl_ms``/``stream_duration_ms``
+  windowed gauges, and declared SLOs (burn rate + breach gauges). See
+  docs/observability.md "Streaming & SLOs".
 
 Pay-for-what-you-use: with no telemetry configured the frontends' hot
 paths check one attribute and do nothing else (~0 overhead); with
@@ -45,20 +52,24 @@ import random
 import threading
 import time
 import weakref
-from bisect import bisect_right
+from bisect import bisect_left, bisect_right
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS_S",
+    "DEFAULT_STREAM_MS_BUCKETS",
     "TRACEPARENT_HEADER",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "RequestSpan",
+    "SLO",
+    "StreamSpan",
     "Telemetry",
     "Tracer",
+    "WindowedSketch",
     "format_traceparent",
     "make_span_id",
     "make_trace_id",
@@ -127,6 +138,22 @@ def _fmt_value(v: float) -> str:
 
 def _escape_label(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _percentile_row(values: Sequence[float],
+                    percentiles: Sequence[float] = (0.5, 0.99),
+                    ) -> Dict[str, float]:
+    """count/avg/pN summary of exact samples — the one percentile-index
+    convention every breakdown (phase, stream, span dump) shares."""
+    s = sorted(values)
+    row: Dict[str, float] = {"count": len(s)}
+    if not s:
+        return row
+    row["avg"] = round(sum(s) / len(s), 4)
+    for q in percentiles:
+        idx = min(int(len(s) * q), len(s) - 1)
+        row[f"p{int(q * 100)}"] = round(s[idx], 4)
+    return row
 
 
 class _Series:
@@ -522,6 +549,357 @@ class RequestSpan:
         }
 
 
+# -- streaming spans ----------------------------------------------------------
+class _StreamAttempt:
+    """One transport attempt of a stream (the initial open, or one
+    reconnect): its open timestamp plus the raw chunk-arrival marks."""
+
+    __slots__ = ("start_ns", "marks")
+
+    def __init__(self, start_ns: int):
+        self.start_ns = start_ns
+        self.marks: List[int] = []
+
+
+class StreamSpan:
+    """One client stream's span: open -> first-chunk (TTFT) -> per-chunk
+    marks -> close/error/reconnect.
+
+    The hot path is :meth:`mark` — one ``perf_counter_ns`` plus one list
+    append on the CURRENT attempt (the bound-method indirection is rebound
+    by :meth:`reconnect`, so marking never branches on attempt state).
+    Everything derived — TTFT, inter-chunk latencies, per-attempt splits —
+    is computed at fold/scrape time, never per chunk.
+
+    Reconnects open a new sub-attempt: TTFT and inter-chunk gaps are
+    always computed WITHIN one attempt, so a retried stream never folds
+    reconnect backoff into TTFT and the gap across a reconnect never
+    poisons the inter-chunk distribution."""
+
+    __slots__ = ("trace_id", "span_id", "frontend", "model", "op",
+                 "start_ns", "end_ns", "attempts", "events", "sampled",
+                 "error", "abandoned", "tid", "_mark")
+
+    def __init__(self, trace_id: str, span_id: str, frontend: str,
+                 model: str, op: str, sampled: bool):
+        # end_ns / events / error / abandoned / tid set lazily off the hot
+        # path; readers use getattr defaults (same pattern as RequestSpan)
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.frontend = frontend
+        self.model = model
+        self.op = op
+        self.start_ns = time.perf_counter_ns()
+        first = _StreamAttempt(self.start_ns)
+        self.attempts: List[_StreamAttempt] = [first]
+        self.sampled = sampled
+        self._mark = first.marks.append
+
+    def mark(self) -> None:
+        """Record one chunk/token arrival (the ≤2 µs/mark hot path)."""
+        self._mark(time.perf_counter_ns())
+
+    def reconnect(self, abandoned: int = 0, resent: int = 0) -> None:
+        """Open a reconnect sub-attempt; subsequent marks land in it."""
+        attempt = _StreamAttempt(time.perf_counter_ns())
+        self.attempts.append(attempt)
+        self._mark = attempt.marks.append
+        self.event("reconnect", attempt=len(self.attempts) - 1,
+                   abandoned=abandoned, resent=resent)
+
+    def event(self, name: str, **attrs) -> None:
+        events = getattr(self, "events", None)
+        if events is None:
+            events = self.events = []
+        events.append((name, time.perf_counter_ns(), attrs or None))
+
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id, self.sampled)
+
+    # -- derived views (fold/scrape side, never the chunk path) --------------
+    @property
+    def chunk_count(self) -> int:
+        return sum(len(a.marks) for a in self.attempts)
+
+    def marks_ns(self) -> List[int]:
+        """All chunk marks in arrival order (attempts concatenated)."""
+        out: List[int] = []
+        for attempt in self.attempts:
+            out.extend(attempt.marks)
+        return out
+
+    def ttft_ms_per_attempt(self) -> List[float]:
+        """Open->first-chunk per attempt that saw a chunk — recorded per
+        reconnect attempt so retries never inflate TTFT."""
+        return [(a.marks[0] - a.start_ns) / 1e6
+                for a in self.attempts if a.marks]
+
+    def itl_values_ms(self) -> List[float]:
+        """Inter-chunk gaps, computed within each attempt only (a gap that
+        spans a reconnect is transport recovery, not token latency)."""
+        out: List[float] = []
+        for attempt in self.attempts:
+            marks = attempt.marks
+            for i in range(1, len(marks)):
+                out.append((marks[i] - marks[i - 1]) / 1e6)
+        return out
+
+    def duration_s(self) -> float:
+        end = getattr(self, "end_ns", 0) or time.perf_counter_ns()
+        return (end - self.start_ns) * 1e-9
+
+    @property
+    def phases(self) -> List[Tuple[str, int, int]]:
+        """Tracer-compatible interval view: one ``attempt`` interval per
+        transport attempt plus its ``ttft`` window."""
+        end_ns = getattr(self, "end_ns", 0)
+        out: List[Tuple[str, int, int]] = []
+        for i, attempt in enumerate(self.attempts):
+            nxt = (self.attempts[i + 1].start_ns
+                   if i + 1 < len(self.attempts) else end_ns)
+            last = attempt.marks[-1] if attempt.marks else (
+                nxt or attempt.start_ns)
+            out.append(("attempt", attempt.start_ns, last))
+            if attempt.marks:
+                out.append(("ttft", attempt.start_ns, attempt.marks[0]))
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        itl = self.itl_values_ms()
+        itl_summary: Dict[str, Any] = _percentile_row(itl)
+        if itl:
+            itl_summary["max"] = round(max(itl), 4)
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "frontend": self.frontend,
+            "model": self.model,
+            "op": self.op,
+            "start_ns": self.start_ns,
+            "end_ns": getattr(self, "end_ns", 0),
+            "duration_ms": round(self.duration_s() * 1e3, 6),
+            "error": getattr(self, "error", None),
+            "abandoned": bool(getattr(self, "abandoned", False)),
+            "chunks": self.chunk_count,
+            "reconnects": len(self.attempts) - 1,
+            "ttft_ms": [round(v, 4) for v in self.ttft_ms_per_attempt()],
+            "itl_ms": itl_summary,
+            "attempts": [
+                {"start_ns": a.start_ns, "chunks": len(a.marks)}
+                for a in self.attempts
+            ],
+            "phases": [
+                {"name": n, "start_ns": s, "end_ns": e,
+                 "duration_ms": round((e - s) / 1e6, 6)}
+                for n, s, e in self.phases
+            ],
+            "events": [
+                {"name": n, "ns": ts, **(attrs or {})}
+                for n, ts, attrs in (getattr(self, "events", None) or ())
+            ],
+        }
+
+
+# -- sliding-window quantile sketch -------------------------------------------
+# Fixed millisecond bucket edges for the windowed stream metrics: 50 µs ..
+# 30 s — SSE token gaps on localhost sit at the bottom, cold-compile first
+# tokens at the top.
+DEFAULT_STREAM_MS_BUCKETS: Tuple[float, ...] = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+
+class WindowedSketch:
+    """A sliding-window quantile sketch: a ring of fixed-bucket
+    sub-windows, merged at read time.
+
+    ``observe`` lands one value in the current sub-window (a bisect plus
+    an increment under the sketch lock — this runs on the FOLD/scrape
+    side, never the per-chunk path). Readers merge the live sub-windows
+    and interpolate quantiles; values older than ``window_s`` age out as
+    their sub-window is recycled. Rotation is lazy on both paths under
+    the same lock, so a scrape concurrent with a rotation sees either the
+    pre- or post-rotation window — never a torn one.
+    """
+
+    __slots__ = ("buckets", "window_s", "subwindows", "_sub_s", "_counts",
+                 "_sums", "_ns", "_period", "_lock", "_clock")
+
+    def __init__(self, window_s: float = 300.0, subwindows: int = 6,
+                 buckets: Sequence[float] = DEFAULT_STREAM_MS_BUCKETS,
+                 clock: Callable[[], float] = time.monotonic):
+        if window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        if subwindows < 1:
+            raise ValueError("subwindows must be >= 1")
+        edges = tuple(sorted(float(b) for b in buckets))
+        if not edges or len(set(edges)) != len(edges):
+            raise ValueError("buckets must be non-empty and distinct")
+        self.buckets = edges
+        self.window_s = float(window_s)
+        self.subwindows = int(subwindows)
+        self._sub_s = self.window_s / self.subwindows
+        self._counts = [[0] * (len(edges) + 1) for _ in range(subwindows)]
+        self._sums = [0.0] * subwindows
+        self._ns = [0] * subwindows
+        self._period: Optional[int] = None
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    def _rotate_locked(self) -> int:
+        """Advance to the current period, recycling expired sub-windows;
+        returns the live slot index. Caller holds the lock."""
+        period = int(self._clock() / self._sub_s)
+        if self._period is None:
+            self._period = period
+        elif period > self._period:
+            empty = len(self.buckets) + 1
+            for i in range(1, min(period - self._period, self.subwindows) + 1):
+                slot = (self._period + i) % self.subwindows
+                self._counts[slot] = [0] * empty
+                self._sums[slot] = 0.0
+                self._ns[slot] = 0
+            self._period = period
+        return self._period % self.subwindows
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            slot = self._rotate_locked()
+            # bisect_left: a value EQUAL to an edge lands in that edge's
+            # ≤-bucket (Prometheus ``le`` semantics) — fraction_le(edge)
+            # is then exact, which the SLO good/bad split relies on (its
+            # single bucket edge IS the threshold)
+            self._counts[slot][bisect_left(self.buckets, value)] += 1
+            self._sums[slot] += value
+            self._ns[slot] += 1
+
+    def merged(self) -> Tuple[List[int], int, float]:
+        """(per-bucket counts, total count, sum) over the live window."""
+        with self._lock:
+            self._rotate_locked()
+            counts = [0] * (len(self.buckets) + 1)
+            for sub in self._counts:
+                for i, n in enumerate(sub):
+                    counts[i] += n
+            return counts, sum(self._ns), sum(self._sums)
+
+    def count(self) -> int:
+        return self.merged()[1]
+
+    def quantile(self, q: float) -> float:
+        """Windowed quantile via linear interpolation inside the owning
+        bucket (same estimate as ``_HistogramSeries.quantile``)."""
+        counts, total, _ = self.merged()
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        lower = 0.0
+        for i, edge in enumerate(self.buckets):
+            prev = cum
+            cum += counts[i]
+            if cum >= rank:
+                frac = (rank - prev) / max(counts[i], 1)
+                return lower + (edge - lower) * min(max(frac, 0.0), 1.0)
+            lower = edge
+        return self.buckets[-1]
+
+    def fraction_le(self, edge: float) -> float:
+        """The windowed fraction of values <= ``edge`` (exact when
+        ``edge`` is a bucket edge — the SLO good/bad split)."""
+        counts, total, _ = self.merged()
+        if total == 0:
+            return 1.0
+        idx = bisect_right(self.buckets, float(edge))
+        return sum(counts[:idx]) / total
+
+    def snapshot(self) -> Dict[str, Any]:
+        """A JSON-pure snapshot (``json.loads(json.dumps(s)) == s``) that
+        :meth:`from_snapshot` restores bit-for-bit."""
+        with self._lock:
+            self._rotate_locked()
+            return {
+                "window_s": self.window_s,
+                "subwindows": self.subwindows,
+                "buckets_ms": list(self.buckets),
+                "counts": [list(sub) for sub in self._counts],
+                "sums": list(self._sums),
+                "ns": list(self._ns),
+                "period": self._period,
+            }
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any],
+                      clock: Callable[[], float] = time.monotonic,
+                      ) -> "WindowedSketch":
+        sketch = cls(snap["window_s"], snap["subwindows"],
+                     snap["buckets_ms"], clock=clock)
+        sketch._counts = [list(sub) for sub in snap["counts"]]
+        sketch._sums = list(snap["sums"])
+        sketch._ns = list(snap["ns"])
+        sketch._period = snap["period"]
+        return sketch
+
+
+class SLO:
+    """One declared streaming objective, e.g. ``ttft_p95 < 200ms over 5m``.
+
+    ``objective`` is the target good fraction (0.95 means 95% of events
+    must land under ``threshold_ms``). The tracker counts every observed
+    event good/bad (cumulative counters), keeps a windowed good/bad split
+    (a :class:`WindowedSketch` whose single bucket edge IS the
+    threshold), and exports at scrape time:
+
+    - ``client_tpu_slo_events_total{slo,outcome}`` — cumulative counters;
+    - ``client_tpu_slo_burn_rate{slo}`` — windowed bad fraction over the
+      error budget (``1 - objective``); burning exactly the budget is 1.0;
+    - ``client_tpu_slo_breached{slo}`` — 1 when the windowed burn rate
+      exceeds 1 (the declared quantile currently misses the threshold).
+    """
+
+    __slots__ = ("name", "metric", "threshold_ms", "objective", "window_s",
+                 "frontend", "window", "good", "bad")
+
+    def __init__(self, name: str, metric: str = "ttft_ms",
+                 threshold_ms: float = 200.0, objective: float = 0.95,
+                 window_s: float = 300.0, frontend: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not 0.0 < objective < 1.0:
+            raise ValueError("objective must be in (0, 1)")
+        if metric not in ("ttft_ms", "itl_ms", "stream_duration_ms"):
+            raise ValueError(f"unknown SLO metric {metric!r}")
+        if threshold_ms <= 0:
+            raise ValueError("threshold_ms must be > 0")
+        self.name = name
+        self.metric = metric
+        self.threshold_ms = float(threshold_ms)
+        self.objective = float(objective)
+        self.window_s = float(window_s)
+        self.frontend = frontend
+        # single bucket edge == threshold: counts[0] is good, counts[1] bad
+        self.window = WindowedSketch(
+            window_s, buckets=(self.threshold_ms,), clock=clock)
+        self.good = None  # counters bound by the owning Telemetry
+        self.bad = None
+
+    def observe(self, value_ms: float) -> None:
+        self.window.observe(value_ms)
+        if value_ms <= self.threshold_ms:
+            if self.good is not None:
+                self.good.inc()
+        elif self.bad is not None:
+            self.bad.inc()
+
+    def burn_rate(self) -> float:
+        bad_fraction = 1.0 - self.window.fraction_le(self.threshold_ms)
+        return bad_fraction / (1.0 - self.objective)
+
+    def breached(self) -> bool:
+        return self.burn_rate() > 1.0
+
+
 class Tracer:
     """Ring buffer of recently finished request spans + dump formats."""
 
@@ -625,6 +1003,7 @@ class Telemetry:
         slow_threshold_s: float = 0.25,
         trace_capacity: int = 256,
         rng: Optional[random.Random] = None,
+        stream_window_s: float = 300.0,
     ):
         if sample not in _SAMPLE_MODES:
             raise ValueError(
@@ -664,6 +1043,25 @@ class Telemetry:
         self.stream_reconnects_total = reg.counter(
             "client_tpu_stream_reconnects_total",
             "GRPC bidi stream auto-reconnects")
+        self.stream_abandoned_sequences_total = reg.counter(
+            "client_tpu_stream_abandoned_sequences_total",
+            "Sequence requests abandoned by a stream reconnect "
+            "(never re-sent)")
+        self.streams_total = reg.counter(
+            "client_tpu_streams_total",
+            "Streams finished (success, error or abandoned) per frontend",
+            ("frontend",))
+        self.stream_errors_total = reg.counter(
+            "client_tpu_stream_errors_total",
+            "Streams finished with an error, by fault domain",
+            ("frontend", "domain"))
+        self.stream_abandoned_total = reg.counter(
+            "client_tpu_stream_abandoned_total",
+            "Streams abandoned by the consumer before exhaustion",
+            ("frontend",))
+        self.stream_chunks_total = reg.counter(
+            "client_tpu_stream_chunks_total",
+            "Chunks/tokens received across all streams", ("frontend",))
         self.pool_ejections_total = reg.counter(
             "client_tpu_pool_ejections_total",
             "Passive outlier ejections per endpoint", ("url",))
@@ -712,8 +1110,48 @@ class Telemetry:
         # the unlucky request folds the backlog inline (amortized, rare).
         self._pending: deque = deque()
         self.registry.add_collector(self._fold_pending)
+        # -- streaming: windowed sketches + SLOs ------------------------------
+        # finished stream spans queue exactly like request spans (lock-free
+        # deque, folded on the scraper's thread); the windowed ttft/itl/
+        # duration sketches and any declared SLOs are fed AT FOLD TIME —
+        # the per-chunk hot path is only StreamSpan.mark()
+        self.stream_window_s = stream_window_s
+        self._pending_streams: deque = deque()
+        self._stream_windows: Dict[Tuple[str, str], WindowedSketch] = {}
+        self._endpoint_ttft: Dict[str, WindowedSketch] = {}
+        self._windows_lock = threading.Lock()
+        self._slos: List[SLO] = []
+        self._window_quantile_gauge = reg.gauge(
+            "client_tpu_stream_window_ms",
+            f"Windowed stream latency quantiles (last "
+            f"{stream_window_s:g}s, merged at scrape time)",
+            ("metric", "frontend", "quantile"))
+        self._window_count_gauge = reg.gauge(
+            "client_tpu_stream_window_count",
+            "Samples in the live window per windowed stream metric",
+            ("metric", "frontend"))
+        self._endpoint_ttft_gauge = reg.gauge(
+            "client_tpu_pool_endpoint_ttft_ms",
+            "Windowed per-endpoint generate_stream TTFT quantiles "
+            "(fed by the pool, merged at scrape time)",
+            ("url", "quantile"))
+        self._slo_events = reg.counter(
+            "client_tpu_slo_events_total",
+            "SLO events by outcome", ("slo", "outcome"))
+        self._slo_burn_gauge = reg.gauge(
+            "client_tpu_slo_burn_rate",
+            "Windowed bad fraction over the error budget (1.0 = burning "
+            "exactly the budget)", ("slo",))
+        self._slo_breached_gauge = reg.gauge(
+            "client_tpu_slo_breached",
+            "1 when the declared objective currently misses its threshold "
+            "over the window", ("slo",))
+        self.registry.add_collector(self._fold_stream_pending)
+        self.registry.add_collector(self._collect_stream_windows)
 
     _FOLD_BACKLOG = 32768
+    _WINDOW_QUANTILES = ((0.5, "p50"), (0.9, "p90"), (0.95, "p95"),
+                         (0.99, "p99"))
 
     # -- span lifecycle ------------------------------------------------------
     def begin(self, frontend: str, model: str = "",
@@ -813,6 +1251,156 @@ class Telemetry:
                     h.sum += seconds
                     h.count += 1
 
+    # -- stream span lifecycle ----------------------------------------------
+    def begin_stream(self, frontend: str, model: str = "",
+                     op: str = "generate_stream") -> StreamSpan:
+        """Open a stream span (same id scheme and sampling decision as
+        :meth:`begin`)."""
+        sampled = True
+        if self._sample_ratio_mode:
+            sampled = self._rng.random() < self.sample_ratio
+        elif self._sample_off:
+            sampled = False
+        suffix = f"{self._next_seq():016x}"
+        return StreamSpan(
+            self._trace_prefix + suffix, suffix, frontend, model, op, sampled)
+
+    def finish_stream(self, span: Optional[StreamSpan],
+                      error: Optional[BaseException] = None,
+                      abandoned: bool = False) -> None:
+        """Close a stream span (idempotent: a span can be finished by a
+        terminal stream error and again by ``stop_stream``/``close`` — the
+        first close wins). Counter/sketch folding is deferred to scrape
+        time exactly like :meth:`finish`."""
+        if span is None or getattr(span, "end_ns", 0):
+            return
+        end_ns = span.end_ns = time.perf_counter_ns()
+        total_s = (end_ns - span.start_ns) * 1e-9
+        domain = None
+        if error is not None:
+            from .resilience import classify_fault  # no import cycle: lazy
+
+            span.error = f"{type(error).__name__}: {error}"[:256]
+            domain = classify_fault(error)
+        if abandoned:
+            span.abandoned = True
+        self._pending_streams.append((span, domain))
+        if self._sample_slow_mode:
+            if total_s >= self.slow_threshold_s:
+                span.tid = threading.get_ident()
+                self.tracer.keep(span)
+        elif span.sampled:
+            span.tid = threading.get_ident()
+            self.tracer.keep(span)
+        if len(self._pending_streams) >= self._FOLD_BACKLOG:
+            self._fold_stream_pending()
+
+    def _stream_window(self, metric: str, frontend: str) -> WindowedSketch:
+        key = (metric, frontend)
+        window = self._stream_windows.get(key)
+        if window is None:
+            with self._windows_lock:
+                window = self._stream_windows.setdefault(
+                    key, WindowedSketch(self.stream_window_s))
+        return window
+
+    def _fold_stream_pending(self) -> None:
+        """Drain finished stream spans into counters, windowed sketches
+        and SLOs. Runs at scrape time (registry collector) or at the
+        backlog threshold; ``popleft`` keeps concurrent folders safe."""
+        pending = self._pending_streams
+        while True:
+            try:
+                span, domain = pending.popleft()
+            except IndexError:
+                return
+            frontend = span.frontend
+            self.streams_total.labels(frontend).inc()
+            chunks = span.chunk_count
+            if chunks:
+                self.stream_chunks_total.labels(frontend).inc(chunks)
+            if domain is not None:
+                self.stream_errors_total.labels(frontend, domain).inc()
+            if getattr(span, "abandoned", False):
+                self.stream_abandoned_total.labels(frontend).inc()
+            ttfts = span.ttft_ms_per_attempt()
+            itls = span.itl_values_ms()
+            duration_ms = span.duration_s() * 1e3
+            samples = (("ttft_ms", ttfts), ("itl_ms", itls),
+                       ("stream_duration_ms", (duration_ms,)))
+            for metric, values in samples:
+                if not values:
+                    continue
+                window = self._stream_window(metric, frontend)
+                for value in values:
+                    if value >= 0.0:  # clock skew guard: never a negative
+                        window.observe(value)
+            for slo in self._slos:
+                if slo.frontend is not None and slo.frontend != frontend:
+                    continue
+                for metric, values in samples:
+                    if metric != slo.metric:
+                        continue
+                    for value in values:
+                        if value >= 0.0:
+                            slo.observe(value)
+
+    def _collect_stream_windows(self) -> None:
+        """Scrape-time collector: merge the windowed sketches into
+        quantile gauges (no hot-path percentile math anywhere)."""
+        with self._windows_lock:
+            windows = list(self._stream_windows.items())
+            endpoints = list(self._endpoint_ttft.items())
+        for (metric, frontend), window in windows:
+            self._window_count_gauge.labels(metric, frontend).set(
+                window.count())
+            for q, label in self._WINDOW_QUANTILES:
+                self._window_quantile_gauge.labels(
+                    metric, frontend, label).set(round(window.quantile(q), 4))
+        for url, window in endpoints:
+            for q, label in self._WINDOW_QUANTILES:
+                self._endpoint_ttft_gauge.labels(url, label).set(
+                    round(window.quantile(q), 4))
+        for slo in self._slos:
+            burn = slo.burn_rate()
+            self._slo_burn_gauge.labels(slo.name).set(round(burn, 4))
+            self._slo_breached_gauge.labels(slo.name).set(
+                1.0 if burn > 1.0 else 0.0)
+
+    # -- SLOs ----------------------------------------------------------------
+    def track_slo(self, name: str, metric: str = "ttft_ms",
+                  threshold_ms: float = 200.0, objective: float = 0.95,
+                  window_s: Optional[float] = None,
+                  frontend: Optional[str] = None) -> SLO:
+        """Declare a streaming SLO (e.g. ``ttft_p95 < 200ms over 5m`` is
+        ``track_slo("ttft_p95", "ttft_ms", 200, objective=0.95,
+        window_s=300)``). Returns the tracker; its good/bad counters,
+        burn rate and breach gauge export on every scrape."""
+        slo = SLO(name, metric, threshold_ms, objective,
+                  window_s if window_s is not None else self.stream_window_s,
+                  frontend)
+        slo.good = self._slo_events.labels(name, "good")
+        slo.bad = self._slo_events.labels(name, "bad")
+        self._slos.append(slo)
+        return slo
+
+    def slos(self) -> List[SLO]:
+        return list(self._slos)
+
+    # -- pool TTFT feed -------------------------------------------------------
+    def observe_endpoint_ttft(self, url: str, ttft_ms: float) -> None:
+        """Record one stream's TTFT against the endpoint that served it
+        (fed by ``PoolClient.generate_stream`` once per stream) so
+        ejection decisions have a latency signal per replica."""
+        if ttft_ms < 0.0:
+            return
+        window = self._endpoint_ttft.get(url)
+        if window is None:
+            with self._windows_lock:
+                window = self._endpoint_ttft.setdefault(
+                    url, WindowedSketch(self.stream_window_s))
+        window.observe(ttft_ms)
+
     # -- resilience observer protocol (duck-typed from resilience.py) --------
     def on_retry(self, attempt: int, exc: BaseException,
                  delay_s: float) -> None:
@@ -824,8 +1412,16 @@ class Telemetry:
     def on_breaker_transition(self, state: str) -> None:
         self.breaker_transitions_total.labels(state).inc()
 
-    def on_stream_reconnect(self) -> None:
+    def on_stream_reconnect(self, event=None) -> None:
+        """Exactly-once bridge for ``resilience.StreamReconnected``: the
+        reconnecting stream calls this (with the event) BEFORE the user
+        callback sees it, so the counters move once per reconnect and the
+        abandoned-sequence count is never lost even when the application
+        swallows the event."""
         self.stream_reconnects_total.inc()
+        abandoned = getattr(event, "abandoned_request_ids", None)
+        if abandoned:
+            self.stream_abandoned_sequences_total.inc(len(abandoned))
 
     def on_hedge_fired(self) -> None:
         self.hedges_fired_total.inc()
@@ -970,19 +1566,36 @@ class Telemetry:
                         ) -> Dict[str, Dict[str, float]]:
         """Per-phase latency percentiles (ms) computed from the EXACT
         samples in the trace ring (not histogram-interpolated) — the
-        perf harness emits this under ``--observe``."""
+        perf harness emits this under ``--observe``. Stream spans share
+        the ring but have their own vocabulary (their ``attempt``/``ttft``
+        intervals are whole-stream-scale): they report via
+        :meth:`stream_breakdown`, never here."""
         samples: Dict[str, List[float]] = {}
         for trace in self.tracer.recent():
+            if "chunks" in trace:  # a StreamSpan, not a request span
+                continue
             for phase in trace["phases"]:
                 samples.setdefault(phase["name"], []).append(
                     phase["duration_ms"])
-        out: Dict[str, Dict[str, float]] = {}
-        for name, values in sorted(samples.items()):
-            values.sort()
-            row = {"count": len(values),
-                   "avg": round(sum(values) / len(values), 4)}
-            for q in percentiles:
-                idx = min(int(len(values) * q), len(values) - 1)
-                row[f"p{int(q * 100)}"] = round(values[idx], 4)
-            out[name] = row
-        return out
+        return {name: _percentile_row(values, percentiles)
+                for name, values in sorted(samples.items())}
+
+    def stream_breakdown(self, percentiles: Sequence[float] = (0.5, 0.99),
+                         ) -> Dict[str, Dict[str, float]]:
+        """TTFT / inter-chunk / duration percentiles (ms) from the EXACT
+        stream samples retained in the trace ring — the perf harness emits
+        this under ``--observe`` for streaming runs. Empty when no stream
+        finished in the ring."""
+        samples: Dict[str, List[float]] = {}
+        with self.tracer._lock:
+            spans = list(self.tracer._ring)
+        for span in spans:
+            if not isinstance(span, StreamSpan):
+                continue
+            samples.setdefault("ttft_ms", []).extend(
+                span.ttft_ms_per_attempt())
+            samples.setdefault("itl_ms", []).extend(span.itl_values_ms())
+            samples.setdefault("stream_duration_ms", []).append(
+                span.duration_s() * 1e3)
+        return {name: _percentile_row(values, percentiles)
+                for name, values in sorted(samples.items()) if values}

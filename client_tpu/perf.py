@@ -89,6 +89,9 @@ class PerfRunner:
         hedge_delay_s: Optional[float] = None,
         observe: bool = False,
         observe_sample: str = "always",
+        generate_stream: bool = False,
+        stream_prompt_tokens: int = 32,
+        stream_output_tokens: int = 16,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -119,8 +122,27 @@ class PerfRunner:
         self.hedge_delay_s = hedge_delay_s
         self.observe = observe
         self.observe_sample = observe_sample
+        self.generate_stream = generate_stream
         self._telemetry = None  # fresh per measurement run (see run())
         self._proxy = None
+        if generate_stream:
+            # one streamed generation per "request": each worker iteration
+            # drives a full SSE session; latency_ms becomes session e2e
+            # and --observe adds the ttft/itl breakdown (client_stream_ms)
+            if protocol != "http":
+                raise ValueError(
+                    "--generate-stream requires the http protocol (the "
+                    "generate extension is an HTTP SSE surface)")
+            if shared_memory != "none":
+                raise ValueError(
+                    "--generate-stream requires --shared-memory none")
+            prompt_rng = np.random.default_rng(seed)
+            self._stream_payload = {
+                "TOKENS": prompt_rng.integers(
+                    0, 256, size=(1, max(1, stream_prompt_tokens)),
+                    dtype=np.int32).tolist(),
+                "MAX_TOKENS": max(1, stream_output_tokens),
+            }
         if protocol in ("native", "native-grpc") and shared_memory == "system":
             raise ValueError("native protocols support --shared-memory none|tpu")
         if protocol == "native-grpc-async" and shared_memory != "none":
@@ -535,6 +557,12 @@ class PerfRunner:
                 own_client.close()
 
     def _infer_once(self, client, inputs, outputs=None):
+        if self.generate_stream:
+            # one "request" = one fully-drained SSE generation session
+            for _event in client.generate_stream(
+                    self.model_name, self._stream_payload):
+                pass
+            return
         if self.protocol == "native-grpc-async":
             done = threading.Event()
             box = {}
@@ -625,6 +653,11 @@ class PerfRunner:
     def _observe_result(self, result: Dict[str, Any]) -> Dict[str, Any]:
         if self._telemetry is not None:
             result["client_phase_ms"] = self._telemetry.phase_breakdown()
+            stream = self._telemetry.stream_breakdown()
+            if stream:
+                # streaming runs: ttft/itl/duration p50/p99 from the exact
+                # StreamSpan samples in the trace ring
+                result["client_stream_ms"] = stream
         return result
 
     # -- sweep -------------------------------------------------------------
@@ -833,8 +866,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--observe", action="store_true",
         help="enable client telemetry (observe.Telemetry, sample=always) "
              "during measurement and append a client-phase p50/p99 "
-             "breakdown (serialize/ttfb/recv/deserialize) to each result",
+             "breakdown (serialize/ttfb/recv/deserialize) to each result; "
+             "with --generate-stream, also a ttft/itl breakdown "
+             "(client_stream_ms)",
     )
+    parser.add_argument(
+        "--generate-stream", action="store_true",
+        help="measure streamed generations instead of unary infers: each "
+             "request drives one generate-extension SSE session to "
+             "exhaustion (http protocol only; latency_ms = session e2e)",
+    )
+    parser.add_argument(
+        "--stream-prompt-tokens", type=int, default=32,
+        help="prompt length for --generate-stream sessions")
+    parser.add_argument(
+        "--stream-output-tokens", type=int, default=16,
+        help="generated tokens per --generate-stream session")
     args = parser.parse_args(argv)
 
     parts = [int(x) for x in args.concurrency_range.split(":")]
@@ -854,6 +901,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.endpoints else None,
         hedge=args.hedge, hedge_delay_s=args.hedge_delay,
         observe=args.observe,
+        generate_stream=args.generate_stream,
+        stream_prompt_tokens=args.stream_prompt_tokens,
+        stream_output_tokens=args.stream_output_tokens,
     )
     try:
         if args.warmup_requests:

@@ -1068,8 +1068,20 @@ class PoolClient(_PoolClientBase):
             # but-never-iterated stream holds no slot
             self.pool.begin(ep)
             ok = True
+            tel = self._telemetry
+            t0 = time.monotonic() if tel is not None else 0.0
+            first = tel is not None
             try:
-                yield from inner
+                for item in inner:
+                    if first:
+                        # per-endpoint TTFT feed: one windowed observation
+                        # per stream, so ejection decisions have a latency
+                        # signal per replica (scrape shows
+                        # client_tpu_pool_endpoint_ttft_ms)
+                        first = False
+                        tel.observe_endpoint_ttft(
+                            ep.url, (time.monotonic() - t0) * 1e3)
+                    yield item
             except Exception as e:
                 ok = False
                 self._record_attempt_failure(ep, e)
@@ -1369,8 +1381,16 @@ class AioPoolClient(_PoolClientBase):
             self._ensure_prober()  # called outside a loop? start it here
             self.pool.begin(ep)
             ok = True
+            tel = self._telemetry
+            t0 = time.monotonic() if tel is not None else 0.0
+            first = tel is not None
             try:
                 async for item in inner:
+                    if first:
+                        # per-endpoint TTFT feed (see the sync twin)
+                        first = False
+                        tel.observe_endpoint_ttft(
+                            ep.url, (time.monotonic() - t0) * 1e3)
                     yield item
             except Exception as e:
                 ok = False

@@ -439,12 +439,17 @@ class ServerCore:
 
     # -- observability (client_tpu.observe counterpart) ----------------------
     def _observe_access(self, request: Dict[str, Any], model_name: str,
-                        t0: int, t_infer: int, infer_ns: int) -> None:
+                        t0: int, t_infer: int, infer_ns: int,
+                        responses: int = 1,
+                        first_response_ns: Optional[int] = None) -> None:
         """Record a server-side span for a request that carried a W3C
         ``traceparent`` (frontends stash the header/metadata value under
         the reserved ``traceparent`` request key). ``client_span_id`` is
         the parent id from the header — the client's request span — so one
-        trace id joins client phases to server queue/compute timings."""
+        trace id joins client phases to server queue/compute timings.
+        Streamed (decoupled) requests additionally carry their response
+        count and the server-side first-response latency, the join target
+        for the client's StreamSpan TTFT."""
         traceparent = request.get("traceparent")
         if not traceparent:
             return
@@ -464,8 +469,11 @@ class ServerCore:
             "queue_ns": max(t_infer - t0, 0),
             "compute_ns": infer_ns,
             "total_ns": time.perf_counter_ns() - t0,
+            "responses": responses,
             "wall_time_s": time.time(),
         }
+        if first_response_ns is not None:
+            record["first_response_ns"] = max(first_response_ns - t0, 0)
         with self._lock:
             self._access.append(record)
 
@@ -765,9 +773,16 @@ class ServerCore:
 
         t_infer = time.perf_counter_ns()
         gen = model.execute_decoupled(inputs, params)
+        n_responses = 0
+        t_first: Optional[int] = None
         try:
             for raw in gen:
-                yield self._build_response(model, model_version, request, raw)
+                response = self._build_response(
+                    model, model_version, request, raw)
+                if t_first is None:
+                    t_first = time.perf_counter_ns()
+                n_responses += 1
+                yield response
         except GeneratorExit:
             # consumer went away mid-stream (client cancel/disconnect):
             # a separate cancel bucket — counting it as success made
@@ -784,7 +799,9 @@ class ServerCore:
         infer_ns = time.perf_counter_ns() - t_infer
         record(True, infer_ns)
         self._trace_request(model_name, request, t0, t_infer, infer_ns)
-        self._observe_access(request, model_name, t0, t_infer, infer_ns)
+        self._observe_access(request, model_name, t0, t_infer, infer_ns,
+                             responses=n_responses,
+                             first_response_ns=t_first)
 
     def _trace_request(self, model_name: str, request: Dict[str, Any],
                        t0: int, t_infer: int, infer_ns: int) -> None:

@@ -535,6 +535,11 @@ class _Handler(BaseHTTPRequestHandler):
         payload = json.loads(body) if body else {}
         core_req = _generate_core_request(
             self.core.model(model_name, model_version), payload)
+        traceparent = self.headers.get("traceparent")
+        if traceparent:
+            # W3C trace context: the whole generation (streamed or not)
+            # joins the client's stream span in ServerCore.access_records
+            core_req["traceparent"] = traceparent
         if not stream:
             return self._send_json(
                 _generate_once(self.core, model_name, model_version,

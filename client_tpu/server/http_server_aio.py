@@ -184,6 +184,9 @@ class AioHttpInferenceServer:
                 payload = await request.json()
                 core_req = _generate_core_request(
                     core.model(name, version), payload)
+                traceparent = request.headers.get("traceparent")
+                if traceparent:
+                    core_req["traceparent"] = traceparent
                 loop = asyncio.get_running_loop()
                 event = await loop.run_in_executor(
                     self._executor,
@@ -201,6 +204,11 @@ class AioHttpInferenceServer:
                 payload = await request.json()
                 core_req = _generate_core_request(
                     core.model(name, version), payload)
+                traceparent = request.headers.get("traceparent")
+                if traceparent:
+                    # W3C trace context: the generation joins the client's
+                    # stream span in ServerCore.access_records
+                    core_req["traceparent"] = traceparent
             except Exception as e:
                 return _error_response(e)
             gen = core.infer_stream(name, version, core_req)

@@ -1,0 +1,737 @@
+"""Streaming observability tests (ISSUE 5).
+
+Covers the StreamSpan lifecycle (open -> TTFT -> per-chunk marks ->
+close/error/reconnect, one sub-attempt per reconnect so retries never
+inflate TTFT), the sliding-window quantile sketch (rotation, scrape-time
+merge, snapshot JSON round-trip, concurrent scrape vs rotation), the
+SLOTracker (good/bad counters, burn rate, breach gauge), the four
+streaming frontends' tracing + traceparent join to server access
+records, the exactly-once StreamReconnected bridge with abandoned
+sequence counts, the pool's per-endpoint TTFT feed, and the harness
+integrations (genai_perf StreamSpan sourcing, perf --generate-stream
+breakdown) — plus the stream_observe_smoke chaos marker.
+"""
+
+import asyncio
+import json
+import queue
+import random
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu.models import default_model_zoo
+from client_tpu.observe import (
+    SLO,
+    StreamSpan,
+    Telemetry,
+    WindowedSketch,
+)
+from client_tpu.pool import PoolClient
+from client_tpu.resilience import (
+    ResiliencePolicy,
+    RetryPolicy,
+    StreamReconnected,
+)
+from client_tpu.server import (
+    AioHttpInferenceServer,
+    GrpcInferenceServer,
+    HttpInferenceServer,
+    ServerCore,
+)
+from client_tpu.testing import ChaosProxy
+from client_tpu.utils import InferenceServerException
+
+SEEDED_RNG = lambda: random.Random(0x57BE)  # noqa: E731
+
+# the channel must redial faster than the test's retry backoff (see
+# tests/test_resilience.py)
+_FAST_REDIAL = [
+    ("grpc.initial_reconnect_backoff_ms", 50),
+    ("grpc.min_reconnect_backoff_ms", 50),
+    ("grpc.max_reconnect_backoff_ms", 100),
+]
+
+# Prometheus text format 0.0.4 sample grammar (mirrors test_observe.py —
+# tests are not a package, so the regex is restated here)
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="([^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="([^"\\]|\\.)*")*\})?'
+    r' [-+]?(\d+(\.\d+)?([eE][-+]?\d+)?|\d+e[-+]?\d+)$')
+
+
+def _assert_prometheus_conformant(text: str):
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("# HELP") or line.startswith("# TYPE"):
+            continue
+        assert _SAMPLE_RE.match(line.replace('le="+Inf"', 'le="inf"')), line
+
+
+def _simple_inputs(mod):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = mod.InferInput("INPUT0", [1, 16], "INT32").set_data_from_numpy(a)
+    in1 = mod.InferInput("INPUT1", [1, 16], "INT32").set_data_from_numpy(b)
+    return a + b, [in0, in1]
+
+
+def _generate_inputs(tokens=4, max_tokens=5):
+    return {"TOKENS": [list(range(1, tokens + 1))], "MAX_TOKENS": max_tokens}
+
+
+def _drain_generate(client, model="tiny_lm_generate", **kwargs):
+    return list(client.generate_stream(model, _generate_inputs(**kwargs)))
+
+
+# -- WindowedSketch -----------------------------------------------------------
+def test_windowed_sketch_quantiles_and_aging():
+    t = [0.0]
+    sketch = WindowedSketch(window_s=60.0, subwindows=6,
+                            buckets=(1.0, 10.0, 100.0), clock=lambda: t[0])
+    for v in (0.5, 5.0, 5.0, 50.0):
+        sketch.observe(v)
+    assert sketch.count() == 4
+    assert 1.0 <= sketch.quantile(0.5) <= 10.0
+    # advance one sub-window: values stay live inside the window
+    t[0] = 15.0
+    sketch.observe(5.0)
+    assert sketch.count() == 5
+    # advance past the whole window: everything ages out
+    t[0] = 100.0
+    assert sketch.count() == 0
+    assert sketch.quantile(0.99) == 0.0
+    # a fresh observation lands in the recycled window
+    sketch.observe(2.0)
+    assert sketch.count() == 1
+
+
+def test_windowed_sketch_fraction_le_and_bounds():
+    t = [0.0]
+    sketch = WindowedSketch(window_s=10.0, subwindows=2,
+                            buckets=(10.0,), clock=lambda: t[0])
+    for v in (1.0, 2.0, 3.0, 50.0):
+        sketch.observe(v)
+    assert sketch.fraction_le(10.0) == pytest.approx(0.75)
+    # empty window reads as all-good (no data is not a breach)
+    t[0] = 100.0
+    assert sketch.fraction_le(10.0) == 1.0
+
+
+def test_windowed_sketch_snapshot_json_roundtrip():
+    t = [7.0]
+    sketch = WindowedSketch(window_s=30.0, subwindows=3,
+                            buckets=(1.0, 5.0), clock=lambda: t[0])
+    for v in (0.5, 2.0, 2.0, 9.0):
+        sketch.observe(v)
+    snap = sketch.snapshot()
+    assert json.loads(json.dumps(snap)) == snap
+    restored = WindowedSketch.from_snapshot(
+        json.loads(json.dumps(snap)), clock=lambda: t[0])
+    assert restored.count() == sketch.count()
+    assert restored.merged() == sketch.merged()
+    for q in (0.5, 0.9, 0.99):
+        assert restored.quantile(q) == sketch.quantile(q)
+
+
+def test_windowed_sketch_concurrent_scrape_vs_rotation():
+    """Scrapes (merge/quantile/snapshot) racing observes across sub-window
+    rotations must never tear: totals stay consistent and non-negative."""
+    sketch = WindowedSketch(window_s=0.08, subwindows=4, buckets=(1.0, 10.0))
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            while not stop.is_set():
+                sketch.observe(5.0)
+        except Exception as e:  # pragma: no cover - the failure under test
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        deadline = time.monotonic() + 0.6
+        while time.monotonic() < deadline:
+            counts, total, total_sum = sketch.merged()
+            assert total == sum(counts) >= 0
+            assert total_sum >= 0.0
+            q = sketch.quantile(0.5)
+            assert 0.0 <= q <= 10.0
+            snap = sketch.snapshot()
+            assert json.loads(json.dumps(snap)) == snap
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+    assert not errors
+
+
+# -- SLO tracking -------------------------------------------------------------
+def test_slo_tracker_counts_burn_and_breach():
+    tel = Telemetry(sample="off")
+    slo = tel.track_slo("ttft_p90", metric="ttft_ms", threshold_ms=100.0,
+                        objective=0.9, window_s=3600.0)
+    # 8 good, 2 bad -> bad fraction 0.2, budget 0.1 -> burn 2.0, breached
+    for _ in range(8):
+        slo.observe(50.0)
+    for _ in range(2):
+        slo.observe(500.0)
+    assert slo.good.get() == 8 and slo.bad.get() == 2
+    assert slo.burn_rate() == pytest.approx(2.0)
+    assert slo.breached()
+    text = tel.registry.prometheus_text()
+    _assert_prometheus_conformant(text)
+    assert 'client_tpu_slo_events_total{slo="ttft_p90",outcome="good"} 8' in text
+    assert 'client_tpu_slo_burn_rate{slo="ttft_p90"} 2' in text
+    assert 'client_tpu_slo_breached{slo="ttft_p90"} 1' in text
+
+
+def test_slo_fed_from_stream_spans_at_fold_time():
+    tel = Telemetry(sample="off")
+    slo = tel.track_slo("ttft_p95", metric="ttft_ms", threshold_ms=200.0,
+                        objective=0.95)
+    span = tel.begin_stream("http", "m")
+    span.mark()  # sub-ms TTFT: a good event
+    tel.finish_stream(span)
+    tel.flush()  # request-span fold; stream fold runs on scrape
+    tel.registry.prometheus_text()
+    assert slo.good.get() == 1 and slo.bad.get() == 0
+    assert not slo.breached()
+
+
+def test_slo_rejects_bad_declarations():
+    tel = Telemetry(sample="off")
+    with pytest.raises(ValueError):
+        tel.track_slo("x", objective=1.0)
+    with pytest.raises(ValueError):
+        tel.track_slo("x", metric="nope")
+    with pytest.raises(ValueError):
+        SLO("x", threshold_ms=0.0)
+
+
+# -- StreamSpan ----------------------------------------------------------------
+def test_stream_span_per_attempt_ttft_and_itl():
+    tel = Telemetry()
+    span = tel.begin_stream("grpc", "m", op="stream")
+    base = span.attempts[0].start_ns
+    span.attempts[0].marks[:] = [base + 10_000_000, base + 12_000_000]
+    span.reconnect(abandoned=2, resent=1)
+    a1 = span.attempts[1]
+    a1.marks[:] = [a1.start_ns + 5_000_000, a1.start_ns + 6_000_000]
+    # TTFT per attempt: the reconnect's first chunk is measured from ITS
+    # open, never from the stream's birth (retries don't inflate TTFT)
+    assert span.ttft_ms_per_attempt() == pytest.approx([10.0, 5.0])
+    # ITL within attempts only: 2 gaps, never one across the reconnect
+    assert span.itl_values_ms() == pytest.approx([2.0, 1.0])
+    assert span.chunk_count == 4
+    d = span.as_dict()
+    assert d["reconnects"] == 1 and d["chunks"] == 4
+    assert [e for e in d["events"] if e["name"] == "reconnect"]
+    tel.finish_stream(span)
+    tel.registry.prometheus_text()  # folds
+    assert tel.stream_chunks_total.labels("grpc").get() == 4
+
+
+def test_finish_stream_idempotent_and_error_classified():
+    tel = Telemetry()
+    span = tel.begin_stream("http", "m")
+    tel.finish_stream(span, error=ConnectionRefusedError("nope"))
+    tel.finish_stream(span)  # second close must not double-count
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("http").get() == 1
+    snap = tel.registry.snapshot()
+    errs = snap["client_tpu_stream_errors_total"]["series"]
+    assert sum(s["value"] for s in errs) == 1
+
+
+def test_stream_label_escaping_in_model_names():
+    """Hostile stream/model names must render as valid exposition text."""
+    tel = Telemetry()
+    span = tel.begin_stream('we"ird\nmodel\\name', 'm"x')
+    span.mark()
+    tel.finish_stream(span)
+    text = tel.registry.prometheus_text()
+    _assert_prometheus_conformant(text)
+    assert 'we\\"ird\\nmodel\\\\name' in text
+
+
+def test_windowed_gauges_exported_at_scrape():
+    tel = Telemetry()
+    for _ in range(3):
+        span = tel.begin_stream("http", "m")
+        span.mark()
+        span.mark()
+        tel.finish_stream(span)
+    text = tel.registry.prometheus_text()
+    _assert_prometheus_conformant(text)
+    for metric in ("ttft_ms", "itl_ms", "stream_duration_ms"):
+        assert (f'client_tpu_stream_window_ms{{metric="{metric}",'
+                f'frontend="http",quantile="p95"}}') in text
+        assert (f'client_tpu_stream_window_count{{metric="{metric}",'
+                f'frontend="http"}}') in text
+
+
+# -- frontends e2e -------------------------------------------------------------
+def test_http_generate_stream_traced_and_joined():
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            events = _drain_generate(client, max_tokens=5)
+    assert len(events) == 5
+    span = client.last_stream_span()
+    assert span is not None and span.chunk_count == 5
+    ttfts = span.ttft_ms_per_attempt()
+    assert len(ttfts) == 1 and ttfts[0] > 0.0
+    assert len(span.itl_values_ms()) == 4
+    records = [r for r in core.access_records()
+               if r["trace_id"] == span.trace_id]
+    assert len(records) == 1
+    assert records[0]["client_span_id"] == span.span_id
+    assert records[0]["responses"] == 5
+    assert records[0]["first_response_ns"] > 0
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("http").get() == 1
+    assert tel.stream_chunks_total.labels("http").get() == 5
+    # the ring retained the stream span
+    trace = tel.recent_traces()[-1]
+    assert trace["op"] == "generate_stream" and trace["chunks"] == 5
+
+
+def test_http_generate_stream_abandoned_counts():
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            gen = client.generate_stream(
+                "tiny_lm_generate", _generate_inputs(max_tokens=8))
+            next(gen)
+            gen.close()  # abandon mid-stream
+    tel.registry.prometheus_text()
+    assert tel.stream_abandoned_total.labels("http").get() == 1
+    assert tel.streams_total.labels("http").get() == 1
+
+
+def test_http_generate_stream_error_finishes_span():
+    tel = Telemetry()
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            with pytest.raises(InferenceServerException):
+                list(client.generate_stream("no_such_model", {"X": 1}))
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("http").get() == 1
+    snap = tel.registry.snapshot()
+    errs = snap["client_tpu_stream_errors_total"]["series"]
+    assert sum(s["value"] for s in errs) == 1
+
+
+def test_aio_generate_stream_traced_and_joined():
+    import client_tpu.http.aio as aioclient
+
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    server = AioHttpInferenceServer(core).start()
+    try:
+        async def drive():
+            async with aioclient.InferenceServerClient(server.url) as client:
+                client.configure_telemetry(tel)
+                events = []
+                async for event in client.generate_stream(
+                        "tiny_lm_generate", _generate_inputs(max_tokens=4)):
+                    events.append(event)
+                return events, client.last_stream_span()
+
+        events, span = asyncio.run(drive())
+    finally:
+        server.stop()
+    assert len(events) == 4 and span.chunk_count == 4
+    records = [r for r in core.access_records()
+               if r["trace_id"] == span.trace_id]
+    assert len(records) == 1
+    assert records[0]["client_span_id"] == span.span_id
+    assert records[0]["responses"] == 4
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("http_aio").get() == 1
+
+
+def test_grpc_stream_traced_and_joined():
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    with GrpcInferenceServer(core) as server:
+        with grpcclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            q: "queue.Queue" = queue.Queue()
+            client.start_stream(lambda r, e: q.put((r, e)))
+            tokens = grpcclient.InferInput("TOKENS", [1, 3], "INT32")
+            tokens.set_data_from_numpy(np.array([[1, 2, 3]], dtype=np.int32))
+            mx = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+            mx.set_data_from_numpy(np.array([4], dtype=np.int32))
+            client.async_stream_infer(
+                "tiny_lm_generate", [tokens, mx],
+                enable_empty_final_response=True, request_id="obs-stream")
+            received = 0
+            while True:
+                result, error = q.get(timeout=30)
+                assert error is None, error
+                if result.is_final_response() and result.is_null_response():
+                    break
+                received += 1
+            span = client.stream_span()
+            assert span is not None
+            client.stop_stream()
+    assert received == 4
+    # marks include the empty-final frame
+    assert span.chunk_count == 5
+    assert span.ttft_ms_per_attempt()[0] > 0.0
+    records = [r for r in core.access_records()
+               if r["trace_id"] == span.trace_id]
+    assert len(records) == 1
+    assert records[0]["client_span_id"] == span.span_id
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("grpc").get() == 1
+
+
+def test_grpc_aio_stream_infer_traced():
+    import client_tpu.grpc.aio as aioclient
+
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    with GrpcInferenceServer(core) as server:
+        async def drive():
+            async with aioclient.InferenceServerClient(server.url) as client:
+                client.configure_telemetry(tel)
+
+                async def requests():
+                    tokens = aioclient.InferInput("TOKENS", [1, 3], "INT32")
+                    tokens.set_data_from_numpy(
+                        np.array([[1, 2, 3]], dtype=np.int32))
+                    mx = aioclient.InferInput("MAX_TOKENS", [1], "INT32")
+                    mx.set_data_from_numpy(np.array([3], dtype=np.int32))
+                    yield {
+                        "model_name": "tiny_lm_generate",
+                        "inputs": [tokens, mx],
+                        "enable_empty_final_response": True,
+                    }
+
+                stream = await client.stream_infer(requests())
+                received = 0
+                async for result, error in stream:
+                    assert error is None
+                    if result.is_final_response() and result.is_null_response():
+                        break
+                    received += 1
+                stream.cancel()
+                return received, client.stream_span()
+
+        received, span = asyncio.run(drive())
+    assert received == 3 and span.chunk_count == 4
+    records = [r for r in core.access_records()
+               if r["trace_id"] == span.trace_id]
+    assert records and records[0]["client_span_id"] == span.span_id
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("grpc_aio").get() == 1
+
+
+# -- reconnect bridge ---------------------------------------------------------
+@pytest.mark.stream_observe_smoke
+def test_stream_reconnect_bridged_exactly_once_with_abandoned_counts():
+    """A killed auto-reconnect stream: the StreamReconnected event lands
+    in the telemetry counters exactly once (including the abandoned
+    sequence count) AND as a reconnect sub-attempt on the stream span,
+    with TTFT recorded per attempt."""
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    events: "queue.Queue" = queue.Queue()
+    with GrpcInferenceServer(core) as server:
+        with ChaosProxy("127.0.0.1", server.port) as proxy:
+            policy = tel.attach(ResiliencePolicy(retry=RetryPolicy(
+                max_attempts=4, initial_backoff_s=0.02, max_backoff_s=0.2,
+                rng=SEEDED_RNG())))
+            with grpcclient.InferenceServerClient(
+                    proxy.url, channel_args=_FAST_REDIAL) as client:
+                client.configure_resilience(policy)
+                client.configure_telemetry(tel)
+                client.start_stream(
+                    lambda r, e: events.put((r, e)), auto_reconnect=True)
+                _, inputs = _simple_inputs(grpcclient)
+
+                client.async_stream_infer("simple", inputs, request_id="a")
+                result, error = events.get(timeout=30)
+                assert error is None
+
+                # freeze the proxy so the sequence request is provably in
+                # flight, then kill the established connection
+                proxy.pause_forwarding = True
+                client.async_stream_infer(
+                    "simple", inputs, request_id="seq-b", sequence_id=77,
+                    sequence_start=True)
+                time.sleep(0.2)
+                proxy.reset_active()
+                proxy.pause_forwarding = False
+
+                result, error = events.get(timeout=30)
+                assert error is None and isinstance(result, StreamReconnected)
+                assert result.abandoned_request_ids == ["seq-b"]
+
+                client.async_stream_infer("simple", inputs, request_id="c")
+                result, error = events.get(timeout=30)
+                assert error is None
+
+                span = client.stream_span()
+                client.stop_stream()
+
+    # exactly-once counters, fed by the observer hook (not the callback)
+    assert tel.stream_reconnects_total.get() == 1
+    assert tel.stream_abandoned_sequences_total.get() == 1
+    # the span carries the reconnect as a sub-attempt with its own TTFT
+    assert len(span.attempts) == 2
+    ttfts = span.ttft_ms_per_attempt()
+    assert len(ttfts) == 2 and all(v > 0.0 for v in ttfts)
+    d = span.as_dict()
+    reconnect_events = [e for e in d["events"] if e["name"] == "reconnect"]
+    assert len(reconnect_events) == 1
+    assert reconnect_events[0]["abandoned"] == 1
+
+
+def test_grpc_terminal_stream_error_finishes_span_with_error():
+    """A stream that dies terminally (and is never stop_stream'd) must
+    still close its span WITH the error — stream_errors_total moves and
+    the span records the failure, not a clean finish."""
+    tel = Telemetry()
+    events: "queue.Queue" = queue.Queue()
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    client = grpcclient.InferenceServerClient(
+        f"127.0.0.1:{dead_port}", channel_args=_FAST_REDIAL)
+    try:
+        client.configure_telemetry(tel)
+        client.start_stream(lambda r, e: events.put((r, e)))
+        _, inputs = _simple_inputs(grpcclient)
+        client.async_stream_infer("simple", inputs)
+        result, error = events.get(timeout=30)
+        assert error is not None  # terminal: connection refused
+        # the span closed at the terminal error, no stop_stream needed
+        deadline = time.monotonic() + 5
+        while not getattr(client.stream_span(), "end_ns", 0):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert client.stream_span().error is not None
+    finally:
+        client.close()
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("grpc").get() == 1
+    snap = tel.registry.snapshot()
+    errs = snap["client_tpu_stream_errors_total"]["series"]
+    assert sum(s["value"] for s in errs
+               if s["labels"]["frontend"] == "grpc") == 1
+
+
+def test_phase_breakdown_excludes_stream_spans():
+    """Stream spans share the trace ring but their whole-stream-scale
+    attempt/ttft intervals must not pollute the unary phase breakdown."""
+    tel = Telemetry()
+    req = tel.begin("http", "m")
+    now = time.perf_counter_ns()
+    req.phase("attempt", now, now + 1_000_000)  # 1 ms
+    tel.finish(req)
+    stream = tel.begin_stream("http", "m")
+    stream.mark()
+    stream.attempts[0].marks[0] = stream.start_ns + 5_000_000_000  # 5 s ttft
+    tel.finish_stream(stream)
+    phases = tel.phase_breakdown()
+    assert phases["attempt"]["count"] == 1  # the request span only
+    assert phases["attempt"]["p50"] < 100.0
+    assert "ttft" not in phases  # stream vocabulary stays out
+    assert tel.stream_breakdown()["ttft_ms"]["count"] == 1
+
+
+def test_slo_value_at_threshold_is_good_in_both_views():
+    """A value exactly equal to the threshold counts good in the
+    cumulative counters AND in the windowed burn-rate view."""
+    tel = Telemetry(sample="off")
+    slo = tel.track_slo("edge", metric="ttft_ms", threshold_ms=200.0,
+                        objective=0.95)
+    for _ in range(10):
+        slo.observe(200.0)
+    assert slo.good.get() == 10 and slo.bad.get() == 0
+    assert slo.window.fraction_le(200.0) == 1.0
+    assert slo.burn_rate() == 0.0 and not slo.breached()
+
+
+# -- pool TTFT feed ------------------------------------------------------------
+def test_pool_generate_stream_feeds_endpoint_ttft():
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry()
+    with HttpInferenceServer(core) as server:
+        client = PoolClient([server.url], protocol="http",
+                            health_interval_s=None, rng=SEEDED_RNG(),
+                            telemetry=tel)
+        try:
+            events = _drain_generate(client, max_tokens=3)
+        finally:
+            client.close()
+    assert len(events) == 3
+    text = tel.registry.prometheus_text()
+    _assert_prometheus_conformant(text)
+    assert (f'client_tpu_pool_endpoint_ttft_ms{{url="{server.url}",'
+            f'quantile="p95"}}') in text
+    # the endpoint client's own stream span traced through the shared tel
+    assert tel.streams_total.labels("http").get() == 1
+
+
+# -- harness integrations ------------------------------------------------------
+def test_genai_perf_sources_ttft_from_stream_span():
+    from client_tpu.genai_perf import GenAiPerfRunner
+
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        runner = GenAiPerfRunner(server.url, "tiny_lm_generate", "generate",
+                                 prompt_tokens=4, output_tokens=3,
+                                 observe=True)
+        runner.run(1, 1)  # warmup (compile)
+        out = runner.run(1, 2)
+    assert out["sessions"] == 2 and out["errors"] == 0
+    assert out["telemetry_source"] == "stream_span"
+    assert out["ttft_ms"]["p50"] > 0.0
+    assert out["ttft_ms_stopwatch"]["p50"] > 0.0
+    assert set(out["telemetry_divergence_ms"]) == {"ttft_p50_ms",
+                                                   "itl_p50_ms"}
+    assert isinstance(out["telemetry_warning"], bool)
+
+
+def test_genai_perf_observe_rejects_sequence_mode():
+    from client_tpu.genai_perf import GenAiPerfRunner
+
+    with pytest.raises(ValueError, match="observe"):
+        GenAiPerfRunner("localhost:1", "decoder_lm", "sequence", 4, 4,
+                        observe=True)
+
+
+def test_perf_generate_stream_breakdown():
+    from client_tpu.perf import PerfRunner
+
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        runner = PerfRunner(server.url, "http", "tiny_lm_generate",
+                            observe=True, generate_stream=True,
+                            stream_prompt_tokens=4, stream_output_tokens=3)
+        try:
+            runner.run(1, 1)  # warmup
+            out = runner.run(1, 3)
+        finally:
+            runner.close()
+    assert out["errors"] == 0 and out["requests"] >= 3
+    stream = out["client_stream_ms"]
+    for key in ("ttft_ms", "itl_ms", "stream_duration_ms"):
+        assert stream[key]["p50"] > 0.0, (key, stream)
+    assert stream["ttft_ms"]["count"] >= 3
+
+
+def test_perf_generate_stream_requires_http():
+    from client_tpu.perf import PerfRunner
+
+    with pytest.raises(ValueError, match="http"):
+        PerfRunner("localhost:1", "grpc", "tiny_lm_generate",
+                   generate_stream=True)
+
+
+# -- concurrent scrape vs stream fold -----------------------------------------
+def test_concurrent_scrape_vs_stream_fold():
+    """Exporters racing finish_stream folds: every stream is folded
+    exactly once, the exposition stays conformant, nothing goes negative."""
+    tel = Telemetry(sample="off")
+    n_streams = 200
+    stop = threading.Event()
+    errors = []
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                _assert_prometheus_conformant(tel.registry.prometheus_text())
+        except Exception as e:  # pragma: no cover - the failure under test
+            errors.append(e)
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(n_streams):
+            span = tel.begin_stream("http", "m")
+            span.mark()
+            span.mark()
+            tel.finish_stream(span)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+    assert not errors
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("http").get() == n_streams
+    assert tel.stream_chunks_total.labels("http").get() == 2 * n_streams
+
+
+# -- chaos smoke ---------------------------------------------------------------
+@pytest.mark.stream_observe_smoke
+@pytest.mark.observe_smoke
+def test_stream_observe_smoke_flap_chaos():
+    """The CI streaming-observability smoke (tools/chaos_smoke.sh): flap
+    chaos over a traced generate_stream — TTFT recorded per attempt on
+    every completed stream, and no exported metric is negative or NaN."""
+    core = ServerCore(default_model_zoo())
+    tel = Telemetry(sample="always")
+    slo = tel.track_slo("smoke_ttft_p95", metric="ttft_ms",
+                        threshold_ms=30000.0, objective=0.95)
+    with HttpInferenceServer(core) as server:
+        with ChaosProxy("127.0.0.1", server.port) as proxy:
+            with httpclient.InferenceServerClient(proxy.url) as client:
+                client.configure_telemetry(tel)
+                completed = 0
+                for i in range(6):
+                    if i == 3:
+                        # RST the pooled connection: the next stream pays a
+                        # reconnect, its TTFT still recorded per attempt
+                        proxy.reset_active()
+                    try:
+                        events = _drain_generate(client, max_tokens=3)
+                        assert len(events) == 3
+                        completed += 1
+                    except InferenceServerException:
+                        pass  # a mid-flap casualty is part of the exercise
+    assert completed >= 4
+    tel.registry.prometheus_text()
+    assert tel.streams_total.labels("http").get() == 6
+    # every completed stream recorded a positive TTFT
+    spans = [t for t in tel.recent_traces() if t.get("op") == "generate_stream"]
+    with_ttft = [t for t in spans if t["ttft_ms"]]
+    assert len(with_ttft) >= completed
+    assert all(v > 0.0 for t in with_ttft for v in t["ttft_ms"])
+    assert slo.good.get() + slo.bad.get() >= completed
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            for key, value in obj.items():
+                if key in ("value", "count", "sum"):
+                    if isinstance(value, (int, float)):
+                        assert value >= 0 and value == value, (key, obj)
+                walk(value)
+        elif isinstance(obj, list):
+            for item in obj:
+                walk(item)
+
+    walk(tel.registry.snapshot())

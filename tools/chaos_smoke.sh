@@ -7,12 +7,16 @@
 #
 # The observability smoke (tests/test_observe.py) runs flap chaos with
 # telemetry on and asserts retry/breaker counters are non-zero and no
-# exported metric goes negative.
+# exported metric goes negative. The streaming-observability smoke
+# (tests/test_stream_observe.py) runs flap chaos over traced streams:
+# reconnect sub-spans present, TTFT recorded per attempt, no
+# negative/NaN metric.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke' \
     -p no:cacheprovider \
-    tests/test_resilience.py tests/test_pool.py tests/test_observe.py "$@"
+    tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
+    tests/test_stream_observe.py "$@"
