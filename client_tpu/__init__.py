@@ -15,6 +15,12 @@ client libraries (triton-inference-server/client), designed TPU-first:
   frontends — active ready-probing + passive outlier ejection, routing
   policies with per-endpoint circuit breakers, shared-deadline failover
   (sequence requests are never silently re-sent), and hedged requests.
+- ``client_tpu.batch``: client-side adaptive micro-batching — an opt-in
+  coalescing dispatcher (``BatchingClient``/``AioBatchingClient``, or
+  ``.coalescing()`` on any frontend/pool) that stacks concurrent
+  compatible ``infer()`` calls into one KServe request within an
+  arrival-rate-tuned window and scatters result rows back per caller
+  (docs/batching.md).
 - ``client_tpu.observe``: client-side observability — request-phase span
   tracing with sampling and Chrome trace dumps, a Prometheus/JSON metrics
   registry fed by the resilience + pool event streams, and W3C

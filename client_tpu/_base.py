@@ -14,6 +14,30 @@ import threading
 import time
 from typing import Dict, Optional
 
+# the four frontends' infer() signatures share this positional prefix;
+# folding positionals into kwargs lets the wrapper layers (pool, batch)
+# stay drop-in replacements for code that calls e.g. client.infer("m",
+# inputs, "2")
+INFER_POSITIONAL_PREFIX = (
+    "model_version", "outputs", "request_id", "sequence_id",
+    "sequence_start", "sequence_end", "priority", "timeout",
+    "client_timeout", "headers",
+)
+
+
+def fold_infer_args(args, kwargs):
+    """Fold ``infer``'s shared positional prefix into ``kwargs``."""
+    if len(args) > len(INFER_POSITIONAL_PREFIX):
+        raise TypeError(
+            "too many positional arguments to wrapped infer(); the "
+            f"frontends diverge after {INFER_POSITIONAL_PREFIX[-1]!r} — "
+            "pass the rest by keyword")
+    for name, value in zip(INFER_POSITIONAL_PREFIX, args):
+        if name in kwargs:
+            raise TypeError(f"infer() got multiple values for argument {name!r}")
+        kwargs[name] = value
+    return kwargs
+
 
 class Request:
     """A mutable view of an outgoing request handed to plugins (headers bag)."""
@@ -49,6 +73,12 @@ class InferenceServerClientBase:
     """Holds the (single) registered plugin and applies it before network ops,
     plus the shared resilience hook every frontend routes its transport
     through (see ``client_tpu.resilience``)."""
+
+    # telemetry frontend label ("http", "grpc", "http_aio", "grpc_aio");
+    # wrapper layers derive theirs from it (e.g. batch -> "http+batch")
+    _FRONTEND = "client"
+    # which batching wrapper coalescing() builds (aio frontends flip this)
+    _BATCH_AIO = False
 
     def __init__(self):
         self._plugin: Optional[InferenceServerClientPlugin] = None
@@ -110,6 +140,19 @@ class InferenceServerClientBase:
         if override is False:
             return None
         return override if override is not None else self._resilience
+
+    # -- micro-batching -----------------------------------------------------
+    def coalescing(self, **kwargs):
+        """Wrap this client in the opt-in coalescing dispatcher
+        (``client_tpu.batch``): concurrent compatible ``infer()`` calls are
+        stacked into one KServe request within an adaptive window and the
+        result rows scattered back per caller. Returns a
+        ``BatchingClient`` (or the asyncio twin for aio frontends); the
+        client's configured telemetry is adopted automatically."""
+        from .batch import AioBatchingClient, BatchingClient
+
+        cls = AioBatchingClient if self._BATCH_AIO else BatchingClient
+        return cls(self, **kwargs)
 
     def register_plugin(self, plugin: InferenceServerClientPlugin) -> None:
         if plugin is None:

@@ -82,6 +82,8 @@ def _to_exception(rpc_error: grpc.RpcError) -> InferenceServerException:
 class InferenceServerClient(InferenceServerClientBase):
     """Client for the KServe v2 GRPC protocol."""
 
+    _FRONTEND = "grpc"
+
     def __init__(
         self,
         url: str,
@@ -449,7 +451,7 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
         resilience=None,
     ) -> InferResult:
-        span = self._obs_begin("grpc", model_name)
+        span = self._obs_begin(self._FRONTEND, model_name)
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
         try:
@@ -556,7 +558,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 raise InferenceServerException(
                     "cannot start a stream: one is already active; stop it first"
                 )
-            span = self._obs_begin_stream("grpc", "", op="stream")
+            span = self._obs_begin_stream(self._FRONTEND, "", op="stream")
             self._stream_span = span
             if span is not None:
                 # stream-level traceparent: every request on the bidi call

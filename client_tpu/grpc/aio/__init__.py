@@ -39,6 +39,9 @@ __all__ = [
 class InferenceServerClient(InferenceServerClientBase):
     """Asyncio client for the KServe v2 GRPC protocol."""
 
+    _FRONTEND = "grpc_aio"
+    _BATCH_AIO = True
+
     def __init__(
         self,
         url: str,
@@ -330,7 +333,7 @@ class InferenceServerClient(InferenceServerClientBase):
         compression_algorithm: Optional[str] = None,
         resilience=None,
     ) -> InferResult:
-        span = self._obs_begin("grpc_aio", model_name)
+        span = self._obs_begin(self._FRONTEND, model_name)
         try:
             request = build_infer_request(
                 model_name, inputs, model_version, outputs, request_id,
@@ -377,7 +380,7 @@ class InferenceServerClient(InferenceServerClientBase):
         key joins every request on the call to the server's access
         records.
         """
-        span = self._obs_begin_stream("grpc_aio", "", op="stream")
+        span = self._obs_begin_stream(self._FRONTEND, "", op="stream")
         self._last_stream_span = span
         if span is not None:
             headers = dict(headers or {})

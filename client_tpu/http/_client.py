@@ -86,6 +86,8 @@ class InferenceServerClient(InferenceServerClientBase):
     thread at a time for sync calls; ``async_infer`` is internally pooled.
     """
 
+    _FRONTEND = "http"
+
     def __init__(
         self,
         url: str,
@@ -585,7 +587,7 @@ class InferenceServerClient(InferenceServerClientBase):
         ``resilience``: per-request ``ResiliencePolicy`` override. Sequence
         requests (``sequence_id != 0``) are non-idempotent: only
         never-sent connect failures are retried for them."""
-        span = self._obs_begin("http", model_name)
+        span = self._obs_begin(self._FRONTEND, model_name)
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
         try:
@@ -721,7 +723,7 @@ class InferenceServerClient(InferenceServerClientBase):
         close/error/abandon) and a ``traceparent`` header joins it to the
         server's access record for the generation."""
         hdrs = dict(headers or {})
-        span = self._obs_begin_stream("http", model_name)
+        span = self._obs_begin_stream(self._FRONTEND, model_name)
         self._last_stream_span = span
         if span is not None:
             hdrs[TRACEPARENT_HEADER] = span.traceparent()

@@ -48,6 +48,9 @@ __all__ = [
 class InferenceServerClient(InferenceServerClientBase):
     """Asyncio client for the KServe v2 HTTP/REST protocol."""
 
+    _FRONTEND = "http_aio"
+    _BATCH_AIO = True
+
     def __init__(
         self,
         url: str,
@@ -343,7 +346,7 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters: Optional[Dict[str, Any]] = None,
         resilience=None,
     ) -> InferResult:
-        span = self._obs_begin("http_aio", model_name)
+        span = self._obs_begin(self._FRONTEND, model_name)
         try:
             body, json_size = build_infer_body(
                 inputs, outputs, request_id, sequence_id, sequence_start,
@@ -437,7 +440,7 @@ class InferenceServerClient(InferenceServerClientBase):
         close/error/abandon) and a ``traceparent`` header joins it to the
         server's access record for the generation."""
         hdrs = dict(headers or {})
-        span = self._obs_begin_stream("http_aio", model_name)
+        span = self._obs_begin_stream(self._FRONTEND, model_name)
         self._last_stream_span = span
         if span is not None:
             hdrs[TRACEPARENT_HEADER] = span.traceparent()

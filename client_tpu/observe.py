@@ -145,14 +145,15 @@ def _percentile_row(values: Sequence[float],
                     ) -> Dict[str, float]:
     """count/avg/pN summary of exact samples — the one percentile-index
     convention every breakdown (phase, stream, span dump) shares."""
+    from .utils import sorted_percentile
+
     s = sorted(values)
     row: Dict[str, float] = {"count": len(s)}
     if not s:
         return row
     row["avg"] = round(sum(s) / len(s), 4)
     for q in percentiles:
-        idx = min(int(len(s) * q), len(s) - 1)
-        row[f"p{int(q * 100)}"] = round(s[idx], 4)
+        row[f"p{int(q * 100)}"] = round(sorted_percentile(s, q), 4)
     return row
 
 
@@ -469,6 +470,9 @@ class MetricsRegistry:
 # -- tracing ------------------------------------------------------------------
 # Canonical phase vocabulary (what each transport can observe of it):
 #   queue       time waiting for a worker/slot before the request is built
+#   coalesce_queue  time parked in the micro-batching dispatcher's queue
+#               before the coalesced wire request was issued
+#               (client_tpu.batch; enqueue -> claim)
 #   serialize   request body/tensor marshaling
 #   connect     TCP/TLS/channel establishment (when separable)
 #   send        request bytes on the wire (when separable from ttfb)
@@ -478,8 +482,8 @@ class MetricsRegistry:
 #   deserialize response unmarshaling into InferResult
 #   attempt     one resilient attempt (sub-span; repeated under retries)
 REQUEST_PHASES = (
-    "queue", "serialize", "connect", "send", "ttfb", "recv", "deserialize",
-    "attempt",
+    "queue", "coalesce_queue", "serialize", "connect", "send", "ttfb",
+    "recv", "deserialize", "attempt",
 )
 
 

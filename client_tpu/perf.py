@@ -26,12 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-
-def _percentile(sorted_values: List[float], q: float) -> float:
-    if not sorted_values:
-        return 0.0
-    idx = min(int(len(sorted_values) * q), len(sorted_values) - 1)
-    return sorted_values[idx]
+from .utils import sorted_percentile as _percentile
 
 
 def _random_tensor(datatype: str, shape: List[int], rng) -> np.ndarray:
@@ -92,6 +87,9 @@ class PerfRunner:
         generate_stream: bool = False,
         stream_prompt_tokens: int = 32,
         stream_output_tokens: int = 16,
+        coalesce: bool = False,
+        batch_window_us: Optional[float] = None,
+        batch_max: int = 32,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -107,7 +105,13 @@ class PerfRunner:
         default is the rolling p95). ``observe``: arm a fresh
         ``observe.Telemetry`` (sample=always) on every measurement run and
         append a client-phase p50/p99 breakdown
-        (serialize/send/ttfb/recv/deserialize) to each result row."""
+        (serialize/send/ttfb/recv/deserialize) to each result row.
+        ``coalesce``: wrap every measurement client in the micro-batching
+        dispatcher (``client_tpu.batch.BatchingClient``) so concurrent
+        workers share coalesced wire requests; ``batch_window_us`` pins
+        the coalescing window (default: adaptive) and ``batch_max``
+        bounds the stacked batch dimension. Each result row then carries
+        a ``client_batch`` block with achieved batch-size p50/p99."""
         self.url = url
         self._direct_url = url
         self.protocol = protocol
@@ -123,6 +127,9 @@ class PerfRunner:
         self.observe = observe
         self.observe_sample = observe_sample
         self.generate_stream = generate_stream
+        self.coalesce = coalesce
+        self.batch_window_us = batch_window_us
+        self.batch_max = batch_max
         self._telemetry = None  # fresh per measurement run (see run())
         self._proxy = None
         if generate_stream:
@@ -169,6 +176,19 @@ class PerfRunner:
                 "one ChaosProxy per replica instead (tools/bench_pool.py)")
         if self.hedge and not self.endpoints:
             raise ValueError("--hedge requires --endpoints")
+        if self.coalesce:
+            if protocol not in ("http", "grpc"):
+                raise ValueError(
+                    "--coalesce requires a python frontend (http|grpc): the "
+                    "batching dispatcher wraps the python clients")
+            if shared_memory != "none":
+                raise ValueError(
+                    "--coalesce requires --shared-memory none: shm-bound "
+                    "tensors never coalesce")
+            if generate_stream:
+                raise ValueError(
+                    "--coalesce applies to unary infers, not "
+                    "--generate-stream")
         if chaos is not None:
             from .testing.chaos import ChaosProxy
 
@@ -212,7 +232,7 @@ class PerfRunner:
 
             return NativeGrpcClient(self.url)
         if self.endpoints:
-            return self._make_pool_client(concurrency)
+            return self._wrap_coalescing(self._make_pool_client(concurrency))
         if self.protocol == "http":
             client = self._client_mod.InferenceServerClient(
                 self.url, concurrency=concurrency)
@@ -225,7 +245,22 @@ class PerfRunner:
                 retry=RetryPolicy(max_attempts=self.retries + 1)))
         if self._telemetry is not None:
             client.configure_telemetry(self._telemetry)
-        return client
+        return self._wrap_coalescing(client)
+
+    def _wrap_coalescing(self, client):
+        """ALL measurement workers share one client, so wrapping it in the
+        batching dispatcher coalesces across workers — the deployment
+        shape the dispatcher exists for."""
+        if not self.coalesce:
+            return client
+        from .batch import BatchingClient
+
+        return BatchingClient(
+            client,
+            window_us=self.batch_window_us,
+            batch_max_rows=self.batch_max,
+            telemetry=self._telemetry,
+        )
 
     def _make_pool_client(self, concurrency: int):
         from .pool import HedgePolicy, PoolClient
@@ -650,6 +685,23 @@ class PerfRunner:
             sample=self.observe_sample,
             trace_capacity=max(measurement_requests, 1024))
 
+    @staticmethod
+    def _batch_result(result: Dict[str, Any],
+                      batch_stats: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        """Achieved client-side batch sizes alongside the latency row."""
+        if batch_stats is not None:
+            result["client_batch"] = {
+                "dispatches": batch_stats["dispatches"],
+                "coalesced_calls": batch_stats["coalesced_calls"],
+                "solo_calls": batch_stats["solo_calls"],
+                "bypass_calls": batch_stats["bypass_calls"],
+                "window_us": batch_stats["window_us"],
+                "rows_p50": batch_stats["batch_rows"]["p50"],
+                "rows_p99": batch_stats["batch_rows"]["p99"],
+                "rows_mean": batch_stats["batch_rows"]["mean"],
+            }
+        return result
+
     def _observe_result(self, result: Dict[str, Any]) -> Dict[str, Any]:
         if self._telemetry is not None:
             result["client_phase_ms"] = self._telemetry.phase_breakdown()
@@ -688,11 +740,12 @@ class PerfRunner:
         for w in workers:
             w.join(timeout=600)
         elapsed = time.perf_counter() - t_start
+        batch_stats = client.stats() if self.coalesce else None
         client.close()
 
         lat_sorted = sorted(latencies)
         n = len(lat_sorted)
-        return self._observe_result({
+        return self._batch_result(self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
             "shared_memory": self.shared_memory,
@@ -708,7 +761,7 @@ class PerfRunner:
                 "p90": round(1000 * _percentile(lat_sorted, 0.90), 3),
                 "p99": round(1000 * _percentile(lat_sorted, 0.99), 3),
             },
-        })
+        }), batch_stats)
 
     def run_rate(self, rate: float, measurement_requests: int,
                  distribution: str = "constant",
@@ -758,6 +811,7 @@ class PerfRunner:
         for w in workers:
             w.join(timeout=600)
         elapsed = time.perf_counter() - t0_box[0]
+        batch_stats = client.stats() if self.coalesce else None
         client.close()
 
         lat_sorted = sorted(records)
@@ -768,7 +822,7 @@ class PerfRunner:
         # (reference threshold: perf_analyzer flags schedule slip; 1 ms
         # separates scheduler jitter from genuine queueing)
         delayed = sum(1 for lag in lag_sorted if lag > 1e-3)
-        return self._observe_result({
+        return self._batch_result(self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
             "shared_memory": self.shared_memory,
@@ -792,7 +846,7 @@ class PerfRunner:
                 "p99": round(1000 * _percentile(lag_sorted, 0.99), 3),
             },
             "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
-        })
+        }), batch_stats)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -877,6 +931,22 @@ def main(argv: Optional[List[str]] = None) -> int:
              "exhaustion (http protocol only; latency_ms = session e2e)",
     )
     parser.add_argument(
+        "--coalesce", action="store_true",
+        help="wrap measurement clients in the micro-batching dispatcher "
+             "(client_tpu.batch): concurrent workers share coalesced wire "
+             "requests; result rows gain achieved batch-size p50/p99",
+    )
+    parser.add_argument(
+        "--batch-window-us", type=float, default=None,
+        help="fixed coalescing window in microseconds (default: adaptive, "
+             "tuned from the observed arrival rate)",
+    )
+    parser.add_argument(
+        "--batch-max", type=int, default=32,
+        help="row cap per coalesced request (size to the model's "
+             "max_batch_size)",
+    )
+    parser.add_argument(
         "--stream-prompt-tokens", type=int, default=32,
         help="prompt length for --generate-stream sessions")
     parser.add_argument(
@@ -904,6 +974,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         generate_stream=args.generate_stream,
         stream_prompt_tokens=args.stream_prompt_tokens,
         stream_output_tokens=args.stream_output_tokens,
+        coalesce=args.coalesce,
+        batch_window_us=args.batch_window_us,
+        batch_max=args.batch_max,
     )
     try:
         if args.warmup_requests:

@@ -70,6 +70,7 @@ from collections import deque
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ._base import INFER_POSITIONAL_PREFIX, fold_infer_args
 from .resilience import (
     CONNECT,
     FATAL,
@@ -82,7 +83,7 @@ from .resilience import (
     RetryPolicy,
     classify_fault,
 )
-from .utils import InferenceServerException
+from .utils import InferenceServerException, sorted_percentile
 
 __all__ = [
     "ROUND_ROBIN",
@@ -433,7 +434,7 @@ class EndpointPool:
             if len(self._latencies) < min_samples:
                 return None
             ordered = sorted(self._latencies)
-        return ordered[min(int(len(ordered) * 0.95), len(ordered) - 1)]
+        return sorted_percentile(ordered, 0.95)
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         """Per-endpoint state + the per-endpoint ResilienceStats counters."""
@@ -459,27 +460,10 @@ class EndpointPool:
         return out
 
 
-# the four frontends' infer() signatures share this positional prefix;
-# folding positionals into kwargs keeps PoolClient a drop-in replacement
-# for code that calls e.g. client.infer("m", inputs, "2")
-_INFER_POSITIONALS = (
-    "model_version", "outputs", "request_id", "sequence_id",
-    "sequence_start", "sequence_end", "priority", "timeout",
-    "client_timeout", "headers",
-)
-
-
-def _fold_infer_args(args, kwargs):
-    if len(args) > len(_INFER_POSITIONALS):
-        raise TypeError(
-            "too many positional arguments to pooled infer(); the frontends "
-            f"diverge after {_INFER_POSITIONALS[-1]!r} — pass the rest by "
-            "keyword")
-    for name, value in zip(_INFER_POSITIONALS, args):
-        if name in kwargs:
-            raise TypeError(f"infer() got multiple values for argument {name!r}")
-        kwargs[name] = value
-    return kwargs
+# the shared positional-prefix folder lives in _base (the batching
+# dispatcher folds the same prefix); legacy aliases kept for callers
+_INFER_POSITIONALS = INFER_POSITIONAL_PREFIX
+_fold_infer_args = fold_infer_args
 
 
 def _default_client_factory(protocol: str, aio: bool):
@@ -670,6 +654,24 @@ class _PoolClientBase:
 
     def telemetry(self):
         return self._telemetry
+
+    @property
+    def _FRONTEND(self) -> str:
+        """The wrapped protocol's telemetry label (wrapper layers — the
+        batching dispatcher — derive their own label from it)."""
+        return getattr(
+            self.pool.endpoints[0].client, "_FRONTEND", "client")
+
+    def coalescing(self, **kwargs):
+        """Wrap this pool in the opt-in coalescing dispatcher
+        (``client_tpu.batch``): concurrent compatible ``infer()`` calls
+        merge into ONE pooled request — one routing decision, one
+        failover/hedge engine run — and the result rows scatter back per
+        caller. The pool's telemetry is adopted automatically."""
+        from .batch import AioBatchingClient, BatchingClient
+
+        cls = AioBatchingClient if self._AIO else BatchingClient
+        return cls(self, **kwargs)
 
     @classmethod
     def _is_broadcast(cls, name: str) -> bool:

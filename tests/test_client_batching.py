@@ -1,0 +1,581 @@
+"""Client-side micro-batching: the coalescing dispatcher end-to-end + units.
+
+Proves the ISSUE acceptance criteria: (a) exact per-caller row scatter
+under concurrency on live HTTP, GRPC and asyncio frontends; (b)
+incompatible keys never merge; (c) a failed batch fans the SAME typed
+error out to every caller in it; (d) sequence requests NEVER coalesce;
+(e) the dispatcher composes with retry/breaker resilience under the chaos
+proxy (``batch_smoke`` marker, run by tools/chaos_smoke.sh); (f) each
+caller's RequestSpan carries a ``coalesce_queue`` phase and the
+batch-size histogram exports via the Prometheus registry.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu._base import InferenceServerClientBase
+from client_tpu.batch import (
+    AioBatchingClient,
+    BatchingClient,
+    CoalescedInferResult,
+)
+from client_tpu.models import default_model_zoo
+from client_tpu.models.batched import BatchedMatMulModel
+from client_tpu.observe import Telemetry
+from client_tpu.pool import PoolClient
+from client_tpu.resilience import (
+    FATAL,
+    CircuitBreaker,
+    ResiliencePolicy,
+    RetryPolicy,
+    classify_fault,
+)
+from client_tpu.server import (
+    AioHttpInferenceServer,
+    GrpcInferenceServer,
+    HttpInferenceServer,
+    ServerCore,
+)
+from client_tpu.testing import ChaosProxy, Fault
+from client_tpu.utils import InferenceServerException
+
+W = BatchedMatMulModel(seed=0)._w_np  # the live servers use seed 0 too
+
+
+# -- helpers ------------------------------------------------------------------
+def _x_input(mod, value, rows=1):
+    x = np.full((rows, 64), float(value), dtype=np.float32)
+    inp = mod.InferInput("X", [rows, 64], "FP32").set_data_from_numpy(x)
+    return x, inp
+
+
+def _run_threads(n, fn):
+    errors = []
+
+    def wrapped(i):
+        try:
+            fn(i)
+        except Exception as e:  # pragma: no cover - surfaced by assert
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=wrapped, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    return errors
+
+
+class FakeResult:
+    """Server-shaped result for the stub inner client: echoes X*2 as Y."""
+
+    def __init__(self, inputs):
+        import numpy as _np
+
+        arrays = {}
+        outputs = []
+        for inp in inputs:
+            raw = inp._get_binary_data()
+            if raw is None:  # shm/JSON-staged bypass traffic: echo zeros
+                arrays["Y"] = _np.zeros(inp.shape(), dtype=_np.float32)
+                outputs.append({"name": "Y", "datatype": "FP32",
+                                "shape": list(inp.shape())})
+                continue
+            arr = _np.frombuffer(
+                bytes(raw), dtype=_np.float32
+            ).reshape(inp.shape())
+            arrays["Y"] = arr * 2.0
+            outputs.append(
+                {"name": "Y", "datatype": "FP32", "shape": list(arr.shape)})
+        self._arrays = arrays
+        self._response = {"model_name": "stub", "outputs": outputs}
+
+    def get_response(self):
+        return self._response
+
+    def get_output(self, name):
+        for out in self._response["outputs"]:
+            if out["name"] == name:
+                return out
+        return None
+
+    def as_numpy(self, name):
+        return self._arrays.get(name)
+
+
+class StubInner(InferenceServerClientBase):
+    """A scriptable inner client recording every wire-level infer."""
+
+    _FRONTEND = "stub"
+
+    def __init__(self, fail=None, delay_s=0.0):
+        super().__init__()
+        self.fail = fail  # callable(inputs) -> optional exception
+        self.delay_s = delay_s
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def infer(self, model_name, inputs, **kwargs):
+        with self.lock:
+            self.calls.append((
+                model_name,
+                [(i.name(), i.datatype(), list(i.shape())) for i in inputs],
+                dict(kwargs),
+            ))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail is not None:
+            exc = self.fail(inputs)
+            if exc is not None:
+                raise exc
+        return FakeResult(inputs)
+
+    def close(self):
+        pass
+
+
+# -- live-server scatter ------------------------------------------------------
+@pytest.fixture(scope="module")
+def http_server():
+    server = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    yield server
+    server.close()
+
+
+def test_exact_row_scatter_under_concurrency_http(http_server):
+    """Every concurrent caller gets exactly its own rows back — and the
+    work actually coalesced into fewer wire requests."""
+    inner = httpclient.InferenceServerClient(http_server.url, concurrency=8)
+    client = BatchingClient(inner, window_us=20000, batch_max_rows=32)
+    results = {}
+
+    def caller(i):
+        rows = 1 + (i % 3)  # mixed row counts share one key (same tail)
+        x, inp = _x_input(httpclient, i, rows)
+        r = client.infer("batched_matmul", [inp])
+        y = r.as_numpy("Y")
+        assert y.shape == (rows, 16)
+        np.testing.assert_allclose(y, x @ W, rtol=1e-2)
+        results[i] = True
+
+    errors = _run_threads(24, caller)
+    stats = client.stats()
+    client.close()
+    assert errors == []
+    assert len(results) == 24
+    assert stats["dispatches"] < 24, stats  # coalescing actually happened
+    assert stats["batch_rows"]["max"] > 1
+    assert stats["coalesced_calls"] > 0
+
+
+def test_exact_row_scatter_grpc():
+    server = GrpcInferenceServer(ServerCore(default_model_zoo())).start()
+    try:
+        client = grpcclient.InferenceServerClient(server.url).coalescing(
+            window_us=20000)
+
+        def caller(i):
+            x, inp = _x_input(grpcclient, i, rows=2)
+            r = client.infer("batched_matmul", [inp])
+            np.testing.assert_allclose(r.as_numpy("Y"), x @ W, rtol=1e-2)
+
+        errors = _run_threads(10, caller)
+        stats = client.stats()
+        client.close()
+        assert errors == []
+        assert stats["dispatches"] < 10
+        assert stats["batch_rows"]["max"] >= 4
+    finally:
+        server.close()
+
+
+def test_exact_row_scatter_aio():
+    with AioHttpInferenceServer(ServerCore(default_model_zoo())) as server:
+        async def main():
+            import client_tpu.http.aio as aioclient
+
+            client = aioclient.InferenceServerClient(server.url).coalescing(
+                window_us=20000)
+            assert isinstance(client, AioBatchingClient)
+
+            async def one(i):
+                x, inp = _x_input(aioclient, i)
+                r = await client.infer("batched_matmul", [inp])
+                np.testing.assert_allclose(r.as_numpy("Y"), x @ W, rtol=1e-2)
+
+            await asyncio.gather(*(one(i) for i in range(12)))
+            stats = client.stats()
+            await client.close()
+            assert stats["dispatches"] < 12
+            assert stats["batch_rows"]["max"] > 1
+
+        asyncio.run(main())
+
+
+def test_pool_composition(http_server):
+    """BatchingClient behind PoolClient: one coalesced request per
+    routing decision, results still scatter exactly."""
+    server_b = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    try:
+        pool = PoolClient([http_server.url, server_b.url], protocol="http",
+                          health_interval_s=None)
+        client = pool.coalescing(window_us=20000, batch_max_rows=32)
+
+        def caller(i):
+            x, inp = _x_input(httpclient, i)
+            r = client.infer("batched_matmul", [inp])
+            np.testing.assert_allclose(r.as_numpy("Y"), x @ W, rtol=1e-2)
+
+        errors = _run_threads(12, caller)
+        stats = client.stats()
+        client.close()
+        assert errors == []
+        assert stats["dispatches"] < 12
+    finally:
+        server_b.close()
+
+
+# -- dispatcher semantics (stub inner) ----------------------------------------
+def _barrier_callers(client, n, make_call):
+    """n threads that enqueue near-simultaneously (barrier + wide window)."""
+    barrier = threading.Barrier(n)
+
+    def caller(i):
+        barrier.wait(timeout=30)
+        make_call(i)
+
+    return _run_threads(n, caller)
+
+
+def test_incompatible_keys_never_merge():
+    inner = StubInner()
+    client = BatchingClient(inner, window_us=100000, batch_max_rows=64)
+
+    def caller(i):
+        if i % 2:
+            x = np.ones((1, 8), dtype=np.float32)
+            inp = httpclient.InferInput("X", [1, 8], "FP32")
+        else:
+            x = np.ones((1, 4), dtype=np.float32)  # different shape tail
+            inp = httpclient.InferInput("X", [1, 4], "FP32")
+        inp.set_data_from_numpy(x)
+        client.infer("stub", [inp])
+
+    errors = _barrier_callers(client, 8, caller)
+    assert errors == []
+    # two compatibility keys -> at least two dispatches, and NO dispatch
+    # mixes the 4-wide and 8-wide tails
+    assert len(inner.calls) >= 2
+    for _, inputs, _ in inner.calls:
+        tails = {tuple(shape[1:]) for _, _, shape in inputs}
+        assert len(tails) == 1
+
+
+def test_differing_parameters_never_merge():
+    inner = StubInner()
+    client = BatchingClient(inner, window_us=100000, batch_max_rows=64)
+
+    def caller(i):
+        x = np.ones((1, 8), dtype=np.float32)
+        inp = httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(x)
+        client.infer("stub", [inp], parameters={"tenant": i % 2})
+
+    errors = _barrier_callers(client, 8, caller)
+    assert errors == []
+    assert len(inner.calls) >= 2
+    for _, _, kwargs in inner.calls:
+        # every merged request carries exactly one parameter set
+        assert kwargs.get("parameters") in ({"tenant": 0}, {"tenant": 1})
+
+
+def test_batch_failure_fans_out_to_every_caller():
+    """One poisoned row fails the whole coalesced request; every caller in
+    the batch receives the SAME typed error."""
+    def fail(inputs):
+        arr = np.frombuffer(
+            bytes(inputs[0]._get_binary_data()), dtype=np.float32)
+        if np.any(arr == 666.0):
+            return InferenceServerException("poisoned row", status="400")
+        return None
+
+    inner = StubInner(fail=fail)
+    client = BatchingClient(inner, window_us=100000, batch_max_rows=64)
+    caught = []
+    lock = threading.Lock()
+
+    def caller(i):
+        value = 666.0 if i == 2 else float(i)
+        x = np.full((1, 8), value, dtype=np.float32)
+        inp = httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(x)
+        try:
+            client.infer("stub", [inp])
+        except InferenceServerException as e:
+            with lock:
+                caught.append((i, e))
+            return
+        with lock:
+            caught.append((i, None))
+
+    errors = _barrier_callers(client, 6, caller)
+    assert errors == []
+    assert len(inner.calls) == 1  # the poison rode ONE coalesced request
+    assert len(caught) == 6
+    excs = {e for _, e in caught}
+    assert excs == {caught[0][1]}  # the same typed error object fanned out
+    exc = next(iter(excs))
+    assert exc is not None and exc.status() == "400"
+    assert classify_fault(exc) == FATAL
+
+
+def test_sequence_requests_never_coalesce():
+    inner = StubInner()
+    client = BatchingClient(inner, window_us=100000, batch_max_rows=64)
+
+    def caller(i):
+        x = np.ones((1, 8), dtype=np.float32)
+        inp = httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(x)
+        if i == 0:
+            client.infer("stub", [inp], sequence_id=7, sequence_start=True,
+                         request_id=f"seq-{i}")
+        else:
+            client.infer("stub", [inp])
+
+    errors = _barrier_callers(client, 5, caller)
+    assert errors == []
+    seq_calls = [kw for _, inputs, kw in inner.calls if kw.get("sequence_id")]
+    assert len(seq_calls) == 1
+    # the sequence request went through verbatim, alone, params intact
+    assert seq_calls[0]["sequence_start"] is True
+    assert seq_calls[0]["request_id"] == "seq-0"
+    seq_inputs = next(
+        inputs for _, inputs, kw in inner.calls if kw.get("sequence_id"))
+    assert seq_inputs[0][2] == [1, 8]  # never stacked
+    assert client.stats()["bypass_calls"] == 1
+
+
+def test_solo_passthrough_is_verbatim(http_server):
+    """A lone eligible call passes through unchanged: native result type,
+    request_id preserved on the wire."""
+    inner = StubInner()
+    client = BatchingClient(inner, window_us=0)
+    x = np.ones((1, 8), dtype=np.float32)
+    inp = httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(x)
+    r = client.infer("stub", [inp], request_id="keep-me")
+    assert isinstance(r, FakeResult)  # not a CoalescedInferResult
+    assert inner.calls[0][2]["request_id"] == "keep-me"
+    assert client.stats()["solo_calls"] == 1
+
+
+def test_iterator_inputs_are_materialized():
+    """A generator of inputs must survive planning: direct frontend calls
+    iterate inputs exactly once, so the drop-in wrapper must too."""
+    inner = StubInner()
+    client = BatchingClient(inner, window_us=0)
+    # eligible generator -> solo passthrough still carries the input
+    x = np.ones((1, 8), dtype=np.float32)
+    r = client.infer("stub", iter([
+        httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(x)]))
+    np.testing.assert_allclose(r.as_numpy("Y"), 2.0 * x)
+    assert len(inner.calls[-1][1]) == 1
+    # ineligible generator (shm-bound second input) -> bypass keeps BOTH
+    shm = httpclient.InferInput("S", [1, 8], "FP32")
+    shm.set_shared_memory("region", 32)
+    ok = httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(x)
+    client.infer("stub", iter([ok, shm]))
+    assert len(inner.calls[-1][1]) == 2
+
+
+def test_shm_json_and_oversized_bypass():
+    inner = StubInner()
+    client = BatchingClient(inner, window_us=0, batch_max_rows=4)
+    # shm-bound input
+    shm_inp = httpclient.InferInput("X", [1, 8], "FP32")
+    shm_inp.set_shared_memory("region", 32)
+    client.infer("stub", [shm_inp])
+    # JSON-staged input
+    json_inp = httpclient.InferInput("X", [1, 8], "FP32")
+    json_inp.set_data_from_numpy(
+        np.ones((1, 8), dtype=np.float32), binary_data=False)
+    client.infer("stub", [json_inp])
+    # per-request resilience override
+    bin_inp = httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(
+        np.ones((1, 8), dtype=np.float32))
+    client.infer("stub", [bin_inp], resilience=ResiliencePolicy())
+    # already a full batch
+    big = httpclient.InferInput("X", [4, 8], "FP32").set_data_from_numpy(
+        np.ones((4, 8), dtype=np.float32))
+    client.infer("stub", [big])
+    assert client.stats()["bypass_calls"] == 4
+    assert client.stats()["dispatches"] == 0
+
+
+def test_adaptive_window_unit():
+    client = BatchingClient(StubInner(), batch_max_rows=32,
+                            max_window_us=20000)
+    state = client._new_state("m")
+    # no arrival history: immediate dispatch
+    assert client._window_s(state) == 0.0
+    # light traffic (gap == service time, one closed-loop caller): zero
+    state.ewma_gap_ns = 3e6
+    state.ewma_service_ns = 3e6
+    assert client._window_s(state) == 0.0
+    # heavy traffic: window opens, capped at half the service time
+    state.ewma_gap_ns = 50e3  # 50us gaps
+    state.ewma_service_ns = 10e6  # 10ms round trips
+    w = client._window_s(state)
+    assert 0.0 < w <= 0.005 + 1e-9
+    assert state.window_us == pytest.approx(w * 1e6)
+    # and never exceeds max_window_us
+    state.ewma_service_ns = 10e9
+    assert client._window_s(state) <= 0.02 + 1e-9
+    client.close()
+
+
+def test_coalesced_result_views():
+    """CoalescedInferResult rewrites shapes per slice and exposes the
+    undivided batch result."""
+    inner = StubInner()
+    client = BatchingClient(inner, window_us=100000, batch_max_rows=64)
+    boxes = {}
+
+    def caller(i):
+        x = np.full((2, 8), float(i), dtype=np.float32)
+        inp = httpclient.InferInput("X", [2, 8], "FP32").set_data_from_numpy(x)
+        boxes[i] = client.infer("stub", [inp])
+
+    errors = _barrier_callers(client, 3, caller)
+    assert errors == []
+    assert len(inner.calls) == 1
+    for i, r in boxes.items():
+        assert isinstance(r, CoalescedInferResult)
+        assert r.get_output("Y")["shape"] == [2, 8]
+        assert r.get_response()["outputs"][0]["shape"] == [2, 8]
+        np.testing.assert_allclose(
+            r.as_numpy("Y"), np.full((2, 8), 2.0 * i, dtype=np.float32))
+        assert r.batch_result().as_numpy("Y").shape == (6, 8)
+
+
+def test_scatter_shape_mismatch_is_typed_error():
+    class BadResult(FakeResult):
+        def get_response(self):
+            resp = dict(super().get_response())
+            resp["outputs"] = [dict(o, shape=[1, 8]) for o in resp["outputs"]]
+            return resp
+
+    class BadInner(StubInner):
+        def infer(self, model_name, inputs, **kwargs):
+            super().infer(model_name, inputs, **kwargs)
+            return BadResult(inputs)
+
+    client = BatchingClient(BadInner(), window_us=100000, batch_max_rows=64)
+    caught = []
+
+    def caller(i):
+        x = np.ones((1, 8), dtype=np.float32)
+        inp = httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(x)
+        try:
+            client.infer("stub", [inp])
+        except InferenceServerException as e:
+            caught.append(e)
+
+    errors = _barrier_callers(client, 3, caller)
+    assert errors == []
+    assert len(caught) == 3
+    assert all(e.status() == "COALESCE_SCATTER" for e in caught)
+
+
+# -- telemetry ----------------------------------------------------------------
+def test_coalesce_queue_phase_and_metrics(http_server):
+    tel = Telemetry(sample="always", trace_capacity=256)
+    inner = httpclient.InferenceServerClient(http_server.url, concurrency=8)
+    inner.configure_telemetry(tel)
+    client = BatchingClient(inner, window_us=20000, batch_max_rows=32,
+                            telemetry=tel)
+    assert client.telemetry() is tel
+
+    def caller(i):
+        _, inp = _x_input(httpclient, i)
+        client.infer("batched_matmul", [inp])
+
+    errors = _run_threads(8, caller)
+    client.close()
+    assert errors == []
+    # each caller's span (frontend "http+batch") shows the coalesce_queue
+    # phase plus the shared wire attempt
+    spans = [t for t in tel.recent_traces()
+             if t.get("frontend") == "http+batch"]
+    assert len(spans) == 8
+    for span in spans:
+        phases = {p["name"] for p in span["phases"]}
+        assert "coalesce_queue" in phases
+        assert "attempt" in phases
+    # the batch-size histogram and window gauge export via the Prometheus
+    # registry (what /metrics serves)
+    text = tel.registry.prometheus_text()
+    assert "client_tpu_batch_rows_bucket" in text
+    assert 'client_tpu_batch_dispatch_total{model="batched_matmul"}' in text
+    assert "client_tpu_batch_window_us" in text
+    assert 'mode="coalesced"' in text
+
+
+def test_configure_telemetry_none_stops_metrics():
+    tel = Telemetry(sample="off")
+    client = BatchingClient(StubInner(), window_us=0, telemetry=tel)
+    x = np.ones((1, 8), dtype=np.float32)
+
+    def one():
+        inp = httpclient.InferInput("X", [1, 8], "FP32").set_data_from_numpy(x)
+        client.infer("stub", [inp])
+
+    one()
+    dispatch = tel.registry.counter("client_tpu_batch_dispatch_total",
+                                    labelnames=("model",))
+    assert dispatch.labels("stub").get() == 1
+    client.configure_telemetry(None)  # clear: spans AND instruments stop
+    one()
+    assert dispatch.labels("stub").get() == 1
+    assert client.stats()["dispatches"] == 2  # plain stats keep counting
+
+
+# -- chaos: batcher x retry/breaker -------------------------------------------
+@pytest.mark.batch_smoke
+def test_batcher_retry_breaker_under_chaos(http_server):
+    """Coalesced requests ride the inner client's resilience policy: under
+    a flapping proxy every caller still gets its exact rows (retries
+    recover the failed batches; a failed batch's error never silently
+    drops a caller)."""
+    proxy = ChaosProxy("127.0.0.1", http_server.port).start()
+    proxy.fault = Fault("flap", every=5)
+    try:
+        inner = httpclient.InferenceServerClient(proxy.url, concurrency=8)
+        inner.configure_resilience(ResiliencePolicy(
+            retry=RetryPolicy(max_attempts=6, initial_backoff_s=0.01,
+                              max_backoff_s=0.05),
+            breaker=CircuitBreaker(min_calls=64),
+        ))
+        client = BatchingClient(inner, window_us=5000, batch_max_rows=32)
+        done = []
+        lock = threading.Lock()
+
+        def caller(i):
+            for j in range(4):
+                x, inp = _x_input(httpclient, i * 10 + j)
+                r = client.infer("batched_matmul", [inp])
+                np.testing.assert_allclose(r.as_numpy("Y"), x @ W, rtol=1e-2)
+                with lock:
+                    done.append((i, j))
+
+        errors = _run_threads(8, caller)
+        stats = client.stats()
+        client.close()
+        assert errors == []
+        assert len(done) == 32
+        assert stats["dispatches"] >= 1
+    finally:
+        proxy.stop()

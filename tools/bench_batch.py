@@ -1,0 +1,224 @@
+"""Generate BENCH_BATCH.json: the client-side micro-batching artifact.
+
+Three questions, answered against live in-process servers running
+``BatchedMatMulModel`` (the dynamic batcher's showcase fixture — X
+FP32[-1, 64] @ W -> Y FP32[-1, 16]):
+
+1. **Sustained QPS at high concurrency** — 64 closed-loop callers through
+   a bare client vs the same callers through ``BatchingClient`` (adaptive
+   window, ``batch_max_rows`` sized to the model's ``max_batch_size``).
+   The acceptance bar is >=5x sustained infer/s for the coalesced arm.
+2. **Open-loop sustained-rate sweep** — ``perf.py``'s
+   ``--request-rate-range`` path at a ladder of offered rates, both arms:
+   achieved rate, latency p99 and schedule slip at each rung (the honest
+   throughput metric per arXiv:2210.04323), plus the achieved client-side
+   batch-size p50/p99 per rung.
+3. **Light-traffic A/B** — one closed-loop caller, bare -> coalesced ->
+   bare again: the adaptive window must collapse to zero and the p50
+   delta must sit inside the bare-vs-bare noise floor.
+
+Each arm runs against its OWN fresh server so the server-side
+``InferBatchStatistics`` (scraped via ``get_inference_statistics``) can
+be cross-checked per arm: with client coalescing on, batch sizes > 1 must
+show up on BOTH sides — the client's dispatch histogram and the server's
+executed-batch distribution.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_batch.py [-o BENCH_BATCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SHAPE = {"X": [1, 64]}
+MODEL = "batched_matmul"
+
+
+def _batch_stat_summary(stats: dict) -> dict:
+    """Condense a model's InferBatchStatistics into the committed row."""
+    rows = stats.get("batch_stats", [])
+    total_execs = sum(r["compute_infer"]["count"] for r in rows)
+    total_rows = sum(
+        r["batch_size"] * r["compute_infer"]["count"] for r in rows)
+    gt1 = sum(r["compute_infer"]["count"] for r in rows if r["batch_size"] > 1)
+    return {
+        "executions": total_execs,
+        "rows_executed": total_rows,
+        "mean_executed_batch": (
+            round(total_rows / total_execs, 2) if total_execs else 0.0),
+        "executions_batch_gt1": gt1,
+        "max_executed_batch": max(
+            (r["batch_size"] for r in rows), default=0),
+        "batch_sizes": {
+            str(r["batch_size"]): r["compute_infer"]["count"] for r in rows},
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_BATCH.json")
+    parser.add_argument("--concurrency", type=int, default=64)
+    parser.add_argument("--requests", type=int, default=1500,
+                        help="closed-loop requests for the unbatched arm")
+    parser.add_argument("--coalesced-requests", type=int, default=6000,
+                        help="closed-loop requests for the coalesced arm "
+                             "(it finishes ~an order of magnitude faster)")
+    parser.add_argument("--batch-max", type=int, default=32,
+                        help="row cap per coalesced request (the model "
+                             "declares max_batch_size=32)")
+    parser.add_argument("--rates", default="500:1000:2000:4000:8000",
+                        help="colon-separated open-loop offered rates "
+                             "(req/s)")
+    parser.add_argument("--ab-requests", type=int, default=400)
+    args = parser.parse_args()
+
+    from client_tpu.http import InferenceServerClient
+    from client_tpu.models import default_model_zoo
+    from client_tpu.perf import PerfRunner
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    out = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "model": MODEL,
+        "batch_max_rows": args.batch_max,
+        "note": (
+            "bare client vs BatchingClient (adaptive window) on "
+            "batched_matmul over the threaded HTTP frontend; each arm "
+            "runs against its OWN fresh server so the server-side "
+            "InferBatchStatistics cross-check is per-arm"
+        ),
+    }
+
+    def runner(url: str, coalesce: bool) -> PerfRunner:
+        return PerfRunner(
+            url, "http", MODEL, shape_overrides=SHAPE,
+            coalesce=coalesce, batch_max=args.batch_max)
+
+    def server_batch_stats(url: str) -> dict:
+        client = InferenceServerClient(url)
+        try:
+            stats = client.get_inference_statistics(MODEL)
+        finally:
+            client.close()
+        return _batch_stat_summary(stats["model_stats"][0])
+
+    # -- 1: sustained QPS at high concurrency (closed loop) ----------------
+    results = {}
+    for arm, coalesce, requests in (
+            ("unbatched", False, args.requests),
+            ("coalesced", True, args.coalesced_requests)):
+        server = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+        try:
+            r = runner(server.url, coalesce)
+            try:
+                r.run(8, 64)  # warmup: jit compile + connection pools
+                results[arm] = r.run(args.concurrency, requests)
+            finally:
+                r.close()
+            results[arm + "_server_batches"] = server_batch_stats(server.url)
+        finally:
+            server.close()
+    speedup = (results["coalesced"]["infer_per_sec"]
+               / max(results["unbatched"]["infer_per_sec"], 1e-9))
+    out["high_concurrency"] = {
+        "concurrency": args.concurrency,
+        "unbatched": results["unbatched"],
+        "coalesced": results["coalesced"],
+        "qps_speedup": round(speedup, 2),
+        "server_batches_unbatched": results["unbatched_server_batches"],
+        "server_batches_coalesced": results["coalesced_server_batches"],
+    }
+    print(f"closed-loop c={args.concurrency}: "
+          f"{results['unbatched']['infer_per_sec']} -> "
+          f"{results['coalesced']['infer_per_sec']} infer/s "
+          f"({speedup:.2f}x); server mean batch "
+          f"{results['unbatched_server_batches']['mean_executed_batch']} -> "
+          f"{results['coalesced_server_batches']['mean_executed_batch']}")
+
+    # -- 2: open-loop sustained-rate sweep ---------------------------------
+    rates = [float(r) for r in args.rates.split(":") if r]
+    sweep = []
+    for arm, coalesce in (("unbatched", False), ("coalesced", True)):
+        server = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+        try:
+            r = runner(server.url, coalesce)
+            try:
+                r.run(8, 64)  # warmup
+                for rate in rates:
+                    n = int(min(max(rate, 500), 4000))
+                    row = r.run_rate(rate, n, distribution="poisson",
+                                     pool_size=args.concurrency)
+                    sweep.append((arm, rate, row))
+                    print(f"open-loop {arm} rate={rate:g}: achieved "
+                          f"{row['achieved_rate']} p99 "
+                          f"{row['latency_ms']['p99']}ms late "
+                          f"{row['delayed_pct']}%")
+            finally:
+                r.close()
+        finally:
+            server.close()
+    out["open_loop"] = [
+        {"arm": arm, "offered_rate": rate, **row}
+        for arm, rate, row in sweep
+    ]
+
+    # -- 3: light-traffic A/B (1 in-flight caller) -------------------------
+    server = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    try:
+        def measure(coalesce: bool) -> dict:
+            r = runner(server.url, coalesce)
+            try:
+                r.run(1, 50)
+                return r.run(1, args.ab_requests)
+            finally:
+                r.close()
+
+        bare = measure(False)
+        coal = measure(True)
+        bare_rerun = measure(False)
+    finally:
+        server.close()
+    bare_p50s = [bare["latency_ms"]["p50"], bare_rerun["latency_ms"]["p50"]]
+    noise_floor_ms = round(abs(bare_p50s[0] - bare_p50s[1]), 3)
+    overhead_ms = round(
+        coal["latency_ms"]["p50"] - sum(bare_p50s) / 2, 3)
+    out["light_traffic_ab"] = {
+        "note": (
+            "single closed-loop caller: the adaptive window must collapse "
+            "to zero (every dispatch a verbatim passthrough) and the p50 "
+            "delta must sit inside the bare-vs-bare noise floor"),
+        "bare": bare,
+        "coalesced": coal,
+        "bare_rerun": bare_rerun,
+        "adaptive_window_us": coal["client_batch"]["window_us"],
+        "solo_dispatch_fraction": round(
+            coal["client_batch"]["solo_calls"]
+            / max(coal["client_batch"]["dispatches"], 1), 3),
+        "p50_overhead_ms": overhead_ms,
+        "noise_floor_ms": noise_floor_ms,
+        "within_noise": abs(overhead_ms) <= max(noise_floor_ms, 0.15),
+    }
+    print(f"light traffic: bare p50 {bare_p50s}, coalesced p50 "
+          f"{coal['latency_ms']['p50']} (overhead {overhead_ms}ms vs "
+          f"noise {noise_floor_ms}ms), window "
+          f"{coal['client_batch']['window_us']}us")
+
+    Path(args.output).write_text(json.dumps(out, indent=1) + "\n")
+    print(f"wrote {args.output}")
+    ok = speedup >= 5.0 and out["light_traffic_ab"]["within_noise"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,13 +10,16 @@
 # exported metric goes negative. The streaming-observability smoke
 # (tests/test_stream_observe.py) runs flap chaos over traced streams:
 # reconnect sub-spans present, TTFT recorded per attempt, no
-# negative/NaN metric.
+# negative/NaN metric. The micro-batching smoke (tests/
+# test_client_batching.py, batch_smoke marker) runs the coalescing
+# dispatcher against retry/breaker resilience under a flapping proxy:
+# every caller must still receive its exact rows.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
-    tests/test_stream_observe.py "$@"
+    tests/test_stream_observe.py tests/test_client_batching.py "$@"
