@@ -14,6 +14,15 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import observe as _observe
+
+# wire family segment -> data-plane accounting family
+SHM_FAMILY_OF = {
+    "systemsharedmemory": "system",
+    "cudasharedmemory": "cuda",
+    "tpusharedmemory": "tpu",
+}
+
 # the four frontends' infer() signatures share this positional prefix;
 # folding positionals into kwargs lets the wrapper layers (pool, batch)
 # stay drop-in replacements for code that calls e.g. client.infer("m",
@@ -119,6 +128,63 @@ class InferenceServerClientBase:
         if tel is None:
             return None
         return tel.begin_stream(frontend, model, op)
+
+    # -- data plane ----------------------------------------------------------
+    def _shm_call(self, family: str, op: str, call, *args, **kwargs):
+        """Run one shm register/unregister RPC under data-plane accounting
+        (registration latency + outcome). With no process-global recorder
+        installed this is one attribute check around the plain call."""
+        rec = _observe._DATAPLANE
+        if rec is None:
+            return call(*args, **kwargs)
+        t0 = time.perf_counter_ns()
+        try:
+            result = call(*args, **kwargs)
+        except BaseException:
+            rec.on_rpc(self._FRONTEND, family, op,
+                       (time.perf_counter_ns() - t0) * 1e-9, ok=False)
+            raise
+        rec.on_rpc(self._FRONTEND, family, op,
+                   (time.perf_counter_ns() - t0) * 1e-9)
+        return result
+
+    async def _shm_call_async(self, family: str, op: str, call,
+                              *args, **kwargs):
+        """Async twin of :meth:`_shm_call` for the aio frontends."""
+        rec = _observe._DATAPLANE
+        if rec is None:
+            return await call(*args, **kwargs)
+        t0 = time.perf_counter_ns()
+        try:
+            result = await call(*args, **kwargs)
+        except BaseException:
+            rec.on_rpc(self._FRONTEND, family, op,
+                       (time.perf_counter_ns() - t0) * 1e-9, ok=False)
+            raise
+        rec.on_rpc(self._FRONTEND, family, op,
+                   (time.perf_counter_ns() - t0) * 1e-9)
+        return result
+
+    # -- ORCA endpoint load ---------------------------------------------------
+    def _orca_opt_in(self, hdrs: Dict[str, str]) -> Dict[str, str]:
+        """Stamp the ORCA opt-in request header when the configured
+        telemetry declared an ``orca_format`` (caller-set values win)."""
+        tel = self._telemetry
+        if tel is not None and tel.orca_format is not None:
+            hdrs.setdefault(
+                _observe.ENDPOINT_LOAD_FORMAT_HEADER, tel.orca_format)
+        return hdrs
+
+    def _orca_ingest(self, result) -> None:
+        """Feed a response's ORCA header (if any) into the telemetry's
+        per-endpoint load gauges. Missing header → nothing happens, so
+        this is safe to call on every infer."""
+        tel = self._telemetry
+        if tel is None:
+            return
+        value = result.get_response_header(_observe.ENDPOINT_LOAD_HEADER)
+        if value is not None:
+            tel.ingest_endpoint_load(self._url, value)
 
     # -- resilience ---------------------------------------------------------
     def configure_resilience(self, policy) -> "InferenceServerClientBase":

@@ -1,0 +1,567 @@
+"""``python -m client_tpu.doctor`` — a one-command fleet snapshot.
+
+Answers "what is the fleet doing right now" in one shot: endpoint
+health and breaker states, SLO status and burn rates, windowed TTFT/ITL
+sketches, batch-dispatcher stats, the shm inventory and data-plane
+accounting, per-endpoint ORCA load, a client/server/network latency
+decomposition from a small probe load, and a clock-skew estimate from
+trace joins — emitted as a human-readable summary plus a JSON artifact,
+with anomaly flags (breaker open, SLO breach, shm churn above threshold,
+load/latency divergence, clock skew).
+
+CLI::
+
+    python -m client_tpu.doctor 127.0.0.1:8000 127.0.0.1:8001 \
+        --protocol http --model simple --json doctor.json
+
+Library::
+
+    from client_tpu.doctor import collect_snapshot, render_summary
+    snap = collect_snapshot(["127.0.0.1:8000"], telemetry=my_telemetry)
+
+When an existing :class:`~client_tpu.observe.Telemetry` is passed, its
+declared SLOs, stream windows and batch instruments are reported; the CLI
+builds a fresh one (so those sections reflect only the probe run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import observe
+from .observe import StatsCorrelator, Telemetry
+from .pool import PoolClient
+from .utils import InferenceServerException, sorted_percentile, triton_to_np_dtype
+
+__all__ = ["collect_snapshot", "render_summary", "main"]
+
+
+def _input_module(protocol: str):
+    if protocol == "http":
+        import client_tpu.http as mod
+    elif protocol == "grpc":
+        import client_tpu.grpc as mod
+    else:
+        raise ValueError(f"unknown protocol {protocol!r} (http|grpc)")
+    return mod
+
+
+def _bounded_client_factory(protocol: str,
+                            timeout_s: float) -> Callable[[str], Any]:
+    """Doctor clients with every transport call bounded by the probe
+    timeout: a replica that accepts TCP but never answers (the blackhole
+    fault) must cost one timeout per call, not the transport's 60 s
+    default times every snapshot RPC. HTTP bounds at the connection
+    pool; gRPC calls carry per-call deadlines (see _bounded_call)."""
+    mod = _input_module(protocol)
+    if protocol == "http":
+        return lambda url: mod.InferenceServerClient(
+            url, connection_timeout=timeout_s, network_timeout=timeout_s)
+    return lambda url: mod.InferenceServerClient(url)
+
+
+def _bounded_call(fn: Callable, *args, timeout_s: float, **kwargs) -> Any:
+    """Call a transport method with ``client_timeout=`` when it takes one
+    (gRPC); HTTP methods are already bounded by the factory's pool
+    timeouts."""
+    if observe.accepts_client_timeout(fn):
+        return fn(*args, client_timeout=timeout_s, **kwargs)
+    return fn(*args, **kwargs)
+
+
+def _synth_inputs(mod, metadata: Dict[str, Any]) -> List[Any]:
+    """Build one InferInput per declared model input, with dynamic (-1)
+    dims collapsed to 1 and deterministic fill data — enough to drive a
+    representative probe infer against any served model."""
+    inputs = []
+    for spec in metadata.get("inputs", []):
+        shape = [1 if int(d) < 0 else int(d) for d in spec.get("shape", [])]
+        datatype = spec.get("datatype", "FP32")
+        inp = mod.InferInput(spec.get("name", ""), shape, datatype)
+        n = int(np.prod(shape)) if shape else 1
+        if datatype == "BYTES":
+            data = np.array([b"doctor"] * n, dtype=np.object_).reshape(shape)
+        else:
+            np_dtype = np.dtype(triton_to_np_dtype(datatype))
+            data = np.ones(n, dtype=np_dtype).reshape(shape)
+        inp.set_data_from_numpy(data)
+        inputs.append(inp)
+    return inputs
+
+
+def _probe_endpoint(ep, mod, model: str, requests: int,
+                    timeout_s: float) -> Dict[str, Any]:
+    """Health-probe one endpoint, then drive ``requests`` probe infers on
+    its client (telemetry + ORCA ride along automatically). The LAST
+    infer is wall-clock bracketed for the skew estimate."""
+    out: Dict[str, Any] = {"url": ep.url}
+    try:
+        out["live"] = bool(ep.client.is_server_live(
+            probe=True, client_timeout=timeout_s))
+        out["ready"] = bool(ep.client.is_server_ready(
+            probe=True, client_timeout=timeout_s))
+    except InferenceServerException as e:
+        out["live"] = out["ready"] = False
+        out["health_error"] = str(e)[:200]
+    if not out["ready"]:
+        return out
+    try:
+        metadata = _bounded_call(ep.client.get_model_metadata, model,
+                                 timeout_s=timeout_s)
+        inputs = _synth_inputs(mod, metadata)
+    except Exception as e:
+        out["probe_error"] = f"metadata: {e}"[:200]
+        return out
+    latencies: List[float] = []
+    errors = 0
+    skew_id = f"doctor-skew-{ep.url}"
+    wall_t0 = wall_t1 = None
+    for i in range(max(requests, 1)):
+        last = i == max(requests, 1) - 1
+        t0 = time.perf_counter()
+        if last:
+            wall_t0 = time.time()
+        try:
+            ep.client.infer(model, inputs, client_timeout=timeout_s,
+                            request_id=skew_id if last else f"doctor-{i}")
+        except Exception as e:
+            errors += 1
+            out.setdefault("probe_error", str(e)[:200])
+            continue
+        if last:
+            wall_t1 = time.time()
+        latencies.append(time.perf_counter() - t0)
+    out["probe_requests"] = len(latencies)
+    out["probe_errors"] = errors
+    if latencies:
+        ordered = sorted(latencies)
+        out["probe_latency_ms"] = {
+            "avg": round(sum(ordered) / len(ordered) * 1e3, 3),
+            "p50": round(sorted_percentile(ordered, 0.5) * 1e3, 3),
+            "max": round(ordered[-1] * 1e3, 3),
+        }
+    # -- clock skew from the trace join (HTTP transports expose the
+    # access records at /v2/trace/access; wall_time_s is stamped at the
+    # server's end of handling, so the client-side bracket bounds it)
+    if wall_t0 is not None and wall_t1 is not None:
+        record = _find_access_record(ep.client, skew_id)
+        if record is not None and "wall_time_s" in record:
+            midpoint = (wall_t0 + wall_t1) / 2.0
+            out["clock_skew_ms"] = round(
+                (record["wall_time_s"] - midpoint) * 1e3, 3)
+            out["clock_skew_uncertainty_ms"] = round(
+                (wall_t1 - wall_t0) / 2.0 * 1e3, 3)
+            out["server_span"] = {
+                "queue_ns": record.get("queue_ns"),
+                "compute_ns": record.get("compute_ns"),
+                "total_ns": record.get("total_ns"),
+            }
+    return out
+
+
+def _find_access_record(client, request_id: str) -> Optional[Dict[str, Any]]:
+    get = getattr(client, "_get", None)  # sync HTTP transport only
+    if get is None:
+        return None
+    try:
+        resp = get("v2/trace/access")
+        if resp.status != 200:
+            return None
+        records = json.loads(resp.data)
+    except Exception:
+        return None
+    for record in reversed(records):
+        if record.get("request_id") == request_id:
+            return record
+    return None
+
+
+def _server_shm_status(client, timeout_s: float) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for family, getter in (
+            ("system", "get_system_shared_memory_status"),
+            ("tpu", "get_tpu_shared_memory_status")):
+        try:
+            out[family] = _bounded_call(getattr(client, getter),
+                                        timeout_s=timeout_s)
+        except Exception as e:
+            out[family] = {"error": str(e)[:200]}
+    return out
+
+
+def _total_dataplane_ops(dp: Dict[str, Any]) -> float:
+    """Every lifecycle op + registration RPC in one recorder snapshot."""
+    total = 0.0
+    for fam in dp.get("families", {}).values():
+        total += (fam["created"] + fam["attached"] + fam["map_reads"]
+                  + fam["map_writes"] + fam["destroyed"])
+    total += sum(dp.get("rpcs", {}).values())
+    return total
+
+
+def _local_shm(recorder) -> Dict[str, Any]:
+    from .utils import shared_memory, tpu_shared_memory
+
+    inventory = (shared_memory.region_inventory()
+                 + tpu_shared_memory.region_inventory())
+    return {
+        "local_inventory": inventory,
+        "dataplane": recorder.snapshot() if recorder is not None else None,
+    }
+
+
+def _slo_status(tel: Telemetry) -> List[Dict[str, Any]]:
+    return [
+        {
+            "name": slo.name,
+            "metric": slo.metric,
+            "threshold_ms": slo.threshold_ms,
+            "objective": slo.objective,
+            "window_s": slo.window_s,
+            "burn_rate": round(slo.burn_rate(), 4),
+            "breached": slo.breached(),
+        }
+        for slo in tel.slos()
+    ]
+
+
+def _registry_section(snapshot: Dict[str, Any], prefix: str) -> Dict[str, Any]:
+    return {name: family for name, family in snapshot.items()
+            if name.startswith(prefix) and family.get("series")}
+
+
+def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
+               skew_warn_ms: float) -> List[Dict[str, Any]]:
+    flags: List[Dict[str, Any]] = []
+    for ep in snap["endpoints"]:
+        url = ep["url"]
+        if not ep.get("live") or not ep.get("ready"):
+            flags.append({"flag": "endpoint_unhealthy", "url": url,
+                          "detail": ep.get("health_error", "not ready")})
+        if ep.get("probe_errors"):
+            flags.append({"flag": "probe_errors", "url": url,
+                          "detail": ep.get("probe_error", "")})
+        skew = ep.get("clock_skew_ms")
+        if skew is not None:
+            slack = ep.get("clock_skew_uncertainty_ms", 0.0)
+            if abs(skew) > skew_warn_ms + slack:
+                flags.append({"flag": "clock_skew", "url": url,
+                              "detail": f"{skew:+.1f} ms (±{slack:.1f})"})
+    for url, stats in snap.get("endpoint_stats", {}).items():
+        state = stats.get("breaker_state")
+        if state and state != "closed":
+            flags.append({"flag": "breaker_" + state, "url": url,
+                          "detail": f"breaker {state}"})
+        if stats.get("ejected"):
+            flags.append({"flag": "endpoint_ejected", "url": url,
+                          "detail": f"for {stats.get('ejected_for_s', 0)}s"})
+    for slo in snap.get("slos", []):
+        if slo["breached"]:
+            flags.append({
+                "flag": "slo_breached", "url": None,
+                "detail": f"{slo['name']}: burn {slo['burn_rate']:.2f}x"})
+    dataplane = snap.get("shm", {}).get("dataplane")
+    if dataplane and churn_threshold_ops_s:
+        # prefer the probe-window rate: the lifetime average of a
+        # long-quiet process dilutes a burst happening right now
+        churn = dataplane.get("churn_ops_per_s_window",
+                              dataplane.get("churn_ops_per_s", 0.0))
+        if churn > churn_threshold_ops_s:
+            flags.append({
+                "flag": "shm_churn_high", "url": None,
+                "detail": f"{churn:.0f} ops/s > {churn_threshold_ops_s:.0f}"})
+    # load/latency divergence: an endpoint much slower than the fleet
+    # median whose server-side busy signal is NOT above median — the
+    # extra milliseconds are outside the server (network, proxy, queueing
+    # in front of it). Endpoints with NO server-side signal are never
+    # flagged: without one the server cannot be ruled out as the cause.
+    rows = [(ep["url"], ep["probe_latency_ms"]["avg"],
+             _server_compute_us(snap, ep["url"]))
+            for ep in snap["endpoints"] if "probe_latency_ms" in ep]
+    if len(rows) >= 2:
+        latencies = sorted(lat for _, lat, _ in rows)
+        computes = sorted(c for _, _, c in rows if c is not None)
+        # LOWER median: with the upper one a 2-endpoint fleet's slower
+        # replica IS the median, so `lat > 2*median` could never fire
+        median_lat = latencies[(len(latencies) - 1) // 2]
+        median_compute = (computes[(len(computes) - 1) // 2]
+                          if computes else None)
+        for url, lat, compute_us in rows:
+            if compute_us is None or median_compute is None:
+                continue
+            slow = lat > 2.0 * median_lat and lat - median_lat > 1.0
+            if not slow:
+                continue
+            # does the server-side compute excess explain the latency
+            # excess? A ratio test on raw compute is noise-prone (tiny
+            # models compute in single-digit ms with same-magnitude
+            # jitter); the divergence question is whether the EXTRA
+            # milliseconds happened inside the server or outside it
+            excess_lat_ms = lat - median_lat
+            excess_compute_ms = max(compute_us - median_compute, 0.0) / 1e3
+            if excess_compute_ms < 0.5 * excess_lat_ms:
+                flags.append({
+                    "flag": "load_latency_divergence", "url": url,
+                    "detail": (f"latency {lat:.1f} ms vs fleet median "
+                               f"{median_lat:.1f} ms, server compute "
+                               f"explains {excess_compute_ms:.1f} ms of "
+                               f"the {excess_lat_ms:.1f} ms excess")})
+    return flags
+
+
+def _server_compute_us(snap: Dict[str, Any], url: str) -> Optional[float]:
+    """The endpoint's server-side busy signal: the decomposition's
+    per-request server compute measured over the probe window, falling
+    back to the ORCA-reported average. The window-scoped number comes
+    first — ORCA's ``avg_compute_infer_us`` is a lifetime average, so
+    one-time history (jit compile, warmup) can read as "busy" long after
+    the endpoint went idle and mask a divergence happening now."""
+    rows = [r for r in snap.get("decomposition", []) if r["url"] == url]
+    if rows:
+        return max(r["server_compute_ms"] for r in rows) * 1e3
+    load = snap.get("endpoint_stats", {}).get(url, {}).get("load")
+    if load:
+        us = load["metrics"].get("named_metrics.avg_compute_infer_us")
+        if us is not None:
+            return us
+    return None
+
+
+def collect_snapshot(
+    urls: Sequence[str],
+    protocol: str = "http",
+    model: str = "simple",
+    requests_per_endpoint: int = 8,
+    orca_format: Optional[str] = "json",
+    telemetry: Optional[Telemetry] = None,
+    churn_threshold_ops_s: float = 10000.0,
+    skew_warn_ms: float = 250.0,
+    probe_timeout_s: float = 10.0,
+    client_factory: Optional[Callable[[str], Any]] = None,
+) -> Dict[str, Any]:
+    """Probe the fleet and return the full snapshot dict (JSON-ready).
+
+    ``orca_format`` configures the Telemetry the doctor builds for the
+    probe; when a caller-supplied ``telemetry`` is passed it is used as
+    is — its own ``orca_format`` (possibly None) wins, since mutating
+    the caller's live telemetry mid-scrape would be worse than
+    honoring its configuration."""
+    tel = telemetry
+    if tel is None:
+        tel = Telemetry(sample="always", orca_format=orca_format,
+                        trace_capacity=max(
+                            1024, requests_per_endpoint * len(urls) * 2))
+    recorder = observe.dataplane()
+    scoped_recorder = recorder is None
+    if scoped_recorder:
+        # CLI runs (and hosts that never enabled accounting) still get a
+        # populated data-plane section and a live churn window — counting
+        # THIS process's shm ops (zero unless this process touches shm)
+        # rather than silently reporting None. With a caller-supplied
+        # Telemetry the recorder gets its own registry: probe-scoped shm
+        # instruments must not render frozen on the caller's long-lived
+        # scrape after the recorder is uninstalled below
+        recorder = observe.enable_dataplane(
+            tel.registry if telemetry is None else None)
+    mod = _input_module(protocol)
+    if client_factory is None:
+        client_factory = _bounded_client_factory(protocol, probe_timeout_s)
+    pool = PoolClient(list(urls), protocol=protocol, telemetry=tel,
+                      health_interval_s=None,
+                      client_factory=client_factory)
+    try:
+        correlator = StatsCorrelator(tel, pool,
+                                     call_timeout_s=probe_timeout_s)
+        correlator.poll_once()  # baseline for the decomposition deltas
+        dataplane_before = (recorder.snapshot()
+                            if recorder is not None else None)
+        probe_t0 = time.monotonic()
+        endpoints = []
+        for ep in pool.pool.endpoints:
+            report = _probe_endpoint(
+                ep, mod, model, requests_per_endpoint, probe_timeout_s)
+            # feed the manual probe verdict into the engine so
+            # endpoint_stats reflects what the doctor just observed
+            pool.pool.set_health(ep, report.get("ready", False))
+            endpoints.append(report)
+        correlator.poll_once()
+        tel.flush()
+        registry_snapshot = tel.registry.snapshot()
+        snap: Dict[str, Any] = {
+            "generated_unix": int(time.time()),
+            "urls": list(urls),
+            "protocol": protocol,
+            "model": model,
+            "endpoints": endpoints,
+            "endpoint_stats": pool.endpoint_stats(),
+            # per-endpoint probe averages: the network+client remainder
+            # is attributed to the endpoint that paid it, not a fleet mean
+            "decomposition": correlator.decomposition(client_ms_by_url={
+                ep["url"]: ep["probe_latency_ms"]["avg"]
+                for ep in endpoints if "probe_latency_ms" in ep}),
+            "slos": _slo_status(tel),
+            "stream_windows": _registry_section(
+                registry_snapshot, "client_tpu_stream_window"),
+            "batch": _registry_section(
+                registry_snapshot, "client_tpu_batch"),
+            "shm": _local_shm(recorder),
+        }
+        server_shm: Dict[str, Any] = {}
+        for ep in pool.pool.endpoints:
+            server_shm[ep.url] = _server_shm_status(ep.client,
+                                                    probe_timeout_s)
+        snap["shm"]["server_regions"] = server_shm
+        dp = snap["shm"]["dataplane"]
+        if dp is not None and dataplane_before is not None:
+            # churn over the probe window, not the recorder's lifetime: a
+            # long-quiet process must still flag a burst happening NOW
+            window_s = max(time.monotonic() - probe_t0, 1e-9)
+            dp["churn_ops_per_s_window"] = round(
+                max(_total_dataplane_ops(dp)
+                    - _total_dataplane_ops(dataplane_before), 0.0)
+                / window_s, 3)
+        snap["anomalies"] = _anomalies(
+            snap, churn_threshold_ops_s, skew_warn_ms)
+        return snap
+    finally:
+        pool.close()
+        if scoped_recorder:
+            observe.install_dataplane(None)
+
+
+def render_summary(snap: Dict[str, Any]) -> str:
+    """The human-readable side of the snapshot."""
+    lines: List[str] = []
+    lines.append(f"client_tpu doctor — {len(snap['urls'])} endpoint(s), "
+                 f"protocol {snap['protocol']}, model {snap['model']}")
+    lines.append("")
+    lines.append("endpoints:")
+    for ep in snap["endpoints"]:
+        state = ("ready" if ep.get("ready")
+                 else ("live" if ep.get("live") else "DOWN"))
+        row = f"  {ep['url']:<24} {state:<6}"
+        lat = ep.get("probe_latency_ms")
+        if lat:
+            row += f" probe p50 {lat['p50']:.2f} ms (avg {lat['avg']:.2f})"
+        skew = ep.get("clock_skew_ms")
+        if skew is not None:
+            row += f"  skew {skew:+.1f} ms"
+        stats = snap.get("endpoint_stats", {}).get(ep["url"], {})
+        breaker = stats.get("breaker_state")
+        if breaker and breaker != "closed":
+            row += f"  breaker={breaker}"
+        load = stats.get("load")
+        if load:
+            busy = load["metrics"].get("named_metrics.avg_compute_infer_us")
+            if busy is not None:
+                row += f"  orca compute {busy / 1e3:.2f} ms"
+        lines.append(row)
+    rows = snap.get("decomposition") or []
+    if rows:
+        lines.append("")
+        lines.append("latency decomposition (per request over the probe "
+                     "window):")
+        for row in rows:
+            parts = [f"  {row['url']:<24} {row['model']:<18}"
+                     f" n={row['requests']:<4}"
+                     f" queue {row['server_queue_ms']:.2f} ms"
+                     f" compute {row['server_compute_ms']:.2f} ms"]
+            if "network_client_overhead_ms" in row:
+                parts.append(
+                    f" network+client {row['network_client_overhead_ms']:.2f}"
+                    f" ms (client total {row['client_request_ms']:.2f} ms)")
+            lines.append("".join(parts))
+    slos = snap.get("slos") or []
+    if slos:
+        lines.append("")
+        lines.append("slos:")
+        for slo in slos:
+            verdict = "BREACHED" if slo["breached"] else "ok"
+            lines.append(
+                f"  {slo['name']:<20} {slo['metric']} < "
+                f"{slo['threshold_ms']:g} ms @ {slo['objective']:.0%}"
+                f"  burn {slo['burn_rate']:.2f}x  {verdict}")
+    shm = snap.get("shm", {})
+    dataplane = shm.get("dataplane")
+    if dataplane:
+        lines.append("")
+        lines.append("data plane (this process):")
+        for family, row in dataplane.get("families", {}).items():
+            if not any(row.values()):
+                continue
+            lines.append(
+                f"  {family:<7} regions={row['regions']:.0f} "
+                f"resident={row['bytes_resident']:.0f}B "
+                f"peak={row['bytes_peak']:.0f}B "
+                f"created={row['created']:.0f} "
+                f"destroyed={row['destroyed']:.0f}")
+        lines.append(
+            f"  churn {dataplane.get('churn_ops_per_s', 0):.1f} ops/s")
+    inventory = shm.get("local_inventory") or []
+    if inventory:
+        lines.append(f"  local regions: "
+                     f"{', '.join(r['name'] for r in inventory)}")
+    anomalies = snap.get("anomalies") or []
+    lines.append("")
+    if anomalies:
+        lines.append(f"ANOMALIES ({len(anomalies)}):")
+        for flag in anomalies:
+            where = f" [{flag['url']}]" if flag.get("url") else ""
+            lines.append(f"  !! {flag['flag']}{where}: {flag['detail']}")
+    else:
+        lines.append("no anomalies detected")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m client_tpu.doctor",
+        description="One-command fleet snapshot for a client_tpu "
+                    "deployment (health, breakers, ORCA load, latency "
+                    "decomposition, shm inventory, anomalies).")
+    parser.add_argument("urls", nargs="+", help="replica host:port urls")
+    parser.add_argument("--protocol", choices=("http", "grpc"),
+                        default="http")
+    parser.add_argument("--model", default="simple",
+                        help="model to probe (inputs synthesized from its "
+                             "metadata)")
+    parser.add_argument("--requests", type=int, default=8,
+                        help="probe infers per endpoint")
+    parser.add_argument("--orca", choices=("json", "text"), default="json",
+                        help="ORCA endpoint-load-metrics format to request")
+    parser.add_argument("--churn-threshold", type=float, default=10000.0,
+                        help="shm churn ops/s above which to flag")
+    parser.add_argument("--skew-warn-ms", type=float, default=250.0)
+    parser.add_argument("--timeout", type=float, default=10.0,
+                        help="per-call timeout (s) bounding every snapshot "
+                             "RPC: health probes, probe infers, stats "
+                             "polls, metadata and shm-status calls")
+    parser.add_argument("--json", dest="json_path", default=None,
+                        help="also write the snapshot JSON artifact here")
+    parser.add_argument("--fail-on-anomaly", action="store_true",
+                        help="exit 1 when any anomaly is flagged")
+    args = parser.parse_args(argv)
+
+    snap = collect_snapshot(
+        args.urls, protocol=args.protocol, model=args.model,
+        requests_per_endpoint=args.requests, orca_format=args.orca,
+        churn_threshold_ops_s=args.churn_threshold,
+        skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout)
+    print(render_summary(snap))
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump(snap, f, indent=2, default=str)
+        print(f"\nsnapshot written to {args.json_path}")
+    if args.fail_on_anomaly and snap.get("anomalies"):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,9 +64,30 @@ class CallContext:
 
     def get_result(self, timeout: Optional[float] = None) -> InferResult:
         try:
-            return InferResult(self._future.result(timeout=timeout))
+            result = InferResult(self._future.result(timeout=timeout))
         except grpc.RpcError as e:
             raise _to_exception(e) from e
+        try:
+            # the future IS the call: stash its response metadata for
+            # get_response_header parity with the unary path
+            result._response_headers = _flatten_metadata(
+                self._future.initial_metadata(),
+                self._future.trailing_metadata())
+        except Exception:
+            pass
+        return result
+
+
+def _flatten_metadata(*metadata_pairs) -> Dict[str, str]:
+    """Initial+trailing response metadata -> one ``{key: value}`` dict
+    (string values only; binary ``-bin`` entries are skipped) — what the
+    unary infer paths stash as ``InferResult._response_headers``."""
+    out: Dict[str, str] = {}
+    for pairs in metadata_pairs:
+        for key, value in pairs or ():
+            if isinstance(value, str):
+                out[key] = value
+    return out
 
 
 def _to_exception(rpc_error: grpc.RpcError) -> InferenceServerException:
@@ -97,6 +118,7 @@ class InferenceServerClient(InferenceServerClientBase):
         channel_args: Optional[List] = None,
     ):
         super().__init__()
+        self._url = url
         self._verbose = verbose
         if channel_args is not None:
             options = list(channel_args)
@@ -182,7 +204,12 @@ class InferenceServerClient(InferenceServerClientBase):
         idempotent: bool = True,
         resilience=None,
         span=None,
+        metadata_sink: Optional[Dict[str, str]] = None,
     ) -> Dict[str, Any]:
+        """``metadata_sink``: when given, the call runs via ``with_call``
+        and the response's initial+trailing metadata (string values only)
+        land in the dict — the GRPC twin of HTTP response headers (e.g.
+        ORCA's ``endpoint-load-metrics``)."""
         if self._verbose:
             print(f"{method}, metadata {headers or {}}\n{request}")
         policy = self._resilience_for(resilience)
@@ -192,12 +219,24 @@ class InferenceServerClient(InferenceServerClientBase):
             attempt_timeout = budget.attempt_timeout_s(
                 status="StatusCode.DEADLINE_EXCEEDED")
             try:
-                return self._callable(method)(
+                if metadata_sink is None:
+                    return self._callable(method)(
+                        request,
+                        metadata=self._metadata(headers),
+                        timeout=attempt_timeout,
+                        compression=to_grpc_compression(
+                            compression_algorithm),
+                    )
+                response, call = self._callable(method).with_call(
                     request,
                     metadata=self._metadata(headers),
                     timeout=attempt_timeout,
                     compression=to_grpc_compression(compression_algorithm),
                 )
+                metadata_sink.clear()  # a retried attempt must not mix
+                metadata_sink.update(_flatten_metadata(
+                    call.initial_metadata(), call.trailing_metadata()))
+                return response
             except grpc.RpcError as e:
                 raise _to_exception(e) from e
 
@@ -378,14 +417,18 @@ class InferenceServerClient(InferenceServerClientBase):
     def register_system_shared_memory(
         self, name, key, byte_size, offset=0, headers=None, client_timeout=None
     ) -> None:
-        self._call(
+        self._shm_call(
+            "system", "register", self._call,
             "SystemSharedMemoryRegister",
             {"name": name, "key": key, "offset": offset, "byte_size": byte_size},
             headers, client_timeout,
         )
 
     def unregister_system_shared_memory(self, name="", headers=None, client_timeout=None) -> None:
-        self._call("SystemSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+        self._shm_call(
+            "system", "unregister", self._call,
+            "SystemSharedMemoryUnregister", {"name": name}, headers,
+            client_timeout)
 
     def _device_shm_status(self, method, region_name, headers, client_timeout):
         resp = self._call(method, {"name": region_name}, headers, client_timeout)
@@ -394,7 +437,9 @@ class InferenceServerClient(InferenceServerClientBase):
     def _device_shm_register(self, method, name, raw_handle, device_id, byte_size, headers, client_timeout):
         if isinstance(raw_handle, str):
             raw_handle = raw_handle.encode("ascii")
-        self._call(
+        self._shm_call(
+            "cuda" if method.startswith("Cuda") else "tpu", "register",
+            self._call,
             method,
             {
                 "name": name,
@@ -416,7 +461,10 @@ class InferenceServerClient(InferenceServerClientBase):
         )
 
     def unregister_cuda_shared_memory(self, name="", headers=None, client_timeout=None) -> None:
-        self._call("CudaSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+        self._shm_call(
+            "cuda", "unregister", self._call,
+            "CudaSharedMemoryUnregister", {"name": name}, headers,
+            client_timeout)
 
     def get_tpu_shared_memory_status(self, region_name="", headers=None, client_timeout=None):
         return self._device_shm_status("TpuSharedMemoryStatus", region_name, headers, client_timeout)
@@ -430,7 +478,10 @@ class InferenceServerClient(InferenceServerClientBase):
         )
 
     def unregister_tpu_shared_memory(self, name="", headers=None, client_timeout=None) -> None:
-        self._call("TpuSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+        self._shm_call(
+            "tpu", "unregister", self._call,
+            "TpuSharedMemoryUnregister", {"name": name}, headers,
+            client_timeout)
 
     # -- inference ---------------------------------------------------------
     def infer(
@@ -459,20 +510,24 @@ class InferenceServerClient(InferenceServerClientBase):
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
             )
-            hdrs = headers
+            # unconditional like HTTP: ORCA opt-in must not depend on
+            # whether this request got a span
+            hdrs = self._orca_opt_in(dict(headers or {}))
             if span is not None:
-                hdrs = dict(headers or {})
                 hdrs[TRACEPARENT_HEADER] = span.traceparent()
                 span.phase("serialize", span.start_ns,
                            time.perf_counter_ns())
             timers.capture(RequestTimers.SEND_START)
+            metadata_sink: Dict[str, str] = {}
             response = self._call(
                 "ModelInfer", request, hdrs, client_timeout, compression_algorithm,
                 idempotent=sequence_id == 0, resilience=resilience, span=span,
+                metadata_sink=metadata_sink,
             )
             timers.capture(RequestTimers.SEND_END)
             timers.capture(RequestTimers.RECV_START)
             result = InferResult(response)
+            result._response_headers = metadata_sink
             timers.capture(RequestTimers.RECV_END)
         except BaseException as e:
             if span is not None:
@@ -485,6 +540,9 @@ class InferenceServerClient(InferenceServerClientBase):
                        timers.get(RequestTimers.RECV_START),
                        timers.get(RequestTimers.RECV_END))
             self._telemetry.finish(span)
+        # after the phase capture: ORCA bookkeeping (header parse + gauge
+        # writes) must not masquerade as recv/deserialize milliseconds
+        self._orca_ingest(result)
         return result
 
     def async_infer(
@@ -512,7 +570,7 @@ class InferenceServerClient(InferenceServerClientBase):
         )
         future = self._callable("ModelInfer").future(
             request,
-            metadata=self._metadata(headers),
+            metadata=self._metadata(self._orca_opt_in(dict(headers or {}))),
             timeout=client_timeout,
             compression=to_grpc_compression(compression_algorithm),
         )
@@ -522,6 +580,15 @@ class InferenceServerClient(InferenceServerClientBase):
                 result, error = None, None
                 try:
                     result = InferResult(f.result())
+                    try:
+                        # the future IS the call: stash response metadata
+                        # for get_response_header parity with the unary
+                        # path (and feed any ORCA header to telemetry)
+                        result._response_headers = _flatten_metadata(
+                            f.initial_metadata(), f.trailing_metadata())
+                        self._orca_ingest(result)
+                    except Exception:
+                        pass
                 except grpc.RpcError as e:
                     error = _to_exception(e)
                 except Exception as e:  # cancelled etc.

@@ -216,6 +216,27 @@ class InferResult:
     def get_response(self) -> Dict[str, Any]:
         return self._response
 
+    def get_response_header(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        """A response metadata value (e.g. ORCA's ``endpoint-load-metrics``).
+
+        Parity with the HTTP clients' header accessor: the unary infer
+        paths stash the call's initial+trailing metadata here (GRPC
+        metadata keys are lowercase on the wire; lookup is
+        case-insensitive for drop-in symmetry with HTTP)."""
+        headers = getattr(self, "_response_headers", None)
+        if not headers:
+            return default
+        # wire metadata keys are already lowercase, so the common case
+        # (every telemetry-enabled infer probes for the ORCA header) is a
+        # single dict hit; the scan only runs for hand-stashed mixed case
+        value = headers.get(name.lower())
+        if value is not None:
+            return value
+        for key, value in headers.items():
+            if key.lower() == name.lower():
+                return value
+        return default
+
     def get_output(self, name: str) -> Optional[Dict[str, Any]]:
         for out in self._response.get("outputs", []):
             if out.get("name") == name:

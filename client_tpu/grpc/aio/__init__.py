@@ -18,7 +18,12 @@ from ...observe import TRACEPARENT_HEADER
 from ...resilience import FATAL, AttemptBudget, classify_fault
 from ...utils import InferenceServerException
 from .. import _messages as M
-from .._client import INT32_MAX, KeepAliveOptions, _to_exception
+from .._client import (
+    INT32_MAX,
+    KeepAliveOptions,
+    _flatten_metadata,
+    _to_exception,
+)
 from .._infer import (
     InferResult,
     build_infer_request,
@@ -55,6 +60,7 @@ class InferenceServerClient(InferenceServerClientBase):
         channel_args: Optional[List] = None,
     ):
         super().__init__()
+        self._url = url
         self._verbose = verbose
         if channel_args is not None:
             options = list(channel_args)
@@ -119,8 +125,11 @@ class InferenceServerClient(InferenceServerClientBase):
     async def _call(
         self, method, request, headers=None, client_timeout=None,
         compression_algorithm=None, idempotent=True, resilience=None,
-        span=None,
+        span=None, metadata_sink=None,
     ):
+        """``metadata_sink``: when given, the response's initial+trailing
+        metadata (string values only) land in the dict — the GRPC twin of
+        HTTP response headers (e.g. ORCA's ``endpoint-load-metrics``)."""
         policy = self._resilience_for(resilience)
         budget = AttemptBudget(policy, client_timeout)
 
@@ -128,12 +137,19 @@ class InferenceServerClient(InferenceServerClientBase):
             attempt_timeout = budget.attempt_timeout_s(
                 status="StatusCode.DEADLINE_EXCEEDED")
             try:
-                return await self._callable(method)(
+                call = self._callable(method)(
                     request,
                     metadata=self._metadata(headers),
                     timeout=attempt_timeout,
                     compression=to_grpc_compression(compression_algorithm),
                 )
+                response = await call
+                if metadata_sink is not None:
+                    metadata_sink.clear()  # a retried attempt must not mix
+                    metadata_sink.update(_flatten_metadata(
+                        await call.initial_metadata(),
+                        await call.trailing_metadata()))
+                return response
             except grpc.aio.AioRpcError as e:
                 raise _to_exception(e) from e
 
@@ -241,19 +257,25 @@ class InferenceServerClient(InferenceServerClientBase):
         return list(resp.get("regions", {}).values())
 
     async def register_system_shared_memory(self, name, key, byte_size, offset=0, headers=None, client_timeout=None):
-        await self._call(
+        await self._shm_call_async(
+            "system", "register", self._call,
             "SystemSharedMemoryRegister",
             {"name": name, "key": key, "offset": offset, "byte_size": byte_size},
             headers, client_timeout,
         )
 
     async def unregister_system_shared_memory(self, name="", headers=None, client_timeout=None):
-        await self._call("SystemSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+        await self._shm_call_async(
+            "system", "unregister", self._call,
+            "SystemSharedMemoryUnregister", {"name": name}, headers,
+            client_timeout)
 
     async def _register_handle(self, method, name, raw_handle, device_id, byte_size, headers, client_timeout):
         if isinstance(raw_handle, str):
             raw_handle = raw_handle.encode("ascii")
-        await self._call(
+        await self._shm_call_async(
+            "cuda" if method.startswith("Cuda") else "tpu", "register",
+            self._call,
             method,
             {"name": name, "raw_handle": raw_handle, "device_id": device_id, "byte_size": byte_size},
             headers, client_timeout,
@@ -267,7 +289,10 @@ class InferenceServerClient(InferenceServerClientBase):
         await self._register_handle("CudaSharedMemoryRegister", name, raw_handle, device_id, byte_size, headers, client_timeout)
 
     async def unregister_cuda_shared_memory(self, name="", headers=None, client_timeout=None):
-        await self._call("CudaSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+        await self._shm_call_async(
+            "cuda", "unregister", self._call,
+            "CudaSharedMemoryUnregister", {"name": name}, headers,
+            client_timeout)
 
     async def get_tpu_shared_memory_status(self, region_name="", headers=None, client_timeout=None):
         resp = await self._call("TpuSharedMemoryStatus", {"name": region_name}, headers, client_timeout)
@@ -277,7 +302,10 @@ class InferenceServerClient(InferenceServerClientBase):
         await self._register_handle("TpuSharedMemoryRegister", name, raw_handle, device_id, byte_size, headers, client_timeout)
 
     async def unregister_tpu_shared_memory(self, name="", headers=None, client_timeout=None):
-        await self._call("TpuSharedMemoryUnregister", {"name": name}, headers, client_timeout)
+        await self._shm_call_async(
+            "tpu", "unregister", self._call,
+            "TpuSharedMemoryUnregister", {"name": name}, headers,
+            client_timeout)
 
     async def update_log_settings(self, settings, headers=None, client_timeout=None):
         req: Dict[str, Any] = {"settings": {}}
@@ -339,18 +367,22 @@ class InferenceServerClient(InferenceServerClientBase):
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
             )
-            hdrs = headers
+            # unconditional like HTTP: ORCA opt-in must not depend on
+            # whether this request got a span
+            hdrs = self._orca_opt_in(dict(headers or {}))
             if span is not None:
-                hdrs = dict(headers or {})
                 hdrs[TRACEPARENT_HEADER] = span.traceparent()
                 span.phase("serialize", span.start_ns, time.perf_counter_ns())
+            metadata_sink: Dict[str, str] = {}
             response = await self._call(
                 "ModelInfer", request, hdrs, client_timeout, compression_algorithm,
                 idempotent=sequence_id == 0, resilience=resilience, span=span,
+                metadata_sink=metadata_sink,
             )
             if span is not None:
                 t_deser = time.perf_counter_ns()
             result = InferResult(response)
+            result._response_headers = metadata_sink
         except BaseException as e:
             if span is not None:
                 self._telemetry.finish(span, error=e)
@@ -358,6 +390,9 @@ class InferenceServerClient(InferenceServerClientBase):
         if span is not None:
             span.phase("deserialize", t_deser, time.perf_counter_ns())
             self._telemetry.finish(span)
+        # after the phase capture: ORCA bookkeeping (header parse + gauge
+        # writes) must not masquerade as deserialize milliseconds
+        self._orca_ingest(result)
         return result
 
     async def stream_infer(

@@ -24,7 +24,13 @@ from urllib.parse import quote, urlencode
 
 import urllib3
 
-from .._base import InferenceServerClientBase, InferStat, Request, RequestTimers
+from .._base import (
+    SHM_FAMILY_OF,
+    InferenceServerClientBase,
+    InferStat,
+    Request,
+    RequestTimers,
+)
 from .._tensor import InferInput, InferRequestedOutput
 from ..observe import TRACEPARENT_HEADER
 from ..resilience import (
@@ -463,14 +469,17 @@ class InferenceServerClient(InferenceServerClientBase):
     def register_system_shared_memory(
         self, name, key, byte_size, offset=0, headers=None, query_params=None
     ) -> None:
-        body = {"key": key, "offset": offset, "byte_size": byte_size}
-        resp = self._post(
-            f"v2/systemsharedmemory/region/{quote(name)}/register",
-            json.dumps(body).encode("utf-8"),
-            headers,
-            query_params,
-        )
-        raise_if_error(resp.status, resp.data)
+        def call():
+            body = {"key": key, "offset": offset, "byte_size": byte_size}
+            resp = self._post(
+                f"v2/systemsharedmemory/region/{quote(name)}/register",
+                json.dumps(body).encode("utf-8"),
+                headers,
+                query_params,
+            )
+            raise_if_error(resp.status, resp.data)
+
+        self._shm_call("system", "register", call)
 
     def unregister_system_shared_memory(
         self, name="", headers=None, query_params=None
@@ -478,18 +487,21 @@ class InferenceServerClient(InferenceServerClientBase):
         self._shm_unregister("systemsharedmemory", name, headers, query_params)
 
     def _shm_register(self, family, name, raw_handle, device_id, byte_size, headers, query_params):
-        body = {
-            "raw_handle": {"b64": raw_handle},
-            "device_id": device_id,
-            "byte_size": byte_size,
-        }
-        resp = self._post(
-            f"v2/{family}/region/{quote(name)}/register",
-            json.dumps(body).encode("utf-8"),
-            headers,
-            query_params,
-        )
-        raise_if_error(resp.status, resp.data)
+        def call():
+            body = {
+                "raw_handle": {"b64": raw_handle},
+                "device_id": device_id,
+                "byte_size": byte_size,
+            }
+            resp = self._post(
+                f"v2/{family}/region/{quote(name)}/register",
+                json.dumps(body).encode("utf-8"),
+                headers,
+                query_params,
+            )
+            raise_if_error(resp.status, resp.data)
+
+        self._shm_call(SHM_FAMILY_OF[family], "register", call)
 
     def _shm_status(self, family, region_name, headers, query_params):
         path = f"v2/{family}"
@@ -501,12 +513,15 @@ class InferenceServerClient(InferenceServerClientBase):
         return json.loads(resp.data) if resp.data else []
 
     def _shm_unregister(self, family, name, headers, query_params):
-        path = f"v2/{family}"
-        if name:
-            path += f"/region/{quote(name)}"
-        path += "/unregister"
-        resp = self._post(path, b"", headers, query_params)
-        raise_if_error(resp.status, resp.data)
+        def call():
+            path = f"v2/{family}"
+            if name:
+                path += f"/region/{quote(name)}"
+            path += "/unregister"
+            resp = self._post(path, b"", headers, query_params)
+            raise_if_error(resp.status, resp.data)
+
+        self._shm_call(SHM_FAMILY_OF[family], "unregister", call)
 
     def get_cuda_shared_memory_status(self, region_name="", headers=None, query_params=None):
         return self._shm_status("cudasharedmemory", region_name, headers, query_params)
@@ -602,7 +617,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 timeout,
                 parameters,
             )
-            hdrs = dict(headers or {})
+            hdrs = self._orca_opt_in(dict(headers or {}))
             body, encoding = compress_body(body, request_compression_algorithm)
             if encoding:
                 hdrs["Content-Encoding"] = encoding
@@ -647,6 +662,9 @@ class InferenceServerClient(InferenceServerClientBase):
         if span is not None:
             span.phase("deserialize", t_deser, time.perf_counter_ns())
             self._telemetry.finish(span)
+        # after the phase capture: ORCA bookkeeping (header parse + gauge
+        # writes) must not masquerade as deserialize milliseconds
+        self._orca_ingest(result)
         if self._verbose:
             print(result.get_response())
         return result

@@ -16,7 +16,7 @@ from urllib.parse import quote
 
 import aiohttp
 
-from ..._base import InferenceServerClientBase, Request
+from ..._base import SHM_FAMILY_OF, InferenceServerClientBase, Request
 from ..._tensor import InferInput, InferRequestedOutput
 from ...observe import TRACEPARENT_HEADER
 from ...resilience import (
@@ -66,6 +66,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 f"unexpected scheme in url '{url}' (pass host:port; use ssl=True for https)"
             )
         scheme = "https" if ssl else "http"
+        self._url = url
         self._base = f"{scheme}://{url}"
         self._verbose = verbose
         self._session = aiohttp.ClientSession(
@@ -279,28 +280,43 @@ class InferenceServerClient(InferenceServerClientBase):
         return json.loads(data) if data else []
 
     async def _shm_unregister(self, family, name, headers, query_params):
-        path = f"v2/{family}"
-        if name:
-            path += f"/region/{quote(name)}"
-        await self._post_json(path + "/unregister", b"", headers, query_params)
+        async def call():
+            path = f"v2/{family}"
+            if name:
+                path += f"/region/{quote(name)}"
+            await self._post_json(
+                path + "/unregister", b"", headers, query_params)
+
+        await self._shm_call_async(SHM_FAMILY_OF[family], "unregister", call)
 
     async def get_system_shared_memory_status(self, region_name="", headers=None, query_params=None):
         return await self._shm_status("systemsharedmemory", region_name, headers, query_params)
 
     async def register_system_shared_memory(self, name, key, byte_size, offset=0, headers=None, query_params=None):
-        body = json.dumps({"key": key, "offset": offset, "byte_size": byte_size}).encode()
-        await self._post_json(
-            f"v2/systemsharedmemory/region/{quote(name)}/register", body, headers, query_params
-        )
+        async def call():
+            body = json.dumps(
+                {"key": key, "offset": offset, "byte_size": byte_size}
+            ).encode()
+            await self._post_json(
+                f"v2/systemsharedmemory/region/{quote(name)}/register",
+                body, headers, query_params)
+
+        await self._shm_call_async("system", "register", call)
 
     async def unregister_system_shared_memory(self, name="", headers=None, query_params=None):
         await self._shm_unregister("systemsharedmemory", name, headers, query_params)
 
     async def _shm_register_handle(self, family, name, raw_handle, device_id, byte_size, headers, query_params):
-        body = json.dumps(
-            {"raw_handle": {"b64": raw_handle}, "device_id": device_id, "byte_size": byte_size}
-        ).encode()
-        await self._post_json(f"v2/{family}/region/{quote(name)}/register", body, headers, query_params)
+        async def call():
+            body = json.dumps(
+                {"raw_handle": {"b64": raw_handle}, "device_id": device_id,
+                 "byte_size": byte_size}
+            ).encode()
+            await self._post_json(
+                f"v2/{family}/region/{quote(name)}/register",
+                body, headers, query_params)
+
+        await self._shm_call_async(SHM_FAMILY_OF[family], "register", call)
 
     async def get_cuda_shared_memory_status(self, region_name="", headers=None, query_params=None):
         return await self._shm_status("cudasharedmemory", region_name, headers, query_params)
@@ -352,7 +368,7 @@ class InferenceServerClient(InferenceServerClientBase):
                 inputs, outputs, request_id, sequence_id, sequence_start,
                 sequence_end, priority, timeout, parameters,
             )
-            hdrs = dict(headers or {})
+            hdrs = self._orca_opt_in(dict(headers or {}))
             body, encoding = compress_body(body, request_compression_algorithm)
             if encoding:
                 hdrs["Content-Encoding"] = encoding
@@ -388,6 +404,9 @@ class InferenceServerClient(InferenceServerClientBase):
         if span is not None:
             span.phase("deserialize", t_deser, time.perf_counter_ns())
             self._telemetry.finish(span)
+        # after the phase capture: ORCA bookkeeping (header parse + gauge
+        # writes) must not masquerade as deserialize milliseconds
+        self._orca_ingest(result)
         if self._verbose:
             print(result.get_response())
         return result

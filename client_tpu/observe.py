@@ -45,6 +45,8 @@ committed ``BENCH_OBSERVE.json`` holds the measured numbers).
 
 from __future__ import annotations
 
+import asyncio
+import inspect
 import itertools
 import json
 import re
@@ -59,20 +61,31 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS_S",
     "DEFAULT_STREAM_MS_BUCKETS",
+    "ENDPOINT_LOAD_FORMAT_HEADER",
+    "ENDPOINT_LOAD_HEADER",
+    "SHM_FAMILIES",
     "TRACEPARENT_HEADER",
     "Counter",
+    "DataPlaneRecorder",
+    "EndpointLoad",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "RequestSpan",
     "SLO",
+    "StatsCorrelator",
     "StreamSpan",
     "Telemetry",
     "Tracer",
     "WindowedSketch",
+    "accepts_client_timeout",
+    "dataplane",
+    "enable_dataplane",
     "format_traceparent",
+    "install_dataplane",
     "make_span_id",
     "make_trace_id",
+    "parse_endpoint_load",
     "parse_traceparent",
 ]
 
@@ -233,6 +246,10 @@ class _HistogramSeries:
         return self.buckets[-1] if self.buckets else lower
 
 
+# label value the cardinality guard aggregates overflowing series into
+OVERFLOW_LABEL = "other"
+
+
 class _Metric:
     """Shared labeled-family machinery for the three instrument kinds."""
 
@@ -256,7 +273,26 @@ class _Metric:
 
     def labels(self, *values) -> Any:
         """The series for one label-value tuple (created on first use and
-        cached — callers are expected to hold on to hot series)."""
+        cached — callers are expected to hold on to hot series).
+
+        Cardinality guard: once this instrument holds the registry's
+        ``max_series_per_metric`` distinct label-sets, NEW label-sets are
+        not materialized — they aggregate into one ``other`` series (every
+        label value :data:`OVERFLOW_LABEL`) and bump the registry's
+        dropped-labelsets counter, so unbounded label sources (region
+        names, URLs) can never blow up the scrape."""
+        return self._resolve(values, fold_overflow=True)
+
+    def try_labels(self, *values) -> Optional[Any]:
+        """Like :meth:`labels`, but returns None (still counting the drop)
+        when the cardinality cap would fold the label-set into the
+        ``other`` series — for instruments where an aggregated value is
+        meaningless (per-entity gauges like the ORCA load: a last-writer-
+        wins mix of endpoints would also be unremovable by TTL expiry)."""
+        return self._resolve(values, fold_overflow=False)
+
+    def _resolve(self, values, fold_overflow: bool,
+                 note_drop: bool = True) -> Optional[Any]:
         key = tuple(str(v) for v in values)
         if len(key) != len(self.labelnames):
             raise ValueError(
@@ -264,12 +300,32 @@ class _Metric:
                 f"got {key}")
         series = self._series.get(key)
         if series is None:
+            dropped = False
             with self._registry._lock:
                 series = self._series.get(key)
                 if series is None:
-                    series = self._new_series()
-                    self._series[key] = series
+                    limit = self._registry.max_series_per_metric
+                    if (limit and self.labelnames
+                            and len(self._series) >= limit):
+                        dropped = True
+                        if fold_overflow:
+                            key = (OVERFLOW_LABEL,) * len(self.labelnames)
+                            series = self._series.get(key)
+                    if series is None and (fold_overflow or not dropped):
+                        series = self._new_series()
+                        self._series[key] = series
+            if dropped and note_drop:
+                # outside the registry lock: the dropped counter may need
+                # to be created, which re-enters _instrument
+                self._registry._note_dropped_labelset(self.name)
         return series
+
+    def remove(self, *values) -> bool:
+        """Drop one label-set's series (stale-endpoint gauge expiry);
+        True when a series was actually removed."""
+        key = tuple(str(v) for v in values)
+        with self._registry._lock:
+            return self._series.pop(key, None) is not None
 
     def _default(self):
         """The unlabeled series (metrics declared with no label names)."""
@@ -335,12 +391,34 @@ class MetricsRegistry:
     returns the existing instrument; a kind/label mismatch raises).
     ``add_collector`` registers a callback run before every export — the
     pool uses it to refresh per-endpoint gauges at scrape time instead of
-    on the data path."""
+    on the data path.
 
-    def __init__(self):
+    ``max_series_per_metric`` caps the distinct label-sets any one
+    instrument may hold (0 disables the cap): past it, new label-sets
+    fold into a single ``other`` series and
+    ``client_tpu_metrics_dropped_labelsets_total{metric}`` counts the
+    overflow resolutions."""
+
+    def __init__(self, max_series_per_metric: int = 512):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self._collectors: List[Callable[[], None]] = []
+        self.max_series_per_metric = max(0, int(max_series_per_metric))
+        self._dropped_labelsets: Optional[Counter] = None
+
+    def _note_dropped_labelset(self, metric_name: str) -> None:
+        # created lazily OUTSIDE the registry lock (counter creation
+        # re-enters _instrument); races create it idempotently
+        counter = self._dropped_labelsets
+        if counter is None:
+            counter = self._dropped_labelsets = self.counter(
+                "client_tpu_metrics_dropped_labelsets_total",
+                "Label-set resolutions folded into the 'other' overflow "
+                "series by the cardinality guard", ("metric",))
+        # note_drop=False: if this counter is itself at the cap, its own
+        # overflow fold must not re-note the drop — that recursed forever
+        counter._resolve((metric_name,), fold_overflow=True,
+                         note_drop=False).inc()
 
     def _instrument(self, cls, name, help, labelnames, **kwargs) -> Any:
         with self._lock:
@@ -465,6 +543,317 @@ class MetricsRegistry:
                     "series": series_out,
                 }
         return out
+
+
+# -- data-plane (shm lifecycle) accounting ------------------------------------
+# The byte-level data plane: shared-memory regions created, attached,
+# read/written and destroyed by utils.shared_memory / utils.tpu_shared_memory,
+# plus the register/unregister RPCs the frontends issue against the server.
+SHM_FAMILIES = ("system", "tpu", "cuda")
+
+
+class _FamilyBinding:
+    """Pre-resolved per-family series so one shm op is dict-lookup-free."""
+
+    __slots__ = ("create", "attach", "map_read", "map_write", "destroy",
+                 "regions", "bytes_resident", "bytes_peak")
+
+    def __init__(self, rec: "DataPlaneRecorder", family: str):
+        self.create = rec.ops.labels(family, "create")
+        self.attach = rec.ops.labels(family, "attach")
+        self.map_read = rec.ops.labels(family, "map_read")
+        self.map_write = rec.ops.labels(family, "map_write")
+        self.destroy = rec.ops.labels(family, "destroy")
+        self.regions = rec.regions.labels(family)
+        self.bytes_resident = rec.bytes_resident.labels(family)
+        self.bytes_peak = rec.bytes_peak.labels(family)
+
+
+class DataPlaneRecorder:
+    """shm lifecycle accounting: region create/attach/map/destroy counters,
+    bytes-resident/peak gauges, and register/unregister RPC latency.
+
+    The shm utils are module-level (regions are process-global state, not
+    client-bound), so the recorder is installed process-globally via
+    :func:`install_dataplane` / :func:`enable_dataplane` /
+    ``Telemetry.enable_dataplane``. The shm modules' hot paths check one
+    module attribute against None and do nothing else when no recorder is
+    installed (the same pay-for-what-you-use bar as request telemetry);
+    with a recorder installed each op batches its counter/gauge updates
+    under ONE registry-lock acquire.
+
+    This is the measure-before-you-optimize baseline for pooled shm
+    arenas (ROADMAP item 1): the per-use-site churn the arena will
+    eliminate is a committed number, not a hunch."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        reg = registry or MetricsRegistry()
+        self.registry = reg
+        self._lock = reg._lock  # all series share it: one acquire per op
+        self.ops = reg.counter(
+            "client_tpu_shm_ops_total",
+            "Shared-memory lifecycle operations "
+            "(create/attach/map_read/map_write/destroy)",
+            ("family", "op"))
+        self.regions = reg.gauge(
+            "client_tpu_shm_regions",
+            "Shared-memory regions currently held by this process",
+            ("family",))
+        self.bytes_resident = reg.gauge(
+            "client_tpu_shm_bytes_resident",
+            "Bytes currently resident in held shared-memory regions",
+            ("family",))
+        self.bytes_peak = reg.gauge(
+            "client_tpu_shm_bytes_peak",
+            "High-water mark of resident shared-memory bytes", ("family",))
+        self.rpc_seconds = reg.histogram(
+            "client_tpu_shm_registration_seconds",
+            "Client-observed latency of shm register/unregister RPCs",
+            ("frontend", "family", "op"))
+        self.rpcs = reg.counter(
+            "client_tpu_shm_rpcs_total",
+            "shm register/unregister RPCs by outcome",
+            ("frontend", "family", "op", "outcome"))
+        self._families = {f: _FamilyBinding(self, f) for f in SHM_FAMILIES}
+        # (frontend, family, op, ok) -> (histogram series, counter series)
+        self._rpc_cache: Dict[Tuple[str, str, str, bool], Tuple[Any, Any]] = {}
+        # handle identity -> recorded nbytes, for regions whose create/
+        # attach THIS recorder saw (destroys of older regions skip the
+        # residency decrement instead of stealing it from live ones)
+        self._live: Dict[int, int] = {}
+        self.started_monotonic = time.monotonic()
+
+    # -- region ops (fed by the shm utils; one lock acquire each) ------------
+    def on_create(self, family: str, nbytes: int,
+                  key: Optional[int] = None) -> None:
+        f = self._families[family]
+        with self._lock:
+            f.create.value += 1
+            f.regions.value += 1
+            f.bytes_resident.value += nbytes
+            if f.bytes_resident.value > f.bytes_peak.value:
+                f.bytes_peak.value = f.bytes_resident.value
+            if key is not None:
+                self._live[key] = nbytes
+
+    def on_attach(self, family: str, nbytes: int,
+                  key: Optional[int] = None) -> None:
+        # an attach maps the region into THIS process too: it is resident
+        # here until its handle is destroyed/detached
+        f = self._families[family]
+        with self._lock:
+            f.attach.value += 1
+            f.regions.value += 1
+            f.bytes_resident.value += nbytes
+            if f.bytes_resident.value > f.bytes_peak.value:
+                f.bytes_peak.value = f.bytes_resident.value
+            if key is not None:
+                self._live[key] = nbytes
+
+    def on_map(self, family: str, write: bool) -> None:
+        f = self._families[family]
+        with self._lock:
+            (f.map_write if write else f.map_read).value += 1
+
+    def on_destroy(self, family: str, nbytes: int,
+                   key: Optional[int] = None) -> None:
+        f = self._families[family]
+        with self._lock:
+            f.destroy.value += 1
+            if key is not None:
+                recorded = self._live.pop(key, None)
+                if recorded is None:
+                    # region predates this recorder (installed mid-process):
+                    # its create was never counted, so its destroy must not
+                    # shrink the residency other live regions account for
+                    return
+                nbytes = recorded
+            # clamp at zero for key-less callers: a destroy with no
+            # matching on_create must not drive the gauges negative
+            f.regions.value = max(f.regions.value - 1, 0)
+            f.bytes_resident.value = max(f.bytes_resident.value - nbytes, 0)
+
+    # -- register/unregister RPCs (fed by the four frontends) ----------------
+    def on_rpc(self, frontend: str, family: str, op: str, seconds: float,
+               ok: bool = True) -> None:
+        key = (frontend, family, op, ok)
+        cached = self._rpc_cache.get(key)
+        if cached is None:
+            cached = (self.rpc_seconds.labels(frontend, family, op),
+                      self.rpcs.labels(frontend, family, op,
+                                       "ok" if ok else "error"))
+            self._rpc_cache[key] = cached
+        hist, counter = cached
+        with self._lock:
+            hist._observe(seconds)
+            counter.value += 1
+
+    # -- read side -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready per-family accounting + RPC totals + churn rate."""
+        elapsed = max(time.monotonic() - self.started_monotonic, 1e-9)
+        out: Dict[str, Any] = {"elapsed_s": round(elapsed, 3)}
+        families: Dict[str, Any] = {}
+        total_ops = 0
+        with self._lock:
+            for name, f in self._families.items():
+                ops = (f.create.value + f.attach.value + f.map_read.value
+                       + f.map_write.value + f.destroy.value)
+                total_ops += ops
+                families[name] = {
+                    "created": f.create.value,
+                    "attached": f.attach.value,
+                    "map_reads": f.map_read.value,
+                    "map_writes": f.map_write.value,
+                    "destroyed": f.destroy.value,
+                    "regions": f.regions.value,
+                    "bytes_resident": f.bytes_resident.value,
+                    "bytes_peak": f.bytes_peak.value,
+                }
+            rpcs: Dict[str, float] = {}
+            for key, series in self.rpcs._series.items():
+                _, family, op, outcome = key
+                label = f"{family}.{op}.{outcome}"
+                rpcs[label] = rpcs.get(label, 0.0) + series.value
+                total_ops += series.value
+        out["families"] = families
+        out["rpcs"] = rpcs
+        out["churn_ops_per_s"] = round(total_ops / elapsed, 3)
+        return out
+
+    def registered_totals(self) -> Dict[str, float]:
+        """Per-family successful register RPC counts (perf-row helper)."""
+        totals: Dict[str, float] = {}
+        with self._lock:
+            for key, series in self.rpcs._series.items():
+                _, family, op, outcome = key
+                if op == "register" and outcome == "ok":
+                    totals[family] = totals.get(family, 0.0) + series.value
+        return totals
+
+
+# the process-global recorder the shm utils and frontends consult; None
+# keeps their hot paths at one attribute load + None check
+_DATAPLANE: Optional[DataPlaneRecorder] = None
+
+
+def dataplane() -> Optional[DataPlaneRecorder]:
+    """The installed process-global data-plane recorder, if any."""
+    return _DATAPLANE
+
+
+def install_dataplane(
+        recorder: Optional[DataPlaneRecorder]) -> Optional[DataPlaneRecorder]:
+    """Install (or clear, with None) the process-global recorder; returns
+    the previous one so scoped users (perf runs, tests) can restore it."""
+    global _DATAPLANE
+    previous = _DATAPLANE
+    _DATAPLANE = recorder
+    return previous
+
+
+def enable_dataplane(
+        registry: Optional[MetricsRegistry] = None) -> DataPlaneRecorder:
+    """Create a :class:`DataPlaneRecorder` on ``registry`` (or a fresh
+    one) and install it process-globally; returns the recorder."""
+    recorder = DataPlaneRecorder(registry)
+    install_dataplane(recorder)
+    return recorder
+
+
+# -- ORCA endpoint load ingestion ---------------------------------------------
+# The server emits per-response load metrics in the ORCA ``endpoint-load-
+# metrics`` response header (json or text form) when the client opts in via
+# the ``endpoint-load-metrics-format`` request header; parsing them into a
+# typed EndpointLoad is the observability half of load-aware routing
+# (ROADMAP item 2 — routing on these stays there).
+ENDPOINT_LOAD_HEADER = "endpoint-load-metrics"
+ENDPOINT_LOAD_FORMAT_HEADER = "endpoint-load-metrics-format"
+
+_ORCA_FORMATS = (None, "json", "text")
+
+
+class EndpointLoad:
+    """One parsed ORCA load report: a flat ``{metric: float}`` mapping
+    (nested maps like ``named_metrics`` flatten to dotted keys)."""
+
+    __slots__ = ("metrics", "format", "received_monotonic")
+
+    def __init__(self, metrics: Dict[str, float], format: str):
+        self.metrics = metrics
+        self.format = format
+        self.received_monotonic = time.monotonic()
+
+    def get(self, name: str, default: Optional[float] = None):
+        return self.metrics.get(name, default)
+
+    def age_s(self) -> float:
+        return max(time.monotonic() - self.received_monotonic, 0.0)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "metrics": dict(self.metrics),
+            "format": self.format,
+            "age_s": round(self.age_s(), 3),
+        }
+
+    def __repr__(self) -> str:
+        return f"EndpointLoad({self.metrics!r}, format={self.format!r})"
+
+
+def _load_value(value) -> Optional[float]:
+    if isinstance(value, bool):
+        return float(value)
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return None
+    # NaN / inf are not reportable load values
+    if f != f or f in (float("inf"), float("-inf")):
+        return None
+    return f
+
+
+def parse_endpoint_load(value: Optional[str],
+                        fmt: Optional[str] = None) -> Optional[EndpointLoad]:
+    """Parse an ORCA ``endpoint-load-metrics`` header value.
+
+    ``fmt`` forces ``"json"`` or ``"text"``; None sniffs (a leading ``{``
+    is json). Unknown keys are preserved verbatim; malformed values are
+    skipped, never raised; a value with nothing parseable returns None
+    (as does a missing header), so ingestion causes no gauge churn on
+    garbage."""
+    if not value or not isinstance(value, str):
+        return None
+    text = value.strip()
+    metrics: Dict[str, float] = {}
+    if fmt == "json" or (fmt is None and text.startswith("{")):
+        try:
+            obj = json.loads(text)
+        except ValueError:
+            return None
+        if not isinstance(obj, dict):
+            return None
+        for key, val in obj.items():
+            if isinstance(val, dict):  # named_metrics / utilization maps
+                for sub, subval in val.items():
+                    f = _load_value(subval)
+                    if f is not None:
+                        metrics[f"{key}.{sub}"] = f
+            else:
+                f = _load_value(val)
+                if f is not None:
+                    metrics[str(key)] = f
+        return EndpointLoad(metrics, "json") if metrics else None
+    for part in text.split(","):
+        key, sep, val = part.partition("=")
+        if not sep:
+            continue
+        key = key.strip()
+        f = _load_value(val.strip())
+        if key and f is not None:
+            metrics[key] = f
+    return EndpointLoad(metrics, "text") if metrics else None
 
 
 # -- tracing ------------------------------------------------------------------
@@ -997,6 +1386,13 @@ class Telemetry:
     the traceparent sampled flag matches), ``slow`` (keep only requests
     slower than ``slow_threshold_s``), or ``off`` (metrics only). Metrics
     are always recorded; sampling gates only trace retention.
+
+    ``orca_format``: ``"json"`` or ``"text"`` makes every frontend this
+    telemetry is configured on opt in to ORCA per-response load metrics
+    (the ``endpoint-load-metrics-format`` request header); parsed reports
+    export as ``client_tpu_endpoint_load{url,metric}`` gauges and surface
+    in ``PoolClient.endpoint_stats()``. Endpoints silent for longer than
+    ``orca_ttl_s`` have their load gauges expired at scrape time.
     """
 
     def __init__(
@@ -1008,10 +1404,15 @@ class Telemetry:
         trace_capacity: int = 256,
         rng: Optional[random.Random] = None,
         stream_window_s: float = 300.0,
+        orca_format: Optional[str] = None,
+        orca_ttl_s: float = 60.0,
     ):
         if sample not in _SAMPLE_MODES:
             raise ValueError(
                 f"unknown sample mode {sample!r} (one of {_SAMPLE_MODES})")
+        if orca_format not in _ORCA_FORMATS:
+            raise ValueError(
+                f"unknown orca_format {orca_format!r} (one of json|text)")
         self.registry = registry or MetricsRegistry()
         self.tracer = Tracer(trace_capacity)
         self.sample = sample
@@ -1152,6 +1553,26 @@ class Telemetry:
             "over the window", ("slo",))
         self.registry.add_collector(self._fold_stream_pending)
         self.registry.add_collector(self._collect_stream_windows)
+        # -- ORCA endpoint load ----------------------------------------------
+        # frontends read orca_format to decide whether to request the
+        # header; ingestion works regardless (a caller may opt in manually
+        # via per-request headers)
+        self.orca_format = orca_format
+        self.orca_ttl_s = float(orca_ttl_s)
+        self._orca_lock = threading.Lock()
+        self._orca_loads: Dict[str, EndpointLoad] = {}
+        self._orca_gauge = reg.gauge(
+            "client_tpu_endpoint_load",
+            "Latest ORCA per-response load report per endpoint "
+            f"(expired after {orca_ttl_s:g}s of silence)",
+            ("url", "metric"))
+        self._orca_reports = reg.counter(
+            "client_tpu_endpoint_load_reports_total",
+            "ORCA load reports ingested per endpoint", ("url",))
+        self._orca_parse_errors = reg.counter(
+            "client_tpu_endpoint_load_parse_errors_total",
+            "ORCA headers that failed to parse", ("url",))
+        self.registry.add_collector(self._expire_orca)
 
     _FOLD_BACKLOG = 32768
     _WINDOW_QUANTILES = ((0.5, "p50"), (0.9, "p90"), (0.95, "p95"),
@@ -1405,6 +1826,77 @@ class Telemetry:
                     url, WindowedSketch(self.stream_window_s))
         window.observe(ttft_ms)
 
+    # -- ORCA endpoint load ---------------------------------------------------
+    def ingest_endpoint_load(self, url: str, header_value: Optional[str],
+                             fmt: Optional[str] = None,
+                             ) -> Optional[EndpointLoad]:
+        """Ingest one response's ORCA header for ``url``. A missing header
+        (None) touches nothing — no gauge churn; a malformed one counts a
+        parse error. Returns the parsed :class:`EndpointLoad`, if any."""
+        if header_value is None:
+            return None
+        load = parse_endpoint_load(header_value, fmt or self.orca_format)
+        if load is None:
+            self._orca_parse_errors.labels(url).inc()
+            return None
+        gauge = self._orca_gauge
+        reports = self._orca_reports.labels(url)
+        with self._orca_lock:
+            # gauge writes stay under the lock: two concurrent reports for
+            # one url must not interleave (the loser could resurrect a
+            # series the winner just removed, orphaning it forever).
+            # try_labels: a load folded into the cardinality-overflow
+            # series would be a meaningless endpoint mix AND unremovable
+            # by the TTL expiry — drop it (counted) instead
+            previous = self._orca_loads.get(url)
+            self._orca_loads[url] = load
+            # resolve series first (lock-free once cached), then write the
+            # whole report under ONE registry-lock acquire — per-metric
+            # series.set() would take it once per metric per response
+            writes = [(series, value)
+                      for name, value in load.metrics.items()
+                      if (series := gauge.try_labels(url, name)) is not None]
+            vanished = ([name for name in previous.metrics
+                         if name not in load.metrics]
+                        if previous is not None else [])
+            with self.registry._lock:
+                for series, value in writes:
+                    series._set(value)
+                reports._inc()
+                for name in vanished:  # metric left the report
+                    gauge._series.pop((url, name), None)
+        return load
+
+    def endpoint_loads(self) -> Dict[str, EndpointLoad]:
+        """The un-expired latest load report per endpoint url."""
+        now = time.monotonic()
+        with self._orca_lock:
+            return {url: load for url, load in self._orca_loads.items()
+                    if now - load.received_monotonic <= self.orca_ttl_s}
+
+    def _expire_orca(self) -> None:
+        """Scrape-time collector: drop load gauges for endpoints that have
+        not reported within ``orca_ttl_s`` (a stale load number is worse
+        than no number — it looks current). Removal happens under
+        ``_orca_lock``, the same invariant ``ingest_endpoint_load`` keeps:
+        an ingest racing the expiry must not have its fresh gauges
+        deleted."""
+        now = time.monotonic()
+        with self._orca_lock:
+            for url, load in list(self._orca_loads.items()):
+                if now - load.received_monotonic > self.orca_ttl_s:
+                    del self._orca_loads[url]
+                    for name in load.metrics:
+                        self._orca_gauge.remove(url, name)
+
+    # -- data plane -----------------------------------------------------------
+    def enable_dataplane(self) -> DataPlaneRecorder:
+        """Install a process-global :class:`DataPlaneRecorder` on THIS
+        telemetry's registry (shm accounting shows up in its scrapes);
+        returns the recorder. See :func:`install_dataplane` to restore a
+        previous one."""
+        return enable_dataplane(self.registry)
+
     # -- resilience observer protocol (duck-typed from resilience.py) --------
     def on_retry(self, attempt: int, exc: BaseException,
                  delay_s: float) -> None:
@@ -1603,3 +2095,292 @@ class Telemetry:
                 span.duration_s() * 1e3)
         return {name: _percentile_row(values, percentiles)
                 for name, values in sorted(samples.items()) if values}
+
+
+# -- client <-> server stats correlation --------------------------------------
+def accepts_client_timeout(fn: Callable) -> bool:
+    """Whether a transport method takes a per-call ``client_timeout=``
+    (gRPC surfaces do; HTTP surfaces bound calls at the connection-pool
+    level instead)."""
+    try:
+        return "client_timeout" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+class StatsCorrelator:
+    """Optional poller that merges SERVER-side timings into the client
+    registry and renders a "where did the milliseconds go" decomposition.
+
+    Each poll calls every endpoint's ``get_inference_statistics()`` (the
+    KServe v2 statistics extension both in-repo servers expose) and — on
+    transports that serve one — scrapes the server's ``/metrics`` text.
+    Server queue/compute/batch-execution timings land in the client
+    registry as ``client_tpu_server_stat_seconds{url,model,stat}`` et al,
+    so ONE client scrape shows both halves of every request.
+
+    :meth:`decomposition` compares the deltas between the first and the
+    most recent poll against the client's own request latency over the
+    same window: per (endpoint, model) it reports server queue ms, server
+    compute ms, and the remainder (network + client overhead) — the
+    framework-comparison methodology of the inference-benchmark literature
+    (client-side totals decomposed against server-side accounting).
+
+    ``endpoints``: a ``{url: client}`` mapping, an iterable of
+    ``(url, client)`` pairs, or a ``PoolClient`` (its per-endpoint sync
+    clients are used). Clients must be synchronous — run the poller
+    beside an aio app with sync clients pointed at the same fleet."""
+
+    def __init__(self, telemetry: Telemetry, endpoints,
+                 interval_s: float = 5.0,
+                 call_timeout_s: Optional[float] = None):
+        self._telemetry = telemetry
+        self.call_timeout_s = call_timeout_s
+        pool = getattr(endpoints, "pool", None)
+        if pool is not None and hasattr(pool, "endpoints"):
+            self._endpoints = [(ep.url, ep.client) for ep in pool.endpoints]
+        elif isinstance(endpoints, dict):
+            self._endpoints = list(endpoints.items())
+        else:
+            self._endpoints = [(url, client) for url, client in endpoints]
+        if not self._endpoints:
+            raise ValueError("StatsCorrelator needs at least one endpoint")
+        self._timeout_kw: Dict[str, bool] = {}
+        for url, client in self._endpoints:
+            stats_fn = getattr(client, "get_inference_statistics", None)
+            if stats_fn is None or asyncio.iscoroutinefunction(stats_fn):
+                # fail at construction, not as a counted error every poll
+                # (an aio client would hand back un-awaited coroutines)
+                raise TypeError(
+                    "StatsCorrelator needs synchronous clients; endpoint "
+                    f"{url!r} is async or lacks get_inference_statistics — "
+                    "run the poller beside an aio app with sync clients "
+                    "pointed at the same fleet")
+            self._timeout_kw[url] = accepts_client_timeout(stats_fn)
+        self.interval_s = interval_s
+        reg = telemetry.registry
+        self._stat_seconds = reg.gauge(
+            "client_tpu_server_stat_seconds",
+            "Cumulative server-side per-model timings mirrored from "
+            "get_inference_statistics", ("url", "model", "stat"))
+        self._stat_requests = reg.gauge(
+            "client_tpu_server_requests",
+            "Cumulative server-side request counts by outcome",
+            ("url", "model", "outcome"))
+        self._batch_seconds = reg.gauge(
+            "client_tpu_server_batch_compute_seconds",
+            "Cumulative server compute per executed batch size",
+            ("url", "model", "batch_size"))
+        self._batch_count = reg.gauge(
+            "client_tpu_server_batch_executions",
+            "Server executions per batch size",
+            ("url", "model", "batch_size"))
+        self._up = reg.gauge(
+            "client_tpu_server_statistics_up",
+            "1 when the last statistics poll of the endpoint succeeded",
+            ("url",))
+        self._poll_errors = reg.counter(
+            "client_tpu_server_statistics_poll_errors_total",
+            "Statistics polls that failed", ("url",))
+        self._lock = threading.Lock()
+        # (url, model) -> cumulative server counters at first/last poll
+        self._baseline: Dict[Tuple[str, str], Dict[str, float]] = {}
+        self._latest: Dict[Tuple[str, str], Dict[str, float]] = {}
+        self._client_base: Optional[Tuple[float, float]] = None
+        self._server_metrics: Dict[str, Dict[str, float]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @staticmethod
+    def _server_row(row: Dict[str, Any]) -> Dict[str, float]:
+        stats = row.get("inference_stats", {})
+
+        def ns(stat: str) -> float:
+            return float(stats.get(stat, {}).get("ns", 0))
+
+        return {
+            "requests": float(stats.get("success", {}).get("count", 0)),
+            "fail": float(stats.get("fail", {}).get("count", 0)),
+            "cancel": float(stats.get("cancel", {}).get("count", 0)),
+            "queue_ns": ns("queue"),
+            "compute_ns": (ns("compute_input") + ns("compute_infer")
+                           + ns("compute_output")),
+            "executions": float(row.get("execution_count", 0)),
+            "inferences": float(row.get("inference_count", 0)),
+        }
+
+    def _client_totals(self) -> Tuple[float, float]:
+        """(sum_s, count) across every frontend's request histogram."""
+        self._telemetry.flush()
+        hist = self._telemetry.request_seconds
+        total_s = 0.0
+        count = 0.0
+        with self._telemetry.registry._lock:
+            for series in hist._series.values():
+                total_s += series.sum
+                count += series.count
+        return total_s, count
+
+    @staticmethod
+    def _parse_prometheus(text: str) -> Dict[str, float]:
+        """Minimal Prometheus text parse: ``{series_string: value}``.
+
+        Handles label values containing spaces (split after the closing
+        ``}``) and the optional trailing timestamp field (ignored, never
+        mistaken for the value)."""
+        out: Dict[str, float] = {}
+        for line in text.splitlines():
+            if not line.strip() or line.startswith("#"):
+                continue
+            brace = line.rfind("}")
+            if brace != -1:
+                name = line[:brace + 1]
+                fields = line[brace + 1:].split()
+            else:
+                parts = line.split()
+                name, fields = parts[0], parts[1:]
+            if not fields:
+                continue
+            try:
+                out[name] = float(fields[0])
+            except ValueError:
+                continue
+        return out
+
+    def _scrape_server_metrics(self, url: str, client) -> None:
+        """Best-effort GET /metrics (sync HTTP transports only)."""
+        get = getattr(client, "_get", None)
+        if get is None:
+            return
+        try:
+            resp = get("metrics")
+            if resp.status != 200:
+                return
+            parsed = self._parse_prometheus(resp.data.decode("utf-8"))
+        except Exception:
+            return
+        with self._lock:
+            self._server_metrics[url] = parsed
+
+    def server_metrics(self, url: str) -> Dict[str, float]:
+        """The last parsed /metrics scrape for ``url`` (may be empty)."""
+        with self._lock:
+            return dict(self._server_metrics.get(url, {}))
+
+    def poll_once(self) -> None:
+        """One poll of every endpoint: refresh the mirrored gauges and the
+        delta bookkeeping ``decomposition()`` reads."""
+        if self._client_base is None:
+            self._client_base = self._client_totals()
+        for url, client in self._endpoints:
+            try:
+                # per-call deadline where the transport takes one (gRPC);
+                # HTTP transports are bounded by their constructor timeouts
+                if self.call_timeout_s is not None and self._timeout_kw[url]:
+                    stats = client.get_inference_statistics(
+                        client_timeout=self.call_timeout_s)
+                else:
+                    stats = client.get_inference_statistics()
+            except Exception:
+                self._poll_errors.labels(url).inc()
+                self._up.labels(url).set(0.0)
+                continue
+            self._up.labels(url).set(1.0)
+            for row in stats.get("model_stats", []):
+                model = row.get("name", "")
+                parsed = self._server_row(row)
+                self._stat_seconds.labels(url, model, "queue").set(
+                    parsed["queue_ns"] / 1e9)
+                self._stat_seconds.labels(url, model, "compute").set(
+                    parsed["compute_ns"] / 1e9)
+                self._stat_requests.labels(url, model, "success").set(
+                    parsed["requests"])
+                self._stat_requests.labels(url, model, "fail").set(
+                    parsed["fail"])
+                self._stat_requests.labels(url, model, "cancel").set(
+                    parsed["cancel"])
+                for batch in row.get("batch_stats", []):
+                    size = batch.get("batch_size", 0)
+                    ci = batch.get("compute_infer", {})
+                    self._batch_seconds.labels(url, model, size).set(
+                        float(ci.get("ns", 0)) / 1e9)
+                    self._batch_count.labels(url, model, size).set(
+                        float(ci.get("count", 0)))
+                with self._lock:
+                    key = (url, model)
+                    self._baseline.setdefault(key, parsed)
+                    self._latest[key] = parsed
+            self._scrape_server_metrics(url, client)
+
+    def decomposition(
+        self,
+        client_ms_by_url: Optional[Dict[str, float]] = None,
+    ) -> List[Dict[str, Any]]:
+        """Per (endpoint, model) latency decomposition over the polled
+        window: server queue / server compute / the network+client
+        remainder, all per request.
+
+        ``client_ms_by_url`` supplies a per-endpoint client request
+        latency (the doctor passes its probe averages) so the remainder
+        is attributed to the endpoint that actually paid it. Without it,
+        client latency falls back to the telemetry-wide request average
+        over the window (the client histograms are per-frontend, not
+        per-endpoint) — fine for a single endpoint, a misattribution on
+        mixed fleets. Needs at least two polls with traffic in between."""
+        client_ms = None
+        if self._client_base is not None:
+            base_s, base_n = self._client_base
+            now_s, now_n = self._client_totals()
+            if now_n > base_n:
+                client_ms = (now_s - base_s) / (now_n - base_n) * 1e3
+        rows: List[Dict[str, Any]] = []
+        with self._lock:
+            pairs = [(key, self._baseline.get(key), latest)
+                     for key, latest in self._latest.items()]
+        for (url, model), base, latest in sorted(pairs, key=lambda p: p[0]):
+            if base is None:
+                continue
+            n = latest["requests"] - base["requests"]
+            if n <= 0:
+                continue
+            queue_ms = (latest["queue_ns"] - base["queue_ns"]) / n / 1e6
+            compute_ms = (latest["compute_ns"] - base["compute_ns"]) / n / 1e6
+            row: Dict[str, Any] = {
+                "url": url,
+                "model": model,
+                "requests": int(n),
+                "server_queue_ms": round(queue_ms, 4),
+                "server_compute_ms": round(compute_ms, 4),
+                "server_total_ms": round(queue_ms + compute_ms, 4),
+            }
+            url_ms = (client_ms_by_url or {}).get(url, client_ms)
+            if url_ms is not None:
+                row["client_request_ms"] = round(url_ms, 4)
+                row["network_client_overhead_ms"] = round(
+                    max(url_ms - (queue_ms + compute_ms), 0.0), 4)
+            rows.append(row)
+        return rows
+
+    # -- background polling ---------------------------------------------------
+    def start(self) -> "StatsCorrelator":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass  # a sick endpoint must not kill the poller
+
+        self._thread = threading.Thread(
+            target=loop, name="client_tpu_stats_correlator", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)

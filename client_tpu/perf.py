@@ -685,6 +685,69 @@ class PerfRunner:
             sample=self.observe_sample,
             trace_capacity=max(measurement_requests, 1024))
 
+    def _arm_dataplane(self):
+        """Scoped shm accounting for shm-mode runs: reuse an already
+        installed recorder, else install one for the run (the caller's
+        try/finally uninstalls an owned one even when the run raises).
+        Returns (recorder, before-snapshot, owned)."""
+        if self.shared_memory not in ("system", "tpu"):
+            return None, None, False
+        from . import observe
+
+        recorder = observe.dataplane()
+        if recorder is not None:
+            return recorder, recorder.snapshot(), False
+        registry = (self._telemetry.registry
+                    if self._telemetry is not None else None)
+        recorder = observe.enable_dataplane(registry)
+        return recorder, recorder.snapshot(), True
+
+    def _shm_result(self, result: Dict[str, Any], recorder,
+                    before) -> Dict[str, Any]:
+        """Registration-churn counters for the run: regions created and
+        register RPCs issued, bytes peak — so BASELINE-style shm sweeps
+        record the data-plane cost the pooled-arena work (ROADMAP item 1)
+        will eliminate."""
+        if recorder is None:
+            return result
+        after = recorder.snapshot()
+        family = self.shared_memory
+        before_fam = before["families"][family]
+        after_fam = after["families"][family]
+
+        def rpc_delta(op: str) -> int:
+            key = f"{family}.{op}.ok"
+            return int(after["rpcs"].get(key, 0) - before["rpcs"].get(key, 0))
+
+        result["client_shm"] = {
+            "family": family,
+            "regions_created": int(
+                after_fam["created"] - before_fam["created"]),
+            "regions_destroyed": int(
+                after_fam["destroyed"] - before_fam["destroyed"]),
+            "regions_registered": rpc_delta("register"),
+            "regions_unregistered": rpc_delta("unregister"),
+            "map_writes": int(
+                after_fam["map_writes"] - before_fam["map_writes"]),
+            "map_reads": int(
+                after_fam["map_reads"] - before_fam["map_reads"]),
+            # the recorder's high-water mark is attributable to THIS run
+            # only when the run raised it (always true for the run-scoped
+            # recorder _arm_dataplane installs; a reused process-global
+            # recorder may carry an earlier run's peak -> unknown/None)
+            "bytes_peak": (int(after_fam["bytes_peak"])
+                           if after_fam["bytes_peak"]
+                           > before_fam["bytes_peak"] else None),
+        }
+        return result
+
+    @staticmethod
+    def _disarm_dataplane(owned: bool) -> None:
+        if owned:
+            from . import observe
+
+            observe.install_dataplane(None)
+
     @staticmethod
     def _batch_result(result: Dict[str, Any],
                       batch_stats: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -715,6 +778,16 @@ class PerfRunner:
     # -- sweep -------------------------------------------------------------
     def run(self, concurrency: int, measurement_requests: int) -> Dict[str, Any]:
         self._arm_telemetry(measurement_requests)
+        shm_rec, shm_before, shm_owned = self._arm_dataplane()
+        try:
+            return self._run_closed(
+                concurrency, measurement_requests, shm_rec, shm_before)
+        finally:
+            # an owned recorder must not outlive the run, even on error
+            self._disarm_dataplane(shm_owned)
+
+    def _run_closed(self, concurrency: int, measurement_requests: int,
+                    shm_rec, shm_before) -> Dict[str, Any]:
         client = self._make_client(concurrency)
         if self.protocol == "native-grpc-async":
             # the shared instance must admit as many RPCs as we have
@@ -745,7 +818,7 @@ class PerfRunner:
 
         lat_sorted = sorted(latencies)
         n = len(lat_sorted)
-        return self._batch_result(self._observe_result({
+        return self._shm_result(self._batch_result(self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
             "shared_memory": self.shared_memory,
@@ -761,7 +834,7 @@ class PerfRunner:
                 "p90": round(1000 * _percentile(lat_sorted, 0.90), 3),
                 "p99": round(1000 * _percentile(lat_sorted, 0.99), 3),
             },
-        }), batch_stats)
+        }), batch_stats), shm_rec, shm_before)
 
     def run_rate(self, rate: float, measurement_requests: int,
                  distribution: str = "constant",
@@ -783,6 +856,17 @@ class PerfRunner:
         schedule = np.concatenate([[0.0], np.cumsum(gaps[:-1])]).tolist()
 
         self._arm_telemetry(measurement_requests)
+        shm_rec, shm_before, shm_owned = self._arm_dataplane()
+        try:
+            return self._run_open(
+                rate, distribution, pool_size, schedule, shm_rec, shm_before)
+        finally:
+            # an owned recorder must not outlive the run, even on error
+            self._disarm_dataplane(shm_owned)
+
+    def _run_open(self, rate: float, distribution: str, pool_size: int,
+                  schedule: List[float], shm_rec,
+                  shm_before) -> Dict[str, Any]:
         client = self._make_client(pool_size)
         if self.protocol == "native-grpc-async":
             client.set_async_concurrency(pool_size)
@@ -822,7 +906,7 @@ class PerfRunner:
         # (reference threshold: perf_analyzer flags schedule slip; 1 ms
         # separates scheduler jitter from genuine queueing)
         delayed = sum(1 for lag in lag_sorted if lag > 1e-3)
-        return self._batch_result(self._observe_result({
+        return self._shm_result(self._batch_result(self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
             "shared_memory": self.shared_memory,
@@ -846,7 +930,7 @@ class PerfRunner:
                 "p99": round(1000 * _percentile(lag_sorted, 0.99), 3),
             },
             "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
-        }), batch_stats)
+        }), batch_stats), shm_rec, shm_before)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

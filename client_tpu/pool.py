@@ -680,8 +680,20 @@ class _PoolClientBase:
     # -- shared helpers ------------------------------------------------------
     def endpoint_stats(self) -> Dict[str, Dict[str, Any]]:
         """Per-endpoint snapshot: health, ejection, breaker state,
-        outstanding count, and the endpoint's ResilienceStats counters."""
-        return self.pool.snapshot()
+        outstanding count, the endpoint's ResilienceStats counters — and,
+        when the pool's telemetry has ingested ORCA reports, the latest
+        un-expired ``EndpointLoad`` per endpoint (a ``load`` key:
+        observation only; routing on it is ROADMAP item 2)."""
+        out = self.pool.snapshot()
+        tel = self._telemetry
+        if tel is not None:
+            loads = tel.endpoint_loads()
+            if loads:
+                for key, stats in out.items():
+                    load = loads.get(key.partition("#")[0])
+                    if load is not None:
+                        stats["load"] = load.as_dict()
+        return out
 
     def _record_attempt_failure(self, ep: EndpointState,
                                 exc: BaseException) -> str:

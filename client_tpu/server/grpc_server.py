@@ -242,13 +242,17 @@ class _Handlers(grpc.GenericRpcHandler):
 
     # -- inference -----------------------------------------------------------
     @staticmethod
-    def _traceparent_of(context) -> Optional[str]:
-        """The W3C trace-context metadata value, if the client sent one
-        (the GRPC twin of the HTTP frontends' traceparent header)."""
+    def _metadata_value(context, wanted: str) -> Optional[str]:
+        """One invocation-metadata value (the GRPC twin of an HTTP
+        request header), or None when the client did not send it."""
         for key, value in (context.invocation_metadata() or ()):
-            if key == "traceparent":
+            if key == wanted:
                 return value
         return None
+
+    @classmethod
+    def _traceparent_of(cls, context) -> Optional[str]:
+        return cls._metadata_value(context, "traceparent")
 
     def _model_infer(self, request, context):
         try:
@@ -256,9 +260,19 @@ class _Handlers(grpc.GenericRpcHandler):
             traceparent = self._traceparent_of(context)
             if traceparent:
                 core_req["traceparent"] = traceparent
+            model_name = request.get("model_name", "")
             responses = self._core.infer(
-                request.get("model_name", ""), request.get("model_version", ""), core_req
+                model_name, request.get("model_version", ""), core_req
             )
+            orca_format = self._metadata_value(
+                context, "endpoint-load-metrics-format")
+            if orca_format in ("json", "text"):
+                # ORCA per-response load metrics ride trailing metadata on
+                # GRPC (the header transport HTTP doesn't have)
+                context.set_trailing_metadata((
+                    ("endpoint-load-metrics",
+                     self._core.orca_report(orca_format, model_name)),
+                ))
             return _encode_core_response(responses[0])
         except InferError as e:
             self._abort(context, e)

@@ -394,6 +394,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200 if (core.live and core.ready) else 503)
             if path == "/v2/models/stats":
                 return self._send_json(core.statistics())
+            if path == "/v2/trace/access":
+                # traceparent-joined server spans (queue/compute ns +
+                # wall_time_s): the doctor reads these to join its probe
+                # trace and estimate client<->server clock skew
+                return self._send_json(core.access_records())
             if path == "/v2/trace/setting":
                 return self._send_json(core.trace_settings)
             if path == "/v2/logging":

@@ -98,8 +98,15 @@ class AioHttpInferenceServer:
         async def server_stats(request):
             return _json_response(core.statistics())
 
+        async def trace_access(request):
+            # traceparent-joined server spans (queue/compute ns +
+            # wall_time_s): the doctor reads these to join its probe
+            # trace and estimate client<->server clock skew
+            return _json_response(core.access_records())
+
         r.add_get("/v2", server_metadata)
         r.add_get("/v2/models/stats", server_stats)
+        r.add_get("/v2/trace/access", trace_access)
 
         async def model_route(request):
             name = request.match_info["name"]

@@ -27,6 +27,10 @@ from .. import (
     serialized_byte_size,
     triton_to_np_dtype,
 )
+# data-plane accounting: every lifecycle/map op consults the process-global
+# recorder (observe._DATAPLANE); with none installed the cost is one module
+# attribute load + None check per op (the pay-for-what-you-use bar)
+from ... import observe as _observe
 
 
 class SharedMemoryException(InferenceServerException):
@@ -170,6 +174,7 @@ def create_shared_memory_region(
         raise SharedMemoryException("shared-memory byte_size must be positive")
     handle = SharedMemoryRegion(triton_shm_name, key)
     name = _posix_name(key)
+    created = True
     with _lock:
         try:
             # created regions stay resource-tracked: unlink() deregisters, and
@@ -194,9 +199,16 @@ def create_shared_memory_region(
                     f"existing shared memory region with key '{key}' is smaller "
                     f"({handle._shm.size}B) than requested ({byte_size}B)"
                 )
+            created = False
         handle._byte_size = byte_size
         _key_refcount[key] = _key_refcount.get(key, 0) + 1
         _active_regions.append(handle)
+    rec = _observe._DATAPLANE
+    if rec is not None:
+        if created:
+            rec.on_create("system", byte_size, key=id(handle))
+        else:
+            rec.on_attach("system", byte_size, key=id(handle))
     return handle
 
 
@@ -206,6 +218,9 @@ def set_shared_memory_region(
     """Copy each array in ``input_values`` into the region back-to-back."""
     if not isinstance(input_values, (list, tuple)):
         raise SharedMemoryException("input_values must be a list of numpy arrays")
+    rec = _observe._DATAPLANE
+    if rec is not None:
+        rec.on_map("system", write=True)
     cursor = offset
     buf = shm_handle.buf()
     for value in input_values:
@@ -234,6 +249,9 @@ def get_contents_as_numpy(
 
     ``datatype`` may be a numpy dtype or a Triton datatype string.
     """
+    rec = _observe._DATAPLANE
+    if rec is not None:
+        rec.on_map("system", write=False)
     if isinstance(datatype, str):
         np_dtype = np.dtype(triton_to_np_dtype(datatype))
         is_bytes = datatype == "BYTES"
@@ -265,6 +283,17 @@ def mapped_shared_memory_regions() -> List[str]:
         return [r.name for r in _active_regions]
 
 
+def region_inventory() -> List[Dict[str, Any]]:
+    """One dict per live handle (name/key/bytes) — the shm inventory the
+    doctor snapshot reports alongside the data-plane counters."""
+    with _lock:
+        return [
+            {"family": "system", "name": r.name, "key": r.key,
+             "byte_size": r.byte_size}
+            for r in _active_regions
+        ]
+
+
 def destroy_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
     """Unmap; unlink the underlying POSIX object when this is the last handle."""
     with _lock:
@@ -283,3 +312,6 @@ def destroy_shared_memory_region(shm_handle: SharedMemoryRegion) -> None:
             _key_refcount[key] = remaining
         _safe_close(shm_handle._shm, unlink=remaining <= 0)
         shm_handle._shm = None
+    rec = _observe._DATAPLANE
+    if rec is not None:
+        rec.on_destroy("system", shm_handle.byte_size, key=id(shm_handle))

@@ -47,12 +47,21 @@ from .. import (
     serialize_byte_tensor,
     triton_to_np_dtype,
 )
+from ... import observe as _observe
 from .._dlpack import SharedMemoryTensor, kDLCPU
 from ..shared_memory import (
     SharedMemoryException,
     _safe_close,
     attach_shared_memory,
 )
+
+
+def _record_map(write: bool) -> None:
+    # data-plane accounting: one op per public map-level call; with no
+    # recorder installed this is one attribute load + None check
+    rec = _observe._DATAPLANE
+    if rec is not None:
+        rec.on_map("tpu", write)
 
 
 def _is_jax_array(t: Any) -> bool:
@@ -187,6 +196,9 @@ class TpuSharedMemoryRegion:
         if not self._cache_enabled and self._shm is not None:
             _safe_close(self._shm, unlink=False)
             self._shm = None
+            rec = _observe._DATAPLANE
+            if rec is not None:  # residency ended: account like a destroy
+                rec.on_destroy("tpu", self._byte_size, key=id(self))
 
     def host_address(self, offset: int = 0) -> int:
         """Raw address of the host window at ``offset`` (for DLPack export)."""
@@ -206,6 +218,19 @@ _registry: Dict[str, TpuSharedMemoryRegion] = {}
 def allocated_shared_memory_regions() -> List[str]:
     with _lock:
         return [r.name for r in _registry.values()]
+
+
+def region_inventory() -> List[Dict[str, Any]]:
+    """One dict per region allocated by this process (doctor inventory)."""
+    with _lock:
+        regions = list(_registry.values())
+    return [
+        {"family": "tpu", "name": r.name, "key": r.shm_key,
+         "byte_size": r.byte_size, "device_id": r.device_id,
+         "colocated": r.colocated,
+         "device_entries": len(r._device_entries)}
+        for r in regions
+    ]
 
 
 def create_shared_memory_region(
@@ -237,6 +262,9 @@ def create_shared_memory_region(
         )
     with _lock:
         _registry[shm_key] = region
+    rec = _observe._DATAPLANE
+    if rec is not None:
+        rec.on_create("tpu", byte_size, key=id(region))
     return region
 
 
@@ -282,6 +310,9 @@ def attach_from_raw_handle(raw_handle: str) -> TpuSharedMemoryRegion:
         raise SharedMemoryException(
             f"unable to attach tpu shared-memory region with key '{shm_key}'"
         )
+    rec = _observe._DATAPLANE
+    if rec is not None:
+        rec.on_attach("tpu", region.byte_size, key=id(region))
     return region
 
 
@@ -295,10 +326,11 @@ def set_shared_memory_region(
     """
     if not isinstance(input_values, (list, tuple)):
         raise SharedMemoryException("input_values must be a list of arrays")
+    _record_map(write=True)
     cursor = offset
     for value in input_values:
         if _is_jax_array(value):
-            cursor = set_shared_memory_region_from_jax(shm_handle, value, cursor)
+            cursor = _set_from_jax(shm_handle, value, cursor)
             continue
         arr = np.asarray(value)
         if arr.dtype == np.object_ or arr.dtype.kind in ("S", "U"):
@@ -324,6 +356,11 @@ def set_shared_memory_region_from_jax(
     host mirror actually runs, its D2H_START/D2H_END points are captured
     (direction semantics: device HBM -> host window).
     """
+    _record_map(write=True)
+    return _set_from_jax(shm_handle, array, offset, timers)
+
+
+def _set_from_jax(shm_handle, array, offset=0, timers=None) -> int:
     nbytes = array.dtype.itemsize * array.size
     shm_handle._check(nbytes, offset, "write")
     shm_handle._cache_device_entry(offset, array, nbytes)
@@ -340,8 +377,9 @@ def set_shared_memory_region_from_dlpack(
     shm_handle: TpuSharedMemoryRegion, tensor, offset: int = 0
 ) -> None:
     """Ingest any ``__dlpack__`` producer (torch/numpy host tensors, jax)."""
+    _record_map(write=True)
     if _is_jax_array(tensor):
-        set_shared_memory_region_from_jax(shm_handle, tensor, offset)
+        _set_from_jax(shm_handle, tensor, offset)
         return
     try:
         arr = np.from_dlpack(tensor)
@@ -354,6 +392,7 @@ def get_contents_as_numpy(
     shm_handle: TpuSharedMemoryRegion, datatype, shape, offset: int = 0
 ) -> np.ndarray:
     """Host view of the region contents (flushes device entries first)."""
+    _record_map(write=False)
     if isinstance(datatype, str):
         triton_dtype = datatype
     else:
@@ -381,6 +420,7 @@ def get_contents_as_jax(
     ``device_put`` from the host window; with ``timers`` given, its
     H2D_START/H2D_END points bracket that transfer (to completion).
     """
+    _record_map(write=False)
     import jax
 
     if isinstance(datatype, str):
@@ -409,6 +449,7 @@ def as_shared_memory_tensor(
     shm_handle: TpuSharedMemoryRegion, datatype: str, shape: Sequence[int], offset: int = 0
 ) -> SharedMemoryTensor:
     """Expose the host window as a DLPack producer (zero-copy consumers)."""
+    _record_map(write=False)
     np_dtype = np.dtype(triton_to_np_dtype(datatype))
     n_elems = int(np.prod(shape)) if len(shape) else 1
     nbytes = n_elems * np_dtype.itemsize
@@ -433,3 +474,6 @@ def destroy_shared_memory_region(shm_handle: TpuSharedMemoryRegion) -> None:
             _owned_names.discard(_posix_name(shm_handle.shm_key))
         _safe_close(shm_handle._shm, unlink=owned)
         shm_handle._shm = None
+        rec = _observe._DATAPLANE
+        if rec is not None:
+            rec.on_destroy("tpu", shm_handle.byte_size, key=id(shm_handle))

@@ -1,0 +1,561 @@
+"""Data-plane & fleet observability tests (ISSUE 7).
+
+Covers: the ORCA ``endpoint-load-metrics`` parser (json vs text forms,
+unknown keys, malformed values, missing header → no gauge churn,
+stale-endpoint gauge expiry), the MetricsRegistry cardinality guard
+(overflow aggregates into an ``other`` series + a dropped-labels
+counter), shm lifecycle accounting in both shm util packages and the
+frontends' register paths, GRPC sync+aio ``get_response_header`` parity
+(ORCA over initial/trailing metadata), the client<->server stats
+correlator, and the doctor fleet snapshot — including the
+``doctor_smoke`` marker run against a 3-replica pool under the chaos
+proxy.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+import client_tpu.observe as observe
+from client_tpu.doctor import collect_snapshot, render_summary
+from client_tpu.models import default_model_zoo
+from client_tpu.observe import (
+    MetricsRegistry,
+    StatsCorrelator,
+    Telemetry,
+    parse_endpoint_load,
+)
+from client_tpu.pool import PoolClient
+from client_tpu.server import (
+    GrpcInferenceServer,
+    HttpInferenceServer,
+    ServerCore,
+)
+from client_tpu.testing import ChaosProxy, Fault
+
+
+def _simple_inputs(mod):
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = mod.InferInput("INPUT0", [1, 16], "INT32")
+    in0.set_data_from_numpy(a)
+    in1 = mod.InferInput("INPUT1", [1, 16], "INT32")
+    in1.set_data_from_numpy(b)
+    return [in0, in1]
+
+
+@pytest.fixture
+def scoped_dataplane():
+    """A fresh recorder installed for the test, always restored."""
+    previous = observe.dataplane()
+    recorder = observe.enable_dataplane()
+    try:
+        yield recorder
+    finally:
+        observe.install_dataplane(previous)
+
+
+# -- ORCA parser --------------------------------------------------------------
+def test_orca_parse_json_form():
+    load = parse_endpoint_load(
+        '{"named_metrics": {"inference_count": 3, "avg_compute_infer_us": '
+        '120}, "cpu_utilization": 0.5}')
+    assert load is not None and load.format == "json"
+    assert load.metrics == {
+        "named_metrics.inference_count": 3.0,
+        "named_metrics.avg_compute_infer_us": 120.0,
+        "cpu_utilization": 0.5,
+    }
+
+
+def test_orca_parse_text_form():
+    load = parse_endpoint_load(
+        "named_metrics.inference_count=5, named_metrics.active_models=2")
+    assert load is not None and load.format == "text"
+    assert load.metrics["named_metrics.inference_count"] == 5.0
+    assert load.metrics["named_metrics.active_models"] == 2.0
+
+
+def test_orca_parse_unknown_keys_preserved():
+    load = parse_endpoint_load('{"rps_fractional": 12.5, "wat": 1}')
+    assert load.metrics == {"rps_fractional": 12.5, "wat": 1.0}
+
+
+def test_orca_parse_malformed_values_skipped_never_raise():
+    # bad values are dropped, good ones survive
+    load = parse_endpoint_load('{"a": "zz", "b": 2, "c": null}')
+    assert load.metrics == {"b": 2.0}
+    # nothing parseable at all -> None (json and text forms)
+    assert parse_endpoint_load('{"a": "zz"}') is None
+    assert parse_endpoint_load("not a report") is None
+    assert parse_endpoint_load("[1, 2]") is None
+    assert parse_endpoint_load("") is None
+    assert parse_endpoint_load(None) is None
+    # NaN / inf are not load values
+    assert parse_endpoint_load('{"a": NaN}') is None
+
+
+def test_orca_ingest_missing_header_no_gauge_churn():
+    tel = Telemetry(orca_format="json")
+    assert tel.ingest_endpoint_load("e:1", None) is None
+    assert tel.endpoint_loads() == {}
+    text = tel.registry.prometheus_text()
+    assert "client_tpu_endpoint_load{" not in text
+    assert "client_tpu_endpoint_load_reports_total" not in text
+
+
+def test_orca_ingest_malformed_counts_parse_error():
+    tel = Telemetry(orca_format="json")
+    assert tel.ingest_endpoint_load("e:1", "{broken") is None
+    tel.flush()
+    assert tel._orca_parse_errors.labels("e:1").get() == 1
+    assert "client_tpu_endpoint_load{" not in tel.registry.prometheus_text()
+
+
+def test_orca_stale_endpoint_gauge_expiry():
+    tel = Telemetry(orca_format="json", orca_ttl_s=0.05)
+    tel.ingest_endpoint_load("e:1", '{"named_metrics": {"x": 1}}')
+    assert 'client_tpu_endpoint_load{url="e:1"' in (
+        tel.registry.prometheus_text())
+    assert "e:1" in tel.endpoint_loads()
+    time.sleep(0.1)
+    # the scrape-time collector expires the silent endpoint's gauges
+    text = tel.registry.prometheus_text()
+    assert 'client_tpu_endpoint_load{url="e:1"' not in text
+    assert tel.endpoint_loads() == {}
+    # cumulative report counters survive expiry (monotonic by contract)
+    assert "client_tpu_endpoint_load_reports_total" in text
+
+
+def test_orca_ingest_drops_vanished_metric_series():
+    tel = Telemetry(orca_format="json")
+    tel.ingest_endpoint_load("e:1", '{"named_metrics": {"x": 1, "y": 2}}')
+    tel.ingest_endpoint_load("e:1", '{"named_metrics": {"x": 3}}')
+    text = tel.registry.prometheus_text()
+    assert 'metric="named_metrics.x"' in text
+    assert 'metric="named_metrics.y"' not in text
+
+
+# -- cardinality guard --------------------------------------------------------
+def test_cardinality_guard_overflows_into_other_series():
+    reg = MetricsRegistry(max_series_per_metric=3)
+    counter = reg.counter("guarded_total", "", ("url",))
+    for i in range(6):
+        counter.labels(f"endpoint-{i}").inc()
+    series_keys = sorted(counter._series)
+    assert len(series_keys) == 4  # 3 real + the 'other' overflow series
+    assert (observe.OVERFLOW_LABEL,) in counter._series
+    assert counter.labels(observe.OVERFLOW_LABEL).get() == 3
+    dropped = reg._dropped_labelsets.labels("guarded_total").get()
+    assert dropped == 3
+    text = reg.prometheus_text()
+    assert 'guarded_total{url="other"} 3' in text
+    assert "client_tpu_metrics_dropped_labelsets_total" in text
+
+
+def test_cardinality_guard_existing_series_keep_working():
+    reg = MetricsRegistry(max_series_per_metric=2)
+    gauge = reg.gauge("g", "", ("k",))
+    gauge.labels("a").set(1)
+    gauge.labels("b").set(2)
+    gauge.labels("c").set(9)  # overflow
+    gauge.labels("a").set(5)  # existing series unaffected by the guard
+    assert gauge.labels("a").get() == 5
+    assert gauge.labels(observe.OVERFLOW_LABEL).get() == 9
+
+
+def test_try_labels_never_folds_into_other():
+    reg = MetricsRegistry(max_series_per_metric=2)
+    gauge = reg.gauge("g", "", ("url", "metric"))
+    assert gauge.try_labels("a", "x") is not None
+    assert gauge.try_labels("b", "y") is not None
+    assert gauge.try_labels("c", "z") is None  # capped: dropped, not folded
+    assert (observe.OVERFLOW_LABEL,) * 2 not in gauge._series
+    assert reg._dropped_labelsets.labels("g").get() == 1
+
+
+def test_dropped_counter_at_cap_does_not_recurse():
+    # the dropped-labelsets counter is itself guarded; once IT hits the
+    # cap, its overflow fold must not re-note the drop (that recursed
+    # until RecursionError, crashing the metric caller's data path)
+    reg = MetricsRegistry(max_series_per_metric=2)
+    for i in range(4):  # 4 instruments, each overflowing the cap
+        counter = reg.counter(f"c{i}_total", "", ("k",))
+        for j in range(4):
+            counter.labels(f"v{j}").inc()
+    dropped = reg._dropped_labelsets
+    assert dropped.labels(observe.OVERFLOW_LABEL).get() > 0
+    reg.prometheus_text()  # still renders
+
+
+def test_orca_overflow_never_leaves_unremovable_series():
+    # a load folded into the 'other' series could never be TTL-expired;
+    # ingestion must drop (counted) instead of folding
+    tel = Telemetry(registry=MetricsRegistry(max_series_per_metric=1),
+                    orca_format="json", orca_ttl_s=0.02)
+    tel.ingest_endpoint_load("e:1", '{"named_metrics": {"x": 1, "y": 2}}')
+    time.sleep(0.05)
+    assert "client_tpu_endpoint_load{" not in tel.registry.prometheus_text()
+
+
+def test_series_remove():
+    reg = MetricsRegistry()
+    gauge = reg.gauge("g", "", ("k",))
+    gauge.labels("a").set(1)
+    assert gauge.remove("a") is True
+    assert gauge.remove("a") is False
+    assert 'g{k="a"}' not in reg.prometheus_text()
+
+
+# -- shm lifecycle accounting -------------------------------------------------
+def test_shm_utils_accounting(scoped_dataplane):
+    import client_tpu.utils.shared_memory as shm
+
+    rec = scoped_dataplane
+    region = shm.create_shared_memory_region(
+        "dp_obs_a", "/dp_obs_a", 4096)
+    shm.set_shared_memory_region(region, [np.arange(8, dtype=np.int32)])
+    shm.get_contents_as_numpy(region, "INT32", [8])
+    snap = rec.snapshot()["families"]["system"]
+    assert snap["created"] == 1
+    assert snap["regions"] == 1
+    assert snap["bytes_resident"] == 4096
+    assert snap["map_writes"] == 1 and snap["map_reads"] == 1
+    # a second handle over the same key is an attach, still resident here
+    second = shm.create_shared_memory_region("dp_obs_a2", "/dp_obs_a", 4096)
+    snap = rec.snapshot()["families"]["system"]
+    assert snap["attached"] == 1 and snap["regions"] == 2
+    shm.destroy_shared_memory_region(second)
+    shm.destroy_shared_memory_region(region)
+    snap = rec.snapshot()["families"]["system"]
+    assert snap["destroyed"] == 2
+    assert snap["regions"] == 0 and snap["bytes_resident"] == 0
+    assert snap["bytes_peak"] == 8192
+    inventory = shm.region_inventory()
+    assert all(r["name"] not in ("dp_obs_a", "dp_obs_a2")
+               for r in inventory)
+
+
+def test_tpu_shm_accounting(scoped_dataplane):
+    import client_tpu.utils.tpu_shared_memory as tpushm
+
+    rec = scoped_dataplane
+    region = tpushm.create_shared_memory_region("dp_obs_tpu", 512)
+    tpushm.set_shared_memory_region(
+        region, [np.arange(4, dtype=np.float32)])
+    tpushm.get_contents_as_numpy(region, "FP32", [4])
+    inventory = tpushm.region_inventory()
+    assert any(r["name"] == "dp_obs_tpu" and r["byte_size"] == 512
+               for r in inventory)
+    tpushm.destroy_shared_memory_region(region)
+    snap = rec.snapshot()["families"]["tpu"]
+    assert snap["created"] == 1 and snap["destroyed"] == 1
+    assert snap["map_writes"] == 1 and snap["map_reads"] == 1
+    assert snap["regions"] == 0 and snap["bytes_peak"] == 512
+
+
+def test_shm_accounting_disabled_is_inert():
+    import client_tpu.utils.shared_memory as shm
+
+    assert observe.dataplane() is None
+    region = shm.create_shared_memory_region("dp_obs_off", "/dp_obs_off", 64)
+    shm.destroy_shared_memory_region(region)  # no recorder, no error
+
+
+def test_frontend_register_rpcs_accounted(scoped_dataplane):
+    import client_tpu.utils.shared_memory as shm
+
+    rec = scoped_dataplane
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            region = shm.create_shared_memory_region(
+                "dp_obs_rpc", "/dp_obs_rpc", 256)
+            try:
+                client.register_system_shared_memory(
+                    "dp_obs_rpc", "/dp_obs_rpc", 256)
+                client.unregister_system_shared_memory("dp_obs_rpc")
+            finally:
+                shm.destroy_shared_memory_region(region)
+    snap = rec.snapshot()
+    assert snap["rpcs"]["system.register.ok"] == 1
+    assert snap["rpcs"]["system.unregister.ok"] == 1
+    hist = rec.rpc_seconds.labels("http", "system", "register")
+    assert hist.count == 1
+    text = rec.registry.prometheus_text()
+    assert "client_tpu_shm_registration_seconds" in text
+
+
+def test_frontend_register_rpc_failure_accounted(scoped_dataplane):
+    rec = scoped_dataplane
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        with httpclient.InferenceServerClient(server.url) as client:
+            client.register_system_shared_memory(
+                "dp_obs_dup", "/dp_obs_dup", 128)
+            with pytest.raises(Exception):
+                # an active name must be unregistered first -> 400
+                client.register_system_shared_memory(
+                    "dp_obs_dup", "/dp_obs_dup", 128)
+            client.unregister_system_shared_memory("dp_obs_dup")
+    assert rec.snapshot()["rpcs"]["system.register.error"] == 1
+
+
+# -- GRPC response-metadata parity + ORCA e2e ---------------------------------
+def test_grpc_sync_get_response_header_orca():
+    core = ServerCore(default_model_zoo())
+    with GrpcInferenceServer(core) as server:
+        tel = Telemetry(orca_format="json")
+        with grpcclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            result = client.infer("simple", _simple_inputs(grpcclient))
+            header = result.get_response_header("endpoint-load-metrics")
+            assert header is not None
+            load = parse_endpoint_load(header)
+            assert load.metrics["named_metrics.inference_count"] >= 1
+            assert result.get_response_header("no-such-header", "dflt") == \
+                "dflt"
+            # ingested into the per-endpoint gauges
+            assert server.url in tel.endpoint_loads()
+            assert 'client_tpu_endpoint_load{' in (
+                tel.registry.prometheus_text())
+
+
+def test_grpc_sync_manual_orca_header_without_telemetry():
+    # opt-in via per-request headers (no telemetry): metadata parity alone
+    core = ServerCore(default_model_zoo())
+    with GrpcInferenceServer(core) as server:
+        with grpcclient.InferenceServerClient(server.url) as client:
+            result = client.infer(
+                "simple", _simple_inputs(grpcclient),
+                headers={"endpoint-load-metrics-format": "text"})
+            header = result.get_response_header("endpoint-load-metrics")
+            assert header and "named_metrics.inference_count=" in header
+
+
+def test_grpc_async_infer_callback_response_headers():
+    # the callback path stashes response metadata (and ingests ORCA)
+    # just like the unary path — parity covers async_infer too
+    import queue
+
+    core = ServerCore(default_model_zoo())
+    with GrpcInferenceServer(core) as server:
+        tel = Telemetry(orca_format="json")
+        with grpcclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            done: "queue.Queue" = queue.Queue()
+            client.async_infer(
+                "simple", _simple_inputs(grpcclient),
+                callback=lambda result, error: done.put((result, error)))
+            result, error = done.get(timeout=30)
+            assert error is None
+            header = result.get_response_header("endpoint-load-metrics")
+            assert header is not None
+            assert server.url in tel.endpoint_loads()
+
+
+def test_grpc_aio_get_response_header_orca():
+    import client_tpu.grpc.aio as aioclient
+
+    async def run():
+        core = ServerCore(default_model_zoo())
+        with GrpcInferenceServer(core) as server:
+            tel = Telemetry(orca_format="json")
+            async with aioclient.InferenceServerClient(server.url) as client:
+                client.configure_telemetry(tel)
+                result = await client.infer(
+                    "simple", _simple_inputs(aioclient))
+                header = result.get_response_header("endpoint-load-metrics")
+                assert header is not None
+                assert server.url in tel.endpoint_loads()
+
+    asyncio.run(run())
+
+
+def test_http_aio_orca_ingestion():
+    import client_tpu.http.aio as aioclient
+    from client_tpu.server import AioHttpInferenceServer
+
+    async def run():
+        core = ServerCore(default_model_zoo())
+        with AioHttpInferenceServer(core) as server:
+            tel = Telemetry(orca_format="text")
+            async with aioclient.InferenceServerClient(server.url) as client:
+                client.configure_telemetry(tel)
+                result = await client.infer(
+                    "simple", _simple_inputs(aioclient))
+                assert result.get_response_header("endpoint-load-metrics")
+                assert server.url in tel.endpoint_loads()
+
+    asyncio.run(run())
+
+
+def test_pool_endpoint_stats_surface_load():
+    cores = [ServerCore(default_model_zoo()) for _ in range(2)]
+    servers = [HttpInferenceServer(core).start() for core in cores]
+    try:
+        tel = Telemetry(orca_format="json")
+        client = PoolClient([s.url for s in servers], protocol="http",
+                            health_interval_s=None, telemetry=tel)
+        try:
+            inputs = _simple_inputs(httpclient)
+            for _ in range(4):  # round robin touches both replicas
+                client.infer("simple", inputs)
+            stats = client.endpoint_stats()
+            assert set(stats) == {s.url for s in servers}
+            for row in stats.values():
+                assert "load" in row, row
+                assert row["load"]["metrics"][
+                    "named_metrics.inference_count"] >= 1
+        finally:
+            client.close()
+    finally:
+        for server in servers:
+            server.stop()
+
+
+# -- stats correlator ---------------------------------------------------------
+def test_stats_correlator_decomposition_and_gauges():
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        tel = Telemetry(sample="always")
+        with httpclient.InferenceServerClient(server.url) as client:
+            client.configure_telemetry(tel)
+            correlator = StatsCorrelator(tel, {server.url: client})
+            inputs = _simple_inputs(httpclient)
+            client.infer("simple", inputs)  # warm (jit compile)
+            correlator.poll_once()  # baseline
+            for _ in range(5):
+                client.infer("simple", inputs)
+            correlator.poll_once()
+            rows = correlator.decomposition()
+            assert rows, "no decomposition rows"
+            row = next(r for r in rows if r["model"] == "simple")
+            assert row["requests"] == 5
+            assert row["server_compute_ms"] > 0
+            assert row["client_request_ms"] >= row["server_total_ms"]
+            assert row["network_client_overhead_ms"] >= 0
+            text = tel.registry.prometheus_text()
+            assert "client_tpu_server_stat_seconds" in text
+            assert "client_tpu_server_statistics_up" in text
+            # the /metrics scrape side (sync HTTP transport)
+            scraped = correlator.server_metrics(server.url)
+            assert scraped.get("client_tpu_server_ready") == 1.0
+
+
+def test_stats_correlator_rejects_async_clients():
+    class FakeAioClient:
+        async def get_inference_statistics(self, *a, **k):
+            return {}
+
+    with pytest.raises(TypeError, match="synchronous"):
+        StatsCorrelator(Telemetry(), {"127.0.0.1:1": FakeAioClient()})
+    with pytest.raises(TypeError, match="synchronous"):
+        StatsCorrelator(Telemetry(), {"127.0.0.1:1": object()})
+
+
+def test_stats_correlator_poll_error_counted():
+    tel = Telemetry()
+    with httpclient.InferenceServerClient("127.0.0.1:9") as client:
+        correlator = StatsCorrelator(tel, {"127.0.0.1:9": client})
+        correlator.poll_once()
+        assert correlator._poll_errors.labels("127.0.0.1:9").get() == 1
+        assert tel.registry.snapshot()[
+            "client_tpu_server_statistics_up"]["series"][0]["value"] == 0.0
+
+
+# -- doctor -------------------------------------------------------------------
+def test_doctor_snapshot_single_replica(tmp_path):
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        snap = collect_snapshot([server.url], requests_per_endpoint=3)
+    assert snap["endpoints"][0]["ready"] is True
+    assert snap["endpoints"][0]["probe_requests"] == 3
+    assert "clock_skew_ms" in snap["endpoints"][0]
+    assert abs(snap["endpoints"][0]["clock_skew_ms"]) < 5000
+    assert snap["decomposition"], snap
+    assert snap["endpoint_stats"][server.url]["load"]["metrics"][
+        "named_metrics.inference_count"] >= 3
+    # JSON artifact round-trips
+    path = tmp_path / "doctor.json"
+    path.write_text(json.dumps(snap, default=str))
+    json.loads(path.read_text())
+    summary = render_summary(snap)
+    assert "endpoints:" in summary and server.url in summary
+
+
+def test_doctor_flags_down_endpoint():
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        snap = collect_snapshot(
+            [server.url, "127.0.0.1:9"], requests_per_endpoint=2,
+            probe_timeout_s=2.0)
+    flags = {f["flag"] for f in snap["anomalies"]}
+    assert "endpoint_unhealthy" in flags
+    down = next(ep for ep in snap["endpoints"]
+                if ep["url"] == "127.0.0.1:9")
+    assert down["ready"] is False
+
+
+@pytest.mark.doctor_smoke
+def test_doctor_smoke_three_replica_chaos(tmp_path):
+    """The doctor against a 3-replica pool under the chaos proxy: one
+    replica behind a latency fault must show up in the decomposition as
+    network (not server) milliseconds and trip the load/latency
+    divergence flag."""
+    cores = [ServerCore(default_model_zoo()) for _ in range(3)]
+    servers = [HttpInferenceServer(core).start() for core in cores]
+    proxies = [ChaosProxy("127.0.0.1", s.port).start() for s in servers]
+    try:
+        # warm every replica (jit compile must not masquerade as chaos)
+        for server in servers:
+            with httpclient.InferenceServerClient(server.url) as client:
+                client.infer("simple", _simple_inputs(httpclient))
+        proxies[0].fault = Fault("latency", latency_s=0.08)
+        snap = collect_snapshot(
+            [p.url for p in proxies], requests_per_endpoint=6,
+            skew_warn_ms=60000.0)
+        ready = [ep for ep in snap["endpoints"] if ep["ready"]]
+        assert len(ready) == 3
+        rows = snap["decomposition"]
+        assert len(rows) == 3
+        for row in rows:
+            assert row["requests"] >= 5  # health probes don't infer
+            assert row["server_compute_ms"] >= 0
+            assert "network_client_overhead_ms" in row
+        slowed = next(ep for ep in snap["endpoints"]
+                      if ep["url"] == proxies[0].url)
+        others = [ep for ep in snap["endpoints"] if ep is not slowed]
+        assert slowed["probe_latency_ms"]["p50"] > max(
+            ep["probe_latency_ms"]["p50"] for ep in others)
+        flags = {f["flag"]: f for f in snap["anomalies"]}
+        assert "load_latency_divergence" in flags
+        assert flags["load_latency_divergence"]["url"] == proxies[0].url
+        # artifact is JSON-pure
+        (tmp_path / "doctor.json").write_text(
+            json.dumps(snap, default=str))
+    finally:
+        for proxy in proxies:
+            proxy.stop()
+        for server in servers:
+            server.stop()
+
+
+def test_doctor_cli_main(tmp_path, capsys):
+    from client_tpu.doctor import main
+
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        out_path = tmp_path / "snap.json"
+        rc = main([server.url, "--requests", "2", "--json", str(out_path)])
+    assert rc == 0
+    assert out_path.exists()
+    snap = json.loads(out_path.read_text())
+    assert snap["endpoints"][0]["ready"] is True
+    captured = capsys.readouterr()
+    assert "client_tpu doctor" in captured.out

@@ -523,7 +523,14 @@ def test_grpc_terminal_stream_error_finishes_span_with_error():
         client.configure_telemetry(tel)
         client.start_stream(lambda r, e: events.put((r, e)))
         _, inputs = _simple_inputs(grpcclient)
-        client.async_stream_infer("simple", inputs)
+        try:
+            client.async_stream_infer("simple", inputs)
+        except InferenceServerException:
+            # the dead channel can die terminally BEFORE the enqueue
+            # lands ("stream is closed"); the terminal error has then
+            # already reached the traced callback — which is exactly the
+            # path this test asserts
+            pass
         result, error = events.get(timeout=30)
         assert error is not None  # terminal: connection refused
         # the span closed at the terminal error, no stop_stream needed

@@ -13,13 +13,18 @@
 # negative/NaN metric. The micro-batching smoke (tests/
 # test_client_batching.py, batch_smoke marker) runs the coalescing
 # dispatcher against retry/breaker resilience under a flapping proxy:
-# every caller must still receive its exact rows.
+# every caller must still receive its exact rows. The doctor smoke
+# (tests/test_dataplane_observe.py, doctor_smoke marker) runs the fleet
+# snapshot against a 3-replica pool with one replica behind a latency
+# fault: the decomposition must attribute the extra milliseconds to the
+# network, not the server, and flag the load/latency divergence.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
-    tests/test_stream_observe.py tests/test_client_batching.py "$@"
+    tests/test_stream_observe.py tests/test_client_batching.py \
+    tests/test_dataplane_observe.py "$@"
