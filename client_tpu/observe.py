@@ -56,6 +56,7 @@ import time
 import weakref
 from bisect import bisect_left, bisect_right
 from collections import deque
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -73,6 +74,7 @@ __all__ = [
     "MetricsRegistry",
     "RequestSpan",
     "SLO",
+    "SLOSpec",
     "StatsCorrelator",
     "StreamSpan",
     "Telemetry",
@@ -86,6 +88,7 @@ __all__ = [
     "make_span_id",
     "make_trace_id",
     "parse_endpoint_load",
+    "parse_slo_spec",
     "parse_traceparent",
 ]
 
@@ -1237,10 +1240,14 @@ class WindowedSketch:
 
 
 class SLO:
-    """One declared streaming objective, e.g. ``ttft_p95 < 200ms over 5m``.
+    """One declared latency objective, e.g. ``ttft_p95 < 200ms over 5m``.
 
     ``objective`` is the target good fraction (0.95 means 95% of events
-    must land under ``threshold_ms``). The tracker counts every observed
+    must land under ``threshold_ms``). Stream metrics (``ttft_ms``,
+    ``itl_ms``, ``stream_duration_ms``) are fed from finished
+    :class:`StreamSpan`\\ s; ``request_ms`` is fed from finished unary
+    :class:`RequestSpan`\\ s (an errored request always counts bad — see
+    :meth:`observe_failure`). The tracker counts every observed
     event good/bad (cumulative counters), keeps a windowed good/bad split
     (a :class:`WindowedSketch` whose single bucket edge IS the
     threshold), and exports at scrape time:
@@ -1261,7 +1268,8 @@ class SLO:
                  clock: Callable[[], float] = time.monotonic):
         if not 0.0 < objective < 1.0:
             raise ValueError("objective must be in (0, 1)")
-        if metric not in ("ttft_ms", "itl_ms", "stream_duration_ms"):
+        if metric not in ("ttft_ms", "itl_ms", "stream_duration_ms",
+                          "request_ms"):
             raise ValueError(f"unknown SLO metric {metric!r}")
         if threshold_ms <= 0:
             raise ValueError("threshold_ms must be > 0")
@@ -1285,12 +1293,132 @@ class SLO:
         elif self.bad is not None:
             self.bad.inc()
 
+    def observe_failure(self) -> None:
+        """Count one errored request as a bad event: an error violates a
+        latency objective whatever its measured duration (a fast 500 is
+        not 'within SLO'). The window sees a finite beyond-threshold
+        value so sums/snapshots stay JSON-pure."""
+        self.window.observe(self.threshold_ms * 2.0)
+        if self.bad is not None:
+            self.bad.inc()
+
     def burn_rate(self) -> float:
         bad_fraction = 1.0 - self.window.fraction_le(self.threshold_ms)
         return bad_fraction / (1.0 - self.objective)
 
     def breached(self) -> bool:
         return self.burn_rate() > 1.0
+
+    def report(self) -> Dict[str, Any]:
+        """Good/bad accounting as one JSON-pure row. Counts come from the
+        cumulative counters when bound (exact over a bounded replay run
+        on a fresh Telemetry — the capacity harness's contract), else
+        from the live window. ``attained`` is the bounded-window verdict:
+        the bad fraction fits inside the error budget — and requires at
+        least one event: a declared objective that was never measured is
+        NOT met (certifying an unmeasured SLO is the dishonest option)."""
+        if self.good is not None and self.bad is not None:
+            good = int(self.good.get())
+            bad = int(self.bad.get())
+        else:
+            counts, total, _ = self.window.merged()
+            good = int(counts[0])
+            bad = int(total - counts[0])
+        total = good + bad
+        bad_fraction = (bad / total) if total else 0.0
+        return {
+            "slo": self.name,
+            "metric": self.metric,
+            "threshold_ms": self.threshold_ms,
+            "objective": self.objective,
+            "good": good,
+            "bad": bad,
+            "events": total,
+            "bad_fraction": round(bad_fraction, 6),
+            "attained": total > 0
+            and bad_fraction <= (1.0 - self.objective) + 1e-12,
+            "burn_rate": round(self.burn_rate(), 4),
+            "breached": self.breached(),
+        }
+
+
+@dataclass
+class SLOSpec:
+    """A parsed capacity-SLO declaration (see :func:`parse_slo_spec`).
+
+    ``kind`` is ``"latency"`` (declare via :meth:`Telemetry.track_slo`
+    with ``metric``/``threshold_ms``/``objective``) or ``"error_rate"``
+    (``limit`` is the max tolerated error fraction; evaluated by the
+    replay harness from its shed/error accounting, not a latency window).
+    """
+
+    spec: str
+    kind: str
+    metric: Optional[str] = None
+    threshold_ms: Optional[float] = None
+    objective: Optional[float] = None
+    limit: Optional[float] = None
+
+    @property
+    def name(self) -> str:
+        return self.spec
+
+
+_SLO_ERROR_RATE_RE = re.compile(
+    r"^\s*error_rate\s*<\s*(?P<value>\d+(?:\.\d+)?)\s*(?P<pct>%)?\s*$")
+_SLO_LATENCY_RE = re.compile(
+    r"^\s*(?:(?P<name>[a-z_]+?)_?)?p(?P<pct>\d{2,4})\s*<\s*"
+    r"(?P<value>\d+(?:\.\d+)?)\s*(?P<unit>ms|s)\s*$")
+
+_SLO_METRICS = {
+    "ttft": "ttft_ms",
+    "itl": "itl_ms",
+    "stream_duration": "stream_duration_ms",
+    "duration": "stream_duration_ms",
+    "latency": "request_ms",
+    "request": "request_ms",
+}
+
+
+def parse_slo_spec(spec: str) -> SLOSpec:
+    """Parse one declared SLO, e.g. ``ttft_p95<200ms``, ``p99<50ms``,
+    ``itl_p99<20ms``, ``error_rate<0.1%``. Latency specs name a metric
+    (``ttft``/``itl``/``duration``/``latency``; bare ``pNN`` means
+    end-to-end request latency), a percentile, and a threshold in ``ms``
+    or ``s``; ``error_rate`` takes ``%`` or a bare fraction."""
+    m = _SLO_ERROR_RATE_RE.match(spec)
+    if m is not None:
+        limit = float(m.group("value"))
+        if m.group("pct"):
+            limit /= 100.0
+        if not 0.0 <= limit < 1.0:
+            raise ValueError(f"error_rate limit out of range: {spec!r}")
+        return SLOSpec(spec=spec.strip(), kind="error_rate", limit=limit)
+    m = _SLO_LATENCY_RE.match(spec)
+    if m is None:
+        raise ValueError(
+            f"malformed SLO spec {spec!r} (want e.g. ttft_p95<200ms, "
+            f"p99<50ms, error_rate<0.1%)")
+    name, pct, value, unit = (m.group("name"), m.group("pct"),
+                              float(m.group("value")), m.group("unit"))
+    metric = _SLO_METRICS.get(name) if name else "request_ms"
+    if metric is None:
+        raise ValueError(
+            f"unknown SLO metric {name!r} in {spec!r} "
+            f"(one of {sorted(_SLO_METRICS)} or error_rate)")
+    # p95 -> 0.95, p999 -> 0.999. The digit count IS the precision, so a
+    # trailing-zero form like p100 would misparse to 0.10 — requiring the
+    # objective to land in [0.5, 1) rejects p100/p05 instead of silently
+    # certifying a 10%-good "SLO"
+    objective = int(pct) / (10.0 ** len(pct))
+    if not 0.5 <= objective < 1.0:
+        raise ValueError(
+            f"percentile out of range in {spec!r} (want p50..p99...)")
+    threshold_ms = value * 1000.0 if unit == "s" else value
+    if threshold_ms <= 0:
+        raise ValueError(f"threshold must be > 0: {spec!r}")
+    return SLOSpec(spec=spec.strip(), kind="latency", metric=metric,
+                   threshold_ms=threshold_ms, objective=objective)
 
 
 class Tracer:
@@ -1526,6 +1654,9 @@ class Telemetry:
         self._endpoint_ttft: Dict[str, WindowedSketch] = {}
         self._windows_lock = threading.Lock()
         self._slos: List[SLO] = []
+        # request_ms SLOs resolved once: _fold_pending pays one truthiness
+        # check when none are declared
+        self._request_slos: List[SLO] = []
         self._window_quantile_gauge = reg.gauge(
             "client_tpu_stream_window_ms",
             f"Windowed stream latency quantiles (last "
@@ -1675,6 +1806,15 @@ class Telemetry:
                     h.counts[bisect_right(h.buckets, seconds)] += 1
                     h.sum += seconds
                     h.count += 1
+            if self._request_slos:
+                for slo in self._request_slos:
+                    if (slo.frontend is not None
+                            and slo.frontend != span.frontend):
+                        continue
+                    if domain is not None:
+                        slo.observe_failure()
+                    else:
+                        slo.observe(total_s * 1e3)
 
     # -- stream span lifecycle ----------------------------------------------
     def begin_stream(self, frontend: str, model: str = "",
@@ -1766,6 +1906,24 @@ class Telemetry:
                 for metric, values in samples:
                     if metric != slo.metric:
                         continue
+                    if metric == "stream_duration_ms" and domain is not None:
+                        # an errored stream's duration is short BECAUSE it
+                        # was truncated — feeding it would count a failed
+                        # session as a fast (good) one. The session did
+                        # not complete inside the objective: bad.
+                        slo.observe_failure()
+                        continue
+                    if metric == "ttft_ms" and domain is not None \
+                            and not values:
+                        # a stream that DIED before its first chunk has no
+                        # TTFT sample, but it did not meet the objective —
+                        # same rule as an errored unary request: an error
+                        # always counts bad, never nothing. (Measured
+                        # ttft/itl samples from partially-failed streams
+                        # stay valid token-timing observations and feed
+                        # normally.)
+                        slo.observe_failure()
+                        continue
                     for value in values:
                         if value >= 0.0:
                             slo.observe(value)
@@ -1807,10 +1965,21 @@ class Telemetry:
         slo.good = self._slo_events.labels(name, "good")
         slo.bad = self._slo_events.labels(name, "bad")
         self._slos.append(slo)
+        if metric == "request_ms":
+            self._request_slos.append(slo)
         return slo
 
     def slos(self) -> List[SLO]:
         return list(self._slos)
+
+    def slo_report(self) -> List[Dict[str, Any]]:
+        """One :meth:`SLO.report` row per declared SLO, after folding any
+        pending spans — so a bounded replay run (fresh Telemetry, read
+        once at the end) gets exact good/bad counts over exactly that
+        run, without requiring a scrape."""
+        self._fold_pending()
+        self._fold_stream_pending()
+        return [slo.report() for slo in self._slos]
 
     # -- pool TTFT feed -------------------------------------------------------
     def observe_endpoint_ttft(self, url: str, ttft_ms: float) -> None:

@@ -821,6 +821,34 @@ class PoolClient(_PoolClientBase):
         while not self._probe_stop.wait(self._health_interval_s):
             self._probe_one(ep)
 
+    def wait_healthy(self, min_healthy: Optional[int] = None,
+                     timeout_s: float = 10.0) -> bool:
+        """Block until at least ``min_healthy`` endpoints (default: all)
+        are healthy, probing directly rather than waiting for the prober
+        cadence. Returns False on timeout. Replay/capacity harnesses call
+        this before measuring so probe warmup (first requests 503ing or
+        routing to not-yet-probed replicas) never pollutes the first
+        measurement window."""
+        want = len(self.pool.endpoints) if min_healthy is None else min_healthy
+        deadline = time.monotonic() + timeout_s
+        first_pass = True
+        while True:
+            healthy = 0
+            for ep in self.pool.endpoints:
+                # endpoints START optimistically healthy — the first pass
+                # must probe every one of them or a down replica would be
+                # vouched for without a single probe ever going out
+                if first_pass or not ep.healthy:
+                    self._probe_one(ep)
+                if ep.healthy:
+                    healthy += 1
+            first_pass = False
+            if healthy >= want:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
     # -- failover engine ------------------------------------------------------
     def _execute(self, op, idempotent: bool = True,
                  timeout_s: Optional[float] = None,

@@ -1,0 +1,525 @@
+"""Replayable workload traces: a versioned JSONL format + seeded generators.
+
+Production traffic is bursty, heavy-tailed and mixed — unary infers,
+SSE/decoupled generation streams and stateful sequences interleave on one
+client. The closed/open-loop sweeps in ``client_tpu.perf`` can't answer
+"what QPS can this fleet serve inside SLO?" for that shape, so this module
+gives the perf harness something replayable:
+
+- **Format** (:class:`TraceRecord`, :func:`dump_trace` / :func:`load_trace`):
+  one JSON object per line. The first line is a ``type: "header"`` record
+  carrying the format version and generator provenance; every following
+  line is a ``type: "request"`` record with an arrival offset (``at_s``,
+  seconds from replay start), a ``kind`` (``unary`` | ``generate_stream``
+  | ``sequence``), the target model/version, and kind-specific payload
+  sizing — tensor ``shapes``/``dtypes`` for unary and sequence records,
+  ``prompt_tokens``/``output_tokens`` for streams. Sequence records carry
+  ``(seq_group, seq_index, seq_len)`` so the replayer can pin each group
+  to one replica (the pool's affinity rules) and issue its steps in order.
+
+- **Versioning**: the header's ``version`` is the format version; a
+  *record* may carry its own ``v`` — records (and whole traces) from a
+  NEWER format are skipped, not fatal (forward compatibility), and the
+  loader reports how many it skipped. Malformed lines are fatal with the
+  1-based line number (:class:`TraceParseError`).
+
+- **Generators** (:func:`poisson_burst`, :func:`heavy_tail`,
+  :func:`mixed`, or :func:`generate` from a ``name:k=v,...`` spec string):
+  each is a pure function of ``(seed, duration, params)`` over ONE
+  ``numpy.random.Generator`` — the same seed and spec always produce a
+  byte-identical trace (see :func:`dumps_trace`), so traces are
+  reproducible without being committed.
+
+The replay engine lives in ``client_tpu.perf`` (``--trace`` /
+``--trace-gen``); the capacity-search driver in ``tools/bench_capacity.py``.
+See docs/capacity.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import math
+from typing import Any, Dict, IO, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+
+TRACE_VERSION = 1
+
+KINDS = ("unary", "generate_stream", "sequence")
+
+# default tensor layouts per well-known zoo model, so generator specs can
+# name a model without restating its wire contract
+_DEFAULT_LAYOUTS: Dict[str, Tuple[Dict[str, List[int]], Dict[str, str]]] = {
+    "simple": ({"INPUT0": [1, 16], "INPUT1": [1, 16]},
+               {"INPUT0": "INT32", "INPUT1": "INT32"}),
+    "batched_matmul": ({"X": [1, 64]}, {"X": "FP32"}),
+    "simple_sequence": ({"INPUT": [1, 1]}, {"INPUT": "INT32"}),
+}
+
+
+class TraceParseError(ValueError):
+    """A malformed trace line; ``line`` is 1-based."""
+
+    def __init__(self, line: int, message: str):
+        super().__init__(f"line {line}: {message}")
+        self.line = line
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceRecord:
+    """One scheduled request. ``at_s`` is the arrival offset from replay
+    start; replaying at speed ``s`` schedules it at ``at_s / s``."""
+
+    at_s: float
+    kind: str
+    model: str
+    version: str = ""
+    # unary / sequence payload sizing
+    shapes: Optional[Dict[str, List[int]]] = None
+    dtypes: Optional[Dict[str, str]] = None
+    # generate_stream payload sizing
+    prompt_tokens: Optional[int] = None
+    output_tokens: Optional[int] = None
+    # sequence grouping: step seq_index of seq_len in group seq_group
+    seq_group: Optional[int] = None
+    seq_index: Optional[int] = None
+    seq_len: Optional[int] = None
+
+    def to_obj(self) -> Dict[str, Any]:
+        obj: Dict[str, Any] = {
+            "type": "request",
+            "at_s": round(float(self.at_s), 6),
+            "kind": self.kind,
+            "model": self.model,
+        }
+        if self.version:
+            obj["model_version"] = self.version
+        if self.shapes is not None:
+            obj["shapes"] = {k: list(v) for k, v in self.shapes.items()}
+            obj["dtypes"] = dict(self.dtypes or {})
+        if self.kind == "generate_stream":
+            obj["prompt_tokens"] = int(self.prompt_tokens)
+            obj["output_tokens"] = int(self.output_tokens)
+        if self.kind == "sequence":
+            obj["seq_group"] = int(self.seq_group)
+            obj["seq_index"] = int(self.seq_index)
+            obj["seq_len"] = int(self.seq_len)
+        return obj
+
+    @classmethod
+    def from_obj(cls, obj: Dict[str, Any], line: int) -> "TraceRecord":
+        kind = obj.get("kind")
+        if kind not in KINDS:
+            raise TraceParseError(line, f"unknown kind {kind!r}")
+        try:
+            at_s = float(obj["at_s"])
+        except (KeyError, TypeError, ValueError):
+            raise TraceParseError(line, "missing/non-numeric at_s") from None
+        if at_s < 0.0 or not math.isfinite(at_s):
+            raise TraceParseError(line, f"at_s out of range: {at_s!r}")
+        model = obj.get("model")
+        if not model or not isinstance(model, str):
+            raise TraceParseError(line, "missing model")
+        kwargs: Dict[str, Any] = {
+            "at_s": round(at_s, 6), "kind": kind, "model": model,
+            "version": str(obj.get("model_version", "")),
+        }
+        if kind in ("unary", "sequence") and "shapes" not in obj:
+            raise TraceParseError(
+                line, f"{kind} requires shapes/dtypes")
+        if "shapes" in obj:
+            shapes = obj["shapes"]
+            dtypes = obj.get("dtypes", {})
+            if not isinstance(shapes, dict) or not isinstance(dtypes, dict):
+                raise TraceParseError(line, "shapes/dtypes must be objects")
+            try:
+                kwargs["shapes"] = {
+                    str(k): [int(d) for d in v] for k, v in shapes.items()}
+            except (TypeError, ValueError):
+                raise TraceParseError(
+                    line, "shapes must map name -> [int, ...]") from None
+            kwargs["dtypes"] = {str(k): str(v) for k, v in dtypes.items()}
+            missing = set(kwargs["shapes"]) - set(kwargs["dtypes"])
+            if missing:
+                raise TraceParseError(
+                    line, f"shapes without dtypes: {sorted(missing)}")
+        if kind == "generate_stream":
+            try:
+                kwargs["prompt_tokens"] = int(obj["prompt_tokens"])
+                kwargs["output_tokens"] = int(obj["output_tokens"])
+            except (KeyError, TypeError, ValueError):
+                raise TraceParseError(
+                    line, "generate_stream requires integer "
+                    "prompt_tokens/output_tokens") from None
+            if kwargs["prompt_tokens"] < 1 or kwargs["output_tokens"] < 1:
+                raise TraceParseError(line, "token counts must be >= 1")
+        if kind == "sequence":
+            try:
+                kwargs["seq_group"] = int(obj["seq_group"])
+                kwargs["seq_index"] = int(obj["seq_index"])
+                kwargs["seq_len"] = int(obj["seq_len"])
+            except (KeyError, TypeError, ValueError):
+                raise TraceParseError(
+                    line, "sequence requires integer "
+                    "seq_group/seq_index/seq_len") from None
+            if not 0 <= kwargs["seq_index"] < kwargs["seq_len"]:
+                raise TraceParseError(
+                    line, f"seq_index {kwargs['seq_index']} outside "
+                    f"seq_len {kwargs['seq_len']}")
+        return cls(**kwargs)
+
+
+@dataclasses.dataclass
+class Trace:
+    """A loaded trace: header metadata + chronologically sorted records.
+    ``skipped`` counts newer-version records the loader passed over."""
+
+    header: Dict[str, Any]
+    records: List[TraceRecord]
+    skipped: int = 0
+
+    @property
+    def duration_s(self) -> float:
+        """Nominal duration: the header's declared span, else the last
+        arrival offset."""
+        declared = self.header.get("duration_s")
+        if declared:
+            return float(declared)
+        return self.records[-1].at_s if self.records else 0.0
+
+    def kind_counts(self) -> Dict[str, int]:
+        counts = {k: 0 for k in KINDS}
+        for rec in self.records:
+            counts[rec.kind] += 1
+        return counts
+
+
+# -- serialization ------------------------------------------------------------
+def _record_line(obj: Dict[str, Any]) -> str:
+    # sort_keys + fixed separators: serialization is a pure function of the
+    # record, so generator determinism carries through to bytes on disk
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def dumps_trace(records: Iterable[TraceRecord],
+                header: Optional[Dict[str, Any]] = None) -> str:
+    """The trace as one JSONL string (header line first). Byte-identical
+    for identical ``(records, header)`` — the determinism contract."""
+    head = {"type": "header", "version": TRACE_VERSION}
+    head.update(header or {})
+    records = list(records)
+    head["records"] = len(records)
+    lines = [_record_line(head)]
+    lines.extend(_record_line(rec.to_obj()) for rec in records)
+    return "\n".join(lines) + "\n"
+
+
+def dump_trace(records: Iterable[TraceRecord],
+               path_or_fp: Union[str, IO[str]],
+               header: Optional[Dict[str, Any]] = None) -> None:
+    text = dumps_trace(records, header)
+    if hasattr(path_or_fp, "write"):
+        path_or_fp.write(text)
+    else:
+        with open(path_or_fp, "w", encoding="utf-8") as fp:
+            fp.write(text)
+
+
+def loads_trace(text: str) -> Trace:
+    return load_trace(io.StringIO(text))
+
+
+def load_trace(path_or_fp: Union[str, IO[str]]) -> Trace:
+    """Parse a JSONL trace. Malformed lines raise :class:`TraceParseError`
+    with the 1-based line number; records (or a whole trace) stamped with
+    a NEWER format version are skipped and counted, never fatal."""
+    if hasattr(path_or_fp, "read"):
+        fp = path_or_fp
+        close = False
+    else:
+        fp = open(path_or_fp, "r", encoding="utf-8")
+        close = True
+    header: Dict[str, Any] = {"version": TRACE_VERSION}
+    records: List[TraceRecord] = []
+    skipped = 0
+    try:
+        for lineno, raw in enumerate(fp, start=1):
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise TraceParseError(lineno, f"invalid JSON ({e.msg})") \
+                    from None
+            if not isinstance(obj, dict):
+                raise TraceParseError(lineno, "record must be a JSON object")
+            rtype = obj.get("type", "request")
+            if rtype == "header":
+                header = {k: v for k, v in obj.items() if k != "type"}
+                continue
+            # forward compatibility: a record from a newer format version
+            # may carry fields with semantics this parser predates — skip
+            # it (counted) instead of guessing
+            v = obj.get("v", header.get("version", TRACE_VERSION))
+            try:
+                v = int(v)
+            except (TypeError, ValueError):
+                raise TraceParseError(lineno, f"non-integer version {v!r}") \
+                    from None
+            if v > TRACE_VERSION:
+                skipped += 1
+                continue
+            if rtype != "request":
+                skipped += 1  # unknown record types: same forward-compat rule
+                continue
+            records.append(TraceRecord.from_obj(obj, lineno))
+    finally:
+        if close:
+            fp.close()
+    records.sort(key=lambda r: r.at_s)
+    return Trace(header=header, records=records, skipped=skipped)
+
+
+# -- generators ---------------------------------------------------------------
+def _modulated_rate(t: float, rate: float, burst_factor: float,
+                    period_s: float, duty: float) -> float:
+    """On/off modulated instantaneous rate with mean ``rate``: bursts at
+    ``rate * burst_factor`` for ``duty`` of each period, with the off-phase
+    rate chosen so the long-run mean stays ``rate`` (clamped at 0 when the
+    burst alone exceeds the mean budget)."""
+    if burst_factor <= 1.0 or duty >= 1.0:
+        return rate
+    phase = (t % period_s) / period_s
+    if phase < duty:
+        return rate * burst_factor
+    return max(0.0, rate * (1.0 - burst_factor * duty) / (1.0 - duty))
+
+
+def _arrival_times(rng: np.random.Generator, duration_s: float, rate: float,
+                   burst_factor: float = 1.0, period_s: float = 2.0,
+                   duty: float = 0.25) -> List[float]:
+    """Non-homogeneous Poisson arrivals by thinning: candidates at the
+    peak rate, each kept with probability ``r(t) / peak``. Pure function
+    of the rng state."""
+    if not (math.isfinite(duration_s) and math.isfinite(rate)
+            and math.isfinite(burst_factor)):
+        # the candidate loop walks to duration_s by exponential steps — a
+        # non-finite bound or rate would walk forever
+        raise ValueError(
+            f"duration_s/rate/burst_factor must be finite "
+            f"(got {duration_s!r}/{rate!r}/{burst_factor!r})")
+    if burst_factor > 1.0 and burst_factor * duty > 1.0:
+        # the off-phase rate clamps at 0 but cannot go negative — past
+        # this point the burst excess is uncompensated and the generated
+        # mean silently exceeds the declared rate (by burst_factor*duty)
+        raise ValueError(
+            f"burst_factor*duty must be <= 1 to preserve the declared "
+            f"mean rate (got {burst_factor}*{duty} = "
+            f"{burst_factor * duty:g})")
+    peak = rate * max(burst_factor, 1.0)
+    if peak <= 0.0 or duration_s <= 0.0:
+        return []
+    times: List[float] = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / peak))
+        if t >= duration_s:
+            break
+        keep = float(rng.random())  # drawn unconditionally: count of draws
+        # per candidate is fixed, so the stream is reproducible even if
+        # the modulation params change
+        if keep * peak <= _modulated_rate(t, rate, burst_factor,
+                                          period_s, duty):
+            times.append(round(t, 6))
+    return times
+
+
+def _heavy_tail_length(rng: np.random.Generator, tail: str, mean: float,
+                       sigma: float, alpha: float, clip: int) -> int:
+    """One heavy-tailed token count: ``lognormal`` (median ``mean``,
+    shape ``sigma``) or ``pareto`` (shape ``alpha``, mean ``mean``)."""
+    if tail == "pareto":
+        # scale so the theoretical mean is ``mean`` (alpha > 1)
+        xm = mean * (alpha - 1.0) / alpha if alpha > 1.0 else mean
+        value = (1.0 + float(rng.pareto(alpha))) * xm
+    else:
+        value = float(rng.lognormal(math.log(max(mean, 1.0)), sigma))
+    return int(min(max(round(value), 1), clip))
+
+
+def _layout(model: str,
+            shapes: Optional[Dict[str, List[int]]] = None,
+            dtypes: Optional[Dict[str, str]] = None,
+            ) -> Tuple[Dict[str, List[int]], Dict[str, str]]:
+    if shapes is not None:
+        return shapes, dict(dtypes or {})
+    if model in _DEFAULT_LAYOUTS:
+        default_shapes, default_dtypes = _DEFAULT_LAYOUTS[model]
+        return dict(default_shapes), dict(default_dtypes)
+    raise ValueError(
+        f"no default tensor layout for model {model!r}: pass shapes/dtypes")
+
+
+def poisson_burst(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
+                  burst_factor: float = 4.0, period_s: float = 2.0,
+                  duty: float = 0.25, model: str = "simple",
+                  shapes: Optional[Dict[str, List[int]]] = None,
+                  dtypes: Optional[Dict[str, str]] = None,
+                  ) -> List[TraceRecord]:
+    """Unary traffic whose arrival rate flips between an on-phase burst
+    (``rate * burst_factor`` for ``duty`` of each ``period_s``) and a
+    quiet phase, keeping the long-run mean at ``rate`` req/s."""
+    rng = np.random.default_rng(seed)
+    shapes, dtypes = _layout(model, shapes, dtypes)
+    return [TraceRecord(at_s=t, kind="unary", model=model,
+                        shapes=shapes, dtypes=dtypes)
+            for t in _arrival_times(rng, duration_s, rate, burst_factor,
+                                    period_s, duty)]
+
+
+def heavy_tail(seed: int = 0, duration_s: float = 10.0, rate: float = 10.0,
+               tail: str = "lognormal", prompt_mean: float = 24.0,
+               prompt_sigma: float = 1.0, output_mean: float = 8.0,
+               output_sigma: float = 0.8, alpha: float = 1.8,
+               max_prompt: int = 96, max_output: int = 32,
+               model: str = "tiny_lm_generate") -> List[TraceRecord]:
+    """Streamed generations with heavy-tailed prompt/output token counts
+    (``lognormal`` or ``pareto``) arriving as plain Poisson at ``rate``."""
+    if tail not in ("lognormal", "pareto"):
+        raise ValueError(f"unknown tail {tail!r} (lognormal|pareto)")
+    rng = np.random.default_rng(seed)
+    records = []
+    for t in _arrival_times(rng, duration_s, rate):
+        records.append(TraceRecord(
+            at_s=t, kind="generate_stream", model=model,
+            prompt_tokens=_heavy_tail_length(
+                rng, tail, prompt_mean, prompt_sigma, alpha, max_prompt),
+            output_tokens=_heavy_tail_length(
+                rng, tail, output_mean, output_sigma, alpha, max_output)))
+    return records
+
+
+def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
+          stream_fraction: float = 0.2, seq_fraction: float = 0.1,
+          burst_factor: float = 3.0, period_s: float = 2.0,
+          duty: float = 0.25, tail: str = "lognormal",
+          prompt_mean: float = 24.0, prompt_sigma: float = 1.0,
+          output_mean: float = 8.0, output_sigma: float = 0.8,
+          alpha: float = 1.8, max_prompt: int = 96, max_output: int = 32,
+          seq_len_min: int = 2, seq_len_max: int = 6,
+          seq_gap_s: float = 0.05, unary_model: str = "simple",
+          stream_model: str = "tiny_lm_generate",
+          seq_model: str = "simple_sequence",
+          shapes: Optional[Dict[str, List[int]]] = None,
+          dtypes: Optional[Dict[str, str]] = None) -> List[TraceRecord]:
+    """Mixed-kind bursty traffic: each Poisson-burst arrival becomes a
+    stream (``stream_fraction``), a whole sequence of ``seq_len_min..max``
+    steps spaced ~``seq_gap_s`` apart (``seq_fraction``), or a unary infer
+    (the rest). ``rate`` counts *arrivals* — a sequence arrival fans out
+    into several requests, so the offered request rate is slightly higher."""
+    if stream_fraction + seq_fraction > 1.0:
+        raise ValueError("stream_fraction + seq_fraction must be <= 1")
+    if seq_len_min < 1 or seq_len_max < seq_len_min:
+        raise ValueError("need 1 <= seq_len_min <= seq_len_max")
+    rng = np.random.default_rng(seed)
+    unary_shapes, unary_dtypes = _layout(unary_model, shapes, dtypes)
+    seq_shapes, seq_dtypes = _layout(seq_model)
+    records: List[TraceRecord] = []
+    group = 0
+    for t in _arrival_times(rng, duration_s, rate, burst_factor,
+                            period_s, duty):
+        pick = float(rng.random())
+        if pick < stream_fraction:
+            records.append(TraceRecord(
+                at_s=t, kind="generate_stream", model=stream_model,
+                prompt_tokens=_heavy_tail_length(
+                    rng, tail, prompt_mean, prompt_sigma, alpha, max_prompt),
+                output_tokens=_heavy_tail_length(
+                    rng, tail, output_mean, output_sigma, alpha, max_output)))
+        elif pick < stream_fraction + seq_fraction:
+            group += 1
+            steps = int(rng.integers(seq_len_min, seq_len_max + 1))
+            at = t
+            for i in range(steps):
+                records.append(TraceRecord(
+                    at_s=round(at, 6), kind="sequence", model=seq_model,
+                    shapes=seq_shapes, dtypes=seq_dtypes,
+                    seq_group=group, seq_index=i, seq_len=steps))
+                at += float(rng.exponential(seq_gap_s))
+        else:
+            records.append(TraceRecord(
+                at_s=t, kind="unary", model=unary_model,
+                shapes=unary_shapes, dtypes=unary_dtypes))
+    # stable by arrival: equal offsets keep insertion order, so a group's
+    # steps never reorder even when gaps round to the same microsecond
+    records.sort(key=lambda r: r.at_s)
+    return records
+
+
+GENERATORS = {
+    "poisson_burst": poisson_burst,
+    "heavy_tail": heavy_tail,
+    "mixed": mixed,
+}
+
+# spec params that must stay strings when parsed from a spec
+_STR_PARAMS = {"model", "unary_model", "stream_model", "seq_model", "tail"}
+
+
+def parse_gen_spec(spec: str) -> Tuple[str, Dict[str, Any]]:
+    """``name:key=value,...`` -> (generator name, kwargs). Values parse as
+    int, then float, else stay strings."""
+    name, _, rest = spec.partition(":")
+    name = name.strip()
+    if name not in GENERATORS:
+        raise ValueError(
+            f"unknown trace generator {name!r} "
+            f"(one of {', '.join(sorted(GENERATORS))})")
+    params: Dict[str, Any] = {}
+    for part in filter(None, (p.strip() for p in rest.split(","))):
+        key, sep, value = part.partition("=")
+        if not sep:
+            raise ValueError(f"malformed spec param {part!r} (want key=value)")
+        key = key.strip()
+        value = value.strip()
+        if key in _STR_PARAMS:
+            params[key] = value
+            continue
+        try:
+            params[key] = int(value)
+        except ValueError:
+            try:
+                params[key] = float(value)
+            except ValueError:
+                params[key] = value
+    return name, params
+
+
+def generate(spec: str, seed: int = 0,
+             duration_s: Optional[float] = None) -> Trace:
+    """Generate a trace from a ``name:k=v,...`` spec string. The header
+    records the full provenance (spec, seed, resolved duration), so a
+    written trace is self-describing and :func:`dumps_trace` of the result
+    is byte-identical for identical ``(spec, seed)``. ``duration_s``
+    OVERRIDES any duration in the spec — the capacity gate uses it to
+    replay a shortened twin of a committed trace's workload shape."""
+    name, params = parse_gen_spec(spec)
+    if duration_s is not None:
+        params["duration_s"] = duration_s
+    try:
+        records = GENERATORS[name](seed=seed, **params)
+    except TypeError as e:
+        raise ValueError(f"bad params for generator {name!r}: {e}") from None
+    header = {
+        "generator": name,
+        "spec": spec,
+        "seed": int(seed),
+        "duration_s": params.get(
+            "duration_s",
+            # the generators' shared default
+            10.0),
+    }
+    return Trace(header=header, records=records)

@@ -1,0 +1,621 @@
+"""Trace-driven workload replay & capacity harness tests (ISSUE 8).
+
+Covers the versioned JSONL trace format (round-trip, malformed-record
+rejection with line numbers, forward-compat version skip), the seeded
+generators' determinism contract (same seed + same spec => byte-identical
+trace), the new ``request_ms`` SLO metric and SLO spec parsing, the
+schedule-slip reporting on open-loop rows, the capacity bisection / gate
+comparison logic, and the mixed-kind replay smoke against the in-repo
+threaded server (``replay_smoke`` marker, run by tools/chaos_smoke.sh).
+"""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu import trace
+from client_tpu.models import default_model_zoo
+from client_tpu.observe import SLO, Telemetry, parse_slo_spec
+from client_tpu.perf import PerfRunner
+from client_tpu.server import HttpInferenceServer, ServerCore
+
+from tools.bench_capacity import bisect_capacity, sustainable
+from tools.capacity_gate import compare as gate_compare
+from tools.capacity_gate import probe_at_floor, shortened_trace
+
+MIXED_SPEC = ("mixed:duration_s=3,rate=30,stream_fraction=0.2,"
+              "seq_fraction=0.15,output_mean=4,max_output=6")
+
+
+# -- format: round-trip --------------------------------------------------------
+def test_trace_round_trip_equal():
+    tr = trace.generate(MIXED_SPEC, seed=5)
+    assert tr.records, "generator produced an empty trace"
+    buf = io.StringIO()
+    trace.dump_trace(tr.records, buf, header=tr.header)
+    loaded = trace.loads_trace(buf.getvalue())
+    assert loaded.records == tr.records
+    assert loaded.skipped == 0
+    assert loaded.header["spec"] == MIXED_SPEC
+    assert loaded.header["seed"] == 5
+    assert loaded.header["records"] == len(tr.records)
+
+
+def test_trace_round_trip_via_file(tmp_path):
+    tr = trace.generate("poisson_burst:duration_s=2,rate=40", seed=1)
+    path = tmp_path / "t.jsonl"
+    trace.dump_trace(tr.records, str(path), header=tr.header)
+    loaded = trace.load_trace(str(path))
+    assert loaded.records == tr.records
+    assert loaded.duration_s == 2
+
+
+def test_trace_records_sorted_and_kinds_counted():
+    tr = trace.generate(MIXED_SPEC, seed=9)
+    offsets = [r.at_s for r in tr.records]
+    assert offsets == sorted(offsets)
+    counts = tr.kind_counts()
+    assert counts["unary"] > 0 and counts["generate_stream"] > 0 \
+        and counts["sequence"] > 0
+    assert sum(counts.values()) == len(tr.records)
+    # sequence groups are complete and ordered
+    by_group = {}
+    for r in tr.records:
+        if r.kind == "sequence":
+            by_group.setdefault(r.seq_group, []).append(r)
+    for group, steps in by_group.items():
+        assert [s.seq_index for s in steps] == list(range(steps[0].seq_len))
+
+
+# -- format: determinism (satellite) ------------------------------------------
+def test_trace_generation_deterministic_byte_identical():
+    a = trace.generate(MIXED_SPEC, seed=42)
+    b = trace.generate(MIXED_SPEC, seed=42)
+    text_a = trace.dumps_trace(a.records, a.header)
+    text_b = trace.dumps_trace(b.records, b.header)
+    assert text_a.encode() == text_b.encode()
+    c = trace.generate(MIXED_SPEC, seed=43)
+    assert trace.dumps_trace(c.records, c.header) != text_a
+
+
+# -- format: malformed rejection with line numbers ----------------------------
+def _valid_lines():
+    tr = trace.generate("poisson_burst:duration_s=1,rate=20", seed=0)
+    return trace.dumps_trace(tr.records, tr.header).splitlines()
+
+
+def test_trace_malformed_json_line_number():
+    lines = _valid_lines()
+    lines[2] = "{not json"
+    with pytest.raises(trace.TraceParseError) as exc:
+        trace.loads_trace("\n".join(lines))
+    assert exc.value.line == 3
+    assert "line 3" in str(exc.value)
+
+
+@pytest.mark.parametrize("mutation, message", [
+    (lambda o: o.pop("at_s"), "at_s"),
+    (lambda o: o.update(at_s=-1.0), "at_s"),
+    (lambda o: o.update(kind="nope"), "kind"),
+    (lambda o: o.pop("model"), "model"),
+])
+def test_trace_bad_record_fields_rejected(mutation, message):
+    lines = _valid_lines()
+    obj = json.loads(lines[1])
+    mutation(obj)
+    lines[1] = json.dumps(obj)
+    with pytest.raises(trace.TraceParseError) as exc:
+        trace.loads_trace("\n".join(lines))
+    assert exc.value.line == 2
+    assert message in str(exc.value)
+
+
+def test_trace_unary_requires_shapes():
+    bad = json.dumps({
+        "type": "request", "at_s": 0.1, "kind": "unary", "model": "simple"})
+    with pytest.raises(trace.TraceParseError, match="line 1.*shapes"):
+        trace.loads_trace(bad)
+
+
+def test_trace_stream_and_sequence_field_validation():
+    bad_stream = json.dumps({
+        "type": "request", "at_s": 0.1, "kind": "generate_stream",
+        "model": "m"})
+    with pytest.raises(trace.TraceParseError, match="line 1.*prompt_tokens"):
+        trace.loads_trace(bad_stream)
+    bad_seq = json.dumps({
+        "type": "request", "at_s": 0.1, "kind": "sequence", "model": "m",
+        "seq_group": 1, "seq_index": 5, "seq_len": 3,
+        "shapes": {"INPUT": [1, 1]}, "dtypes": {"INPUT": "INT32"}})
+    with pytest.raises(trace.TraceParseError, match="seq_index"):
+        trace.loads_trace(bad_seq)
+
+
+# -- format: forward-compat version skip --------------------------------------
+def test_trace_newer_version_records_skipped_not_fatal():
+    lines = _valid_lines()
+    total = len(lines) - 1  # minus header
+    # a single record from a newer format: unknown semantics, skip it
+    newer = {"type": "request", "v": trace.TRACE_VERSION + 1,
+             "kind": "teleport", "model": "m", "at_s": 0.5,
+             "wormhole": True}
+    lines.insert(2, json.dumps(newer))
+    # an unknown record TYPE rides the same rule
+    lines.append(json.dumps({"type": "annotation", "note": "hi"}))
+    loaded = trace.loads_trace("\n".join(lines))
+    assert loaded.skipped == 2
+    assert len(loaded.records) == total
+
+
+def test_trace_whole_file_from_newer_format_skips_all():
+    text = "\n".join([
+        json.dumps({"type": "header", "version": trace.TRACE_VERSION + 7}),
+        json.dumps({"type": "request", "kind": "quantum", "at_s": 0.0}),
+        json.dumps({"type": "request", "kind": "unary", "model": "m",
+                    "at_s": 0.1}),
+    ])
+    loaded = trace.loads_trace(text)
+    # every record inherits the newer header version -> all skipped
+    assert loaded.records == [] and loaded.skipped == 2
+
+
+# -- generators ---------------------------------------------------------------
+def test_poisson_burst_modulation_and_bounds():
+    recs = trace.poisson_burst(seed=3, duration_s=10.0, rate=100.0,
+                               burst_factor=5.0, period_s=2.0, duty=0.2)
+    assert all(0.0 <= r.at_s < 10.0 for r in recs)
+    # on-phase (first 20% of each period) must be several times denser
+    # than the off-phase: count arrivals per phase bucket
+    on = sum(1 for r in recs if (r.at_s % 2.0) / 2.0 < 0.2)
+    off = len(recs) - on
+    assert on > off, f"burst did not dominate: on={on} off={off}"
+    # long-run mean stays near the declared rate
+    assert 0.6 * 100.0 * 10.0 < len(recs) < 1.4 * 100.0 * 10.0
+
+
+@pytest.mark.parametrize("tail", ["lognormal", "pareto"])
+def test_heavy_tail_lengths_clipped_and_spread(tail):
+    recs = trace.heavy_tail(seed=4, duration_s=20.0, rate=20.0, tail=tail,
+                            max_prompt=96, max_output=32)
+    prompts = [r.prompt_tokens for r in recs]
+    assert all(1 <= p <= 96 for p in prompts)
+    assert all(1 <= r.output_tokens <= 32 for r in recs)
+    assert len(set(prompts)) > 5, "no spread in prompt lengths"
+
+
+def test_generator_spec_parsing_and_errors():
+    name, params = trace.parse_gen_spec(
+        "mixed:duration_s=5,rate=40,tail=pareto,unary_model=simple")
+    assert name == "mixed" and params["duration_s"] == 5
+    assert params["tail"] == "pareto" and params["unary_model"] == "simple"
+    with pytest.raises(ValueError, match="unknown trace generator"):
+        trace.parse_gen_spec("nope:duration_s=5")
+    with pytest.raises(ValueError, match="key=value"):
+        trace.parse_gen_spec("mixed:duration_s")
+    with pytest.raises(ValueError, match="bad params"):
+        trace.generate("mixed:bogus_param=1")
+
+
+# -- SLO spec parsing + request_ms metric -------------------------------------
+def test_parse_slo_spec_matrix():
+    spec = parse_slo_spec("ttft_p95<200ms")
+    assert (spec.kind, spec.metric, spec.threshold_ms, spec.objective) == \
+        ("latency", "ttft_ms", 200.0, 0.95)
+    spec = parse_slo_spec("p99<50ms")
+    assert (spec.metric, spec.objective) == ("request_ms", 0.99)
+    spec = parse_slo_spec("latency_p999<1s")
+    assert (spec.metric, spec.threshold_ms, spec.objective) == \
+        ("request_ms", 1000.0, 0.999)
+    spec = parse_slo_spec("error_rate<0.1%")
+    assert (spec.kind, spec.limit) == ("error_rate", 0.001)
+    assert parse_slo_spec("error_rate<0.005").limit == 0.005
+    for bad in ("nope", "p<50ms", "latency<5ms", "error_rate<20ms",
+                "error_rate<150%", "ttft_p95<5%", "foo_p95<5ms", "p00<1ms",
+                # p100 would misparse to objective 0.10 — must be rejected,
+                # not silently certify a 10%-good "SLO"
+                "p100<50ms", "p05<50ms"):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+
+def test_request_ms_slo_fed_from_unary_spans():
+    tel = Telemetry()
+    slo = tel.track_slo("lat_p90", "request_ms", threshold_ms=50.0,
+                        objective=0.9)
+    for _ in range(8):
+        span = tel.begin("http", "m")
+        tel.finish(span)  # ~instant: good
+    slow = tel.begin("http", "m")
+    slow.start_ns -= int(80e6)  # 80 ms ago
+    tel.finish(slow)
+    err = tel.begin("http", "m")
+    tel.finish(err, error=RuntimeError("boom"))  # errors always count bad
+    rows = tel.slo_report()
+    assert rows[0]["good"] == 8 and rows[0]["bad"] == 2
+    assert rows[0]["events"] == 10
+    assert rows[0]["attained"] is False  # 20% bad > 10% budget
+    # stream-metric SLOs are untouched by unary spans
+    ttft = tel.track_slo("ttft", "ttft_ms", threshold_ms=100.0)
+    span = tel.begin("http", "m")
+    tel.finish(span)
+    assert tel.slo_report()[1]["events"] == 0
+    assert ttft.report()["events"] == 0
+
+
+def test_slo_report_unbound_uses_window():
+    slo = SLO("x", "request_ms", threshold_ms=10.0, objective=0.5)
+    slo.observe(5.0)
+    slo.observe(50.0)
+    slo.observe_failure()
+    row = slo.report()
+    assert row["good"] == 1 and row["bad"] == 2 and row["attained"] is False
+
+
+# -- open-loop schedule slip (satellite) --------------------------------------
+def test_open_loop_rows_report_offered_vs_achieved_and_max_lag():
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        runner = PerfRunner(server.url, "http", "simple")
+        try:
+            row = runner.run_rate(50.0, 60, distribution="poisson",
+                                  pool_size=8)
+        finally:
+            runner.close()
+    assert row["offered_rate"] == 50.0
+    assert row["achieved_arrival_rate"] > 0.0
+    lag = row["schedule_lag_ms"]
+    assert lag["max"] >= lag["p99"] >= lag["p50"] >= 0.0
+    assert row["issued"] == 60
+
+
+def test_open_loop_poisson_schedule_seed_deterministic():
+    r1 = PerfRunner.__new__(PerfRunner)
+    r1.rng = np.random.default_rng(7)
+    r2 = PerfRunner.__new__(PerfRunner)
+    r2.rng = np.random.default_rng(7)
+    gaps1 = r1.rng.exponential(1.0 / 25.0, size=64)
+    gaps2 = r2.rng.exponential(1.0 / 25.0, size=64)
+    assert np.array_equal(gaps1, gaps2)
+
+
+# -- capacity bisection / gate logic ------------------------------------------
+def test_bisect_capacity_finds_boundary():
+    probes = []
+
+    def evaluate(speed):
+        probes.append(speed)
+        return speed <= 3.0, {"speed": speed, "slo_ok": speed <= 3.0}
+
+    best, rows = bisect_capacity(evaluate, 1.0, 8.0, iters=8)
+    assert abs(best - 3.0) < 0.1
+    assert len(rows) == len(probes)
+    assert all(r["slo_ok"] == (r["speed"] <= 3.0) for r in rows)
+
+
+def test_bisect_capacity_edges():
+    best, rows = bisect_capacity(
+        lambda s: (False, {"speed": s}), 1.0, 8.0, iters=4)
+    assert best == 0.0 and len(rows) == 1  # lo already fails: stop early
+    best, rows = bisect_capacity(
+        lambda s: (True, {"speed": s}), 1.0, 8.0, iters=4)
+    assert best == 8.0 and len(rows) == 2  # hi passes: nothing to bisect
+
+
+def test_sustainable_requires_delivery_not_just_latency():
+    """Past saturation the replay self-throttles: request latency stays
+    flattering while the schedule slips. A probe that could not ISSUE the
+    offered arrival schedule on time must NOT count as sustainable,
+    whatever its latency SLOs say — and the metric is the arrival rate,
+    not the completion rate (whose elapsed includes the drain tail)."""
+    ok = {"slo_ok": True, "offered_rate": 100.0,
+          "achieved_arrival_rate": 99.0}
+    assert sustainable(ok) is True
+    under = {"slo_ok": True, "offered_rate": 700.0,
+             "achieved_arrival_rate": 300.0}
+    assert sustainable(under) is False
+    missed = {"slo_ok": False, "offered_rate": 100.0,
+              "achieved_arrival_rate": 100.0}
+    assert sustainable(missed) is False
+
+
+def test_capacity_gate_compare_tolerance():
+    ok = gate_compare(100.0, 90.0, tolerance=0.15)
+    assert ok["regressed"] is False
+    bad = gate_compare(100.0, 84.0, tolerance=0.15)
+    assert bad["regressed"] is True and bad["floor_qps"] == 85.0
+    # improvements never fail; a zero committed baseline can't regress
+    assert gate_compare(100.0, 140.0)["regressed"] is False
+    assert gate_compare(0.0, 0.0)["regressed"] is False
+
+
+def test_capacity_gate_zero_committed_capacity_never_regresses():
+    doc = {"arms": {"baseline": {"max_speed": 0.0,
+                                 "max_sustainable_qps": 0.0}}}
+    res = probe_at_floor(doc, "baseline", tolerance=0.15, duration_s=1.0,
+                         replay_workers=4, attempts=2)
+    assert res["regressed"] is False and res["attempts"] == []
+
+
+def test_capacity_gate_shortened_trace_same_shape():
+    doc = {"trace": {"spec": MIXED_SPEC, "seed": 5}}
+    short = shortened_trace(doc, 1.5)
+    assert short.header["seed"] == 5
+    assert short.header["spec"] == MIXED_SPEC
+    assert short.duration_s == 1.5
+    # same workload shape at a shorter duration: all kinds still present,
+    # arrivals inside the window (sequence tails may spill past it), and
+    # re-generation is deterministic
+    assert min(short.kind_counts().values()) > 0
+    assert all(r.at_s < 1.5 for r in short.records if r.kind != "sequence")
+    again = shortened_trace(doc, 1.5)
+    assert again.records == short.records
+
+
+def test_pool_wait_healthy_probes_fresh_pool():
+    """Endpoints start optimistically healthy; wait_healthy must not
+    vouch for a fresh pool without issuing a single probe."""
+    from client_tpu._base import InferenceServerClientBase
+    from client_tpu.pool import PoolClient
+
+    class DownStub(InferenceServerClientBase):
+        def __init__(self, url):
+            super().__init__()
+            self.url = url
+
+        def is_server_ready(self, probe=False, client_timeout=None, **kw):
+            return False
+
+        def close(self):
+            pass
+
+    pool = PoolClient(["u1", "u2"], client_factory=DownStub,
+                      health_interval_s=None)
+    try:
+        assert pool.wait_healthy(timeout_s=0.3) is False
+        assert pool.wait_healthy(min_healthy=0, timeout_s=0.2) is True
+    finally:
+        pool.close()
+
+
+# -- replay engine ------------------------------------------------------------
+def test_run_trace_rejects_bad_inputs():
+    runner = PerfRunner.__new__(PerfRunner)  # no server needed
+    runner.protocol = "grpc"
+    runner.shared_memory = "none"
+    with pytest.raises(ValueError, match="empty trace"):
+        PerfRunner.run_trace(runner, [])
+    stream_rec = trace.TraceRecord(
+        at_s=0.0, kind="generate_stream", model="m",
+        prompt_tokens=4, output_tokens=2)
+    with pytest.raises(ValueError, match="HTTP SSE"):
+        PerfRunner.run_trace(runner, [stream_rec])
+    runner.protocol = "native"
+    with pytest.raises(ValueError, match="python frontend"):
+        PerfRunner.run_trace(runner, [stream_rec])
+    runner.protocol = "http"
+    runner.shared_memory = "tpu"
+    with pytest.raises(ValueError, match="shared-memory none"):
+        PerfRunner.run_trace(runner, [stream_rec])
+    runner.shared_memory = "none"
+    with pytest.raises(ValueError, match="speed"):
+        PerfRunner.run_trace(runner, [stream_rec], speed=0.0)
+
+
+def test_stream_dead_before_first_chunk_counts_bad_on_ttft_slo():
+    """A stream that errors before any chunk has no TTFT sample — it must
+    count BAD on a ttft SLO (same rule as errored unary requests), never
+    vanish from the verdict."""
+    tel = Telemetry()
+    tel.track_slo("ttft", "ttft_ms", threshold_ms=100.0)
+    span = tel.begin_stream("http", "m")
+    tel.finish_stream(span, error=RuntimeError("connect reset pre-token"))
+    row = tel.slo_report()[0]
+    assert row["bad"] == 1 and row["good"] == 0
+    assert row["attained"] is False
+
+
+def test_errored_stream_counts_bad_on_duration_slo():
+    """A truncated (errored) stream's short duration must never count as
+    a GOOD duration event — the session did not complete inside the
+    objective, it did not complete at all."""
+    tel = Telemetry()
+    tel.track_slo("dur", "stream_duration_ms", threshold_ms=5000.0)
+    span = tel.begin_stream("http", "m")
+    span.mark()  # one chunk arrived, then the stream died
+    tel.finish_stream(span, error=RuntimeError("reset mid-stream"))
+    row = tel.slo_report()[0]
+    assert row["bad"] == 1 and row["good"] == 0
+
+
+def test_slo_report_zero_events_not_attained():
+    """A declared objective that never received an event must not be
+    certified as met (a ttft SLO on a unary-only replay, say)."""
+    tel = Telemetry()
+    tel.track_slo("ttft", "ttft_ms", threshold_ms=100.0)
+    span = tel.begin("http", "m")
+    tel.finish(span)  # unary span: feeds no ttft events
+    row = tel.slo_report()[0]
+    assert row["events"] == 0 and row["attained"] is False
+
+
+@pytest.mark.replay_smoke
+def test_mixed_trace_replay_smoke_threaded_server():
+    """The acceptance-shaped smoke: a seeded mixed-kind trace replayed
+    open-loop against the in-repo threaded server. Every record must
+    complete without error, sequence steps must hit the server in order
+    (the accumulator proves it), per-kind percentiles and SLO verdicts
+    must be present, and offered-vs-achieved rates reported."""
+    tr = trace.generate(
+        "mixed:duration_s=2,rate=25,stream_fraction=0.15,"
+        "seq_fraction=0.15,output_mean=3,max_output=5", seed=13)
+    counts = tr.kind_counts()
+    assert min(counts.values()) > 0, counts
+    seq_results = {}
+
+    def on_result(rec, outcome):
+        if rec.kind == "sequence" and not isinstance(outcome, Exception):
+            seq_results[(rec.seq_group, rec.seq_index)] = int(
+                outcome.as_numpy("OUTPUT")[0, 0])
+
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        runner = PerfRunner(server.url, "http", "simple")
+        try:
+            row = runner.run_trace(
+                tr, speed=1.5, replay_workers=12,
+                slos=["ttft_p95<5000ms", "p99<5000ms", "error_rate<1%"],
+                on_result=on_result)
+        finally:
+            runner.close()
+
+    assert row["issued"] == len(tr.records)
+    assert row["errors"] == 0 and row["shed"] == 0, row["error_sample"]
+    assert set(row["kinds"]) == {"unary", "generate_stream", "sequence"}
+    for kind_row in row["kinds"].values():
+        assert kind_row["latency_ms"]["p99"] >= kind_row["latency_ms"]["p50"]
+    assert row["offered_rate"] > 0 and row["achieved_rate"] > 0
+    assert row["achieved_arrival_rate"] > 0
+    assert row["schedule_lag_ms"]["max"] >= 0
+    # stream kinds carried TTFT/ITL sourced from StreamSpans
+    assert row["client_stream_ms"]["ttft_ms"]["count"] == \
+        counts["generate_stream"]
+    assert row["slo_ok"] is True, row["slo"]
+    assert {r["slo"] for r in row["slo"]} == \
+        {"ttft_p95<5000ms", "p99<5000ms", "error_rate<1%"}
+    # request_ms population: exactly ONE event per unary/sequence record
+    # (never inner-dispatch or hedge-attempt spans)
+    p99_row = next(r for r in row["slo"] if r["slo"] == "p99<5000ms")
+    assert p99_row["events"] == counts["unary"] + counts["sequence"]
+    # sequence ordering: the accumulator's running total at step k is
+    # (k+1) * v where v is the (cached, constant) step value — any
+    # out-of-order or resent step would break the arithmetic progression
+    groups = {g for g, _ in seq_results}
+    assert len(groups) == row["sequence_groups"]
+    for group in groups:
+        steps = sorted(i for g, i in seq_results if g == group)
+        assert steps == list(range(len(steps)))
+        v = seq_results[(group, 0)]
+        for i in steps:
+            assert seq_results[(group, i)] == (i + 1) * v, \
+                (group, i, v, seq_results)
+
+
+def test_replay_instantaneous_burst_uses_header_duration():
+    """All arrivals at offset 0 (a pure burst): offered_rate must fall
+    back to the header's declared span instead of dividing by ~0 and
+    producing an unsatisfiable 1e9 req/s."""
+    layout = ({"INPUT0": [1, 16], "INPUT1": [1, 16]},
+              {"INPUT0": "INT32", "INPUT1": "INT32"})
+    recs = [trace.TraceRecord(at_s=0.0, kind="unary", model="simple",
+                              shapes=layout[0], dtypes=layout[1])
+            for _ in range(4)]
+    tr = trace.Trace(header={"duration_s": 2.0}, records=recs)
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        runner = PerfRunner(server.url, "http", "simple")
+        try:
+            row = runner.run_trace(tr, speed=1.0, replay_workers=4)
+        finally:
+            runner.close()
+    assert row["requests"] == 4
+    assert row["offered_rate"] == 2.0  # 4 records over the declared 2 s
+
+
+def test_replay_abandons_sequence_group_after_failed_step():
+    """A failed sequence step poisons its group: later steps must not be
+    sent into server state that never saw the failure — they count as
+    errors ('group abandoned'), never as served."""
+    layout = ({"INPUT": [1, 1]}, {"INPUT": "INT32"})
+    recs = [trace.TraceRecord(
+        at_s=0.01 * i, kind="sequence", model="no_such_model",
+        shapes=layout[0], dtypes=layout[1],
+        seq_group=1, seq_index=i, seq_len=3) for i in range(3)]
+    dispatched = []
+
+    def on_result(rec, outcome):
+        dispatched.append((rec.seq_index, outcome))
+
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        runner = PerfRunner(server.url, "http", "simple")
+        try:
+            row = runner.run_trace(recs, speed=2.0, replay_workers=3,
+                                   on_result=on_result)
+        finally:
+            runner.close()
+    assert row["errors"] == 3 and row["requests"] == 0
+    later = {i: outcome for i, outcome in dispatched if i > 0}
+    assert len(later) == 2
+    for outcome in later.values():
+        assert "abandoned" in str(outcome), outcome
+
+
+def test_spanless_stream_failures_count_bad_on_stream_slos():
+    """Streams that fail before a StreamSpan exists (pool endpoint
+    selection with every replica down) must still count BAD on span-fed
+    ttft/duration SLOs — not vanish from the verdict."""
+    recs = [trace.TraceRecord(at_s=0.02 * i, kind="generate_stream",
+                              model="tiny_lm_generate",
+                              prompt_tokens=4, output_tokens=2)
+            for i in range(3)]
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        # control plane on the live server; the POOL has one dead replica
+        runner = PerfRunner(server.url, "http", "simple",
+                            endpoints=["127.0.0.1:1"])
+        try:
+            row = runner.run_trace(recs, speed=4.0, replay_workers=3,
+                                   slos=["ttft_p95<5s", "duration_p90<5s"])
+        finally:
+            runner.close()
+    assert row["errors"] + row["shed"] == 3
+    for slo_row in row["slo"]:
+        assert slo_row["bad"] == 3 and slo_row["good"] == 0, slo_row
+        assert slo_row["attained"] is False
+    assert row["slo_ok"] is False
+
+
+def test_nonfinite_generator_params_rejected():
+    """inf/nan duration or rate would make the arrival loop walk forever
+    — reject at the boundary instead of hanging the CLI."""
+    for override in ({"duration_s": float("inf")}, {"rate": float("nan")}):
+        params = {"duration_s": 1.0, "rate": 10.0, **override}
+        with pytest.raises(ValueError, match="finite"):
+            trace.poisson_burst(seed=0, **params)
+
+
+def test_burst_over_budget_rejected():
+    """burst_factor*duty > 1 cannot preserve the declared mean rate (the
+    off-phase clamps at 0): reject instead of silently over-offering."""
+    with pytest.raises(ValueError, match="burst_factor"):
+        trace.poisson_burst(seed=0, duration_s=2.0, rate=50.0,
+                            burst_factor=5.0, duty=0.25)
+    # product == 1 is the degenerate-but-exact boundary: all mass in the
+    # burst, long-run mean still equal to the declared rate
+    assert trace.poisson_burst(seed=0, duration_s=2.0, rate=50.0,
+                               burst_factor=4.0, duty=0.25)
+
+
+def test_replay_reports_errors_without_aborting():
+    """Records targeting a missing model count as errors; the replay
+    completes and the error-rate SLO verdict reflects them."""
+    recs = [trace.TraceRecord(at_s=0.01 * i, kind="unary", model="no_such",
+                              shapes={"INPUT0": [1, 16]},
+                              dtypes={"INPUT0": "INT32"})
+            for i in range(10)]
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        runner = PerfRunner(server.url, "http", "simple")
+        try:
+            row = runner.run_trace(recs, speed=4.0, replay_workers=4,
+                                   slos=["error_rate<1%"])
+        finally:
+            runner.close()
+    assert row["issued"] == 10 and row["errors"] == 10
+    assert row["error_rate"] == 1.0
+    assert row["slo_ok"] is False
+    err_row = next(r for r in row["slo"] if r["metric"] == "error_rate")
+    assert err_row["attained"] is False and err_row["value"] == 1.0

@@ -1,0 +1,290 @@
+"""Generate BENCH_CAPACITY.json: SLO capacity curves across a feature matrix.
+
+The one question every prior bench artifact only circles: **what QPS can
+this client/fleet serve inside SLO?** This driver answers it by bisecting
+the replay speed of ONE seeded mixed-kind trace (unary + generate_stream
+SSE + sequences; ``client_tpu.trace``) against live in-process servers,
+per feature-matrix arm:
+
+- ``baseline``      — one server, bare HTTP client
+- ``batching``      — one server, the PR 6 coalescing dispatcher armed
+- ``pool3_hedge``   — 3-replica PoolClient with hedged requests
+- ``pool3_chaos``   — 3-replica PoolClient, one replica behind a
+  ChaosProxy latency fault, retries armed — capacity under partial failure
+
+Every probed speed emits a full replay row (per-kind latency/TTFT/ITL
+percentiles, offered-vs-achieved rate, schedule slip, shed/error
+fractions, per-SLO verdicts); the bisection keeps the highest speed whose
+row attains EVERY declared SLO. ``max_sustainable_qps`` is that row's
+offered rate. tools/capacity_gate.py replays the same spec against the
+committed artifact and fails CI on >15% regression.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_capacity.py [-o BENCH_CAPACITY.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# one trace, all arms: capacity numbers are apples-to-apples. The unary
+# model is batched_matmul so the batching arm has rows to coalesce; short
+# streams keep the CPU-backed generate path from dominating wall time.
+TRACE_SPEC = ("mixed:duration_s=4,rate=60,stream_fraction=0.1,"
+              "seq_fraction=0.1,unary_model=batched_matmul,"
+              "prompt_mean=12,max_prompt=32,output_mean=4,max_output=6,"
+              "burst_factor=3,period_s=1.0,duty=0.3")
+TRACE_SEED = 2026
+# p95, not p99: a 4-second probe sees a few hundred unary requests, and a
+# p99 verdict over that flips on ~3 GIL-scheduling outliers — p95 binds on
+# genuine queueing (17+ bad samples) instead of single-core jitter
+SLOS = ["ttft_p95<500ms", "p95<200ms", "error_rate<1%"]
+# a probe must also DELIVER the offered schedule: past saturation the
+# replay workers self-throttle, request latency stays flattering while
+# the schedule silently slips — the very failure mode the replay's
+# offered-vs-achieved reporting exists to expose
+MIN_DELIVERY_RATIO = 0.9
+
+
+def sustainable(row: Dict[str, Any],
+                min_delivery: float = MIN_DELIVERY_RATIO) -> bool:
+    """One probe's verdict: every declared SLO attained AND the replay
+    actually ISSUED the arrival schedule on time (achieved arrival rate ≥
+    ``min_delivery`` of offered). Latency SLOs alone cannot catch
+    saturation — past it the workers self-throttle and queue wait lands in
+    schedule slip, not per-request latency. The arrival rate (not the
+    completion rate) is the delivery metric: completions are measured over
+    an elapsed that includes the post-schedule drain tail, which at high
+    replay speeds would deflate a perfectly-served probe."""
+    offered = row["offered_rate"]
+    delivered = (row["achieved_arrival_rate"] >= min_delivery * offered
+                 if offered > 0 else True)
+    return bool(row["slo_ok"] and delivered)
+
+
+def bisect_capacity(evaluate: Callable[[float], Tuple[bool, Dict[str, Any]]],
+                    lo: float, hi: float, iters: int = 5,
+                    ) -> Tuple[float, List[Dict[str, Any]]]:
+    """Max sustainable replay speed by bisection. ``evaluate(speed)``
+    returns ``(slo_ok, row)``; assumes ok is monotone-decreasing in speed
+    (true up to measurement noise — each probe's full row is kept so a
+    non-monotone flip is visible in the artifact, not silently absorbed).
+    Returns ``(best_speed, rows)``; best_speed 0.0 when even ``lo`` fails."""
+    rows: List[Dict[str, Any]] = []
+    ok, row = evaluate(lo)
+    rows.append(row)
+    if not ok:
+        return 0.0, rows
+    best = lo
+    ok, row = evaluate(hi)
+    rows.append(row)
+    if ok:
+        return hi, rows
+    for _ in range(iters):
+        mid = (lo + hi) / 2.0
+        ok, row = evaluate(mid)
+        rows.append(row)
+        if ok:
+            lo = best = mid
+        else:
+            hi = mid
+    return best, rows
+
+
+def _warm(url: str) -> None:
+    """Pre-compile every model the trace touches on one server: the first
+    generate pays the jit trace, and a capacity probe must never bill
+    compilation to the SLO."""
+    import numpy as np
+
+    from client_tpu.http import InferenceServerClient, InferInput
+
+    with InferenceServerClient(url) as client:
+        x = InferInput("X", [1, 64], "FP32")
+        x.set_data_from_numpy(np.zeros((1, 64), dtype=np.float32))
+        client.infer("batched_matmul", [x])
+        s = InferInput("INPUT", [1, 1], "INT32")
+        s.set_data_from_numpy(np.ones((1, 1), dtype=np.int32))
+        client.infer("simple_sequence", [s], sequence_id=999983,
+                     sequence_start=True, sequence_end=True)
+        for _ in client.generate_stream(
+                "tiny_lm_generate",
+                {"TOKENS": [[1, 2, 3, 4]], "MAX_TOKENS": 2}):
+            pass
+
+
+@contextlib.contextmanager
+def arm_runner(name: str, chaos_latency_s: float = 0.01):
+    """Stand up one feature-matrix arm — fresh in-process servers, warmed
+    models, a PerfRunner configured with the arm's knobs — and tear it
+    all down on exit. Shared by the capacity search (main) and the
+    regression gate (tools/capacity_gate.py), so each arm has exactly one
+    definition. Yields ``(runner, feature_description)``."""
+    from client_tpu.models import default_model_zoo
+    from client_tpu.perf import PerfRunner
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing import ChaosProxy, Fault
+
+    if name not in ("baseline", "batching", "pool3_hedge", "pool3_chaos"):
+        raise ValueError(f"unknown arm {name!r}")
+    n_servers = 3 if name.startswith("pool3") else 1
+    servers = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+               for _ in range(n_servers)]
+    proxy = None
+    runner = None
+    try:
+        for s in servers:
+            _warm(s.url)
+        kwargs: Dict[str, Any] = {}
+        feature = "bare client, one replica"
+        endpoints = None
+        if name == "batching":
+            kwargs.update(coalesce=True, batch_max=32)
+            feature = "coalescing dispatcher (client_tpu.batch)"
+        elif name == "pool3_hedge":
+            endpoints = [s.url for s in servers]
+            # 100 ms: hedge genuine stragglers only — a tighter delay
+            # duplicates the p90 tail, which on a shared-core fleet
+            # ADDS load instead of cutting it
+            kwargs.update(hedge=True, hedge_delay_s=0.1)
+            feature = "3-replica PoolClient, hedged requests"
+        elif name == "pool3_chaos":
+            proxy = ChaosProxy("127.0.0.1", servers[-1].port).start()
+            proxy.fault = Fault("latency", latency_s=chaos_latency_s)
+            endpoints = [s.url for s in servers[:-1]] + [proxy.url]
+            kwargs.update(retries=1)
+            feature = (f"3-replica PoolClient, one replica behind a "
+                       f"{chaos_latency_s * 1e3:g}ms latency "
+                       f"ChaosProxy, retries=1")
+        runner = PerfRunner(servers[0].url, "http", "batched_matmul",
+                            shape_overrides={"X": [1, 64]},
+                            endpoints=endpoints, **kwargs)
+        yield runner, feature
+    finally:
+        if runner is not None:
+            runner.close()
+        if proxy is not None:
+            proxy.stop()
+        for s in servers:
+            s.stop()
+
+
+def _search(runner, tr, speed_lo: float, speed_hi: float, iters: int,
+            replay_workers: int) -> Dict[str, Any]:
+    def evaluate(speed: float) -> Tuple[bool, Dict[str, Any]]:
+        row = runner.run_trace(tr, speed=round(speed, 3),
+                               replay_workers=replay_workers, slos=SLOS)
+        row["delivery_ratio"] = round(
+            row["achieved_arrival_rate"] / row["offered_rate"], 3) \
+            if row["offered_rate"] else 1.0
+        row["sustainable"] = sustainable(row)
+        print(f"  speed={row['speed']} offered={row['offered_rate']}/s "
+              f"achieved={row['achieved_rate']}/s errors={row['errors']} "
+              f"shed={row['shed']} lag_max={row['schedule_lag_ms']['max']}ms "
+              f"slo_ok={row['slo_ok']} "
+              f"sustainable={row['sustainable']}", flush=True)
+        return row["sustainable"], row
+
+    _, rows = bisect_capacity(evaluate, speed_lo, speed_hi, iters)
+    # confirmation pass: a committed capacity must be REPRODUCIBLE, not a
+    # lucky probe — re-evaluate the highest sustainable speed; on failure
+    # fall back to the next-lower one (the gate will hold future runs to
+    # 85% of this number, so an outlier-high single probe must not anchor
+    # the baseline)
+    candidates = sorted({r["speed"] for r in rows if r["sustainable"]},
+                        reverse=True)
+    best_row = None
+    # walk ALL sustainable candidates, highest first: flaky confirmations
+    # must anchor the baseline at the highest REPRODUCIBLE speed, never
+    # silently commit 0.0 (which would disable the gate for this arm)
+    for speed in candidates:
+        ok, row = evaluate(speed)
+        row["confirmation"] = True
+        rows.append(row)
+        if ok:
+            best_row = row
+            break
+    return {
+        "max_speed": best_row["speed"] if best_row else 0.0,
+        "max_sustainable_qps": best_row["offered_rate"] if best_row else 0.0,
+        "achieved_qps_at_max": best_row["achieved_rate"] if best_row else 0.0,
+        "rows": rows,
+    }
+
+
+def main(argv=None, trace_override=None) -> int:
+    """``trace_override``: a pre-built ``trace.Trace`` replacing the
+    module-level spec — tools/capacity_gate.py passes a shortened twin of
+    the committed trace so both definitions of every arm stay HERE."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_CAPACITY.json")
+    parser.add_argument("--speed-lo", type=float, default=0.5)
+    parser.add_argument("--speed-hi", type=float, default=8.0)
+    parser.add_argument("--iters", type=int, default=5)
+    parser.add_argument("--replay-workers", type=int, default=32)
+    parser.add_argument("--chaos-latency-s", type=float, default=0.01)
+    parser.add_argument(
+        "--arms", default="baseline,batching,pool3_hedge,pool3_chaos")
+    args = parser.parse_args(argv)
+
+    from client_tpu import trace as trace_mod
+
+    tr = (trace_override if trace_override is not None
+          else trace_mod.generate(TRACE_SPEC, seed=TRACE_SEED))
+    out: Dict[str, Any] = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "note": (
+            "max sustainable QPS per feature arm: bisection over the "
+            "replay speed of one seeded mixed-kind trace (unary + SSE "
+            "stream + sequence) against live in-process servers; a speed "
+            "is sustainable when every declared SLO is attained over the "
+            "whole replay window"
+        ),
+        "trace": {
+            "spec": tr.header.get("spec", TRACE_SPEC),
+            "seed": tr.header.get("seed", TRACE_SEED),
+            "records": len(tr.records),
+            "duration_s": tr.duration_s,
+            "kinds": tr.kind_counts(),
+        },
+        "slos": list(SLOS),
+        "search": {
+            "speed_lo": args.speed_lo,
+            "speed_hi": args.speed_hi,
+            "iters": args.iters,
+            "replay_workers": args.replay_workers,
+            "min_delivery_ratio": MIN_DELIVERY_RATIO,
+            "chaos_latency_s": args.chaos_latency_s,
+        },
+        "arms": {},
+    }
+
+    for name in [a.strip() for a in args.arms.split(",") if a.strip()]:
+        with arm_runner(name, args.chaos_latency_s) as (runner, feature):
+            print(f"arm {name}: {feature}", flush=True)
+            arm = _search(runner, tr, args.speed_lo, args.speed_hi,
+                          args.iters, args.replay_workers)
+            arm["feature"] = feature
+        out["arms"][name] = arm
+
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    summary = {name: arm["max_sustainable_qps"]
+               for name, arm in out["arms"].items()}
+    print("max_sustainable_qps:", json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,150 @@
+"""Capacity regression gate: fresh short replay vs the committed baseline.
+
+Reads the committed ``BENCH_CAPACITY.json`` (tools/bench_capacity.py),
+rebuilds the named arm (same definition — ``bench_capacity.arm_runner``)
+and replays a shortened twin of the committed trace **at the committed
+capacity's floor speed** — ``max_speed * (1 - tolerance)``. If the arm
+can no longer attain the committed SLOs at 85% of its committed
+capacity, SLO capacity has regressed >15%: exit 1. A probe is retried
+(``--attempts``, default 2) before the verdict, so one scheduling
+hiccup on a shared-core CI box doesn't false-fail the gate; a fresh
+capacity ABOVE the committed one never fails — regenerate and commit
+the artifact to ratchet the baseline up.
+
+Probing at the floor (instead of re-bisecting) keeps the gate one-replay
+cheap AND immune to the bisection grid's quantization, which near the
+low end is coarser than the tolerance itself.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/capacity_gate.py \
+        [--baseline BENCH_CAPACITY.json] [--arm baseline] \
+        [--tolerance 0.15] [--duration-s 3.0] [--attempts 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def compare(committed_qps: float, fresh_qps: float,
+            tolerance: float = 0.15) -> Dict[str, Any]:
+    """Pure verdict for number-vs-number comparisons: ``regressed`` when
+    the fresh capacity falls more than ``tolerance`` below the committed
+    one (0 committed never regresses — there is nothing to fall from)."""
+    floor = committed_qps * (1.0 - tolerance)
+    return {
+        "committed_qps": committed_qps,
+        "fresh_qps": fresh_qps,
+        "tolerance": tolerance,
+        "floor_qps": round(floor, 1),
+        "ratio": round(fresh_qps / committed_qps, 3) if committed_qps else None,
+        "regressed": committed_qps > 0 and fresh_qps < floor,
+    }
+
+
+def shortened_trace(doc: Dict[str, Any], duration_s: float):
+    """The committed artifact's generator spec/seed re-generated at a
+    shorter duration — the same workload shape, CI-cheap."""
+    from client_tpu import trace as trace_mod
+
+    return trace_mod.generate(doc["trace"]["spec"],
+                              seed=int(doc["trace"]["seed"]),
+                              duration_s=duration_s)
+
+
+def probe_at_floor(doc: Dict[str, Any], arm: str, tolerance: float,
+                   duration_s: float, replay_workers: int,
+                   attempts: int) -> Dict[str, Any]:
+    """Replay the shortened trace at the committed floor speed; regressed
+    only if EVERY attempt misses an SLO."""
+    import tools.bench_capacity as bench
+
+    committed = doc["arms"][arm]
+    floor_speed = float(committed["max_speed"]) * (1.0 - tolerance)
+    result: Dict[str, Any] = {
+        "arm": arm,
+        "committed_max_speed": committed["max_speed"],
+        "committed_qps": committed["max_sustainable_qps"],
+        "tolerance": tolerance,
+        "floor_speed": round(floor_speed, 3),
+        "attempts": [],
+    }
+    if floor_speed <= 0.0:
+        # a zero committed capacity has nothing to regress from
+        result["regressed"] = False
+        return result
+    tr = shortened_trace(doc, duration_s)
+    slos = list(doc["slos"])
+    search = doc.get("search", {})
+    min_delivery = float(search.get(
+        "min_delivery_ratio", bench.MIN_DELIVERY_RATIO))
+    # rebuild the arm under the SAME fault AND harness concurrency the
+    # committed number was measured under — a different chaos latency is
+    # a different workload, and fewer replay workers is a different
+    # issuing capacity (the caller's value is only the fallback)
+    chaos_latency_s = float(search.get("chaos_latency_s", 0.01))
+    replay_workers = int(search.get("replay_workers", replay_workers))
+    with bench.arm_runner(arm, chaos_latency_s) as (runner, feature):
+        result["feature"] = feature
+        # warm the measurement path the way the bench's own low-speed
+        # first probe does (connections, server jit, telemetry) — a cold
+        # client slammed straight at the floor speed measures startup
+        # transients, not capacity
+        runner.run_trace(tr, speed=min(1.0, floor_speed),
+                         replay_workers=replay_workers, slos=slos)
+        for _ in range(max(1, attempts)):
+            row = runner.run_trace(tr, speed=round(floor_speed, 3),
+                                   replay_workers=replay_workers, slos=slos)
+            ok = bench.sustainable(row, min_delivery)
+            result["attempts"].append({
+                "offered_rate": row["offered_rate"],
+                "achieved_rate": row["achieved_rate"],
+                "errors": row["errors"],
+                "shed": row["shed"],
+                "slo_ok": row["slo_ok"],
+                "sustainable": ok,
+                "slo": row["slo"],
+            })
+            if ok:
+                break
+    result["regressed"] = not any(
+        a["sustainable"] for a in result["attempts"])
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--baseline", default="BENCH_CAPACITY.json")
+    parser.add_argument("--arm", default="baseline")
+    parser.add_argument("--tolerance", type=float, default=0.15)
+    parser.add_argument("--duration-s", type=float, default=3.0)
+    parser.add_argument("--attempts", type=int, default=2)
+    parser.add_argument("--replay-workers", type=int, default=32)
+    args = parser.parse_args()
+
+    doc = json.loads(Path(args.baseline).read_text())
+    if args.arm not in doc["arms"]:
+        print(f"arm {args.arm!r} not in {args.baseline} "
+              f"(has: {sorted(doc['arms'])})")
+        return 2
+    verdict = probe_at_floor(doc, args.arm, args.tolerance, args.duration_s,
+                             args.replay_workers, args.attempts)
+    print(json.dumps(verdict, indent=2))
+    if verdict["regressed"]:
+        print(f"FAIL: {args.arm} no longer sustains "
+              f"{(1 - args.tolerance) * 100:.0f}% of its committed "
+              f"SLO capacity ({verdict['committed_qps']} QPS)")
+        return 1
+    print(f"OK: {args.arm} capacity within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,14 +17,18 @@
 # (tests/test_dataplane_observe.py, doctor_smoke marker) runs the fleet
 # snapshot against a 3-replica pool with one replica behind a latency
 # fault: the decomposition must attribute the extra milliseconds to the
-# network, not the server, and flag the load/latency divergence.
+# network, not the server, and flag the load/latency divergence. The
+# trace-replay smoke (tests/test_trace_replay.py, replay_smoke marker)
+# replays a seeded mixed-kind trace (unary + SSE stream + sequence)
+# open-loop against the threaded server: every record must complete,
+# sequence steps in order, with SLO verdicts and slip reported.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
-    tests/test_dataplane_observe.py "$@"
+    tests/test_dataplane_observe.py tests/test_trace_replay.py "$@"
