@@ -86,16 +86,23 @@ def bench_identity_wire(client, httpclient, x_np, min_iters=20):
 
 
 def bench_identity_shm(client, httpclient, x_np, family, min_iters=20):
+    import uuid
+
     import numpy as np
 
+    # uuid-suffixed names/keys: two concurrent bench runs on one host must
+    # never attach each other's regions (fixed "/bench_in" keys used to
+    # collide and corrupt both runs)
+    name_in = f"bench_in_{uuid.uuid4().hex[:8]}"
+    name_out = f"bench_out_{uuid.uuid4().hex[:8]}"
     nbytes = x_np.nbytes
     if family == "system":
         import client_tpu.utils.shared_memory as shm
 
-        rin = shm.create_shared_memory_region("bench_in", "/bench_in", nbytes)
-        rout = shm.create_shared_memory_region("bench_out", "/bench_out", nbytes)
-        client.register_system_shared_memory("bench_in", "/bench_in", nbytes)
-        client.register_system_shared_memory("bench_out", "/bench_out", nbytes)
+        rin = shm.create_shared_memory_region(name_in, f"/{name_in}", nbytes)
+        rout = shm.create_shared_memory_region(name_out, f"/{name_out}", nbytes)
+        client.register_system_shared_memory(name_in, f"/{name_in}", nbytes)
+        client.register_system_shared_memory(name_out, f"/{name_out}", nbytes)
 
         def write_input():
             shm.set_shared_memory_region(rin, [x_np])
@@ -116,10 +123,10 @@ def bench_identity_shm(client, httpclient, x_np, family, min_iters=20):
 
         x_dev = jax.device_put(x_np)
         x_dev.block_until_ready()
-        rin = tpushm.create_shared_memory_region("bench_in", nbytes, colocated=True)
-        rout = tpushm.create_shared_memory_region("bench_out", nbytes, colocated=True)
-        client.register_tpu_shared_memory("bench_in", tpushm.get_raw_handle(rin), 0, nbytes)
-        client.register_tpu_shared_memory("bench_out", tpushm.get_raw_handle(rout), 0, nbytes)
+        rin = tpushm.create_shared_memory_region(name_in, nbytes, colocated=True)
+        rout = tpushm.create_shared_memory_region(name_out, nbytes, colocated=True)
+        client.register_tpu_shared_memory(name_in, tpushm.get_raw_handle(rin), 0, nbytes)
+        client.register_tpu_shared_memory(name_out, tpushm.get_raw_handle(rout), 0, nbytes)
         stat = InferStat()
         current = {}
 
@@ -148,9 +155,9 @@ def bench_identity_shm(client, httpclient, x_np, family, min_iters=20):
         def step():
             write_input()
             inp = httpclient.InferInput("INPUT0", list(x_np.shape), "FP32")
-            inp.set_shared_memory("bench_in", nbytes)
+            inp.set_shared_memory(name_in, nbytes)
             out0 = httpclient.InferRequestedOutput("OUTPUT0")
-            out0.set_shared_memory("bench_out", nbytes)
+            out0.set_shared_memory(name_out, nbytes)
             client.infer("identity_fp32", [inp], outputs=[out0])
             read_output()
 
@@ -199,18 +206,25 @@ def bench_identity_xproc(httpclient, x_np, server):
     try:
         out["wire"] = _stats(bench_identity_wire(client, httpclient, x_np))
 
-        rin = tpushm.create_shared_memory_region("xp_in", nbytes, colocated=False)
-        rout = tpushm.create_shared_memory_region("xp_out", nbytes, colocated=False)
-        client.register_tpu_shared_memory("xp_in", tpushm.get_raw_handle(rin), 0, nbytes)
-        client.register_tpu_shared_memory("xp_out", tpushm.get_raw_handle(rout), 0, nbytes)
+        import uuid
+
+        # uuid-suffixed registration names: the tpu shm KEY is already
+        # uuid-generated, but two runs registering "xp_in" against one
+        # server would still collide on the name
+        name_in = f"xp_in_{uuid.uuid4().hex[:8]}"
+        name_out = f"xp_out_{uuid.uuid4().hex[:8]}"
+        rin = tpushm.create_shared_memory_region(name_in, nbytes, colocated=False)
+        rout = tpushm.create_shared_memory_region(name_out, nbytes, colocated=False)
+        client.register_tpu_shared_memory(name_in, tpushm.get_raw_handle(rin), 0, nbytes)
+        client.register_tpu_shared_memory(name_out, tpushm.get_raw_handle(rout), 0, nbytes)
         try:
             def step():
                 # D2H: device buffer mirrored into the host window
                 tpushm.set_shared_memory_region_from_jax(rin, x_dev)
                 inp = httpclient.InferInput("INPUT0", list(x_np.shape), "FP32")
-                inp.set_shared_memory("xp_in", nbytes)
+                inp.set_shared_memory(name_in, nbytes)
                 o = httpclient.InferRequestedOutput("OUTPUT0")
-                o.set_shared_memory("xp_out", nbytes)
+                o.set_shared_memory(name_out, nbytes)
                 client.infer("identity_fp32", [inp], outputs=[o])
                 # H2D: server-written window bytes onto the client's device
                 res = tpushm.get_contents_as_jax(rout, "FP32", list(x_np.shape))
@@ -266,19 +280,23 @@ def bench_densenet(http_client, grpc_client, httpclient, grpcclient):
 
     # tpu-shm HTTP: image written from the device array into a colocated
     # region; logits land in a region read back as a jax.Array
+    import uuid
+
     in_bytes = img_np.nbytes
     out_bytes = 1000 * 4
-    rin = tpushm.create_shared_memory_region("dn_in", in_bytes, colocated=True)
-    rout = tpushm.create_shared_memory_region("dn_out", out_bytes, colocated=True)
-    http_client.register_tpu_shared_memory("dn_in", tpushm.get_raw_handle(rin), 0, in_bytes)
-    http_client.register_tpu_shared_memory("dn_out", tpushm.get_raw_handle(rout), 0, out_bytes)
+    name_in = f"dn_in_{uuid.uuid4().hex[:8]}"
+    name_out = f"dn_out_{uuid.uuid4().hex[:8]}"
+    rin = tpushm.create_shared_memory_region(name_in, in_bytes, colocated=True)
+    rout = tpushm.create_shared_memory_region(name_out, out_bytes, colocated=True)
+    http_client.register_tpu_shared_memory(name_in, tpushm.get_raw_handle(rin), 0, in_bytes)
+    http_client.register_tpu_shared_memory(name_out, tpushm.get_raw_handle(rout), 0, out_bytes)
     try:
         def step_shm():
             tpushm.set_shared_memory_region_from_jax(rin, img_dev)
             inp = httpclient.InferInput("data_0", [3, 224, 224], "FP32")
-            inp.set_shared_memory("dn_in", in_bytes)
+            inp.set_shared_memory(name_in, in_bytes)
             o = httpclient.InferRequestedOutput("fc6_1")
-            o.set_shared_memory("dn_out", out_bytes)
+            o.set_shared_memory(name_out, out_bytes)
             http_client.infer("densenet_onnx", [inp], outputs=[o])
             logits = tpushm.get_contents_as_jax(rout, "FP32", [1000, 1, 1])
             logits.block_until_ready()

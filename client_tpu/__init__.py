@@ -26,6 +26,11 @@ client libraries (triton-inference-server/client), designed TPU-first:
   registry fed by the resilience + pool event streams, and W3C
   ``traceparent`` propagation joined to server-side access records and a
   ``/metrics`` endpoint (docs/observability.md).
+- ``client_tpu.arena``: the pooled shm arena — size-class slab allocator
+  over both shared-memory packages with ref-counted leases, LRU watermark
+  trimming and per-endpoint cached server registrations; the transparent
+  zero-copy fast path behind ``configure_arena``/``shm_arena=`` and
+  ``set_data_from_numpy(..., arena=...)`` (docs/tpu_shared_memory.md).
 - ``client_tpu.utils``: Triton<->numpy dtype mapping with *native* bfloat16
   (via ml_dtypes), BYTES/BF16 wire serialization.
 - ``client_tpu.utils.shared_memory``: POSIX system shared memory data plane.

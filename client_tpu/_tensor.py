@@ -32,6 +32,44 @@ def _is_jax_array(t: Any) -> bool:
     return mod.startswith("jax") or mod.startswith("jaxlib")
 
 
+def _release_quietly(lease) -> None:
+    """Drop one lease reference, tolerating a lease some OTHER holder
+    (e.g. ``InferResult.release_arena``) already fully released — the
+    convenience release paths are ensure-gone, not strict handoffs."""
+    from .arena import ArenaError
+
+    try:
+        lease.release()
+    except ArenaError:
+        pass
+
+
+class ArenaOutputsMixin:
+    """The result-side arena surface shared by the HTTP and GRPC
+    ``InferResult`` classes: the frontends attach output leases here when
+    requested outputs were bound via ``ArenaLease.bind_output`` /
+    ``ShmArena.request_output``, and ``as_numpy`` serves zero-copy views
+    through :meth:`_arena_lease_for`."""
+
+    _arena_output_leases: Optional[Dict[str, Any]] = None
+    _arena_released = False
+
+    def _arena_lease_for(self, name: str):
+        leases = self._arena_output_leases
+        return leases.get(name) if leases else None
+
+    def release_arena(self) -> None:
+        """Release every output lease bound to this result (idempotent).
+        The lease map is kept so a later ``as_numpy`` on one of these
+        outputs raises the typed ``ArenaLeaseReleased`` instead of
+        silently returning None."""
+        if self._arena_released:
+            return
+        self._arena_released = True
+        for lease in (self._arena_output_leases or {}).values():
+            _release_quietly(lease)
+
+
 def _to_host_ndarray(tensor: Any) -> np.ndarray:
     """Materialize ``tensor`` on host as a numpy ndarray with minimal copies."""
     if isinstance(tensor, np.ndarray):
@@ -50,6 +88,11 @@ def _to_host_ndarray(tensor: Any) -> np.ndarray:
 
 class InferInput:
     """An input tensor for an inference request."""
+
+    # arena fast path (client_tpu.arena): a lease staged via
+    # ``set_data_from_numpy(..., arena=...)`` or ``ArenaLease.bind_input``;
+    # re-staging the input releases it
+    _arena_lease = None
 
     def __init__(self, name: str, shape: Sequence[int], datatype: str):
         self._name = name
@@ -74,8 +117,16 @@ class InferInput:
         return self
 
     # -- data paths --------------------------------------------------------
-    def set_data_from_numpy(self, input_tensor, binary_data: bool = True) -> "InferInput":
-        """Stage tensor contents in the request (binary blob or JSON list)."""
+    def set_data_from_numpy(self, input_tensor, binary_data: bool = True,
+                            arena=None) -> "InferInput":
+        """Stage tensor contents in the request (binary blob or JSON list).
+
+        ``arena``: a :class:`client_tpu.arena.ShmArena` — the tensor is
+        written ONCE straight into a leased slab and the input binds it via
+        shared-memory params (no bytes on the wire); the region's server
+        registration is ensured (and cached) at ``infer()`` time. The
+        input holds the lease until re-staged or
+        :meth:`release_arena_lease` is called."""
         input_tensor = _to_host_ndarray(input_tensor)
         dtype = np_to_triton_dtype(input_tensor.dtype)
         if dtype != self._datatype:
@@ -83,6 +134,36 @@ class InferInput:
                 f"got unexpected datatype {dtype} from numpy array; expected {self._datatype}"
             )
         self._validate_shape(input_tensor)
+
+        if arena is not None:
+            if not binary_data:
+                raise InferenceServerException(
+                    "arena staging requires binary_data=True")
+            # BYTES/BF16 serialize exactly once (the payload sizes the
+            # lease AND is the write); fixed-width dtypes skip the staging
+            # copy entirely — write_numpy copies straight into the slab
+            if self._datatype == "BYTES":
+                s = serialize_byte_tensor(input_tensor)
+                payload = s.item() if s.size else b""
+            elif self._datatype == "BF16":
+                s = serialize_bf16_tensor(input_tensor)
+                payload = s.item() if s.size else b""
+            else:
+                payload = None
+            nbytes = input_tensor.nbytes if payload is None else len(payload)
+            lease = arena.lease(max(nbytes, 1))
+            try:
+                if payload is None:
+                    lease.write_numpy(input_tensor)
+                else:
+                    lease.write(payload)
+            except BaseException:
+                lease.release()
+                raise
+            self._json_data = None
+            self._raw_data = None
+            lease.bind_input(self)  # releases any previous lease
+            return self
 
         self._clear_shared_memory_params()
         self._json_data = None
@@ -149,6 +230,7 @@ class InferInput:
 
     def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0) -> "InferInput":
         """Reference tensor contents in a pre-registered shared-memory region."""
+        self.release_arena_lease()
         self._json_data = None
         self._raw_data = None
         self._parameters.pop("binary_data_size", None)
@@ -156,6 +238,16 @@ class InferInput:
         self._parameters["shared_memory_byte_size"] = byte_size
         if offset != 0:
             self._parameters["shared_memory_offset"] = offset
+        return self
+
+    def release_arena_lease(self) -> "InferInput":
+        """Release the arena lease this input holds (no-op without one;
+        idempotent even if the lease was already released elsewhere).
+        Called automatically whenever the input is re-staged."""
+        lease = self._arena_lease
+        if lease is not None:
+            self._arena_lease = None
+            _release_quietly(lease)
         return self
 
     # -- encoder-facing private API ---------------------------------------
@@ -170,6 +262,7 @@ class InferInput:
             )
 
     def _clear_shared_memory_params(self) -> None:
+        self.release_arena_lease()
         for k in ("shared_memory_region", "shared_memory_byte_size", "shared_memory_offset"):
             self._parameters.pop(k, None)
 
@@ -206,6 +299,11 @@ class InferInput:
 class InferRequestedOutput:
     """A requested output tensor with optional classification / shm placement."""
 
+    # arena fast path: a lease bound via ``ArenaLease.bind_output`` /
+    # ``ShmArena.request_output``; the frontends attach it to the
+    # InferResult so ``as_numpy`` serves a zero-copy view over the slab
+    _arena_lease = None
+
     def __init__(self, name: str, binary_data: bool = True, class_count: int = 0):
         self._name = name
         self._binary_data = binary_data
@@ -216,6 +314,7 @@ class InferRequestedOutput:
         return self._name
 
     def set_shared_memory(self, region_name: str, byte_size: int, offset: int = 0) -> "InferRequestedOutput":
+        self.release_arena_lease()
         self._parameters["shared_memory_region"] = region_name
         self._parameters["shared_memory_byte_size"] = byte_size
         if offset != 0:
@@ -223,8 +322,18 @@ class InferRequestedOutput:
         return self
 
     def unset_shared_memory(self) -> "InferRequestedOutput":
+        self.release_arena_lease()
         for k in ("shared_memory_region", "shared_memory_byte_size", "shared_memory_offset"):
             self._parameters.pop(k, None)
+        return self
+
+    def release_arena_lease(self) -> "InferRequestedOutput":
+        """Release the arena lease this output holds (no-op without one;
+        idempotent even if the lease was already released elsewhere)."""
+        lease = self._arena_lease
+        if lease is not None:
+            self._arena_lease = None
+            _release_quietly(lease)
         return self
 
     # -- encoder-facing private API ---------------------------------------

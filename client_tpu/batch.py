@@ -393,6 +393,18 @@ class _BatchingCore:
         runs under whatever policy the wrapped client (or pool) carries."""
         return self._inner.configure_resilience(policy)
 
+    def configure_arena(self, arena):
+        """The shm arena belongs to the inner client too: arena-leased
+        (shm-param) inputs bypass coalescing verbatim, while plain binary
+        inputs coalesce and the JOINED batch payload is promoted into one
+        leased slab at dispatch — zero-copy batching end to end. Returns
+        this wrapper (not the inner client) so configuration chains."""
+        self._inner.configure_arena(arena)
+        return self
+
+    def arena(self):
+        return self._inner.arena()
+
     def stats(self) -> Dict[str, Any]:
         """A snapshot of dispatcher behavior: dispatch/solo/coalesced/
         bypass counts, the live window, and batch-size percentiles over

@@ -213,7 +213,45 @@ def _local_shm(recorder) -> Dict[str, Any]:
     return {
         "local_inventory": inventory,
         "dataplane": recorder.snapshot() if recorder is not None else None,
+        "arena": _arena_status(),
     }
+
+
+def _arena_status() -> List[Dict[str, Any]]:
+    """One row per live ShmArena: slab/byte residency, hit rates, and the
+    registration cache grouped per endpoint (empty list = no arenas)."""
+    import sys
+
+    arena_mod = sys.modules.get("client_tpu.arena")
+    if arena_mod is None:
+        return []
+    rows = []
+    for a in arena_mod.arenas():
+        try:
+            rows.append({
+                "stats": a.stats(),
+                "regions": a.inventory(),
+                "registration_cache": a.registration_entries(),
+            })
+        except Exception as e:
+            rows.append({"error": str(e)[:200]})
+    return rows
+
+
+def _arena_leased_bytes() -> int:
+    """Total leased bytes across every live arena (leak-flag baseline)."""
+    import sys
+
+    arena_mod = sys.modules.get("client_tpu.arena")
+    if arena_mod is None:
+        return 0
+    total = 0
+    for a in arena_mod.arenas():
+        try:
+            total += a.stats()["leased_bytes"]
+        except Exception:
+            pass
+    return total
 
 
 def _slo_status(tel: Telemetry) -> List[Dict[str, Any]]:
@@ -276,6 +314,14 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
             flags.append({
                 "flag": "shm_churn_high", "url": None,
                 "detail": f"{churn:.0f} ops/s > {churn_threshold_ops_s:.0f}"})
+    leased = snap.get("shm", {}).get("arena_leased_bytes")
+    if leased and leased["after_probe"] > leased["before_probe"]:
+        # leased bytes did not return to the pre-probe baseline: some path
+        # leased a slab during the probe and never released it
+        flags.append({
+            "flag": "shm_arena_leak", "url": None,
+            "detail": (f"leased bytes {leased['before_probe']} -> "
+                       f"{leased['after_probe']} over the probe")})
     # load/latency divergence: an endpoint much slower than the fleet
     # median whose server-side busy signal is NOT above median — the
     # extra milliseconds are outside the server (network, proxy, queueing
@@ -381,6 +427,7 @@ def collect_snapshot(
         correlator.poll_once()  # baseline for the decomposition deltas
         dataplane_before = (recorder.snapshot()
                             if recorder is not None else None)
+        arena_leased_before = _arena_leased_bytes()
         probe_t0 = time.monotonic()
         endpoints = []
         for ep in pool.pool.endpoints:
@@ -426,6 +473,22 @@ def collect_snapshot(
                 max(_total_dataplane_ops(dp)
                     - _total_dataplane_ops(dataplane_before), 0.0)
                 / window_s, 3)
+        # arena leak check: leased bytes must return to the pre-probe
+        # baseline once the probe's requests have settled — growth means
+        # some path leased without releasing. Application traffic on other
+        # threads holds transient leases mid-infer, so a raised reading is
+        # re-sampled after short settles and only the settled value is
+        # compared (false flags would make the anomaly untrustworthy).
+        arena_leased_after = _arena_leased_bytes()
+        for _ in range(3):
+            if arena_leased_after <= arena_leased_before:
+                break
+            time.sleep(0.2)
+            arena_leased_after = _arena_leased_bytes()
+        snap["shm"]["arena_leased_bytes"] = {
+            "before_probe": arena_leased_before,
+            "after_probe": arena_leased_after,
+        }
         snap["anomalies"] = _anomalies(
             snap, churn_threshold_ops_s, skew_warn_ms)
         return snap
@@ -503,6 +566,18 @@ def render_summary(snap: Dict[str, Any]) -> str:
                 f"destroyed={row['destroyed']:.0f}")
         lines.append(
             f"  churn {dataplane.get('churn_ops_per_s', 0):.1f} ops/s")
+    for row in shm.get("arena") or []:
+        stats = row.get("stats")
+        if not stats:
+            continue
+        hit_rate = stats.get("hit_rate")
+        cache = row.get("registration_cache") or {}
+        lines.append(
+            f"  arena  regions={stats['regions']} "
+            f"leased={stats['leased_bytes']}B free={stats['free_bytes']}B "
+            f"hit_rate={'n/a' if hit_rate is None else f'{hit_rate:.0%}'} "
+            f"reg_cache={sum(len(v) for v in cache.values())} entries"
+            f"/{len(cache)} endpoints")
     inventory = shm.get("local_inventory") or []
     if inventory:
         lines.append(f"  local regions: "

@@ -12,7 +12,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .._tensor import InferInput, InferRequestedOutput
+from .._tensor import ArenaOutputsMixin, InferInput, InferRequestedOutput
 from ..utils import (
     RESERVED_REQUEST_PARAMETERS,
     InferenceServerException,
@@ -202,7 +202,7 @@ def build_infer_request(
     return request
 
 
-class InferResult:
+class InferResult(ArenaOutputsMixin):
     """The result of an inference over GRPC (decoded ModelInferResponse)."""
 
     def __init__(self, response: Dict[str, Any]):
@@ -261,6 +261,12 @@ class InferResult:
         datatype = out.get("datatype", "")
         oparams = out.get("parameters", {})
         if "shared_memory_region" in oparams:
+            lease = self._arena_lease_for(name)
+            if lease is not None:
+                # arena fast path: a zero-copy view over the leased slab,
+                # pinned by the lease (reading after its last release
+                # raises arena.ArenaLeaseReleased)
+                return lease.as_numpy(datatype, shape)
             return None
         if raw_index < len(self._raw):
             raw = self._raw[raw_index]

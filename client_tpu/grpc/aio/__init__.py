@@ -268,7 +268,7 @@ class InferenceServerClient(InferenceServerClientBase):
         await self._shm_call_async(
             "system", "unregister", self._call,
             "SystemSharedMemoryUnregister", {"name": name}, headers,
-            client_timeout)
+            client_timeout, region_name=name)
 
     async def _register_handle(self, method, name, raw_handle, device_id, byte_size, headers, client_timeout):
         if isinstance(raw_handle, str):
@@ -305,7 +305,7 @@ class InferenceServerClient(InferenceServerClientBase):
         await self._shm_call_async(
             "tpu", "unregister", self._call,
             "TpuSharedMemoryUnregister", {"name": name}, headers,
-            client_timeout)
+            client_timeout, region_name=name)
 
     async def update_log_settings(self, settings, headers=None, client_timeout=None):
         req: Dict[str, Any] = {"settings": {}}
@@ -362,7 +362,12 @@ class InferenceServerClient(InferenceServerClientBase):
         resilience=None,
     ) -> InferResult:
         span = self._obs_begin(self._FRONTEND, model_name)
+        actx = None
         try:
+            # arena data plane: promote staged binary inputs into leased
+            # slabs and ensure (cached) region registrations BEFORE the
+            # request is built, so it rides shm params
+            actx = await self._arena_bind_async(inputs, outputs)
             request = build_infer_request(
                 model_name, inputs, model_version, outputs, request_id,
                 sequence_id, sequence_start, sequence_end, priority, timeout, parameters,
@@ -383,10 +388,15 @@ class InferenceServerClient(InferenceServerClientBase):
                 t_deser = time.perf_counter_ns()
             result = InferResult(response)
             result._response_headers = metadata_sink
+            if actx is not None:
+                actx.finish(result)
         except BaseException as e:
             if span is not None:
                 self._telemetry.finish(span, error=e)
             raise
+        finally:
+            if actx is not None:
+                actx.settle()
         if span is not None:
             span.phase("deserialize", t_deser, time.perf_counter_ns())
             self._telemetry.finish(span)
@@ -424,6 +434,11 @@ class InferenceServerClient(InferenceServerClientBase):
         async def request_gen():
             async for kwargs in inputs_iterator:
                 enable_final = kwargs.pop("enable_empty_final_response", False)
+                # ensure-only arena binding per stream request (no
+                # promotion: the stream outlives each yielded request)
+                await self._arena_bind_async(
+                    kwargs.get("inputs") or (), kwargs.get("outputs"),
+                    promote=False)
                 req = build_infer_request(**kwargs)
                 if enable_final:
                     req.setdefault("parameters", {})[

@@ -521,7 +521,8 @@ class InferenceServerClient(InferenceServerClientBase):
             resp = self._post(path, b"", headers, query_params)
             raise_if_error(resp.status, resp.data)
 
-        self._shm_call(SHM_FAMILY_OF[family], "unregister", call)
+        self._shm_call(SHM_FAMILY_OF[family], "unregister", call,
+                       region_name=name)
 
     def get_cuda_shared_memory_status(self, region_name="", headers=None, query_params=None):
         return self._shm_status("cudasharedmemory", region_name, headers, query_params)
@@ -605,7 +606,12 @@ class InferenceServerClient(InferenceServerClientBase):
         span = self._obs_begin(self._FRONTEND, model_name)
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
+        actx = None
         try:
+            # arena data plane: promote staged binary inputs into leased
+            # slabs and ensure (cached) region registrations BEFORE the
+            # body is built, so the request rides shm params
+            actx = self._arena_bind(inputs, outputs)
             body, json_size = build_infer_body(
                 inputs,
                 outputs,
@@ -653,10 +659,17 @@ class InferenceServerClient(InferenceServerClientBase):
                 resp.data, int(header_length) if header_length is not None else None
             )
             result._response_headers = dict(resp.headers)  # e.g. endpoint-load-metrics
+            if actx is not None:
+                actx.finish(result)
         except BaseException as e:
             if span is not None:
                 self._telemetry.finish(span, error=e)
             raise
+        finally:
+            # response fully received: promoted input leases release and
+            # the inputs' wire staging is restored for reuse
+            if actx is not None:
+                actx.settle()
         timers.capture(RequestTimers.REQUEST_END)
         self._infer_stat.update(timers)
         if span is not None:

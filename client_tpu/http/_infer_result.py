@@ -12,6 +12,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from .._tensor import ArenaOutputsMixin
 from ..utils import (
     InferenceServerException,
     deserialize_bf16_tensor,
@@ -20,7 +21,7 @@ from ..utils import (
 )
 
 
-class InferResult:
+class InferResult(ArenaOutputsMixin):
     """The result of an inference request over HTTP."""
 
     def __init__(self, response_body: bytes, header_length: Optional[int] = None):
@@ -100,6 +101,12 @@ class InferResult:
         shape = output["shape"]
         params = output.get("parameters", {})
         if "shared_memory_region" in params:
+            lease = self._arena_lease_for(name)
+            if lease is not None:
+                # arena fast path: a zero-copy view over the leased slab,
+                # pinned by the lease (reading after its last release
+                # raises arena.ArenaLeaseReleased)
+                return lease.as_numpy(datatype, shape)
             return None  # contents live in the shared-memory region
         if name in self._offsets:
             start, end = self._offsets[name]
@@ -130,7 +137,8 @@ class InferResult:
 
     def as_numpy(self, name: str) -> Optional[np.ndarray]:
         """Decode output ``name`` as a numpy array (zero-copy for fixed-width
-        binary outputs); None if the output lives in shared memory."""
+        binary outputs AND for arena-leased shared-memory outputs); None if
+        the output lives in a non-arena shared-memory region."""
         output = self.get_output(name)
         if output is None:
             return None

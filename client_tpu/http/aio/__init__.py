@@ -287,7 +287,8 @@ class InferenceServerClient(InferenceServerClientBase):
             await self._post_json(
                 path + "/unregister", b"", headers, query_params)
 
-        await self._shm_call_async(SHM_FAMILY_OF[family], "unregister", call)
+        await self._shm_call_async(SHM_FAMILY_OF[family], "unregister", call,
+                                   region_name=name)
 
     async def get_system_shared_memory_status(self, region_name="", headers=None, query_params=None):
         return await self._shm_status("systemsharedmemory", region_name, headers, query_params)
@@ -363,7 +364,12 @@ class InferenceServerClient(InferenceServerClientBase):
         resilience=None,
     ) -> InferResult:
         span = self._obs_begin(self._FRONTEND, model_name)
+        actx = None
         try:
+            # arena data plane: promote staged binary inputs into leased
+            # slabs and ensure (cached) region registrations BEFORE the
+            # body is built, so the request rides shm params
+            actx = await self._arena_bind_async(inputs, outputs)
             body, json_size = build_infer_body(
                 inputs, outputs, request_id, sequence_id, sequence_start,
                 sequence_end, priority, timeout, parameters,
@@ -397,10 +403,15 @@ class InferenceServerClient(InferenceServerClientBase):
                 data, int(header_length) if header_length is not None else None
             )
             result._response_headers = resp_headers  # e.g. endpoint-load-metrics
+            if actx is not None:
+                actx.finish(result)
         except BaseException as e:
             if span is not None:
                 self._telemetry.finish(span, error=e)
             raise
+        finally:
+            if actx is not None:
+                actx.settle()
         if span is not None:
             span.phase("deserialize", t_deser, time.perf_counter_ns())
             self._telemetry.finish(span)

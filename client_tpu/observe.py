@@ -617,9 +617,30 @@ class DataPlaneRecorder:
             "client_tpu_shm_rpcs_total",
             "shm register/unregister RPCs by outcome",
             ("frontend", "family", "op", "outcome"))
+        # arena accounting (client_tpu.arena): slab lease hit/miss, leased/
+        # free bytes per size class, and registration-cache outcomes —
+        # cached-vs-issued is THE number proving registration RPCs/req -> 0
+        self.arena_leases = reg.counter(
+            "client_tpu_arena_leases_total",
+            "Arena slab leases (hit = served from a free slab; "
+            "miss = a new region was carved)",
+            ("family", "class", "outcome"))
+        self.arena_bytes = reg.gauge(
+            "client_tpu_arena_bytes",
+            "Arena bytes by size class and state (leased/free)",
+            ("family", "class", "state"))
+        self.arena_registrations = reg.counter(
+            "client_tpu_arena_registrations_total",
+            "Arena registration-cache outcomes "
+            "(issued = RPC sent; cached = served without network; "
+            "invalidated = entry dropped on ejection/unregister)",
+            ("outcome",))
         self._families = {f: _FamilyBinding(self, f) for f in SHM_FAMILIES}
         # (frontend, family, op, ok) -> (histogram series, counter series)
         self._rpc_cache: Dict[Tuple[str, str, str, bool], Tuple[Any, Any]] = {}
+        # (family, class) -> (hit ctr, miss ctr, leased gauge, free gauge)
+        self._arena_cache: Dict[Tuple[str, int], Tuple[Any, Any, Any, Any]] = {}
+        self._arena_reg_cache: Dict[str, Any] = {}
         # handle identity -> recorded nbytes, for regions whose create/
         # attach THIS recorder saw (destroys of older regions skip the
         # residency decrement instead of stealing it from live ones)
@@ -691,6 +712,60 @@ class DataPlaneRecorder:
             hist._observe(seconds)
             counter.value += 1
 
+    # -- arena ops (fed by client_tpu.arena; one lock acquire each) ----------
+    def _arena_series(self, family: str, class_bytes: int):
+        key = (family, class_bytes)
+        cached = self._arena_cache.get(key)
+        if cached is None:
+            label = str(class_bytes)
+            made = (self.arena_leases.labels(family, label, "hit"),
+                    self.arena_leases.labels(family, label, "miss"),
+                    self.arena_bytes.labels(family, label, "leased"),
+                    self.arena_bytes.labels(family, label, "free"))
+            # insert under the registry lock: snapshot() iterates this dict
+            # under the same lock, so a first lease of a new class must not
+            # mutate it mid-iteration (labels() manages its own locking and
+            # is called before the acquire — never nested)
+            with self._lock:
+                cached = self._arena_cache.setdefault(key, made)
+        return cached
+
+    def on_arena_lease(self, family: str, class_bytes: int, hit: bool) -> None:
+        hit_c, miss_c, leased_g, free_g = self._arena_series(family, class_bytes)
+        with self._lock:
+            (hit_c if hit else miss_c).value += 1
+            leased_g.value += class_bytes
+            free_g.value = max(free_g.value - class_bytes, 0)
+
+    def on_arena_release(self, family: str, class_bytes: int) -> None:
+        _, _, leased_g, free_g = self._arena_series(family, class_bytes)
+        with self._lock:
+            leased_g.value = max(leased_g.value - class_bytes, 0)
+            free_g.value += class_bytes
+
+    def on_arena_carve(self, family: str, class_bytes: int,
+                       slab_count: int) -> None:
+        """A new region was carved into ``slab_count`` free slabs."""
+        _, _, _, free_g = self._arena_series(family, class_bytes)
+        with self._lock:
+            free_g.value += class_bytes * slab_count
+
+    def on_arena_trim(self, family: str, class_bytes: int,
+                      slab_count: int) -> None:
+        """A fully-free region was destroyed (its slabs leave the pool)."""
+        _, _, _, free_g = self._arena_series(family, class_bytes)
+        with self._lock:
+            free_g.value = max(free_g.value - class_bytes * slab_count, 0)
+
+    def on_arena_registration(self, outcome: str) -> None:
+        series = self._arena_reg_cache.get(outcome)
+        if series is None:
+            made = self.arena_registrations.labels(outcome)
+            with self._lock:
+                series = self._arena_reg_cache.setdefault(outcome, made)
+        with self._lock:
+            series.value += 1
+
     # -- read side -----------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """JSON-ready per-family accounting + RPC totals + churn rate."""
@@ -719,8 +794,20 @@ class DataPlaneRecorder:
                 label = f"{family}.{op}.{outcome}"
                 rpcs[label] = rpcs.get(label, 0.0) + series.value
                 total_ops += series.value
+            arena: Dict[str, Any] = {
+                "leases": {}, "bytes": {}, "registrations": {}}
+            for (family, class_bytes), (hit_c, miss_c, leased_g, free_g) \
+                    in self._arena_cache.items():
+                arena["leases"][f"{family}.{class_bytes}"] = {
+                    "hits": hit_c.value, "misses": miss_c.value}
+                arena["bytes"][f"{family}.{class_bytes}"] = {
+                    "leased": leased_g.value, "free": free_g.value}
+            for outcome, series in self._arena_reg_cache.items():
+                arena["registrations"][outcome] = series.value
         out["families"] = families
         out["rpcs"] = rpcs
+        if arena["leases"] or arena["registrations"]:
+            out["arena"] = arena
         out["churn_ops_per_s"] = round(total_ops / elapsed, 3)
         return out
 

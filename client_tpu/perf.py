@@ -153,6 +153,13 @@ class PerfRunner:
         self.batch_window_us = batch_window_us
         self.batch_max = batch_max
         self._telemetry = None  # fresh per measurement run (see run())
+        # one ShmArena per runner (created lazily on the first shm-mode
+        # worker setup): slabs and cached registrations survive across
+        # workers AND runs, so a sweep's steady state pays zero region
+        # create/destroy and zero registration RPCs per request
+        self._arena = None
+        self._arena_lock = threading.Lock()
+        self._arena_before = None
         self._proxy = None
         if generate_stream:
             # one streamed generation per "request": each worker iteration
@@ -370,48 +377,83 @@ class PerfRunner:
         finally:
             client.close()
 
-    def _make_shm_outputs(self, client, worker_id, family):
-        """Create+register output regions; returns (outputs, cleanup)."""
+    def _run_arena(self):
+        """The runner's lazily-created ShmArena (uuid-keyed regions, so
+        concurrent runs on one host can never collide on fixed names).
+        Lock-guarded: every worker thread sets up concurrently and all of
+        them must share ONE arena."""
+        with self._arena_lock:
+            if self._arena is None:
+                from .arena import ShmArena
+
+                family = "tpu" if (self.shared_memory == "tpu"
+                                   or self.protocol.startswith("native")) \
+                    else "system"
+                self._arena = ShmArena(default_family=family, colocated=True)
+            return self._arena
+
+    def _shm_worker_setup(self, client, worker_id, family=None):
+        """ONE shared setup path for every shm mode (system / tpu / native):
+        leases input+output slabs from the runner's arena, writes each
+        payload once, and lets the (cached) registration machinery issue
+        the register RPC only on a region's first use per endpoint — this
+        replaces the five per-use-site create/register/destroy blocks this
+        file used to carry. Returns (inputs, outputs_or_None, cleanup)."""
+        from .utils import serialized_byte_size
+
+        family = family or self.shared_memory
+        native = self.protocol in ("native", "native-grpc")
+        arena = self._run_arena()
         mod = self._client_mod
-        regions = []
-        outputs = []
-        if family == "system":
-            import client_tpu.utils.shared_memory as shm
-
-            for name, nbytes in self._output_sizes.items():
-                rname = f"perf_{worker_id}_out_{name}"
-                region = shm.create_shared_memory_region(rname, f"/{rname}", nbytes)
-                client.register_system_shared_memory(rname, f"/{rname}", nbytes)
-                out = mod.InferRequestedOutput(name)
-                out.set_shared_memory(rname, nbytes)
-                regions.append((rname, region, shm.destroy_shared_memory_region,
-                                client.unregister_system_shared_memory))
-                outputs.append(out)
-        else:
-            import client_tpu.utils.tpu_shared_memory as tpushm
-
-            for name, nbytes in self._output_sizes.items():
-                region = tpushm.create_shared_memory_region(
-                    f"perf_{worker_id}_out_{name}", nbytes, colocated=True
-                )
-                client.register_tpu_shared_memory(
-                    region.name, tpushm.get_raw_handle(region), 0, nbytes
-                )
-                out = mod.InferRequestedOutput(name)
-                out.set_shared_memory(region.name, nbytes)
-                regions.append((region.name, region, tpushm.destroy_shared_memory_region,
-                                client.unregister_tpu_shared_memory))
-                outputs.append(out)
+        leases = []
 
         def cleanup():
-            for rname, region, destroy, unregister in regions:
+            for lease in leases:
                 try:
-                    unregister(rname)
+                    lease.release()
                 except Exception:
                     pass
-                destroy(region)
 
-        return outputs or None, cleanup
+        try:
+            inputs = []
+            for name, datatype, shape, data in self._tensors:
+                nbytes = (serialized_byte_size(data)
+                          if datatype == "BYTES" else data.nbytes)
+                lease = arena.lease(nbytes, family=family)
+                leases.append(lease)
+                if family == "tpu" and datatype != "BYTES":
+                    import jax
+
+                    dev = jax.device_put(data)
+                    dev.block_until_ready()
+                    lease.write_jax(dev)
+                else:
+                    lease.write_numpy(data)
+                if native:
+                    arena.ensure_registered(client, lease._region)
+                    inputs.append((name, ("shm", lease.region_name, nbytes,
+                                          lease.offset, datatype, shape)))
+                else:
+                    # bind_input attaches the lease, so infer() ensures the
+                    # (cached) registration against whichever endpoint the
+                    # request actually lands on
+                    inputs.append(lease.bind_input(
+                        mod.InferInput(name, shape, datatype)))
+            outputs = []
+            for name, nbytes in self._output_sizes.items():
+                lease = arena.lease(nbytes, family=family)
+                leases.append(lease)
+                if native:
+                    arena.ensure_registered(client, lease._region)
+                    outputs.append((name, ("shm", lease.region_name,
+                                           lease.byte_size, lease.offset)))
+                else:
+                    outputs.append(lease.bind_output(
+                        mod.InferRequestedOutput(name)))
+        except Exception:
+            cleanup()
+            raise
+        return inputs, outputs or None, cleanup
 
     # -- one worker --------------------------------------------------------
     def _worker_setup(self, client, worker_id):
@@ -419,8 +461,6 @@ class PerfRunner:
         (concurrency) and open-loop (request-rate) workers.
 
         Returns (client, inputs, outputs, shm_cleanup, own_client)."""
-        from .utils import serialized_byte_size
-
         mod = self._client_mod
         shm_ctx = None
         own_client = None
@@ -447,77 +487,9 @@ class PerfRunner:
                 # here or the native socket/handle leaks per failed worker
                 own_client.close()
                 raise
-        elif self.shared_memory == "system":
-            import client_tpu.utils.shared_memory as shm
-
-            regions = []
-            inputs = []
-            for name, datatype, shape, data in self._tensors:
-                nbytes = serialized_byte_size(data) if datatype == "BYTES" else data.nbytes
-                rname = f"perf_{worker_id}_{name}"
-                region = shm.create_shared_memory_region(rname, f"/{rname}", nbytes)
-                shm.set_shared_memory_region(region, [data])
-                client.register_system_shared_memory(rname, f"/{rname}", nbytes)
-                inp = mod.InferInput(name, shape, datatype)
-                inp.set_shared_memory(rname, nbytes)
-                regions.append((rname, region))
-                inputs.append(inp)
-
-            outputs, out_cleanup = self._make_shm_outputs(client, worker_id, "system")
-
-            def cleanup():
-                for rname, region in regions:
-                    try:
-                        client.unregister_system_shared_memory(rname)
-                    except Exception:
-                        pass
-                    shm.destroy_shared_memory_region(region)
-                out_cleanup()
-
-            shm_ctx = cleanup
-        elif self.shared_memory == "tpu":
-            import jax
-
-            import client_tpu.utils.tpu_shared_memory as tpushm
-
-            regions = []
-            inputs = []
-            for name, datatype, shape, data in self._tensors:
-                if datatype == "BYTES":
-                    nbytes = serialized_byte_size(data)
-                    region = tpushm.create_shared_memory_region(
-                        f"perf_{worker_id}_{name}", nbytes
-                    )
-                    tpushm.set_shared_memory_region(region, [data])
-                else:
-                    nbytes = data.nbytes
-                    region = tpushm.create_shared_memory_region(
-                        f"perf_{worker_id}_{name}", nbytes, colocated=True
-                    )
-                    dev = jax.device_put(data)
-                    dev.block_until_ready()
-                    tpushm.set_shared_memory_region_from_jax(region, dev)
-                rname = region.name
-                client.register_tpu_shared_memory(
-                    rname, tpushm.get_raw_handle(region), 0, nbytes
-                )
-                inp = mod.InferInput(name, shape, datatype)
-                inp.set_shared_memory(rname, nbytes)
-                regions.append((rname, region))
-                inputs.append(inp)
-
-            outputs, out_cleanup = self._make_shm_outputs(client, worker_id, "tpu")
-
-            def cleanup():
-                for rname, region in regions:
-                    try:
-                        client.unregister_tpu_shared_memory(rname)
-                    except Exception:
-                        pass
-                    tpushm.destroy_shared_memory_region(region)
-                out_cleanup()
-
-            shm_ctx = cleanup
+        elif self.shared_memory in ("system", "tpu"):
+            inputs, outputs, shm_ctx = self._shm_worker_setup(
+                client, worker_id)
         else:
             outputs = None
             inputs = []
@@ -641,63 +613,12 @@ class PerfRunner:
         client.infer(self.model_name, inputs, outputs=outputs)
 
     def _native_worker_setup(self, client, worker_id):
-        """(inputs, outputs, cleanup) for the native protocol's worker."""
-        from .utils import serialized_byte_size
-
+        """(inputs, outputs, cleanup) for the native protocol's worker —
+        shm mode rides the same arena helper as the python frontends."""
         if self.shared_memory == "none":
             inputs = [(name, data) for name, _, _, data in self._tensors]
             return inputs, None, None
-        import jax
-
-        import client_tpu.utils.tpu_shared_memory as tpushm
-
-        regions = []
-
-        def cleanup():
-            for region in regions:
-                try:
-                    client.unregister_shared_memory("tpu", region.name)
-                except Exception:
-                    pass
-                tpushm.destroy_shared_memory_region(region)
-
-        inputs = []
-        try:
-            for name, datatype, shape, data in self._tensors:
-                nbytes = serialized_byte_size(data) if datatype == "BYTES" else data.nbytes
-                region = tpushm.create_shared_memory_region(
-                    f"perfn_{worker_id}_{name}", nbytes,
-                    colocated=(datatype != "BYTES"),
-                )
-                regions.append(region)
-                if datatype == "BYTES":
-                    tpushm.set_shared_memory_region(region, [data])
-                else:
-                    dev = jax.device_put(data)
-                    dev.block_until_ready()
-                    tpushm.set_shared_memory_region_from_jax(region, dev)
-                client.register_tpu_shared_memory(
-                    region.name, tpushm.get_raw_handle(region), 0, nbytes
-                )
-                inputs.append(
-                    (name, ("shm", region.name, nbytes, 0, datatype, shape))
-                )
-            outputs = []
-            for name, nbytes in self._output_sizes.items():
-                region = tpushm.create_shared_memory_region(
-                    f"perfn_{worker_id}_out_{name}", nbytes, colocated=True
-                )
-                regions.append(region)
-                client.register_tpu_shared_memory(
-                    region.name, tpushm.get_raw_handle(region), 0, nbytes
-                )
-                outputs.append((name, ("shm", region.name, nbytes, 0)))
-        except Exception:
-            # release anything created/registered so a retry can reuse names
-            cleanup()
-            raise
-
-        return inputs, outputs or None, cleanup
+        return self._shm_worker_setup(client, worker_id, family="tpu")
 
     def _arm_telemetry(self, measurement_requests: int):
         """A fresh Telemetry per measurement run (sample=always, ring sized
@@ -720,6 +641,11 @@ class PerfRunner:
             return None, None, False
         from . import observe
 
+        # arena hit-rate baseline for this run's client_shm row (the arena
+        # itself is cumulative across a sweep's runs — that reuse IS the
+        # point — so the row reports deltas)
+        self._arena_before = (self._arena.stats()
+                              if self._arena is not None else None)
         recorder = observe.dataplane()
         if recorder is not None:
             return recorder, recorder.snapshot(), False
@@ -765,6 +691,32 @@ class PerfRunner:
                            if after_fam["bytes_peak"]
                            > before_fam["bytes_peak"] else None),
         }
+        if self._arena is not None:
+            astats = self._arena.stats()
+            abefore = self._arena_before or {}
+
+            def adelta(key: str) -> int:
+                return int(astats[key] - abefore.get(key, 0))
+
+            leases = adelta("leases")
+            reg_issued = adelta("registrations_issued")
+            reg_cached = adelta("registrations_cached")
+            result["client_shm"]["arena"] = {
+                "leases": leases,
+                "hits": adelta("hits"),
+                "misses": adelta("misses"),
+                # a warm sweep's later runs should approach 1.0: slabs and
+                # registrations outlive the run that created them
+                "hit_rate": (round(adelta("hits") / leases, 4)
+                             if leases else None),
+                "registrations_issued": reg_issued,
+                "registrations_cached": reg_cached,
+                "registration_cache_hit_rate": (
+                    round(reg_cached / (reg_cached + reg_issued), 4)
+                    if (reg_cached + reg_issued) else None),
+                "leased_bytes": astats["leased_bytes"],
+                "regions": astats["regions"],
+            }
         return result
 
     @staticmethod

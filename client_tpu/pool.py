@@ -482,6 +482,25 @@ def _default_client_factory(protocol: str, aio: bool):
     return mod.InferenceServerClient
 
 
+def _arena_event_observer(arena, chain=None):
+    """Chainable pool observer invalidating the arena's cached shm
+    registrations whenever a replica is ejected or probed unhealthy (it
+    may have restarted and dropped its server-side registrations)."""
+
+    def observer(event: PoolEvent) -> None:
+        if isinstance(event, EndpointEjected) or (
+                isinstance(event, EndpointHealthChanged)
+                and not event.healthy):
+            try:
+                arena.invalidate_endpoint(event.url)
+            except Exception:
+                pass  # an observer must never break the data path
+        if chain is not None:
+            chain(event)
+
+    return observer
+
+
 class _PoolClientBase:
     """Construction + bookkeeping shared by the sync and asyncio wrappers."""
 
@@ -512,6 +531,7 @@ class _PoolClientBase:
         on_event: Optional[Callable[[PoolEvent], None]] = None,
         clock: Callable[[], float] = time.monotonic,
         telemetry=None,
+        shm_arena=None,
     ):
         """``urls``: N ``host:port`` replica addresses. ``client_factory``
         overrides the per-endpoint client constructor (receives the url);
@@ -545,6 +565,18 @@ class _PoolClientBase:
         if breaker_factory is None:
             breaker_factory = CircuitBreaker
         self._telemetry = telemetry
+        if shm_arena is True:
+            from .arena import default_arena
+
+            shm_arena = default_arena()
+        self._shm_arena = shm_arena
+        if shm_arena is not None:
+            # ejection means the replica was failing (it may have restarted
+            # and lost its server-side shm registrations): drop the arena's
+            # cached registrations for that url so the next use re-issues
+            # the RPC instead of pointing the server at a region it no
+            # longer holds
+            on_event = _arena_event_observer(shm_arena, chain=on_event)
         if telemetry is not None:
             # count every typed pool event exactly once, then forward to
             # the caller's observer (if any)
@@ -563,6 +595,12 @@ class _PoolClientBase:
                 if telemetry is not None and hasattr(
                         client, "configure_telemetry"):
                     client.configure_telemetry(telemetry)
+                if shm_arena is not None and hasattr(
+                        client, "configure_arena"):
+                    # each endpoint client carries the SAME arena: one slab
+                    # write serves every replica, and registrations cache
+                    # per (endpoint url, region)
+                    client.configure_arena(shm_arena)
                 endpoints.append(EndpointState(url, client, policy, weight))
         except Exception:
             self._abandon(endpoints)
@@ -654,6 +692,15 @@ class _PoolClientBase:
 
     def telemetry(self):
         return self._telemetry
+
+    def configure_arena(self, arena):
+        raise InferenceServerException(
+            "PoolClient wires the shm arena through every endpoint (and its "
+            "ejection-invalidation hook) at construction; pass shm_arena= "
+            "to the pool constructor instead")
+
+    def arena(self):
+        return self._shm_arena
 
     @property
     def _FRONTEND(self) -> str:

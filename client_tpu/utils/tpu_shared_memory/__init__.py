@@ -139,6 +139,14 @@ class TpuSharedMemoryRegion:
             )
         return self._shm.buf
 
+    def host_buffer(self) -> memoryview:
+        """The raw mapped host window (public twin of the system regions'
+        ``buf()``). NOTE: does NOT flush cached device entries — callers
+        slicing sub-ranges (the arena's slab views) flush via
+        :meth:`read_host`/``_flush_overlapping`` first, or use
+        :meth:`read_host` for a coherent view."""
+        return self._host_buf()
+
     def _check(self, nbytes: int, offset: int, op: str) -> None:
         if offset < 0 or nbytes < 0 or offset + nbytes > self._byte_size:
             raise SharedMemoryException(

@@ -1,0 +1,303 @@
+"""Generate BENCH_ARENA.json: the pooled-shm-arena cost-model artifact.
+
+The A/B the arena exists for, answered against a live in-process server:
+
+1. **Per-use-site baseline** — the pre-arena data plane: every request
+   creates its input/output regions, registers them, infers, unregisters
+   and destroys them (exactly what perf.py's five copy-pasted blocks and
+   bench.py used to do). Counters prove the churn: ~2 region creates and
+   ~2 registration RPCs per request.
+2. **Arena steady state** — the same workload through ``configure_arena``:
+   after a short warmup the measured window must show region
+   create/destroy ops == 0 and registration RPCs == 0 while map ops keep
+   growing (requests ARE flowing), with p50 no worse than the baseline.
+3. **64-caller size sweep** — concurrency 64 over payloads from 4 KiB to
+   4 MiB through the arena path: the size-invariance claim (CHIP_BENCH's
+   flat p50) restated under high concurrency on the shm data plane.
+
+``--check`` re-validates an existing artifact's acceptance invariants and
+exits non-zero on violation (wired in CI next to the capacity gate via
+tests/test_arena.py::test_bench_arena_artifact_claims).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_arena.py [-o BENCH_ARENA.json]
+    JAX_PLATFORMS=cpu python tools/bench_arena.py --check BENCH_ARENA.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import sys
+import threading
+import time
+import uuid
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _stats(times_s):
+    times = sorted(times_s)
+
+    def pct(q):
+        return round(times[min(int(len(times) * q), len(times) - 1)] * 1e3, 4)
+
+    return {"p50_ms": pct(0.50), "p90_ms": pct(0.90), "p99_ms": pct(0.99),
+            "mean_ms": round(sum(times) / len(times) * 1e3, 4),
+            "requests": len(times)}
+
+
+def _rpc_total(snap, op):
+    return sum(v for k, v in snap["rpcs"].items()
+               if k.endswith(f".{op}.ok"))
+
+
+def bench_per_use_site(client, httpclient, shm, x, requests):
+    """One request = the full create/register/infer/unregister/destroy
+    lifecycle, per use-site — the churn the arena amortizes away."""
+    from client_tpu import observe
+
+    recorder = observe.dataplane()
+    before = recorder.snapshot()
+    nbytes = x.nbytes
+    times = []
+    for _ in range(requests):
+        t0 = time.perf_counter()
+        name_in = f"abench_in_{uuid.uuid4().hex[:8]}"
+        name_out = f"abench_out_{uuid.uuid4().hex[:8]}"
+        rin = shm.create_shared_memory_region(name_in, f"/{name_in}", nbytes)
+        rout = shm.create_shared_memory_region(name_out, f"/{name_out}", nbytes)
+        try:
+            shm.set_shared_memory_region(rin, [x])
+            client.register_system_shared_memory(name_in, f"/{name_in}", nbytes)
+            client.register_system_shared_memory(name_out, f"/{name_out}", nbytes)
+            inp = httpclient.InferInput("INPUT0", list(x.shape), "FP32")
+            inp.set_shared_memory(name_in, nbytes)
+            out = httpclient.InferRequestedOutput("OUTPUT0")
+            out.set_shared_memory(name_out, nbytes)
+            client.infer("identity_fp32", [inp], outputs=[out])
+            shm.get_contents_as_numpy(rout, np.float32, list(x.shape))
+            client.unregister_system_shared_memory(name_in)
+            client.unregister_system_shared_memory(name_out)
+        finally:
+            shm.destroy_shared_memory_region(rin)
+            shm.destroy_shared_memory_region(rout)
+        times.append(time.perf_counter() - t0)
+    after = recorder.snapshot()
+    fam = after["families"]["system"]
+    fam0 = before["families"]["system"]
+    row = _stats(times)
+    row["regions_created_per_request"] = round(
+        (fam["created"] - fam0["created"]) / requests, 3)
+    row["regions_destroyed_per_request"] = round(
+        (fam["destroyed"] - fam0["destroyed"]) / requests, 3)
+    row["registration_rpcs_per_request"] = round(
+        (_rpc_total(after, "register") - _rpc_total(before, "register"))
+        / requests, 3)
+    return row
+
+
+def bench_arena(client, httpclient, arena, x, requests, warmup=30):
+    """One request = stage into a lease (transparent promotion), infer with
+    an arena-leased output, read the zero-copy view, release."""
+    from client_tpu import observe
+
+    recorder = observe.dataplane()
+    client.configure_arena(arena)
+
+    def step():
+        inp = httpclient.InferInput("INPUT0", list(x.shape), "FP32")
+        inp.set_data_from_numpy(x, arena=arena)
+        out = arena.request_output("OUTPUT0", x.nbytes)
+        result = client.infer("identity_fp32", [inp], outputs=[out])
+        view = result.as_numpy("OUTPUT0")
+        assert view.shape == x.shape
+        result.release_arena()
+        inp.release_arena_lease()
+
+    for _ in range(warmup):
+        step()
+    before = recorder.snapshot()
+    astats_before = arena.stats()
+    times = []
+    for _ in range(requests):
+        t0 = time.perf_counter()
+        step()
+        times.append(time.perf_counter() - t0)
+    after = recorder.snapshot()
+    astats = arena.stats()
+    fam = after["families"]["system"]
+    fam0 = before["families"]["system"]
+    row = _stats(times)
+    leases = astats["leases"] - astats_before["leases"]
+    row["steady_state"] = {
+        "requests": requests,
+        # THE acceptance numbers: zero region churn, zero registration
+        # RPCs over the whole measured window
+        "regions_created": int(fam["created"] - fam0["created"]),
+        "regions_destroyed": int(fam["destroyed"] - fam0["destroyed"]),
+        "registration_rpcs": int(
+            _rpc_total(after, "register") - _rpc_total(before, "register")),
+        # ...while map ops keep growing (requests really flowed via shm)
+        "map_writes": int(fam["map_writes"] - fam0["map_writes"]),
+        "map_reads": int(fam["map_reads"] - fam0["map_reads"]),
+        "lease_hit_rate": round(
+            (astats["hits"] - astats_before["hits"]) / leases, 4),
+        "registrations_cached": int(astats["registrations_cached"]
+                                    - astats_before["registrations_cached"]),
+    }
+    row["residual_leased_bytes"] = arena.stats()["leased_bytes"]
+    return row
+
+
+def bench_concurrency(url, httpclient, arena, nbytes, callers=64,
+                      iters_per_caller=8):
+    """64 callers, each re-staging its tensor into the arena per request
+    (lease -> write once -> infer -> zero-copy read -> release)."""
+    x = np.zeros((1, nbytes // 4), dtype=np.float32)
+    times = []
+    times_lock = threading.Lock()
+    errors = []
+    barrier = threading.Barrier(callers, timeout=60)
+
+    def worker():
+        try:
+            client = httpclient.InferenceServerClient(url, concurrency=1)
+            client.configure_arena(arena)
+            barrier.wait()
+            local = []
+            for _ in range(iters_per_caller):
+                t0 = time.perf_counter()
+                inp = httpclient.InferInput("INPUT0", list(x.shape), "FP32")
+                inp.set_data_from_numpy(x, arena=arena)
+                out = arena.request_output("OUTPUT0", x.nbytes)
+                result = client.infer("identity_fp32", [inp], outputs=[out])
+                assert result.as_numpy("OUTPUT0").shape == x.shape
+                result.release_arena()
+                inp.release_arena_lease()
+                local.append(time.perf_counter() - t0)
+            client.close()
+            with times_lock:
+                times.extend(local)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=worker) for _ in range(callers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    if errors:
+        raise RuntimeError(f"concurrency arm failed: {errors[:3]}")
+    row = _stats(times)
+    row["callers"] = callers
+    row["payload_bytes"] = nbytes
+    return row
+
+
+def check(path: str) -> int:
+    data = json.loads(Path(path).read_text())
+    failures = []
+    steady = data["arena"]["steady_state"]
+    if steady["regions_created"] != 0 or steady["regions_destroyed"] != 0:
+        failures.append("steady-state region churn is not zero")
+    if steady["registration_rpcs"] != 0:
+        failures.append("steady-state registration RPCs are not zero")
+    if steady["map_writes"] <= 0:
+        failures.append("no map traffic in the steady-state window")
+    if data["arena"]["residual_leased_bytes"] != 0:
+        failures.append("leased bytes did not return to zero")
+    if data["arena"]["p50_ms"] > (data["per_use_site"]["p50_ms"]
+                                  + data["noise_floor_ms"]):
+        failures.append("arena p50 regressed past the per-use-site baseline")
+    for msg in failures:
+        print(f"CHECK FAILED: {msg}", file=sys.stderr)
+    if not failures:
+        print(f"{path}: all arena acceptance invariants hold")
+    return 1 if failures else 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_ARENA.json")
+    parser.add_argument("--requests", type=int, default=300)
+    parser.add_argument("--payload-bytes", type=int, default=256 * 1024)
+    parser.add_argument("--sweep-bytes", type=int, nargs="*",
+                        default=[4 * 1024, 256 * 1024, 4 * 1024 * 1024])
+    parser.add_argument("--callers", type=int, default=64)
+    parser.add_argument("--check", metavar="ARTIFACT",
+                        help="validate an existing artifact instead of "
+                             "benchmarking")
+    args = parser.parse_args()
+    if args.check:
+        return check(args.check)
+
+    import client_tpu.http as httpclient
+    import client_tpu.utils.shared_memory as shm
+    from client_tpu import observe
+    from client_tpu.arena import ShmArena
+    from client_tpu.models import default_model_zoo
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    observe.enable_dataplane()
+    x = np.zeros((1, args.payload_bytes // 4), dtype=np.float32)
+    out = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "payload_bytes": args.payload_bytes,
+        "note": (
+            "per-use-site create/register/destroy per request vs pooled "
+            "arena (size-class slabs, cached registrations); single-host "
+            "in-process threaded HTTP server, CPU container numbers"
+        ),
+    }
+    server = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    try:
+        client = httpclient.InferenceServerClient(server.url, concurrency=4)
+        arena = ShmArena()
+        try:
+            # noise floor: A/A of the arena arm (two identical short runs)
+            aa1 = bench_arena(client, httpclient, arena, x, args.requests // 2)
+            aa2 = bench_arena(client, httpclient, arena, x, args.requests // 2)
+            out["noise_floor_ms"] = round(
+                abs(aa1["p50_ms"] - aa2["p50_ms"]) + 0.02, 4)
+            out["per_use_site"] = bench_per_use_site(
+                client, httpclient, shm, x, args.requests)
+            out["arena"] = bench_arena(
+                client, httpclient, arena, x, args.requests)
+            sweep = {}
+            for nbytes in args.sweep_bytes:
+                sweep[str(nbytes)] = bench_concurrency(
+                    server.url, httpclient, arena, nbytes,
+                    callers=args.callers)
+            out["concurrency_sweep"] = {
+                "callers": args.callers, "by_payload_bytes": sweep,
+                "note": (
+                    "single-core CPU container: 64 callers share one core "
+                    "with the in-process server, so p50 tracks the "
+                    "server-side identity memcpy, not the client data "
+                    "plane; the steady-state A/B rows above are the "
+                    "size-independent client-side cost evidence (on TPU "
+                    "hardware CHIP_BENCH's ~0.8 ms p50 size-invariance is "
+                    "the matching number)"),
+            }
+            out["arena_stats_final"] = arena.stats()
+        finally:
+            client.close()
+            arena.close(force=True)
+    finally:
+        server.close()
+        observe.install_dataplane(None)
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

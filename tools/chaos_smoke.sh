@@ -21,14 +21,20 @@
 # trace-replay smoke (tests/test_trace_replay.py, replay_smoke marker)
 # replays a seeded mixed-kind trace (unary + SSE stream + sequence)
 # open-loop against the threaded server: every record must complete,
-# sequence steps in order, with SLO verdicts and slip reported.
+# sequence steps in order, with SLO verdicts and slip reported. The
+# shm-arena smoke (tests/test_arena.py, arena_smoke marker) runs the
+# transparent arena promotion path against retry resilience under a
+# flapping proxy: every request completes, no slab is double-leased,
+# leased bytes return to zero, and the registration cache keeps the
+# register RPCs amortized across the flaps.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
-    tests/test_dataplane_observe.py tests/test_trace_replay.py "$@"
+    tests/test_dataplane_observe.py tests/test_trace_replay.py \
+    tests/test_arena.py "$@"
