@@ -15,6 +15,13 @@ client libraries (triton-inference-server/client), designed TPU-first:
   frontends — active ready-probing + passive outlier ejection, routing
   policies with per-endpoint circuit breakers, shared-deadline failover
   (sequence requests are never silently re-sent), and hedged requests.
+- ``client_tpu.admission``: adaptive admission control — an AIMD /
+  gradient2-style concurrency limiter over observed latency, priority
+  lanes with deadline-aware LIFO shedding (typed ``AdmissionRejected``,
+  counted as shed-not-error everywhere), wired through the pool
+  (``PoolClient(admission=..., endpoint_limits=...)``) together with the
+  ``orca_weighted`` routing policy that feeds smooth-WRR weights from
+  the servers' ORCA load reports (docs/admission.md).
 - ``client_tpu.batch``: client-side adaptive micro-batching — an opt-in
   coalescing dispatcher (``BatchingClient``/``AioBatchingClient``, or
   ``.coalescing()`` on any frontend/pool) that stacks concurrent

@@ -10,9 +10,10 @@ from __future__ import annotations
 
 import abc
 import base64
+import contextvars
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from . import observe as _observe
 
@@ -44,6 +45,29 @@ def _any_arena_lease(inputs, outputs) -> bool:
         if getattr(out, "_arena_lease", None) is not None:
             return True
     return False
+
+
+# admission-queue phase handoff: the pool's admission gate runs BEFORE a
+# frontend's request span exists, so it stashes the wait interval in a
+# contextvar (thread- and task-local) and the next span begun on the same
+# thread/task claims it as an ``admission_queue`` phase. Consume-once, so
+# an admitted-then-errored call can never donate its wait to a later
+# request. (Hedged attempts run on executor threads that don't inherit
+# the caller's context — their spans simply skip the phase.)
+_ADMISSION_PHASE: contextvars.ContextVar = contextvars.ContextVar(
+    "client_tpu_admission_phase", default=None)
+
+
+def stash_admission_phase(start_ns: int, end_ns: int) -> None:
+    """Record an admission-queue wait for the next span on this context."""
+    _ADMISSION_PHASE.set((start_ns, end_ns))
+
+
+def consume_admission_phase() -> Optional[Tuple[int, int]]:
+    value = _ADMISSION_PHASE.get()
+    if value is not None:
+        _ADMISSION_PHASE.set(None)
+    return value
 
 
 def fold_infer_args(args, kwargs):
@@ -126,11 +150,17 @@ class InferenceServerClientBase:
 
     def _obs_begin(self, frontend: str, model: str):
         """A request span when telemetry is configured, else None — the
-        single hot-path gate all four frontends share."""
+        single hot-path gate all four frontends share. A pending
+        admission-queue wait stashed by the pool's admission gate is
+        claimed onto the new span as its first phase."""
         tel = self._telemetry
         if tel is None:
             return None
-        return tel.begin(frontend, model)
+        span = tel.begin(frontend, model)
+        pending = consume_admission_phase()
+        if pending is not None:
+            span.phase("admission_queue", pending[0], pending[1])
+        return span
 
     def _obs_begin_stream(self, frontend: str, model: str,
                           op: str = "generate_stream"):

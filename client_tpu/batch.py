@@ -65,7 +65,13 @@ Composition contract:
   non-sequence calls merge). A failed batch fans the SAME typed error out
   to every caller in it.
 - **Behind ``PoolClient``** — wrap the pool: each coalesced request is one
-  routing decision (one replica choice, one failover/hedge engine run).
+  routing decision (one replica choice, one failover/hedge engine run) —
+  and, with the pool's admission control armed (``client_tpu.admission``),
+  ONE admission decision: a coalesced batch admits once, and a shed batch
+  fans the same typed ``AdmissionRejected`` to every caller (counted as
+  ``shed_dispatches`` in :meth:`stats`, distinct from dispatch errors).
+  Requests with different ``priority`` values never share a key, so the
+  admission controller's lanes still see each caller's true priority.
 - **Telemetry** — with an ``observe.Telemetry`` configured (or adopted
   from the inner client), every caller gets its own ``RequestSpan`` with a
   ``coalesce_queue`` phase (enqueue -> claim) and an ``attempt`` phase
@@ -332,6 +338,7 @@ class _BatchingCore:
         self._solo = 0
         self._bypass = 0
         self._dispatch_errors = 0
+        self._shed_dispatches = 0
         self._recent_rows: deque = deque(maxlen=4096)
         self._last_window_us = 0.0
         # telemetry instruments: one (rows, dispatch, calls, errors,
@@ -417,6 +424,7 @@ class _BatchingCore:
                 "solo_calls": self._solo,
                 "bypass_calls": self._bypass,
                 "dispatch_errors": self._dispatch_errors,
+                "shed_dispatches": self._shed_dispatches,
                 "window_us": round(self._last_window_us, 1),
                 "batch_rows": {
                     "p50": sorted_percentile(rows, 0.5),
@@ -617,8 +625,17 @@ class _BatchingCore:
         if instruments is not None:
             instruments[2].labels(model, "bypass").inc()
 
+    @staticmethod
+    def _is_shed(error: Optional[BaseException]) -> bool:
+        """Was this dispatch shed by admission control?"""
+        from .admission import ADMISSION_REJECTED_STATUS
+
+        return (isinstance(error, InferenceServerException)
+                and error.status() == ADMISSION_REJECTED_STATUS)
+
     def _account_dispatch(self, state, batch: List[_PendingCall],
-                          total_rows: int, error: bool) -> None:
+                          total_rows: int, error: bool,
+                          shed: bool = False) -> None:
         n = len(batch)
         with self._stats_lock:
             self._dispatches += 1
@@ -627,7 +644,12 @@ class _BatchingCore:
                 self._solo += 1
             else:
                 self._coalesced += n
-            if error:
+            if shed:
+                # a shed batch is honest load-shedding, not a dispatch
+                # failure — accounted separately so error_rate math stays
+                # truthful under overload
+                self._shed_dispatches += 1
+            elif error:
                 self._dispatch_errors += 1
         instruments = self._instruments
         if instruments is not None:
@@ -636,7 +658,7 @@ class _BatchingCore:
             m_rows.labels(model).observe(total_rows)
             m_dispatch.labels(model).inc()
             m_calls.labels(model, "solo" if n == 1 else "coalesced").inc(n)
-            if error:
+            if error and not shed:
                 m_errors.labels(model).inc()
             m_window.labels(model).set(round(state.window_us, 1))
 
@@ -783,7 +805,8 @@ class BatchingClient(_BatchingCore):
         if error is None:
             self._note_service(state, t1 - t0)
         self._account_dispatch(state, batch, total_rows,
-                               error=error is not None)
+                               error=error is not None,
+                               shed=self._is_shed(error))
         self._finish_spans(batch, t0, t1, total_rows, error)
         if error is not None and not isinstance(error, Exception):
             raise error  # KeyboardInterrupt/SystemExit: don't swallow
@@ -916,7 +939,8 @@ class AioBatchingClient(_BatchingCore):
         if error is None:
             self._note_service(state, t1 - t0)
         self._account_dispatch(state, batch, total_rows,
-                               error=error is not None)
+                               error=error is not None,
+                               shed=self._is_shed(error))
         self._finish_spans(batch, t0, t1, total_rows, error)
         if error is not None and not isinstance(error, Exception):
             raise error

@@ -7,7 +7,11 @@ accounting, per-endpoint ORCA load, a client/server/network latency
 decomposition from a small probe load, and a clock-skew estimate from
 trace joins — emitted as a human-readable summary plus a JSON artifact,
 with anomaly flags (breaker open, SLO breach, shm churn above threshold,
-load/latency divergence, clock skew).
+load/latency divergence, clock skew, admission collapse). When the
+passed telemetry carries attached admission controllers
+(``PoolClient(admission=...)``), the snapshot gains an ``admission``
+section (limit/inflight/per-lane sheds) and an ``admission_collapse``
+anomaly fires when a limit is pinned at its floor while an SLO burns.
 
 CLI::
 
@@ -254,6 +258,21 @@ def _arena_leased_bytes() -> int:
     return total
 
 
+def _admission_status(tel: Telemetry) -> List[Dict[str, Any]]:
+    """One row per admission controller attached to the telemetry (the
+    pool wires its controller in at construction): limit, inflight,
+    per-lane queue depth and shed counts. Empty when nothing is armed."""
+    rows = []
+    for ctrl, scope in tel.admission_controllers():
+        try:
+            row = dict(ctrl.snapshot())
+        except Exception as e:
+            row = {"error": str(e)[:200]}
+        row["scope"] = scope
+        rows.append(row)
+    return rows
+
+
 def _slo_status(tel: Telemetry) -> List[Dict[str, Any]]:
     return [
         {
@@ -304,6 +323,21 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
             flags.append({
                 "flag": "slo_breached", "url": None,
                 "detail": f"{slo['name']}: burn {slo['burn_rate']:.2f}x"})
+    # admission collapse: the adaptive limit is pinned at its floor WHILE
+    # an SLO is burning — the limiter has given all it can and latency is
+    # still over target, i.e. the fleet is undersized (or a replica is
+    # sick), not merely bursty. A floor-pinned limit on a quiet, in-SLO
+    # fleet is just the idle state and is never flagged.
+    slo_burning = any(s.get("breached") for s in snap.get("slos", []))
+    for row in snap.get("admission", []) or []:
+        if row.get("collapsed") and slo_burning:
+            flags.append({
+                "flag": "admission_collapse", "url": None,
+                "detail": (f"scope {row.get('scope', 'pool')}: limit "
+                           f"{row.get('limit')} pinned at floor "
+                           f"{row.get('limiter', {}).get('min_limit')} "
+                           f"with an SLO burning "
+                           f"(shed_total={row.get('shed_total')})")})
     dataplane = snap.get("shm", {}).get("dataplane")
     if dataplane and churn_threshold_ops_s:
         # prefer the probe-window rate: the lifetime average of a
@@ -453,6 +487,7 @@ def collect_snapshot(
                 ep["url"]: ep["probe_latency_ms"]["avg"]
                 for ep in endpoints if "probe_latency_ms" in ep}),
             "slos": _slo_status(tel),
+            "admission": _admission_status(tel),
             "stream_windows": _registry_section(
                 registry_snapshot, "client_tpu_stream_window"),
             "batch": _registry_section(
@@ -540,6 +575,22 @@ def render_summary(snap: Dict[str, Any]) -> str:
                     f" network+client {row['network_client_overhead_ms']:.2f}"
                     f" ms (client total {row['client_request_ms']:.2f} ms)")
             lines.append("".join(parts))
+    admission = snap.get("admission") or []
+    if admission:
+        lines.append("")
+        lines.append("admission:")
+        for row in admission:
+            if "error" in row:
+                lines.append(f"  {row.get('scope', 'pool')}: {row['error']}")
+                continue
+            sheds = sum(
+                n for lane in row.get("lanes", {}).values()
+                for n in lane.get("shed", {}).values())
+            lines.append(
+                f"  {row.get('scope', 'pool'):<8} limit={row['limit']} "
+                f"inflight={row['inflight']} "
+                f"admitted={row['admitted_total']} shed={sheds}"
+                f"{'  COLLAPSED' if row.get('collapsed') else ''}")
     slos = snap.get("slos") or []
     if slos:
         lines.append("")

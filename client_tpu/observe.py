@@ -949,6 +949,9 @@ def parse_endpoint_load(value: Optional[str],
 # -- tracing ------------------------------------------------------------------
 # Canonical phase vocabulary (what each transport can observe of it):
 #   queue       time waiting for a worker/slot before the request is built
+#   admission_queue  time parked in the pool's admission controller
+#               (client_tpu.admission; acquire -> admit — stashed by the
+#               pool and claimed by the endpoint client's span)
 #   coalesce_queue  time parked in the micro-batching dispatcher's queue
 #               before the coalesced wire request was issued
 #               (client_tpu.batch; enqueue -> claim)
@@ -961,8 +964,8 @@ def parse_endpoint_load(value: Optional[str],
 #   deserialize response unmarshaling into InferResult
 #   attempt     one resilient attempt (sub-span; repeated under retries)
 REQUEST_PHASES = (
-    "queue", "coalesce_queue", "serialize", "connect", "send", "ttfb",
-    "recv", "deserialize", "attempt",
+    "queue", "admission_queue", "coalesce_queue", "serialize", "connect",
+    "send", "ttfb", "recv", "deserialize", "attempt",
 )
 
 
@@ -1705,6 +1708,29 @@ class Telemetry:
         self.hedge_losses_total = reg.counter(
             "client_tpu_hedge_losses_total",
             "Requests where the primary beat an in-flight hedge")
+        # -- admission control (client_tpu.admission) -------------------------
+        self.admission_shed_total = reg.counter(
+            "client_tpu_admission_shed_total",
+            "Requests shed by admission control, by priority lane and "
+            "shed reason (saturated/deadline/queue_full/queue_timeout/"
+            "endpoint_saturated)", ("lane", "reason"))
+        self.admission_admitted_total = reg.counter(
+            "client_tpu_admission_admitted_total",
+            "Requests admitted by admission control, by priority lane",
+            ("lane",))
+        self._admission_limit_gauge = reg.gauge(
+            "client_tpu_admission_limit",
+            "Live adaptive concurrency limit per attached controller",
+            ("scope",))
+        self._admission_inflight_gauge = reg.gauge(
+            "client_tpu_admission_inflight",
+            "In-flight requests holding an admission slot", ("scope",))
+        self._admission_queue_depth_gauge = reg.gauge(
+            "client_tpu_admission_queue_depth",
+            "Waiters parked in each priority lane's LIFO admission queue",
+            ("scope", "lane"))
+        self._admission_ctrls: List[Any] = []  # (weakref, scope) pairs
+        self._admission_collector_installed = False
         self._bindings: Dict[str, _FrontendBinding] = {}
         self._pools: List[Any] = []
         self._pools_lock = threading.Lock()
@@ -2190,6 +2216,78 @@ class Telemetry:
         if breaker is not None:
             breaker.on_transition = self.on_breaker_transition
         return policy
+
+    # -- admission bridge -----------------------------------------------------
+    def on_admission_admit(self, lane: str, waited_s: float) -> None:
+        self.admission_admitted_total.labels(lane).inc()
+
+    def on_admission_shed(self, lane: str, reason: str) -> None:
+        self.admission_shed_total.labels(lane, reason).inc()
+
+    def attach_admission(self, controller, scope: str = "pool") -> Any:
+        """Wire an ``admission.AdmissionController`` into this telemetry:
+        its sheds/admits feed ``client_tpu_admission_shed_total{lane,
+        reason}`` / ``..._admitted_total{lane}``, and the live limit,
+        in-flight count and per-lane queue depths export as gauges at
+        scrape time (held by weak reference, like pools). Returns the
+        controller for chaining."""
+        controller.observer = self
+        with self._pools_lock:
+            # disambiguate: two pools sharing one Telemetry must not
+            # export colliding {scope=...} gauges where the last-collected
+            # controller silently stands in for both
+            taken = {s for ref, s in self._admission_ctrls
+                     if ref() is not None}
+            if scope in taken:
+                n = 2
+                while f"{scope}#{n}" in taken:
+                    n += 1
+                scope = f"{scope}#{n}"
+            self._admission_ctrls.append((weakref.ref(controller), scope))
+            if not self._admission_collector_installed:
+                self._admission_collector_installed = True
+                self.registry.add_collector(self._collect_admission)
+        return controller
+
+    def admission_controllers(self) -> List[Any]:
+        """The live attached controllers (dead weakrefs skipped) —
+        doctor's admission section reads their snapshots."""
+        with self._pools_lock:
+            refs = list(self._admission_ctrls)
+        out = []
+        for ref, scope in refs:
+            ctrl = ref()
+            if ctrl is not None:
+                out.append((ctrl, scope))
+        return out
+
+    def _collect_admission(self) -> None:
+        dead = []
+        with self._pools_lock:
+            refs = list(self._admission_ctrls)
+        for entry in refs:
+            ref, scope = entry
+            ctrl = ref()
+            if ctrl is None:
+                dead.append(entry)
+                continue
+            try:
+                snap = ctrl.snapshot()
+            except Exception:
+                continue  # one sick controller must not break the scrape
+            self._admission_limit_gauge.labels(scope).set(snap["limit"])
+            self._admission_inflight_gauge.labels(scope).set(
+                snap["inflight"])
+            for lane, row in snap["lanes"].items():
+                self._admission_queue_depth_gauge.labels(scope, lane).set(
+                    row["depth"])
+        if dead:
+            with self._pools_lock:
+                for entry in dead:
+                    try:
+                        self._admission_ctrls.remove(entry)
+                    except ValueError:
+                        pass
 
     # -- pool bridge ---------------------------------------------------------
     def pool_observer(self, chain: Optional[Callable[[Any], None]] = None,

@@ -112,6 +112,12 @@ class PerfRunner:
         coalesce: bool = False,
         batch_window_us: Optional[float] = None,
         batch_max: int = 32,
+        routing: Optional[str] = None,
+        admission: bool = False,
+        admission_mode: str = "aimd",
+        admission_target_ms: Optional[float] = None,
+        admission_max_queue_wait_s: float = 0.05,
+        endpoint_limits: bool = False,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -152,6 +158,15 @@ class PerfRunner:
         self.coalesce = coalesce
         self.batch_window_us = batch_window_us
         self.batch_max = batch_max
+        self.routing = routing
+        self.admission = admission
+        self.admission_mode = admission_mode
+        self.admission_target_ms = admission_target_ms
+        self.admission_max_queue_wait_s = admission_max_queue_wait_s
+        self.endpoint_limits = endpoint_limits
+        # orca_weighted routing needs the frontends to OPT IN to the ORCA
+        # response header; every Telemetry this runner builds carries it
+        self._orca_format = "json" if routing == "orca_weighted" else None
         self._telemetry = None  # fresh per measurement run (see run())
         # one ShmArena per runner (created lazily on the first shm-mode
         # worker setup): slabs and cached registrations survive across
@@ -205,6 +220,10 @@ class PerfRunner:
                 "one ChaosProxy per replica instead (tools/bench_pool.py)")
         if self.hedge and not self.endpoints:
             raise ValueError("--hedge requires --endpoints")
+        if (routing or admission or endpoint_limits) and not self.endpoints:
+            raise ValueError(
+                "--routing/--admission/--endpoint-limits require "
+                "--endpoints: they are pool-level policies")
         if self.coalesce:
             if protocol not in ("http", "grpc"):
                 raise ValueError(
@@ -307,17 +326,35 @@ class PerfRunner:
             hedge = HedgePolicy(delay_s=self.hedge_delay_s)
         endpoint_retry = (
             RetryPolicy(max_attempts=self.retries + 1) if self.retries else None)
+        telemetry = self._telemetry
+        if self.routing == "orca_weighted" and telemetry is None:
+            # the pool can only route on loads somebody ingests: a quiet
+            # (sample=off) telemetry carries the ORCA opt-in + gauges
+            from .observe import Telemetry
+
+            telemetry = Telemetry(sample="off", orca_format="json")
+        admission = None
+        if self.admission:
+            from .admission import AdmissionController
+
+            admission = AdmissionController(
+                mode=self.admission_mode,
+                target_ms=self.admission_target_ms,
+                max_queue_wait_s=self.admission_max_queue_wait_s)
         return PoolClient(
             self.endpoints,
             protocol=self.protocol,
             client_factory=factory,
+            routing=self.routing or "round_robin",
             health_interval_s=0.5,
             endpoint_retry=endpoint_retry,
             hedge=hedge,
             # primary + hedge both ride the executor: size it so the full
             # worker concurrency never queues behind hedge threads
             hedge_executor_workers=max(8, 2 * concurrency),
-            telemetry=self._telemetry,
+            telemetry=telemetry,
+            admission=admission,
+            endpoint_limits=True if self.endpoint_limits else None,
         )
 
     def _control_client(self):
@@ -499,7 +536,11 @@ class PerfRunner:
                 inputs.append(inp)
         return client, inputs, outputs, shm_ctx, own_client
 
-    def _worker(self, client, barrier, stop, latencies, errors, counter, worker_id):
+    def _worker(self, client, barrier, stop, latencies, errors, sheds,
+                counter, worker_id):
+        from .admission import AdmissionRejected
+        from .resilience import CircuitOpenError
+
         shm_ctx = None
         own_client = None
         setup_failed = False
@@ -522,6 +563,8 @@ class PerfRunner:
                 try:
                     self._infer_once(client, inputs, outputs)
                     latencies.append(time.perf_counter() - t0)
+                except (CircuitOpenError, AdmissionRejected) as e:
+                    sheds.append(str(e))  # deliberate shedding, not error
                 except Exception as e:  # measured as failure, loop continues
                     errors.append(str(e))
                 with lock:
@@ -535,13 +578,16 @@ class PerfRunner:
                 own_client.close()
 
     def _rate_worker(self, client, barrier, stop, schedule, cursor, t0_box,
-                     records, lags, issues, errors, worker_id):
+                     records, lags, issues, errors, sheds, worker_id):
         """Open-loop worker: claims the next arrival slot from the shared
         schedule, sleeps until its wall-clock time, then issues one sync
         infer. Lateness (actual start - scheduled start) is recorded per
         request — under saturation the pool can't keep up and the lag
         distribution, not just latency, shows it (perf_analyzer's delayed
         request semantics for --request-rate-range)."""
+        from .admission import AdmissionRejected
+        from .resilience import CircuitOpenError
+
         shm_ctx = None
         own_client = None
         setup_failed = False
@@ -581,6 +627,8 @@ class PerfRunner:
                 try:
                     self._infer_once(client, inputs, outputs)
                     records.append(time.perf_counter() - t1)
+                except (CircuitOpenError, AdmissionRejected) as e:
+                    sheds.append(str(e))  # deliberate shedding, not error
                 except Exception as e:  # measured as failure, loop continues
                     errors.append(str(e))
         finally:
@@ -630,7 +678,8 @@ class PerfRunner:
 
         self._telemetry = Telemetry(
             sample=self.observe_sample,
-            trace_capacity=max(measurement_requests, 1024))
+            trace_capacity=max(measurement_requests, 1024),
+            orca_format=self._orca_format)
 
     def _arm_dataplane(self):
         """Scoped shm accounting for shm-mode runs: reuse an already
@@ -727,6 +776,28 @@ class PerfRunner:
             observe.install_dataplane(None)
 
     @staticmethod
+    def _admission_stats(client) -> Optional[Dict[str, Any]]:
+        """The pool's admission-controller snapshot (limit, inflight,
+        per-lane sheds), when one is armed — appended to result rows as
+        ``client_admission`` so artifacts carry the shed story."""
+        getter = getattr(client, "admission", None)
+        if getter is None:
+            return None
+        try:
+            ctrl = getter()
+            return ctrl.snapshot() if ctrl is not None else None
+        except Exception:
+            return None
+
+    @staticmethod
+    def _admission_result(result: Dict[str, Any],
+                          admission_stats: Optional[Dict[str, Any]],
+                          ) -> Dict[str, Any]:
+        if admission_stats is not None:
+            result["client_admission"] = admission_stats
+        return result
+
+    @staticmethod
     def _batch_result(result: Dict[str, Any],
                       batch_stats: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         """Achieved client-side batch sizes alongside the latency row."""
@@ -773,13 +844,15 @@ class PerfRunner:
             client.set_async_concurrency(concurrency)
         latencies: List[float] = []
         errors: List[str] = []
+        sheds: List[str] = []  # breaker fast-fails + admission rejections
         stop = threading.Event()
         barrier = threading.Barrier(concurrency + 1)
         counter = (threading.Lock(), [0], measurement_requests)
         workers = [
             threading.Thread(
                 target=self._worker,
-                args=(client, barrier, stop, latencies, errors, counter, i),
+                args=(client, barrier, stop, latencies, errors, sheds,
+                      counter, i),
                 daemon=True,
             )
             for i in range(concurrency)
@@ -792,22 +865,34 @@ class PerfRunner:
             w.join(timeout=600)
         elapsed = time.perf_counter() - t_start
         batch_stats = client.stats() if self.coalesce else None
+        admission_stats = self._admission_stats(client)
         client.close()
 
         lat_sorted = sorted(latencies)
         n = len(lat_sorted)
-        return self._shm_result(self._batch_result(self._observe_result({
+        issued = n + len(errors) + len(sheds)
+        return self._admission_result(self._shm_result(self._batch_result(
+            self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
             "shared_memory": self.shared_memory,
             "concurrency": concurrency,
             "requests": n,
             "errors": len(errors),
+            "shed": len(sheds),
+            # a breaker fast-fail / admission rejection is deliberate
+            # load-shedding, not a server error: the two rates must never
+            # share a bucket (that would make overload unreadable)
+            "error_pct": round(100.0 * len(errors) / issued, 2)
+            if issued else 0.0,
+            "shed_pct": round(100.0 * len(sheds) / issued, 2)
+            if issued else 0.0,
             "error_sample": errors[0] if errors else None,
+            "shed_sample": sheds[0] if sheds else None,
             "duration_s": round(elapsed, 3),
             "infer_per_sec": round(n / elapsed, 1) if elapsed > 0 else 0.0,
             "latency_ms": _latency_ms_row(lat_sorted),
-        }), batch_stats), shm_rec, shm_before)
+        }), batch_stats), shm_rec, shm_before), admission_stats)
 
     def run_rate(self, rate: float, measurement_requests: int,
                  distribution: str = "constant",
@@ -847,6 +932,7 @@ class PerfRunner:
         lags: List[float] = []  # schedule lag of EVERY issued request
         issues: List[float] = []  # actual arrival offset of every request
         errors: List[str] = []
+        sheds: List[str] = []  # breaker fast-fails + admission rejections
         stop = threading.Event()
         barrier = threading.Barrier(pool_size + 1)
         cursor = (threading.Lock(), [0])
@@ -855,7 +941,7 @@ class PerfRunner:
             threading.Thread(
                 target=self._rate_worker,
                 args=(client, barrier, stop, schedule, cursor, t0_box,
-                      records, lags, issues, errors, i),
+                      records, lags, issues, errors, sheds, i),
                 daemon=True,
             )
             for i in range(pool_size)
@@ -870,6 +956,7 @@ class PerfRunner:
             w.join(timeout=600)
         elapsed = time.perf_counter() - t0_box[0]
         batch_stats = client.stats() if self.coalesce else None
+        admission_stats = self._admission_stats(client)
         client.close()
 
         lat_sorted = sorted(records)
@@ -885,7 +972,8 @@ class PerfRunner:
         # denominator for every capacity claim (a saturated pool that
         # silently under-offers would otherwise flatter its own number)
         arrival_window = max(issues) if issues else 0.0
-        return self._shm_result(self._batch_result(self._observe_result({
+        return self._admission_result(self._shm_result(self._batch_result(
+            self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
             "shared_memory": self.shared_memory,
@@ -896,7 +984,16 @@ class PerfRunner:
             "requests": n,
             "issued": issued,
             "errors": len(errors),
+            "shed": len(sheds),
+            # under saturation the split is the whole story: shed_pct is
+            # honest load-shedding (breaker fast-fail / admission), while
+            # error_pct is genuine failure — they never share a bucket
+            "error_pct": round(100.0 * len(errors) / issued, 2)
+            if issued else 0.0,
+            "shed_pct": round(100.0 * len(sheds) / issued, 2)
+            if issued else 0.0,
             "error_sample": errors[0] if errors else None,
+            "shed_sample": sheds[0] if sheds else None,
             "duration_s": round(elapsed, 3),
             "achieved_rate": round(n / elapsed, 1) if elapsed > 0 else 0.0,
             "achieved_arrival_rate": round(issued / arrival_window, 1)
@@ -904,7 +1001,7 @@ class PerfRunner:
             "latency_ms": _latency_ms_row(lat_sorted),
             "schedule_lag_ms": _lag_ms_row(lag_sorted),
             "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
-        }), batch_stats), shm_rec, shm_before)
+        }), batch_stats), shm_rec, shm_before), admission_stats)
 
     # -- trace replay --------------------------------------------------------
     _SEQ_GATE_TIMEOUT_S = 60.0
@@ -981,7 +1078,8 @@ class PerfRunner:
         self._telemetry = Telemetry(
             sample="always",
             trace_capacity=len(records) + 64,
-            stream_window_s=window_s)
+            stream_window_s=window_s,
+            orca_format=self._orca_format)
         # request_ms SLOs are fed PER TRACE RECORD from the replay's own
         # outcome accounting, NOT from telemetry spans: under coalescing
         # every batch adds an inner-dispatch span and under hedging every
@@ -1073,11 +1171,12 @@ class PerfRunner:
             outcomes = list(outcomes)
             errors = list(errors)
             batch_stats = client.stats() if self.coalesce else None
+            admission_stats = self._admission_stats(client)
         finally:
             client.close()
-        return self._trace_result(
+        return self._admission_result(self._trace_result(
             header, records, speed, elapsed, outcomes, errors, specs,
-            batch_stats, resources, request_slos)
+            batch_stats, resources, request_slos), admission_stats)
 
     def _replay_warmup(self, client, records, resources) -> None:
         """One best-effort dispatch per distinct (kind, model) BEFORE the
@@ -1105,6 +1204,7 @@ class PerfRunner:
 
     def _replay_worker(self, client, barrier, stop, records, speed, cursor,
                        t0_box, resources, outcomes, errors, on_result):
+        from .admission import AdmissionRejected
         from .resilience import CircuitOpenError
 
         try:
@@ -1144,7 +1244,7 @@ class PerfRunner:
                         f"{rec.seq_index}: predecessor failed or never "
                         f"completed (group abandoned)")
                 outcome = self._replay_dispatch(client, rec, resources)
-            except CircuitOpenError as e:
+            except (CircuitOpenError, AdmissionRejected) as e:
                 status = "shed"
                 outcome = e
                 errors.append(f"{rec.kind}: {e}")
@@ -1489,6 +1589,30 @@ def main(argv: Optional[List[str]] = None) -> int:
              "max_batch_size)",
     )
     parser.add_argument(
+        "--routing", default=None,
+        choices=("round_robin", "least_outstanding", "weighted",
+                 "orca_weighted"),
+        help="pool routing policy (requires --endpoints); orca_weighted "
+             "feeds smooth-WRR weights from the servers' ORCA "
+             "endpoint-load-metrics reports, falling back to "
+             "least_outstanding while loads are stale or absent")
+    parser.add_argument(
+        "--admission", action="store_true",
+        help="arm the pool's adaptive admission controller "
+             "(client_tpu.admission): saturated/deadline-infeasible "
+             "requests are shed with a typed AdmissionRejected, counted "
+             "as shed (never error) in every result row")
+    parser.add_argument(
+        "--admission-mode", choices=("aimd", "gradient"), default="aimd")
+    parser.add_argument(
+        "--admission-target-ms", type=float, default=None,
+        help="SLO latency target the limiter defends (default: a minRTT "
+             "EWMA tolerance band)")
+    parser.add_argument(
+        "--endpoint-limits", action="store_true",
+        help="arm a per-endpoint adaptive concurrency limit (selection "
+             "skips replicas at their limit; requires --endpoints)")
+    parser.add_argument(
         "--stream-prompt-tokens", type=int, default=32,
         help="prompt length for --generate-stream sessions")
     parser.add_argument(
@@ -1548,6 +1672,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         coalesce=args.coalesce,
         batch_window_us=args.batch_window_us,
         batch_max=args.batch_max,
+        routing=args.routing,
+        admission=args.admission,
+        admission_mode=args.admission_mode,
+        admission_target_ms=args.admission_target_ms,
+        endpoint_limits=args.endpoint_limits,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside
@@ -1632,26 +1761,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"distribution={args.request_distribution}"
         )
         print(f"{'rate':>7} {'ach':>7} {'p50 ms':>8} {'p90 ms':>8} {'p99 ms':>8} "
-              f"{'lag p99':>8} {'late%':>6} {'err':>4}")
+              f"{'lag p99':>8} {'late%':>6} {'err':>4} {'shed':>5}")
         for r in results:
             lm = r["latency_ms"]
             print(
                 f"{r['request_rate']:>7} {r['achieved_rate']:>7} {lm['p50']:>8} "
                 f"{lm['p90']:>8} {lm['p99']:>8} "
                 f"{r['schedule_lag_ms']['p99']:>8} {r['delayed_pct']:>6} "
-                f"{r['errors']:>4}"
+                f"{r['errors']:>4} {r['shed']:>5}"
             )
     else:
         print(
             f"model={args.model_name} protocol={args.protocol} "
             f"shared_memory={args.shared_memory}"
         )
-        print(f"{'conc':>5} {'infer/s':>9} {'avg ms':>8} {'p50 ms':>8} {'p90 ms':>8} {'p99 ms':>8} {'err':>4}")
+        print(f"{'conc':>5} {'infer/s':>9} {'avg ms':>8} {'p50 ms':>8} {'p90 ms':>8} {'p99 ms':>8} {'err':>4} {'shed':>5}")
         for r in results:
             lm = r["latency_ms"]
             print(
                 f"{r['concurrency']:>5} {r['infer_per_sec']:>9} {lm['avg']:>8} "
-                f"{lm['p50']:>8} {lm['p90']:>8} {lm['p99']:>8} {r['errors']:>4}"
+                f"{lm['p50']:>8} {lm['p90']:>8} {lm['p99']:>8} {r['errors']:>4} "
+                f"{r.get('shed', 0):>5}"
             )
     return 1 if any(r["errors"] and not r["requests"] for r in results) else 0
 

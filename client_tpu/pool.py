@@ -28,12 +28,23 @@ What the pool provides:
   growing window (``base_ejection_s * multiplier^k``, capped at
   ``max_ejection_s``), Envoy-style. At most ``ceil(N/2)`` replicas are
   ever ejected at once — the pool degrades before it self-blinds.
-- **Routing policies** — ``round_robin``, ``least_outstanding``, and
-  ``weighted`` (smooth weighted round-robin over static weights), each
-  honoring health, ejection, and the per-endpoint
-  :class:`~client_tpu.resilience.CircuitBreaker`: an endpoint whose
+- **Routing policies** — ``round_robin``, ``least_outstanding``,
+  ``weighted`` (smooth weighted round-robin over static weights), and
+  ``orca_weighted`` (smooth-WRR over weights derived from the servers'
+  TTL-fresh ORCA ``endpoint-load-metrics`` reports, hysteresis-smoothed,
+  falling back to least-outstanding whenever any replica's load is stale
+  or absent), each honoring health, ejection, the per-endpoint
+  :class:`~client_tpu.resilience.CircuitBreaker` (an endpoint whose
   breaker is open is never selected; a half-open endpoint receives
-  exactly the probes its breaker admits.
+  exactly the probes its breaker admits) and, when armed, the
+  per-endpoint adaptive concurrency limit.
+- **Admission control** — ``admission=`` installs a pool-level
+  :class:`~client_tpu.admission.AdmissionController` (adaptive limiter +
+  priority lanes + deadline-aware shedding): one token covers the whole
+  failover/hedge run, saturated requests raise the typed
+  ``AdmissionRejected`` (counted as *shed*, never error), and
+  ``endpoint_limits=`` adds a per-replica adaptive limit that selection
+  honors like a breaker (docs/admission.md).
 - **Transparent failover** — one shared
   :class:`~client_tpu.resilience.AttemptBudget` deadline across replicas;
   re-attempts obey PR 1's idempotency rule: a sequence request
@@ -70,10 +81,22 @@ from collections import deque
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from ._base import INFER_POSITIONAL_PREFIX, fold_infer_args
+from ._base import (
+    INFER_POSITIONAL_PREFIX,
+    consume_admission_phase,
+    fold_infer_args,
+    stash_admission_phase,
+)
+from .admission import (
+    AdaptiveLimiter,
+    AdmissionController,
+    AdmissionRejected,
+    SHED_ENDPOINT_SATURATED,
+)
 from .resilience import (
     CONNECT,
     FATAL,
+    SHED,
     TIMEOUT,
     TRANSIENT,
     AttemptBudget,
@@ -89,6 +112,7 @@ __all__ = [
     "ROUND_ROBIN",
     "LEAST_OUTSTANDING",
     "WEIGHTED",
+    "ORCA_WEIGHTED",
     "AioPoolClient",
     "EndpointEjected",
     "EndpointHealthChanged",
@@ -98,12 +122,63 @@ __all__ = [
     "NoEndpointAvailableError",
     "PoolClient",
     "SequenceAbandoned",
+    "load_score",
 ]
 
 ROUND_ROBIN = "round_robin"
 LEAST_OUTSTANDING = "least_outstanding"
 WEIGHTED = "weighted"
-_ROUTING_POLICIES = (ROUND_ROBIN, LEAST_OUTSTANDING, WEIGHTED)
+ORCA_WEIGHTED = "orca_weighted"
+_ROUTING_POLICIES = (ROUND_ROBIN, LEAST_OUTSTANDING, WEIGHTED, ORCA_WEIGHTED)
+
+# orca_weighted tuning: the weight floor keeps a slammed replica barely
+# in rotation (so its load reports keep flowing and recovery is visible);
+# hysteresis ignores weight moves smaller than this fraction of the old
+# weight (ORCA reports arrive per-response — routing must not thrash on
+# report-to-report jitter); smoothing is the EWMA step for moves that DO
+# clear the hysteresis band
+_ORCA_WEIGHT_FLOOR = 0.05
+_ORCA_HYSTERESIS = 0.10
+_ORCA_SMOOTHING = 0.5
+# utilization dominates the blend when both signals exist; qps fills in
+# relative pressure between replicas reporting equal utilization
+_ORCA_QPS_BLEND = 0.3
+
+
+def load_score(load, max_qps: Optional[float] = None,
+               max_busy_us: Optional[float] = None) -> Optional[float]:
+    """One ORCA report -> a busy score in [0, 1] (higher = more loaded).
+
+    Prefers the standard ORCA utilization signals
+    (``application_utilization``, ``cpu_utilization``, or the max over a
+    ``utilization.*`` map), blended with relative QPS
+    (``rps_fractional``/``qps`` against the fleet max) when present.
+    Falls back to the in-repo server's
+    ``named_metrics.avg_compute_infer_us`` (relative to the fleet max) so
+    orca_weighted works against servers that report busy-time rather
+    than utilization. Returns None when the report carries no usable
+    signal."""
+    metrics = load.metrics
+    util = metrics.get("application_utilization")
+    if util is None:
+        util = metrics.get("cpu_utilization")
+    if util is None:
+        subs = [v for k, v in metrics.items() if k.startswith("utilization")]
+        util = max(subs) if subs else None
+    qps = metrics.get("rps_fractional", metrics.get("qps"))
+    qps_norm = (qps / max_qps if qps is not None and max_qps else None)
+    if util is not None:
+        util = min(max(float(util), 0.0), 1.0)
+        if qps_norm is not None:
+            return ((1.0 - _ORCA_QPS_BLEND) * util
+                    + _ORCA_QPS_BLEND * min(max(qps_norm, 0.0), 1.0))
+        return util
+    if qps_norm is not None:
+        return min(max(qps_norm, 0.0), 1.0)
+    busy = metrics.get("named_metrics.avg_compute_infer_us")
+    if busy is not None and max_busy_us:
+        return min(max(float(busy) / max_busy_us, 0.0), 1.0)
+    return None
 
 
 class NoEndpointAvailableError(InferenceServerException):
@@ -218,16 +293,23 @@ class HedgePolicy:
 class EndpointState:
     """One replica: its client, breaker-backed policy, and outlier state.
 
-    All mutable fields are guarded by the owning pool's lock."""
+    All mutable fields are guarded by the owning pool's lock.
+    ``limiter`` (optional) is a per-endpoint
+    :class:`~client_tpu.admission.AdaptiveLimiter`: selection skips an
+    endpoint whose outstanding count has reached its adaptive limit, and
+    ``shed_total`` counts the requests shed because EVERY candidate was
+    at its limit. ``_orca_weight`` is the hysteresis-smoothed
+    ``orca_weighted`` routing weight (None until the first fresh load)."""
 
     __slots__ = (
         "url", "client", "policy", "weight", "outstanding", "healthy",
         "consecutive_failures", "ejected", "ejected_until", "ejection_count",
-        "last_ejection_end", "_wrr_current",
+        "last_ejection_end", "_wrr_current", "limiter", "shed_total",
+        "_orca_weight",
     )
 
     def __init__(self, url: str, client: Any, policy: ResiliencePolicy,
-                 weight: float = 1.0):
+                 weight: float = 1.0, limiter: Optional[AdaptiveLimiter] = None):
         self.url = url
         self.client = client
         self.policy = policy  # breaker + per-endpoint ResilienceStats
@@ -240,6 +322,9 @@ class EndpointState:
         self.ejection_count = 0
         self.last_ejection_end = 0.0
         self._wrr_current = 0.0
+        self.limiter = limiter
+        self.shed_total = 0
+        self._orca_weight: Optional[float] = None
 
 
 class EndpointPool:
@@ -261,7 +346,14 @@ class EndpointPool:
         latency_window: int = 256,
         clock: Callable[[], float] = time.monotonic,
         on_event: Optional[Callable[[PoolEvent], None]] = None,
+        load_lookup: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
+        """``load_lookup`` (``orca_weighted`` routing): a zero-arg callable
+        returning ``{url: observe.EndpointLoad}`` containing ONLY
+        TTL-fresh reports — typically ``Telemetry.endpoint_loads``. A pick
+        where any candidate lacks a fresh report falls back to
+        least-outstanding: the policy never routes on (or divides by) an
+        expired load."""
         if not endpoints:
             raise ValueError("pool needs at least one endpoint")
         if routing not in _ROUTING_POLICIES:
@@ -281,6 +373,14 @@ class EndpointPool:
         self.max_ejected = math.ceil(len(self.endpoints) / 2)
         self._clock = clock
         self._on_event = on_event
+        self._load_lookup = load_lookup
+        # micro-cache over the lookup: loads only change on response
+        # ingest, so a few ms of reuse spares the per-pick dict build
+        # (and the telemetry-lock acquire) on the hot routing path.
+        # Real time on purpose — a test-injected fake pool clock must
+        # not freeze the cache across ingests.
+        self._load_cache: Any = None
+        self._load_cache_at = 0.0
         self._lock = threading.Lock()
         self._rr = 0
         self._latencies: deque = deque(maxlen=latency_window)
@@ -306,20 +406,85 @@ class EndpointPool:
                 ep.consecutive_failures = 0
                 events.append(EndpointReadmitted(ep.url))
 
-    def _eligible(self, ep: EndpointState) -> bool:
-        if ep.ejected or not ep.healthy:
-            return False
-        breaker = ep.policy.breaker
-        return breaker is None or breaker.would_admit()
+    @staticmethod
+    def _within_limit(ep: EndpointState) -> bool:
+        return ep.limiter is None or ep.limiter.would_admit(ep.outstanding)
+
+    def _orca_weights(self,
+                      candidates: List[EndpointState]) -> Optional[Dict[int, float]]:
+        """Hysteresis-smoothed smooth-WRR weights from the TTL-fresh load
+        reports, or None when ANY candidate lacks a fresh report (the
+        whole pick then falls back to least-outstanding — a half-fresh
+        weighting would starve exactly the replicas whose reports went
+        silent). Caller holds the pool lock."""
+        lookup = self._load_lookup
+        if lookup is None:
+            return None
+        now = time.monotonic()
+        if self._load_cache is not None and now - self._load_cache_at < 0.002:
+            loads = self._load_cache
+        else:
+            try:
+                loads = lookup()  # TTL-filtered by the telemetry
+            except Exception:
+                return None
+            self._load_cache = loads
+            self._load_cache_at = now
+        if not loads:
+            return None
+        per_ep = []
+        for ep in candidates:
+            load = loads.get(ep.url)
+            if load is None:
+                return None  # stale or absent: never route on it
+            per_ep.append((ep, load))
+        # fleet-relative normalizers for the qps / busy-time signals
+        qps_values = [l.metrics.get("rps_fractional", l.metrics.get("qps"))
+                      for _, l in per_ep]
+        max_qps = max((q for q in qps_values if q is not None), default=None)
+        busy_values = [l.metrics.get("named_metrics.avg_compute_infer_us")
+                       for _, l in per_ep]
+        max_busy = max((b for b in busy_values if b is not None), default=None)
+        weights: Dict[int, float] = {}
+        for ep, load in per_ep:
+            score = load_score(load, max_qps, max_busy)
+            if score is None:
+                return None  # a report with no usable signal: fall back
+            target = max(1.0 - score, _ORCA_WEIGHT_FLOOR) * ep.weight
+            old = ep._orca_weight
+            if old is None:
+                smoothed = target
+            elif abs(target - old) < _ORCA_HYSTERESIS * max(old, 1e-9):
+                smoothed = old  # inside the hysteresis band: hold steady
+            else:
+                smoothed = old + _ORCA_SMOOTHING * (target - old)
+            ep._orca_weight = smoothed
+            weights[id(ep)] = smoothed
+        return weights
 
     def _pick(self, candidates: List[EndpointState]) -> EndpointState:
         if len(candidates) == 1:
             return candidates[0]
-        if self.routing == LEAST_OUTSTANDING:
+        routing = self.routing
+        if routing == ORCA_WEIGHTED:
+            weights = self._orca_weights(candidates)
+            if weights is not None:
+                # smooth-WRR over the load-derived weights (same
+                # algorithm as the static ``weighted`` policy)
+                total = sum(weights.values())
+                for ep in candidates:
+                    ep._wrr_current += weights[id(ep)]
+                best = max(candidates, key=lambda e: e._wrr_current)
+                best._wrr_current -= total
+                return best
+            # loads stale/absent/unusable: degrade to least_outstanding
+            # (client-local pressure) rather than stalling or guessing
+            routing = LEAST_OUTSTANDING
+        if routing == LEAST_OUTSTANDING:
             least = min(ep.outstanding for ep in candidates)
             candidates = [ep for ep in candidates if ep.outstanding == least]
             # ties rotate so idle pools still spread load
-        elif self.routing == WEIGHTED:
+        elif routing == WEIGHTED:
             # smooth weighted round-robin (nginx algorithm): deterministic,
             # interleaves instead of bursting onto the heaviest endpoint
             total = sum(ep.weight for ep in candidates)
@@ -334,32 +499,64 @@ class EndpointPool:
 
     def select(self, exclude: Sequence[EndpointState] = ()) -> EndpointState:
         """Pick an endpoint under the routing policy, honoring health,
-        ejection windows, and breaker admission. ``exclude`` lists
+        ejection windows, breaker admission and (when armed) each
+        endpoint's adaptive concurrency limit. ``exclude`` lists
         endpoints already tried by this call's failover loop. When no
         eligible endpoint remains, panic-routes to a non-excluded endpoint
         whose breaker would still admit (degraded beats unavailable);
-        raises :class:`NoEndpointAvailableError` when even that is empty."""
+        raises :class:`NoEndpointAvailableError` when even that is empty.
+        When the ONLY thing blocking every survivor is its adaptive
+        limit, the pool is genuinely saturated — that raises a typed
+        :class:`~client_tpu.admission.AdmissionRejected` (reason
+        ``endpoint_saturated``, counted per endpoint as ``shed_total``)
+        instead of piling more work onto replicas already past their
+        limits."""
         events: List[PoolEvent] = []
         excluded = set(map(id, exclude))
+        saturated = False
         with self._lock:
             now = self._clock()
             self._readmit_expired(now, events)
-            candidates = [
+            # healthy tier first, WITHOUT the limiter: whether the pool
+            # enters the panic tier must depend on health/ejection/breaker
+            # alone — healthy replicas transiently at their adaptive limit
+            # must shed, never spill traffic onto an ejected outlier
+            healthy = [
                 ep for ep in self.endpoints
-                if id(ep) not in excluded and self._eligible(ep)
+                if id(ep) not in excluded and not ep.ejected and ep.healthy
+                and (ep.policy.breaker is None
+                     or ep.policy.breaker.would_admit())
             ]
-            if not candidates:
-                # panic tier: ignore health/ejection, still skip endpoints
-                # whose breaker would fast-fail without touching a socket
-                candidates = [
+            candidates = [ep for ep in healthy if self._within_limit(ep)]
+            if not candidates and healthy:
+                # every HEALTHY replica is blocked only by its adaptive
+                # limit: the pool is genuinely saturated — shed (typed)
+                saturated = True
+                for ep in healthy:
+                    ep.shed_total += 1
+            elif not candidates:
+                # panic tier: no healthy replica at all — ignore health/
+                # ejection, still skip endpoints whose breaker would
+                # fast-fail without touching a socket
+                relaxed = [
                     ep for ep in self.endpoints
                     if id(ep) not in excluded
                     and (ep.policy.breaker is None
                          or ep.policy.breaker.would_admit())
                 ]
+                candidates = [ep for ep in relaxed if self._within_limit(ep)]
+                if not candidates and relaxed:
+                    saturated = True
+                    for ep in relaxed:
+                        ep.shed_total += 1
             picked = self._pick(candidates) if candidates else None
         self._emit_all(events)
         if picked is None:
+            if saturated:
+                raise AdmissionRejected(
+                    SHED_ENDPOINT_SATURATED, lane="endpoint",
+                    msg="every candidate endpoint is at its adaptive "
+                        "concurrency limit")
             raise NoEndpointAvailableError()
         return picked
 
@@ -375,6 +572,10 @@ class EndpointPool:
     def record_success(self, ep: EndpointState,
                        latency_s: Optional[float] = None) -> None:
         events: List[PoolEvent] = []
+        if ep.limiter is not None:
+            # latency None (admin/metadata calls) is a neutral feed: the
+            # per-endpoint limit tracks INFER latency only
+            ep.limiter.on_result(latency_s, ok=True)
         with self._lock:
             ep.consecutive_failures = 0
             if ep.ejected:
@@ -392,6 +593,10 @@ class EndpointPool:
         :meth:`record_success`) into the outlier detector."""
         if domain not in (CONNECT, TRANSIENT, TIMEOUT):
             return
+        if ep.limiter is not None:
+            # a transport-level failure is the strongest back-off signal
+            # the endpoint can send: decay its adaptive limit
+            ep.limiter.on_result(None, ok=False)
         events: List[PoolEvent] = []
         with self._lock:
             ep.consecutive_failures += 1
@@ -454,6 +659,14 @@ class EndpointPool:
                     "ejection_count": ep.ejection_count,
                     "outstanding": ep.outstanding,
                     "weight": ep.weight,
+                    # admission view: the adaptive per-endpoint limit (None
+                    # when no limiter is armed), the in-flight count it
+                    # gates, and how many requests were shed because every
+                    # candidate sat at its limit
+                    "limit": (round(ep.limiter.limit, 2)
+                              if ep.limiter is not None else None),
+                    "inflight": ep.outstanding,
+                    "shed_total": ep.shed_total,
                     "breaker_state": breaker.state if breaker is not None else None,
                     "resilience": ep.policy.stats.as_dict(),
                 }
@@ -532,6 +745,8 @@ class _PoolClientBase:
         clock: Callable[[], float] = time.monotonic,
         telemetry=None,
         shm_arena=None,
+        admission=None,
+        endpoint_limits=None,
     ):
         """``urls``: N ``host:port`` replica addresses. ``client_factory``
         overrides the per-endpoint client constructor (receives the url);
@@ -549,7 +764,20 @@ class _PoolClientBase:
         endpoint client — pool events feed its counters (ejections,
         readmissions, health flips, hedge win/loss), per-endpoint breakers
         and retries report through it, endpoint stats surface as gauges at
-        scrape time, and each endpoint client traces request phases."""
+        scrape time, and each endpoint client traces request phases.
+
+        ``admission``: an :class:`~client_tpu.admission.AdmissionController`
+        (or ``True`` for defaults) gating every pooled ``infer`` /
+        ``generate_stream``: ONE token covers the whole failover/hedge
+        engine run; saturated or deadline-infeasible requests raise the
+        typed ``AdmissionRejected`` instead of queueing. ``endpoint_limits``
+        (``True`` or a zero-arg ``AdaptiveLimiter`` factory) arms a
+        per-endpoint adaptive concurrency limit that selection honors
+        like a breaker. ``routing="orca_weighted"`` requires ``telemetry``
+        (ideally with ``orca_format=`` set so the frontends opt in): the
+        smooth-WRR weights come from the TTL-fresh ORCA load reports,
+        falling back to least-outstanding whenever any replica's load is
+        stale or absent."""
         urls = list(urls)
         if not urls:
             raise ValueError("pool needs at least one url")
@@ -564,7 +792,19 @@ class _PoolClientBase:
             client_factory = _default_client_factory(protocol, self._AIO)
         if breaker_factory is None:
             breaker_factory = CircuitBreaker
+        if routing == ORCA_WEIGHTED and telemetry is None:
+            raise ValueError(
+                "routing='orca_weighted' needs telemetry=: the ORCA load "
+                "reports it routes on are ingested by observe.Telemetry "
+                "(set orca_format='json'|'text' on it so every frontend "
+                "opts in to the endpoint-load-metrics header)")
         self._telemetry = telemetry
+        if admission is True:
+            admission = AdmissionController()
+        self._admission = admission
+        if endpoint_limits is True:
+            endpoint_limits = AdaptiveLimiter
+        limiter_factory = endpoint_limits if callable(endpoint_limits) else None
         if shm_arena is True:
             from .arena import default_arena
 
@@ -601,7 +841,9 @@ class _PoolClientBase:
                     # write serves every replica, and registrations cache
                     # per (endpoint url, region)
                     client.configure_arena(shm_arena)
-                endpoints.append(EndpointState(url, client, policy, weight))
+                endpoints.append(EndpointState(
+                    url, client, policy, weight,
+                    limiter=limiter_factory() if limiter_factory else None))
         except Exception:
             self._abandon(endpoints)
             raise
@@ -616,6 +858,11 @@ class _PoolClientBase:
                 ejection_decay_s=ejection_decay_s,
                 clock=clock,
                 on_event=on_event,
+                # orca_weighted: weights come from the telemetry's
+                # TTL-filtered load map — an expired report is simply
+                # absent, so the policy can never divide by a stale load
+                load_lookup=(telemetry.endpoint_loads
+                             if routing == ORCA_WEIGHTED else None),
             )
         except Exception:
             self._abandon(endpoints)
@@ -624,6 +871,9 @@ class _PoolClientBase:
             # per-endpoint health/ejection/breaker/outstanding gauges,
             # refreshed from pool.snapshot() at scrape time
             telemetry.register_pool(self.pool)
+            if self._admission is not None:
+                # shed/admit counters + limit/inflight/queue-depth gauges
+                telemetry.attach_admission(self._admission)
         self._hedge = hedge
         self._hedge_executor_workers = (
             hedge_executor_workers
@@ -702,6 +952,58 @@ class _PoolClientBase:
     def arena(self):
         return self._shm_arena
 
+    def admission(self):
+        return self._admission
+
+    # -- admission helpers ---------------------------------------------------
+    def _admission_deadline(self, timeout_s: Optional[float]) -> Optional[float]:
+        """The request's absolute deadline under the pool's budget policy
+        (the caller's explicit timeout wins) — what deadline-aware
+        shedding judges feasibility against."""
+        return AttemptBudget(self._budget_policy, timeout_s).deadline
+
+    def _admission_note_shed(self, exc: AdmissionRejected) -> None:
+        """Export a shed raised below the controller (the per-endpoint
+        saturation path) exactly once; controller-level sheds were
+        already counted by its observer."""
+        if exc.counted:
+            return
+        exc.counted = True
+        tel = self._telemetry
+        if tel is not None:
+            try:
+                tel.on_admission_shed(exc.lane, exc.reason)
+            except Exception:
+                pass  # an observer must never break the data path
+
+    def _admission_settle(self, token, t0: float,
+                          exc: Optional[BaseException]) -> None:
+        """Release the pool-level admission slot, feeding the limiter the
+        whole pooled call's outcome: successes and FATAL application
+        answers are completions (the fleet served them); transport-class
+        failures are breaches (the overload back-off signal); sheds,
+        breaker fast-fails and interrupts teach nothing."""
+        # the call may have finished without any endpoint span claiming
+        # the stashed wait (all-ejected select, endpoint saturation, an
+        # endpoint client built without configure_telemetry): drop any
+        # unclaimed stash or it would leak onto the next, unrelated
+        # request's span — a no-op in the common claimed case
+        consume_admission_phase()
+        if exc is None:
+            token.release(time.monotonic() - t0, ok=True)
+            return
+        if isinstance(exc, AdmissionRejected):
+            self._admission_note_shed(exc)
+            token.release()
+            return
+        if isinstance(exc, CircuitOpenError) or not isinstance(exc, Exception):
+            token.release()
+            return
+        if classify_fault(exc) in (CONNECT, TRANSIENT, TIMEOUT):
+            token.release(time.monotonic() - t0, ok=False)
+        else:
+            token.release(time.monotonic() - t0, ok=True)
+
     @property
     def _FRONTEND(self) -> str:
         """The wrapped protocol's telemetry label (wrapper layers — the
@@ -729,8 +1031,10 @@ class _PoolClientBase:
         """Per-endpoint snapshot: health, ejection, breaker state,
         outstanding count, the endpoint's ResilienceStats counters — and,
         when the pool's telemetry has ingested ORCA reports, the latest
-        un-expired ``EndpointLoad`` per endpoint (a ``load`` key:
-        observation only; routing on it is ROADMAP item 2)."""
+        un-expired ``EndpointLoad`` per endpoint (a ``load`` key;
+        ``routing="orca_weighted"`` routes on exactly these reports) —
+        plus the admission view: the adaptive per-endpoint ``limit``,
+        the ``inflight`` count it gates, and ``shed_total``."""
         out = self.pool.snapshot()
         tel = self._telemetry
         if tel is not None:
@@ -932,8 +1236,10 @@ class PoolClient(_PoolClientBase):
                 continue
             except Exception as e:
                 domain = self._record_attempt_failure(ep, e)
-                if domain == FATAL:
-                    raise  # the server answered; failover cannot help
+                if domain in (FATAL, SHED):
+                    # FATAL: the server answered; SHED: a client-local
+                    # admission rejection — failover cannot help either
+                    raise
                 last = e
                 if domain in (TRANSIENT, TIMEOUT) and not idempotent:
                     self._sequence_event(ep, request_id, sequence_id, e)
@@ -947,6 +1253,26 @@ class PoolClient(_PoolClientBase):
         assert last is not None
         raise last
 
+    # -- admission gate -------------------------------------------------------
+    def _admission_begin(self, kwargs, sequence_id: int):
+        """Acquire the pool-level admission slot (or raise the typed
+        ``AdmissionRejected``). Established sequences force-admit:
+        shedding a step of server-held sequence state would poison it.
+        A non-zero queue wait is stashed for the endpoint client's span
+        (the ``admission_queue`` phase)."""
+        ctrl = self._admission
+        force = bool(sequence_id) and not self._seq_repin_allowed(sequence_id)
+        deadline = self._admission_deadline(kwargs.get("client_timeout"))
+        t0_ns = time.perf_counter_ns()
+        token = ctrl.acquire(
+            kwargs.get("priority") or 0, deadline, force=force)
+        if token.waited_s and self._telemetry is not None:
+            # only worth stashing when a span can claim it; an unclaimed
+            # stash would sit in the contextvar waiting to pollute some
+            # unrelated client's next span on this thread
+            stash_admission_phase(t0_ns, time.perf_counter_ns())
+        return token
+
     # -- inference -------------------------------------------------------------
     def infer(self, model_name: str, inputs, *args, **kwargs):
         """Pool-routed ``infer`` (positional arguments follow the
@@ -955,14 +1281,40 @@ class PoolClient(_PoolClientBase):
         scatter — are NEVER hedged, re-attempt only never-sent connect
         failures (moving the pin only while the sequence has no
         server-side state yet), and an in-flight death surfaces a
-        :class:`SequenceAbandoned` event plus the original error."""
+        :class:`SequenceAbandoned` event plus the original error.
+        With admission armed, ONE token covers the whole failover/hedge
+        engine run; a saturated pool raises ``AdmissionRejected``."""
         kwargs = _fold_infer_args(args, kwargs)
         sequence_id = kwargs.get("sequence_id", 0)
+        if self._admission is None:
+            try:
+                return self._infer_routed(model_name, inputs, kwargs,
+                                          sequence_id)
+            except AdmissionRejected as e:
+                self._admission_note_shed(e)  # endpoint-limiter shed
+                raise
+        token = self._admission_begin(kwargs, sequence_id)
+        t0 = time.monotonic()
+        try:
+            result = self._infer_routed(model_name, inputs, kwargs,
+                                        sequence_id)
+        except BaseException as e:
+            self._admission_settle(token, t0, e)
+            raise
+        self._admission_settle(token, t0, None)
+        return result
+
+    def _infer_routed(self, model_name: str, inputs, kwargs,
+                      sequence_id: int):
         timeout_s = kwargs.get("client_timeout")
         request_id = kwargs.get("request_id", "")
         if sequence_id:
             return self._sequence_infer(model_name, inputs, kwargs)
         if self._hedge is not None:
+            # hedged attempts run on executor threads that don't inherit
+            # this context: a stashed admission phase would never be
+            # claimed and could leak onto a later unrelated span
+            consume_admission_phase()
             return self._hedged_infer(model_name, inputs, kwargs, timeout_s)
 
         def op(client, remaining):
@@ -1009,8 +1361,8 @@ class PoolClient(_PoolClientBase):
                 continue
             except Exception as e:
                 domain = self._record_attempt_failure(ep, e)
-                if domain == FATAL:
-                    raise
+                if domain in (FATAL, SHED):
+                    raise  # neither outcome is servable elsewhere
                 last = e
                 if domain == CONNECT:
                     if self._seq_repin_allowed(sequence_id):
@@ -1102,7 +1454,7 @@ class PoolClient(_PoolClientBase):
                     result = f.result()
                 except Exception as e:
                     if (not isinstance(e, CircuitOpenError)
-                            and classify_fault(e) == FATAL):
+                            and classify_fault(e) in (FATAL, SHED)):
                         for p in futures:
                             p.cancel()
                         raise  # the server answered; racing more copies won't help
@@ -1147,14 +1499,28 @@ class PoolClient(_PoolClientBase):
         count stays held until the stream is exhausted (or abandoned), so
         ``least_outstanding`` routing sees long-lived generations — a bare
         delegation would release the slot as soon as the iterator is
-        returned, before a single event streamed."""
-        ep = self.pool.select()
+        returned, before a single event streamed. With admission armed the
+        stream holds one slot for its whole life (admitted on first
+        iteration, like the outstanding count; released without feeding
+        the limiter — an SSE session's duration is not a unary RTT)."""
+        try:
+            ep = self.pool.select()
+        except AdmissionRejected as e:
+            self._admission_note_shed(e)
+            raise
         inner = ep.client.generate_stream(*args, **kwargs)  # lazy: no I/O yet
 
         def stream():
             # begin/done pair with actual iteration (the underlying client
             # generator only issues the request on first next); a returned-
-            # but-never-iterated stream holds no slot
+            # but-never-iterated stream holds no slot (nor admission)
+            token = None
+            if self._admission is not None:
+                try:
+                    token = self._admission.acquire()
+                except AdmissionRejected as e:
+                    self._admission_note_shed(e)
+                    raise
             self.pool.begin(ep)
             ok = True
             tel = self._telemetry
@@ -1179,6 +1545,8 @@ class PoolClient(_PoolClientBase):
                 # abandonment closes the generator -> GeneratorExit runs
                 # this too, releasing the outstanding slot
                 self.pool.done(ep)
+                if token is not None:
+                    token.release()
                 if ok:
                     self.pool.record_success(ep)
 
@@ -1363,8 +1731,8 @@ class AioPoolClient(_PoolClientBase):
                 continue
             except Exception as e:
                 domain = self._record_attempt_failure(ep, e)
-                if domain == FATAL:
-                    raise
+                if domain in (FATAL, SHED):
+                    raise  # neither outcome is servable elsewhere
                 last = e
                 if domain in (TRANSIENT, TIMEOUT) and not idempotent:
                     self._sequence_event(ep, request_id, sequence_id, e)
@@ -1378,17 +1746,55 @@ class AioPoolClient(_PoolClientBase):
         assert last is not None
         raise last
 
+    # -- admission gate -------------------------------------------------------
+    async def _admission_begin(self, kwargs, sequence_id: int):
+        """Async twin of the sync gate (see ``PoolClient._admission_begin``)."""
+        ctrl = self._admission
+        force = bool(sequence_id) and not self._seq_repin_allowed(sequence_id)
+        deadline = self._admission_deadline(kwargs.get("client_timeout"))
+        t0_ns = time.perf_counter_ns()
+        token = await ctrl.acquire_async(
+            kwargs.get("priority") or 0, deadline, force=force)
+        if token.waited_s and self._telemetry is not None:
+            # see the sync twin: stash only when a span can claim it
+            stash_admission_phase(t0_ns, time.perf_counter_ns())
+        return token
+
     # -- inference -------------------------------------------------------------
     async def infer(self, model_name: str, inputs, *args, **kwargs):
         """Pool-routed async ``infer`` (same affinity/idempotency/hedging
-        contract as the sync twin)."""
+        and admission contract as the sync twin)."""
         kwargs = _fold_infer_args(args, kwargs)
         sequence_id = kwargs.get("sequence_id", 0)
+        if self._admission is None:
+            try:
+                return await self._infer_routed(model_name, inputs, kwargs,
+                                                sequence_id)
+            except AdmissionRejected as e:
+                self._admission_note_shed(e)  # endpoint-limiter shed
+                raise
+        token = await self._admission_begin(kwargs, sequence_id)
+        t0 = time.monotonic()
+        try:
+            result = await self._infer_routed(model_name, inputs, kwargs,
+                                              sequence_id)
+        except BaseException as e:
+            self._admission_settle(token, t0, e)
+            raise
+        self._admission_settle(token, t0, None)
+        return result
+
+    async def _infer_routed(self, model_name: str, inputs, kwargs,
+                            sequence_id: int):
         timeout_s = kwargs.get("client_timeout")
         request_id = kwargs.get("request_id", "")
         if sequence_id:
             return await self._sequence_infer(model_name, inputs, kwargs)
         if self._hedge is not None:
+            # hedge tasks share this task's context, but racing attempts
+            # would each claim-or-miss the one stashed phase
+            # nondeterministically — drop it instead (see the sync twin)
+            consume_admission_phase()
             return await self._hedged_infer(
                 model_name, inputs, kwargs, timeout_s)
 
@@ -1434,8 +1840,8 @@ class AioPoolClient(_PoolClientBase):
                 continue
             except Exception as e:
                 domain = self._record_attempt_failure(ep, e)
-                if domain == FATAL:
-                    raise
+                if domain in (FATAL, SHED):
+                    raise  # neither outcome is servable elsewhere
                 last = e
                 if domain == CONNECT:
                     if self._seq_repin_allowed(sequence_id):
@@ -1460,14 +1866,26 @@ class AioPoolClient(_PoolClientBase):
     # -- streaming (HTTP generate extension) ----------------------------------
     def generate_stream(self, *args, **kwargs):
         """Pool-routed async SSE generate stream; the endpoint's
-        ``outstanding`` slot is held for the life of the iteration (see
-        the sync twin)."""
+        ``outstanding`` slot — and, with admission armed, one admission
+        slot — is held for the life of the iteration (see the sync
+        twin)."""
         self._ensure_prober()  # streaming-only pools still need health
-        ep = self.pool.select()
+        try:
+            ep = self.pool.select()
+        except AdmissionRejected as e:
+            self._admission_note_shed(e)
+            raise
         inner = ep.client.generate_stream(*args, **kwargs)  # lazy: no I/O yet
 
         async def stream():
             self._ensure_prober()  # called outside a loop? start it here
+            token = None
+            if self._admission is not None:
+                try:
+                    token = await self._admission.acquire_async()
+                except AdmissionRejected as e:
+                    self._admission_note_shed(e)
+                    raise
             self.pool.begin(ep)
             ok = True
             tel = self._telemetry
@@ -1487,6 +1905,8 @@ class AioPoolClient(_PoolClientBase):
                 raise
             finally:
                 self.pool.done(ep)
+                if token is not None:
+                    token.release()
                 if ok:
                     self.pool.record_success(ep)
 
@@ -1558,7 +1978,7 @@ class AioPoolClient(_PoolClientBase):
                         result = t.result()
                     except Exception as e:
                         if (not isinstance(e, CircuitOpenError)
-                                and classify_fault(e) == FATAL):
+                                and classify_fault(e) in (FATAL, SHED)):
                             await cancel_pending()
                             raise
                         failures.append(e)

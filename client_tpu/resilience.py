@@ -42,6 +42,7 @@ __all__ = [
     "TRANSIENT",
     "TIMEOUT",
     "FATAL",
+    "SHED",
     "AttemptBudget",
     "CircuitBreaker",
     "CircuitOpenError",
@@ -58,6 +59,13 @@ CONNECT = "connect"      # never reached the server: always safe to retry
 TRANSIENT = "transient"  # may have reached the server: retry iff idempotent
 TIMEOUT = "timeout"      # budget spent in flight: retry iff opted in + idempotent
 FATAL = "fatal"          # corruption / protocol / application error: never retry
+SHED = "shed"            # admission control rejected it client-side: never sent,
+#                          never retried, and NOT a breaker/ejection signal —
+#                          accounting counts it as shed, not error
+
+# client_tpu.admission.AdmissionRejected carries this status; matching on
+# the status string keeps this module free of an admission import
+_ADMISSION_REJECTED_STATUS = "ADMISSION_REJECTED"
 
 # Exception type names (checked across the __cause__/__context__ chain, and
 # across each exception's MRO) that mark a request as never-sent.
@@ -152,6 +160,12 @@ def classify_fault(exc: BaseException) -> str:
     transport error as its ``__cause__``) to a fault domain."""
     if isinstance(exc, CircuitOpenError):
         return FATAL  # retrying inside an open circuit defeats the breaker
+    if (isinstance(exc, InferenceServerException)
+            and exc.status() == _ADMISSION_REJECTED_STATUS):
+        # admission control shed it before anything touched the wire:
+        # never retried (retries_domain: unknown domain -> False), never
+        # a breaker outcome (see _record), counted as shed by harnesses
+        return SHED
     chain = _chain(exc)
     names: List[str] = []
     for e in chain:
@@ -559,6 +573,12 @@ class ResiliencePolicy:
             # no outcome to record — but if op() raised it while OUR breaker
             # was half-open, the admitted probe slot must be released or the
             # breaker wedges (half-open has no time-based escape)
+            breaker.abort_probe()
+        elif self.classify(exc) == SHED:
+            # a client-local admission rejection never touched the
+            # endpoint: no outcome to record, but a half-open probe slot
+            # taken by this attempt must be released (same rule as a
+            # nested CircuitOpenError)
             breaker.abort_probe()
         elif self.classify(exc) in (CONNECT, TRANSIENT, TIMEOUT):
             breaker.record(False)

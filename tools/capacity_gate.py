@@ -15,11 +15,20 @@ Probing at the floor (instead of re-bisecting) keeps the gate one-replay
 cheap AND immune to the bisection grid's quantization, which near the
 low end is coarser than the tolerance itself.
 
+With ``--admission`` the gate instead re-checks the committed admission
+overload proof (``BENCH_ADMISSION.json``, tools/bench_admission.py): it
+re-runs BOTH overload arms at the committed 2x speed on a shortened twin
+of the trace and exits 1 when the committed invariants (admitted-traffic
+p99 inside the declared SLO, honest nonzero shed, delivery improved over
+the un-admitted baseline) no longer hold live.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/capacity_gate.py \
         [--baseline BENCH_CAPACITY.json] [--arm baseline] \
         [--tolerance 0.15] [--duration-s 3.0] [--attempts 2]
+    JAX_PLATFORMS=cpu python tools/capacity_gate.py --admission \
+        [--admission-baseline BENCH_ADMISSION.json] [--duration-s 2.0]
 """
 
 from __future__ import annotations
@@ -119,6 +128,30 @@ def probe_at_floor(doc: Dict[str, Any], arm: str, tolerance: float,
     return result
 
 
+def admission_recheck(baseline: str, duration_s: float,
+                      attempts: int) -> int:
+    """Live re-validation of the committed admission overload proof
+    (both arm definitions live in tools/bench_admission.py)."""
+    import tools.bench_admission as bench
+
+    doc = json.loads(Path(baseline).read_text())
+    verdict = bench.probe_overload(doc, duration_s=duration_s,
+                                   attempts=attempts)
+    adm = verdict["arms"]["admitted"]["row"]
+    print(json.dumps({
+        "overload_speed": doc["overload"]["speed"],
+        "declared_admitted_p99_ms": doc["declared_admitted_p99_ms"],
+        "fresh_admitted_p99_ms": adm["latency_ms"].get("p99"),
+        "fresh_shed_rate": adm["shed_rate"],
+        "problems": verdict["problems"],
+    }, indent=2))
+    if verdict["problems"]:
+        print("FAIL: the admission overload invariants no longer hold")
+        return 1
+    print("OK: admission overload proof reproduces")
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--baseline", default="BENCH_CAPACITY.json")
@@ -127,7 +160,19 @@ def main() -> int:
     parser.add_argument("--duration-s", type=float, default=3.0)
     parser.add_argument("--attempts", type=int, default=2)
     parser.add_argument("--replay-workers", type=int, default=32)
+    parser.add_argument("--admission", action="store_true",
+                        help="re-check the committed admission overload "
+                             "proof instead of an SLO-capacity arm")
+    parser.add_argument("--admission-baseline",
+                        default="BENCH_ADMISSION.json")
     args = parser.parse_args()
+
+    if args.admission:
+        return admission_recheck(
+            args.admission_baseline,
+            # the admission re-check runs two arms: default to a shorter
+            # twin than the capacity gate's single-arm probe
+            min(args.duration_s, 2.0), args.attempts)
 
     doc = json.loads(Path(args.baseline).read_text())
     if args.arm not in doc["arms"]:
