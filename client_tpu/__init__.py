@@ -38,6 +38,13 @@ client libraries (triton-inference-server/client), designed TPU-first:
   trimming and per-endpoint cached server registrations; the transparent
   zero-copy fast path behind ``configure_arena``/``shm_arena=`` and
   ``set_data_from_numpy(..., arena=...)`` (docs/tpu_shared_memory.md).
+- ``client_tpu.shard``: sharded scatter-gather serving — a
+  ``PartitionSpec``-like ``ShardLayout`` maps tensor axes to
+  replica-pinned endpoints; ``ShardedClient``/``AioShardedClient`` split
+  one logical ``infer()`` into per-shard requests fanned out through the
+  pool, staged zero-copy via the arena, and gathered with exactness
+  asserts; a lost shard fails the whole request with a typed
+  ``ShardFailed`` (docs/sharding.md).
 - ``client_tpu.utils``: Triton<->numpy dtype mapping with *native* bfloat16
   (via ml_dtypes), BYTES/BF16 wire serialization.
 - ``client_tpu.utils.shared_memory``: POSIX system shared memory data plane.

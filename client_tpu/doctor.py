@@ -288,6 +288,27 @@ def _slo_status(tel: Telemetry) -> List[Dict[str, Any]]:
     ]
 
 
+def _shard_section(layout, snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Shard topology: the layout's declaration plus each pinned
+    endpoint's probed health/ejection/breaker state, in shard order."""
+    by_url = {ep["url"]: ep for ep in snap.get("endpoints", [])}
+    stats = snap.get("endpoint_stats", {})
+    shards = []
+    for i, url in enumerate(layout.endpoints):
+        ep = by_url.get(url, {})
+        st = stats.get(url, {})
+        shards.append({
+            "shard": i,
+            "url": url,
+            "live": bool(ep.get("live")),
+            "ready": bool(ep.get("ready")),
+            "ejected": bool(st.get("ejected")),
+            "breaker_state": st.get("breaker_state"),
+            "outstanding": st.get("outstanding"),
+        })
+    return {"layout": layout.describe(), "shards": shards}
+
+
 def _registry_section(snapshot: Dict[str, Any], prefix: str) -> Dict[str, Any]:
     return {name: family for name, family in snapshot.items()
             if name.startswith(prefix) and family.get("series")}
@@ -318,6 +339,26 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
         if stats.get("ejected"):
             flags.append({"flag": "endpoint_ejected", "url": url,
                           "detail": f"for {stats.get('ejected_for_s', 0)}s"})
+    # a sharded deployment has ZERO failover headroom: every logical
+    # request needs EVERY pinned endpoint, so one degraded replica is a
+    # whole-deployment outage, not an N-1 brownout — say so explicitly
+    for row in (snap.get("shard") or {}).get("shards", []):
+        problems = []
+        if not row.get("ready"):
+            problems.append("not ready")
+        if row.get("ejected"):
+            problems.append("ejected")
+        breaker = row.get("breaker_state")
+        if breaker and breaker != "closed":
+            problems.append(f"breaker {breaker}")
+        if problems:
+            flags.append({
+                "flag": "shard_degraded", "url": row["url"],
+                "detail": (f"shard {row['shard']} pinned endpoint is "
+                           f"{', '.join(problems)}; a sharded deployment "
+                           "has zero failover headroom — every logical "
+                           "request fails (typed ShardFailed) until this "
+                           "replica recovers")})
     for slo in snap.get("slos", []):
         if slo["breached"]:
             flags.append({
@@ -424,6 +465,7 @@ def collect_snapshot(
     skew_warn_ms: float = 250.0,
     probe_timeout_s: float = 10.0,
     client_factory: Optional[Callable[[str], Any]] = None,
+    shard_layout=None,
 ) -> Dict[str, Any]:
     """Probe the fleet and return the full snapshot dict (JSON-ready).
 
@@ -431,7 +473,17 @@ def collect_snapshot(
     probe; when a caller-supplied ``telemetry`` is passed it is used as
     is — its own ``orca_format`` (possibly None) wins, since mutating
     the caller's live telemetry mid-scrape would be worse than
-    honoring its configuration."""
+    honoring its configuration.
+
+    ``shard_layout``: a ``client_tpu.shard.ShardLayout`` (or its spec
+    string, resolved over ``urls`` in order) describing a sharded
+    deployment — adds a ``shard`` topology section and flags
+    ``shard_degraded`` when any pinned endpoint is unhealthy, ejected or
+    breaker-open."""
+    if isinstance(shard_layout, str):
+        from .shard import ShardLayout
+
+        shard_layout = ShardLayout.parse(shard_layout, list(urls))
     tel = telemetry
     if tel is None:
         tel = Telemetry(sample="always", orca_format=orca_format,
@@ -498,6 +550,8 @@ def collect_snapshot(
         for ep in pool.pool.endpoints:
             server_shm[ep.url] = _server_shm_status(ep.client,
                                                     probe_timeout_s)
+        if shard_layout is not None:
+            snap["shard"] = _shard_section(shard_layout, snap)
         snap["shm"]["server_regions"] = server_shm
         dp = snap["shm"]["dataplane"]
         if dp is not None and dataplane_before is not None:
@@ -575,6 +629,24 @@ def render_summary(snap: Dict[str, Any]) -> str:
                     f" network+client {row['network_client_overhead_ms']:.2f}"
                     f" ms (client total {row['client_request_ms']:.2f} ms)")
             lines.append("".join(parts))
+    shard = snap.get("shard")
+    if shard:
+        lines.append("")
+        layout = shard.get("layout", {})
+        lines.append(
+            f"shard topology ({layout.get('shards')} shards; inputs "
+            f"{layout.get('inputs')} -> outputs {layout.get('outputs')}):")
+        for row in shard.get("shards", []):
+            state = "ready" if row.get("ready") else "DEGRADED"
+            extra = []
+            if row.get("ejected"):
+                extra.append("ejected")
+            breaker = row.get("breaker_state")
+            if breaker and breaker != "closed":
+                extra.append(f"breaker={breaker}")
+            lines.append(
+                f"  shard {row['shard']}: {row['url']:<24} {state}"
+                f"{('  ' + ' '.join(extra)) if extra else ''}")
     admission = snap.get("admission") or []
     if admission:
         lines.append("")
@@ -664,6 +736,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--churn-threshold", type=float, default=10000.0,
                         help="shm churn ops/s above which to flag")
     parser.add_argument("--skew-warn-ms", type=float, default=250.0)
+    parser.add_argument("--shard-layout", default=None,
+                        help="sharded-deployment layout spec over the "
+                             "given urls in shard order, e.g. "
+                             "'TOKENS=0->LOGITS=0,NEXT_TOKEN=0': adds the "
+                             "shard topology section and the "
+                             "shard_degraded anomaly (client_tpu.shard)")
     parser.add_argument("--timeout", type=float, default=10.0,
                         help="per-call timeout (s) bounding every snapshot "
                              "RPC: health probes, probe infers, stats "
@@ -678,7 +756,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         args.urls, protocol=args.protocol, model=args.model,
         requests_per_endpoint=args.requests, orca_format=args.orca,
         churn_threshold_ops_s=args.churn_threshold,
-        skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout)
+        skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout,
+        shard_layout=args.shard_layout)
     print(render_summary(snap))
     if args.json_path:
         with open(args.json_path, "w") as f:

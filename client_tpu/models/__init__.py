@@ -10,6 +10,7 @@ TPU VM: the same wire contracts, but the compute runs on XLA.
 
 from .base import Model, TensorSpec
 from .decoder_batched import BatchedDecoderModel
+from .decoder_prefill import PrefillDecoderModel
 from .ensemble import EnsembleModel, EnsembleStep, build_image_ensemble
 from .generate import TinyGenerateModel
 from .simple import (
@@ -28,6 +29,7 @@ __all__ = [
     "EnsembleStep",
     "IdentityModel",
     "Model",
+    "PrefillDecoderModel",
     "RepeatModel",
     "SequenceAccumulatorModel",
     "StringAddSubModel",

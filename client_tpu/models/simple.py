@@ -209,6 +209,7 @@ def default_model_zoo() -> List[Model]:
     from .batched import BatchedMatMulModel
     from .decoder import TinyDecoderModel
     from .decoder_batched import BatchedDecoderModel
+    from .decoder_prefill import PrefillDecoderModel
     from .generate import TinyGenerateModel
 
     decoder = TinyDecoderModel()
@@ -227,4 +228,8 @@ def default_model_zoo() -> List[Model]:
         decoder,
         TinyGenerateModel(decoder=decoder),
         BatchedDecoderModel(),
+        # stateless batched prompt scoring (builds lazily): the sharded
+        # scatter-gather client's batch-axis targets (client_tpu/shard.py)
+        PrefillDecoderModel(tp=False),
+        PrefillDecoderModel(tp=True),
     ]

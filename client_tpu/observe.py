@@ -962,10 +962,17 @@ def parse_endpoint_load(value: Optional[str],
 #               GRPC unary: the whole call, send+server+receive)
 #   recv        response body read
 #   deserialize response unmarshaling into InferResult
-#   attempt     one resilient attempt (sub-span; repeated under retries)
+#   attempt     one resilient attempt (sub-span; repeated under retries —
+#               and one per SHARD on a sharded logical request, so
+#               phase_breakdown's attempt row is the slowest-shard leg)
+#   shard_scatter  slicing + arena staging + dispatch of the per-shard
+#               requests of one sharded logical infer (client_tpu.shard)
+#   shard_gather   shard-response exactness checks + logical-result
+#               assembly after the last shard landed
 REQUEST_PHASES = (
     "queue", "admission_queue", "coalesce_queue", "serialize", "connect",
     "send", "ttfb", "recv", "deserialize", "attempt",
+    "shard_scatter", "shard_gather",
 )
 
 
@@ -1708,6 +1715,24 @@ class Telemetry:
         self.hedge_losses_total = reg.counter(
             "client_tpu_hedge_losses_total",
             "Requests where the primary beat an in-flight hedge")
+        # -- sharded scatter-gather (client_tpu.shard) ------------------------
+        self.shard_requests_total = reg.counter(
+            "client_tpu_shard_requests_total",
+            "Sharded LOGICAL requests finished (success or error) per "
+            "frontend", ("frontend",))
+        self.shard_subrequests_total = reg.counter(
+            "client_tpu_shard_subrequests_total",
+            "Per-shard requests issued by the scatter-gather layer, by "
+            "pinned endpoint", ("url",))
+        self.shard_failed_total = reg.counter(
+            "client_tpu_shard_failed_total",
+            "Logical requests failed by a shard (the whole request fails "
+            "— never a partial gather), by the failing pinned endpoint",
+            ("url",))
+        self.shard_skew_seconds = reg.histogram(
+            "client_tpu_shard_skew_seconds",
+            "Slowest-minus-fastest shard completion skew per successful "
+            "logical request (the scatter-gather straggler cost)")
         # -- admission control (client_tpu.admission) -------------------------
         self.admission_shed_total = reg.counter(
             "client_tpu_admission_shed_total",
@@ -2200,6 +2225,20 @@ class Telemetry:
         abandoned = getattr(event, "abandoned_request_ids", None)
         if abandoned:
             self.stream_abandoned_sequences_total.inc(len(abandoned))
+
+    def on_shard_subrequest(self, url: str) -> None:
+        self.shard_subrequests_total.labels(url).inc()
+
+    def on_shard_result(self, frontend: str,
+                        skew_s: Optional[float] = None) -> None:
+        """One sharded logical request finished (either way); ``skew_s``
+        (successes only) is the slowest-minus-fastest shard interval."""
+        self.shard_requests_total.labels(frontend).inc()
+        if skew_s is not None:
+            self.shard_skew_seconds.observe(max(0.0, skew_s))
+
+    def on_shard_failed(self, url: str) -> None:
+        self.shard_failed_total.labels(url).inc()
 
     def on_hedge_fired(self) -> None:
         self.hedges_fired_total.inc()

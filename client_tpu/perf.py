@@ -118,6 +118,7 @@ class PerfRunner:
         admission_target_ms: Optional[float] = None,
         admission_max_queue_wait_s: float = 0.05,
         endpoint_limits: bool = False,
+        shard_layout=None,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -164,6 +165,18 @@ class PerfRunner:
         self.admission_target_ms = admission_target_ms
         self.admission_max_queue_wait_s = admission_max_queue_wait_s
         self.endpoint_limits = endpoint_limits
+        # sharded scatter-gather (client_tpu.shard): a ShardLayout or a
+        # spec string ("IN=0->OUT=0") resolved over --endpoints in order;
+        # measurement clients become ShardedClients over the pool
+        if isinstance(shard_layout, str):
+            from .shard import ShardLayout
+
+            if not endpoints:
+                raise ValueError(
+                    "--shard-layout requires --endpoints: each shard is "
+                    "pinned to one replica url")
+            shard_layout = ShardLayout.parse(shard_layout, list(endpoints))
+        self.shard_layout = shard_layout
         # orca_weighted routing needs the frontends to OPT IN to the ORCA
         # response header; every Telemetry this runner builds carries it
         self._orca_format = "json" if routing == "orca_weighted" else None
@@ -224,6 +237,21 @@ class PerfRunner:
             raise ValueError(
                 "--routing/--admission/--endpoint-limits require "
                 "--endpoints: they are pool-level policies")
+        if self.shard_layout is not None:
+            if not self.endpoints:
+                raise ValueError(
+                    "--shard-layout requires --endpoints: each shard is "
+                    "pinned to one replica url")
+            if self.hedge or self.coalesce:
+                raise ValueError(
+                    "--shard-layout rejects --hedge and --coalesce: "
+                    "sharded requests never hedge (a hedge would race a "
+                    "replica holding a different partition) and never "
+                    "coalesce (see docs/sharding.md)")
+            if generate_stream:
+                raise ValueError(
+                    "--shard-layout applies to unary/sharded infers, not "
+                    "--generate-stream")
         if self.coalesce:
             if protocol not in ("http", "grpc"):
                 raise ValueError(
@@ -280,7 +308,21 @@ class PerfRunner:
 
             return NativeGrpcClient(self.url)
         if self.endpoints:
-            return self._wrap_coalescing(self._make_pool_client(concurrency))
+            pool = self._make_pool_client(concurrency)
+            if self.shard_layout is not None:
+                from .shard import ShardedClient
+
+                # one ShardedClient per measurement client: logical infers
+                # scatter across the replica-pinned endpoints (the pool
+                # carries the arena so shards stage zero-copy). Every
+                # logical request holds n_shards fan-out threads, so the
+                # executor must admit the full worker concurrency or the
+                # harness would measure its own thread pool
+                return ShardedClient(
+                    pool, self.shard_layout,
+                    executor_workers=max(
+                        8, 2 * concurrency * self.shard_layout.n_shards))
+            return self._wrap_coalescing(pool)
         if self.protocol == "http":
             client = self._client_mod.InferenceServerClient(
                 self.url, concurrency=concurrency)
@@ -309,6 +351,21 @@ class PerfRunner:
             batch_max_rows=self.batch_max,
             telemetry=self._telemetry,
         )
+
+    def _shard_arena(self):
+        """One NON-promoting arena per runner for the sharded arms: the
+        scatter path leases fresh per-request slabs explicitly (safe), but
+        transparent promotion of the replay's SHARED cached InferInputs
+        would mutate one input's raw-data/shm-params state from many
+        workers at once — unsharded replay records must stay plain
+        binary."""
+        with self._arena_lock:
+            if self._arena is None:
+                from .arena import ShmArena
+
+                self._arena = ShmArena(promote_inputs=False,
+                                       name_prefix="perf_shard")
+            return self._arena
 
     def _make_pool_client(self, concurrency: int):
         from .pool import HedgePolicy, PoolClient
@@ -344,6 +401,10 @@ class PerfRunner:
         return PoolClient(
             self.endpoints,
             protocol=self.protocol,
+            # sharded scatter staging rides the arena fast path (cached
+            # per-endpoint registrations; see client_tpu.shard)
+            shm_arena=self._shard_arena() if self.shard_layout is not None
+            else None,
             client_factory=factory,
             routing=self.routing or "round_robin",
             health_interval_s=0.5,
@@ -1064,6 +1125,12 @@ class PerfRunner:
             raise ValueError(
                 "trace contains generate_stream records: the generate "
                 "extension is an HTTP SSE surface (use -i http)")
+        if (any(r.kind == "sharded" for r in records)
+                and self.shard_layout is None):
+            raise ValueError(
+                "trace contains sharded records: configure --shard-layout "
+                "(with --endpoints) so the replayer can scatter them "
+                "(client_tpu.shard)")
         specs: List[SLOSpec] = [
             spec if isinstance(spec, SLOSpec) else parse_slo_spec(spec)
             for spec in slos]
@@ -1193,7 +1260,10 @@ class PerfRunner:
             done.add(key)
             try:
                 if rec.kind == "sequence":
-                    client.infer(
+                    # same unwrap as _replay_dispatch: a ShardedClient
+                    # types-rejects sequence kwargs, and a swallowed
+                    # rejection here would silently skip the warmup
+                    getattr(client, "inner", client).infer(
                         rec.model, resources.inputs_for(rec),
                         sequence_id=999979,
                         sequence_start=True, sequence_end=True)
@@ -1249,7 +1319,14 @@ class PerfRunner:
                 outcome = e
                 errors.append(f"{rec.kind}: {e}")
             except Exception as e:  # measured as failure, replay continues
-                status = "error"
+                # a sharded logical request wraps its per-shard failure in
+                # ShardFailed; a breaker-open/admission cause underneath is
+                # still a SHED, not an error — same classification contract
+                # as the unsharded kinds
+                cause = getattr(e, "cause", None)
+                status = ("shed" if isinstance(
+                    cause, (CircuitOpenError, AdmissionRejected))
+                    else "error")
                 outcome = e
                 errors.append(f"{rec.kind}: {e}")
             finally:
@@ -1273,6 +1350,14 @@ class PerfRunner:
                 on_result(rec, outcome)
 
     def _replay_dispatch(self, client, rec, resources):
+        if rec.kind == "sharded":
+            # the measurement client IS the ShardedClient in shard mode
+            return client.infer(
+                rec.model, resources.inputs_for(rec),
+                model_version=rec.version)
+        # non-sharded kinds bypass the scatter-gather wrapper (a sharded
+        # client types-rejects streams and would scatter plain unaries)
+        client = getattr(client, "inner", client)
         if rec.kind == "generate_stream":
             events = []
             for event in client.generate_stream(
@@ -1613,6 +1698,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="arm a per-endpoint adaptive concurrency limit (selection "
              "skips replicas at their limit; requires --endpoints)")
     parser.add_argument(
+        "--shard-layout", default=None,
+        help="scatter-gather every infer across --endpoints per this "
+             "layout spec, e.g. 'TOKENS=0->LOGITS=0,NEXT_TOKEN=0' "
+             "(tensor=axis pairs, 'r' = replicated, inputs->outputs; "
+             "shard i pins to the i-th --endpoints url; rejects --hedge/"
+             "--coalesce; also required to replay 'sharded' trace "
+             "records — see client_tpu.shard / docs/sharding.md)")
+    parser.add_argument(
         "--stream-prompt-tokens", type=int, default=32,
         help="prompt length for --generate-stream sessions")
     parser.add_argument(
@@ -1677,6 +1770,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         admission_mode=args.admission_mode,
         admission_target_ms=args.admission_target_ms,
         endpoint_limits=args.endpoint_limits,
+        shard_layout=args.shard_layout,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside

@@ -560,6 +560,17 @@ class EndpointPool:
             raise NoEndpointAvailableError()
         return picked
 
+    def endpoint_by_url(self, url: str) -> EndpointState:
+        """The EndpointState serving ``url`` (the sharded scatter-gather
+        layer pins each shard to one replica by url). Raises
+        :class:`NoEndpointAvailableError` for an unknown url — a layout
+        naming a replica outside the pool has no legal target."""
+        for ep in self.endpoints:
+            if ep.url == url:
+                return ep
+        raise NoEndpointAvailableError(
+            f"endpoint {url!r} is not a member of this pool")
+
     # -- accounting ----------------------------------------------------------
     def begin(self, ep: EndpointState) -> None:
         with self._lock:
@@ -1389,6 +1400,32 @@ class PoolClient(_PoolClientBase):
         assert last is not None
         raise last
 
+    def pinned_infer(self, url: str, model_name: str, inputs, *args,
+                     **kwargs):
+        """ONE infer against the named replica: no routing, no failover,
+        no hedging, and no pool-level admission gate — the sharded
+        scatter-gather layer (``client_tpu.shard``) owns retry/admission
+        semantics per LOGICAL request and pins each shard here. The
+        outcome still feeds the endpoint's breaker, outlier detector,
+        outstanding count and latency window exactly like a routed
+        attempt, so shard traffic is visible to ``least_outstanding``
+        routing and health accounting (shard-aware routing)."""
+        kwargs = _fold_infer_args(args, kwargs)
+        ep = self.pool.endpoint_by_url(url)
+        self.pool.begin(ep)
+        t0 = time.monotonic()
+        try:
+            result = ep.client.infer(model_name, inputs, **kwargs)
+        except CircuitOpenError:
+            raise  # nothing was sent; the breaker already knows
+        except Exception as e:
+            self._record_attempt_failure(ep, e)
+            raise
+        finally:
+            self.pool.done(ep)
+        self.pool.record_success(ep, time.monotonic() - t0)
+        return result
+
     def _get_executor(self) -> ThreadPoolExecutor:
         with self._executor_lock:
             if self._executor is None:
@@ -1862,6 +1899,29 @@ class AioPoolClient(_PoolClientBase):
             return result
         assert last is not None
         raise last
+
+    async def pinned_infer(self, url: str, model_name: str, inputs, *args,
+                           **kwargs):
+        """Async twin of the sync :meth:`PoolClient.pinned_infer` (the
+        sharded scatter-gather layer's per-shard dispatch)."""
+        self._ensure_prober()
+        kwargs = _fold_infer_args(args, kwargs)
+        ep = self.pool.endpoint_by_url(url)
+        self.pool.begin(ep)
+        t0 = time.monotonic()
+        try:
+            result = await ep.client.infer(model_name, inputs, **kwargs)
+        except asyncio.CancelledError:
+            raise  # a cancelled sibling shard: no outcome to record
+        except CircuitOpenError:
+            raise
+        except Exception as e:
+            self._record_attempt_failure(ep, e)
+            raise
+        finally:
+            self.pool.done(ep)
+        self.pool.record_success(ep, time.monotonic() - t0)
+        return result
 
     # -- streaming (HTTP generate extension) ----------------------------------
     def generate_stream(self, *args, **kwargs):

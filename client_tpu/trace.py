@@ -11,11 +11,15 @@ gives the perf harness something replayable:
   carrying the format version and generator provenance; every following
   line is a ``type: "request"`` record with an arrival offset (``at_s``,
   seconds from replay start), a ``kind`` (``unary`` | ``generate_stream``
-  | ``sequence``), the target model/version, and kind-specific payload
-  sizing — tensor ``shapes``/``dtypes`` for unary and sequence records,
-  ``prompt_tokens``/``output_tokens`` for streams. Sequence records carry
-  ``(seq_group, seq_index, seq_len)`` so the replayer can pin each group
-  to one replica (the pool's affinity rules) and issue its steps in order.
+  | ``sequence`` | ``sharded``), the target model/version, and
+  kind-specific payload sizing — tensor ``shapes``/``dtypes`` for unary,
+  sequence and sharded records, ``prompt_tokens``/``output_tokens`` for
+  streams. Sequence records carry ``(seq_group, seq_index, seq_len)`` so
+  the replayer can pin each group to one replica (the pool's affinity
+  rules) and issue its steps in order. ``sharded`` records (format v2,
+  stamped per record so v1 loaders skip-and-count them) are logical
+  scatter-gather requests replayed through ``perf.py --shard-layout``
+  (``client_tpu.shard``).
 
 - **Versioning**: the header's ``version`` is the format version; a
   *record* may carry its own ``v`` — records (and whole traces) from a
@@ -45,9 +49,17 @@ from typing import Any, Dict, IO, Iterable, List, Optional, Tuple, Union
 
 import numpy as np
 
-TRACE_VERSION = 1
+# what THIS parser understands; headers are written at the BASE version so
+# a v1 reader still loads the v1-compatible records of a mixed trace, and
+# only records carrying newer-versioned semantics stamp their own ``v``
+# (the PR 8 forward-compat rule: skip-and-count, never fatal)
+TRACE_VERSION = 2
+BASE_VERSION = 1
+# record kinds introduced after the base format stamp their records with
+# the version that introduced them
+_KIND_VERSIONS = {"sharded": 2}
 
-KINDS = ("unary", "generate_stream", "sequence")
+KINDS = ("unary", "generate_stream", "sequence", "sharded")
 
 # default tensor layouts per well-known zoo model, so generator specs can
 # name a model without restating its wire contract
@@ -56,6 +68,10 @@ _DEFAULT_LAYOUTS: Dict[str, Tuple[Dict[str, List[int]], Dict[str, str]]] = {
                {"INPUT0": "INT32", "INPUT1": "INT32"}),
     "batched_matmul": ({"X": [1, 64]}, {"X": "FP32"}),
     "simple_sequence": ({"INPUT": [1, 1]}, {"INPUT": "INT32"}),
+    # stateless batched prompt scoring (client_tpu/shard.py's batch-axis
+    # scatter-gather targets); replay tokens stay inside the VOCAB
+    "decoder_lm_prefill": ({"TOKENS": [4, 8]}, {"TOKENS": "INT32"}),
+    "decoder_lm_tp_prefill": ({"TOKENS": [4, 8]}, {"TOKENS": "INT32"}),
 }
 
 
@@ -76,7 +92,7 @@ class TraceRecord:
     kind: str
     model: str
     version: str = ""
-    # unary / sequence payload sizing
+    # unary / sequence / sharded payload sizing
     shapes: Optional[Dict[str, List[int]]] = None
     dtypes: Optional[Dict[str, str]] = None
     # generate_stream payload sizing
@@ -86,6 +102,9 @@ class TraceRecord:
     seq_group: Optional[int] = None
     seq_index: Optional[int] = None
     seq_len: Optional[int] = None
+    # sharded records: the generator's declared fan-out (informational —
+    # the replayer's --shard-layout decides the real endpoints/axes)
+    shards: Optional[int] = None
 
     def to_obj(self) -> Dict[str, Any]:
         obj: Dict[str, Any] = {
@@ -106,6 +125,13 @@ class TraceRecord:
             obj["seq_group"] = int(self.seq_group)
             obj["seq_index"] = int(self.seq_index)
             obj["seq_len"] = int(self.seq_len)
+        if self.kind == "sharded" and self.shards is not None:
+            obj["shards"] = int(self.shards)
+        v = _KIND_VERSIONS.get(self.kind)
+        if v is not None and v > BASE_VERSION:
+            # newer-kind records stamp their own version so a BASE_VERSION
+            # reader skips exactly these (counted) and keeps the rest
+            obj["v"] = v
         return obj
 
     @classmethod
@@ -126,7 +152,7 @@ class TraceRecord:
             "at_s": round(at_s, 6), "kind": kind, "model": model,
             "version": str(obj.get("model_version", "")),
         }
-        if kind in ("unary", "sequence") and "shapes" not in obj:
+        if kind in ("unary", "sequence", "sharded") and "shapes" not in obj:
             raise TraceParseError(
                 line, f"{kind} requires shapes/dtypes")
         if "shapes" in obj:
@@ -168,6 +194,14 @@ class TraceRecord:
                 raise TraceParseError(
                     line, f"seq_index {kwargs['seq_index']} outside "
                     f"seq_len {kwargs['seq_len']}")
+        if kind == "sharded" and "shards" in obj:
+            try:
+                kwargs["shards"] = int(obj["shards"])
+            except (TypeError, ValueError):
+                raise TraceParseError(
+                    line, "shards must be an integer") from None
+            if kwargs["shards"] < 1:
+                raise TraceParseError(line, "shards must be >= 1")
         return cls(**kwargs)
 
 
@@ -207,7 +241,7 @@ def dumps_trace(records: Iterable[TraceRecord],
                 header: Optional[Dict[str, Any]] = None) -> str:
     """The trace as one JSONL string (header line first). Byte-identical
     for identical ``(records, header)`` — the determinism contract."""
-    head = {"type": "header", "version": TRACE_VERSION}
+    head = {"type": "header", "version": BASE_VERSION}
     head.update(header or {})
     records = list(records)
     head["records"] = len(records)
@@ -413,25 +447,46 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
           seq_gap_s: float = 0.05, unary_model: str = "simple",
           stream_model: str = "tiny_lm_generate",
           seq_model: str = "simple_sequence",
+          shard_fraction: float = 0.0, shards: int = 2,
+          shard_model: str = "decoder_lm_tp_prefill",
+          shard_batch: Optional[int] = None,
           shapes: Optional[Dict[str, List[int]]] = None,
           dtypes: Optional[Dict[str, str]] = None) -> List[TraceRecord]:
     """Mixed-kind bursty traffic: each Poisson-burst arrival becomes a
     stream (``stream_fraction``), a whole sequence of ``seq_len_min..max``
-    steps spaced ~``seq_gap_s`` apart (``seq_fraction``), or a unary infer
-    (the rest). ``rate`` counts *arrivals* — a sequence arrival fans out
-    into several requests, so the offered request rate is slightly higher."""
-    if stream_fraction + seq_fraction > 1.0:
-        raise ValueError("stream_fraction + seq_fraction must be <= 1")
+    steps spaced ~``seq_gap_s`` apart (``seq_fraction``), a sharded
+    scatter-gather logical request (``shard_fraction``; replayed through
+    ``--shard-layout``), or a unary infer (the rest). ``rate`` counts
+    *arrivals* — a sequence arrival fans out into several requests, so the
+    offered request rate is slightly higher. The default
+    ``shard_fraction=0`` draws nothing extra from the rng, so pre-sharding
+    specs keep producing byte-identical traces."""
+    if stream_fraction + seq_fraction + shard_fraction > 1.0:
+        raise ValueError(
+            "stream_fraction + seq_fraction + shard_fraction must be <= 1")
     if seq_len_min < 1 or seq_len_max < seq_len_min:
         raise ValueError("need 1 <= seq_len_min <= seq_len_max")
     rng = np.random.default_rng(seed)
     unary_shapes, unary_dtypes = _layout(unary_model, shapes, dtypes)
     seq_shapes, seq_dtypes = _layout(seq_model)
+    shard_shapes, shard_dtypes = (
+        _layout(shard_model) if shard_fraction > 0.0 else ({}, {}))
+    if shard_batch is not None:
+        if shard_batch < shards:
+            raise ValueError(f"shard_batch {shard_batch} < shards {shards}")
+        shard_shapes = {k: [int(shard_batch)] + list(v[1:])
+                        for k, v in shard_shapes.items()}
     records: List[TraceRecord] = []
     group = 0
     for t in _arrival_times(rng, duration_s, rate, burst_factor,
                             period_s, duty):
         pick = float(rng.random())
+        if shard_fraction and pick >= stream_fraction + seq_fraction \
+                and pick < stream_fraction + seq_fraction + shard_fraction:
+            records.append(TraceRecord(
+                at_s=t, kind="sharded", model=shard_model,
+                shapes=shard_shapes, dtypes=shard_dtypes, shards=shards))
+            continue
         if pick < stream_fraction:
             records.append(TraceRecord(
                 at_s=t, kind="generate_stream", model=stream_model,
@@ -459,14 +514,45 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
     return records
 
 
+def sharded(seed: int = 0, duration_s: float = 10.0, rate: float = 20.0,
+            burst_factor: float = 1.0, period_s: float = 2.0,
+            duty: float = 0.25, shards: int = 2,
+            model: str = "decoder_lm_tp_prefill",
+            batch: Optional[int] = None,
+            shapes: Optional[Dict[str, List[int]]] = None,
+            dtypes: Optional[Dict[str, str]] = None) -> List[TraceRecord]:
+    """Sharded logical requests arriving Poisson (optionally bursty):
+    each record is ONE logical scatter-gather infer whose tensors the
+    replayer splits per its ``--shard-layout`` across ``shards``
+    replica-pinned endpoints (``client_tpu.shard``). ``batch`` overrides
+    the leading (shard) dimension of the model's default layout — spec
+    strings can't carry shape dicts, and the sharded axis must be at
+    least ``shards`` long. Records are stamped ``v=2`` so a v1 loader
+    skips them (counted) instead of failing."""
+    if shards < 1:
+        raise ValueError("shards must be >= 1")
+    rng = np.random.default_rng(seed)
+    shapes, dtypes = _layout(model, shapes, dtypes)
+    if batch is not None:
+        if batch < shards:
+            raise ValueError(f"batch {batch} < shards {shards}")
+        shapes = {k: [int(batch)] + list(v[1:]) for k, v in shapes.items()}
+    return [TraceRecord(at_s=t, kind="sharded", model=model,
+                        shapes=shapes, dtypes=dtypes, shards=shards)
+            for t in _arrival_times(rng, duration_s, rate, burst_factor,
+                                    period_s, duty)]
+
+
 GENERATORS = {
     "poisson_burst": poisson_burst,
     "heavy_tail": heavy_tail,
     "mixed": mixed,
+    "sharded": sharded,
 }
 
 # spec params that must stay strings when parsed from a spec
-_STR_PARAMS = {"model", "unary_model", "stream_model", "seq_model", "tail"}
+_STR_PARAMS = {"model", "unary_model", "stream_model", "seq_model",
+               "shard_model", "tail"}
 
 
 def parse_gen_spec(spec: str) -> Tuple[str, Dict[str, Any]]:

@@ -11,6 +11,10 @@ per feature-matrix arm:
 - ``pool3_hedge``   — 3-replica PoolClient with hedged requests
 - ``pool3_chaos``   — 3-replica PoolClient, one replica behind a
   ChaosProxy latency fault, retries armed — capacity under partial failure
+- ``sharded2``      — 2-replica scatter-gather fleet (client_tpu.shard):
+  every logical request splits across both replicas and gathers with
+  exactness asserts; replays its own ``sharded`` trace (recorded per-arm
+  as ``trace_spec`` so the gate re-generates the right workload)
 
 Every probed speed emits a full replay row (per-kind latency/TTFT/ITL
 percentiles, offered-vs-achieved rate, schedule slip, shed/error
@@ -49,6 +53,17 @@ TRACE_SEED = 2026
 # p99 verdict over that flips on ~3 GIL-scheduling outliers — p95 binds on
 # genuine queueing (17+ bad samples) instead of single-core jitter
 SLOS = ["ttft_p95<500ms", "p95<200ms", "error_rate<1%"]
+# the sharded arm's own workload: one-logical-request-across-the-mesh
+# records (format v2) over the row-parallel matmul — 8 rows split 4+4
+SHARD_TRACE_SPEC = ("sharded:duration_s=4,rate=40,model=batched_matmul,"
+                    "batch=8,shards=2,burst_factor=3,period_s=1.0,"
+                    "duty=0.3")
+# per-arm trace specs (default: TRACE_SPEC); the artifact records each
+# arm's spec as ``trace_spec`` so capacity_gate replays the right shape
+ARM_TRACE_SPECS = {"sharded2": SHARD_TRACE_SPEC}
+# per-arm SLO sets: the sharded trace has no streams, so a ttft objective
+# would sit at 0 events and read "not attained" forever
+ARM_SLOS = {"sharded2": ["p95<200ms", "error_rate<1%"]}
 # a probe must also DELIVER the offered schedule: past saturation the
 # replay workers self-throttle, request latency stays flattering while
 # the schedule silently slips — the very failure mode the replay's
@@ -135,9 +150,11 @@ def arm_runner(name: str, chaos_latency_s: float = 0.01):
     from client_tpu.server import HttpInferenceServer, ServerCore
     from client_tpu.testing import ChaosProxy, Fault
 
-    if name not in ("baseline", "batching", "pool3_hedge", "pool3_chaos"):
+    if name not in ("baseline", "batching", "pool3_hedge", "pool3_chaos",
+                    "sharded2"):
         raise ValueError(f"unknown arm {name!r}")
-    n_servers = 3 if name.startswith("pool3") else 1
+    n_servers = 3 if name.startswith("pool3") else (
+        2 if name == "sharded2" else 1)
     servers = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
                for _ in range(n_servers)]
     proxy = None
@@ -148,7 +165,15 @@ def arm_runner(name: str, chaos_latency_s: float = 0.01):
         kwargs: Dict[str, Any] = {}
         feature = "bare client, one replica"
         endpoints = None
-        if name == "batching":
+        shapes = {"X": [1, 64]}
+        if name == "sharded2":
+            endpoints = [s.url for s in servers]
+            kwargs.update(shard_layout="X=0->Y=0")
+            shapes = {"X": [8, 64]}
+            feature = ("2-replica scatter-gather fleet "
+                       "(client_tpu.shard): logical requests split "
+                       "across both replicas, gathered exactly")
+        elif name == "batching":
             kwargs.update(coalesce=True, batch_max=32)
             feature = "coalescing dispatcher (client_tpu.batch)"
         elif name == "pool3_hedge":
@@ -167,7 +192,7 @@ def arm_runner(name: str, chaos_latency_s: float = 0.01):
                        f"{chaos_latency_s * 1e3:g}ms latency "
                        f"ChaosProxy, retries=1")
         runner = PerfRunner(servers[0].url, "http", "batched_matmul",
-                            shape_overrides={"X": [1, 64]},
+                            shape_overrides=shapes,
                             endpoints=endpoints, **kwargs)
         yield runner, feature
     finally:
@@ -180,10 +205,12 @@ def arm_runner(name: str, chaos_latency_s: float = 0.01):
 
 
 def _search(runner, tr, speed_lo: float, speed_hi: float, iters: int,
-            replay_workers: int) -> Dict[str, Any]:
+            replay_workers: int, slos=None) -> Dict[str, Any]:
+    slos = list(SLOS) if slos is None else list(slos)
+
     def evaluate(speed: float) -> Tuple[bool, Dict[str, Any]]:
         row = runner.run_trace(tr, speed=round(speed, 3),
-                               replay_workers=replay_workers, slos=SLOS)
+                               replay_workers=replay_workers, slos=slos)
         row["delivery_ratio"] = round(
             row["achieved_arrival_rate"] / row["offered_rate"], 3) \
             if row["offered_rate"] else 1.0
@@ -272,11 +299,25 @@ def main(argv=None, trace_override=None) -> int:
     }
 
     for name in [a.strip() for a in args.arms.split(",") if a.strip()]:
+        arm_spec = ARM_TRACE_SPECS.get(name)
+        if arm_spec is not None and trace_override is None:
+            arm_tr = trace_mod.generate(arm_spec, seed=TRACE_SEED)
+        else:
+            arm_tr = tr
+        arm_slos = ARM_SLOS.get(name)
         with arm_runner(name, args.chaos_latency_s) as (runner, feature):
             print(f"arm {name}: {feature}", flush=True)
-            arm = _search(runner, tr, args.speed_lo, args.speed_hi,
-                          args.iters, args.replay_workers)
+            arm = _search(runner, arm_tr, args.speed_lo, args.speed_hi,
+                          args.iters, args.replay_workers, slos=arm_slos)
             arm["feature"] = feature
+            if arm_spec is not None and trace_override is None:
+                # the gate re-generates per-arm workloads from this; an
+                # override replay measured a DIFFERENT workload, so
+                # stamping the arm spec would point the gate at a trace
+                # the committed number never saw
+                arm["trace_spec"] = arm_spec
+            if arm_slos is not None:
+                arm["slos"] = list(arm_slos)
         out["arms"][name] = arm
 
     Path(args.output).write_text(json.dumps(out, indent=2) + "\n")

@@ -1,0 +1,360 @@
+"""Generate BENCH_SHARD.json: the sharded scatter-gather proof artifact.
+
+Four arms over 2 in-process replica servers (the same topology every other
+bench in this repo uses — CPU container numbers, honest about it):
+
+- **exactness**: a batch of prompts scattered across N
+  ``decoder_lm_tp_prefill`` replicas (client_tpu.shard) and gathered must
+  be BIT-identical to the single-process reference model
+  (``decoder_lm_prefill``, tp step bit-equal by models/decoder_tp.py's
+  guarantee) on every request.
+- **scatter_vs_single**: latency + closed-loop capacity of the sharded
+  fleet vs ONE replica serving the full batch, over the non-TP prefill
+  (each replica scores half the rows; in-process TP replicas would
+  serialize on the virtual-device lock and hide the win).
+- **steady_state**: sharded infers through the shm-arena fast path —
+  after warmup, region creates and registration RPCs per request must be
+  ZERO (slabs reused, registrations cached per (endpoint, region)).
+- **chaos**: one replica RSTs mid-run; every affected logical request
+  must fail with the typed ShardFailed naming the dead shard/endpoint,
+  and every success must stay bit-exact (zero partial gathers).
+
+``--check`` re-validates an existing artifact's acceptance invariants and
+exits nonzero on violation (tests/test_shard.py pins the same claims):
+
+    JAX_PLATFORMS=cpu python tools/bench_shard.py [-o BENCH_SHARD.json]
+    JAX_PLATFORMS=cpu python tools/bench_shard.py --check BENCH_SHARD.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _percentiles(samples_s):
+    xs = sorted(samples_s)
+    n = len(xs)
+    if not n:
+        return {}
+    pick = lambda q: xs[min(n - 1, int(q * (n - 1) + 0.5))]  # noqa: E731
+    return {
+        "avg": round(1e3 * sum(xs) / n, 3),
+        "p50": round(1e3 * pick(0.50), 3),
+        "p90": round(1e3 * pick(0.90), 3),
+        "p99": round(1e3 * pick(0.99), 3),
+    }
+
+
+def check(path: str) -> int:
+    data = json.loads(Path(path).read_text())
+    failures = []
+    if data["exactness"]["bit_exact"] is not True:
+        failures.append("scatter-gather is not bit-exact vs the "
+                        "single-process reference")
+    if data["exactness"]["requests"] <= 0:
+        failures.append("exactness arm measured no requests")
+    steady = data["steady_state"]
+    if steady["requests"] <= 0:
+        failures.append("steady-state arm measured no requests")
+    if steady["region_creates_per_request"] != 0:
+        failures.append("steady-state sharded infers created regions")
+    if steady["registration_rpcs_per_request"] != 0:
+        failures.append("steady-state sharded infers issued "
+                        "registration RPCs")
+    chaos = data["chaos"]
+    if chaos["affected_requests"] <= 0:
+        failures.append("chaos arm affected no requests")
+    if chaos["shard_failed_typed"] != chaos["affected_requests"]:
+        failures.append(
+            "a killed shard did not produce typed ShardFailed on 100% "
+            "of affected logical requests")
+    if chaos["partial_gathers"] != 0:
+        failures.append("chaos arm produced partial gathers")
+    if chaos["failed_shard_named"] is not True:
+        failures.append("ShardFailed did not name the killed "
+                        "shard/endpoint")
+    if chaos.get("recovered_after_heal", 0) <= 0:
+        failures.append("no logical request succeeded after the killed "
+                        "shard healed")
+    for msg in failures:
+        print(f"CHECK FAILED: {msg}", file=sys.stderr)
+    if not failures:
+        print(f"{path}: all sharded scatter-gather acceptance "
+              "invariants hold")
+    return 1 if failures else 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_SHARD.json")
+    parser.add_argument("--exact-requests", type=int, default=15)
+    parser.add_argument("--latency-requests", type=int, default=40)
+    parser.add_argument("--steady-requests", type=int, default=200)
+    parser.add_argument("--chaos-requests", type=int, default=40)
+    parser.add_argument("--rows", type=int, default=8)
+    parser.add_argument("--prompt-tokens", type=int, default=8)
+    parser.add_argument("--check", metavar="ARTIFACT",
+                        help="validate an existing artifact instead of "
+                             "benchmarking")
+    args = parser.parse_args()
+    if args.check:
+        return check(args.check)
+
+    import client_tpu.http as httpclient
+    from client_tpu.arena import ShmArena
+    from client_tpu.models import default_model_zoo
+    from client_tpu.models.decoder_prefill import PrefillDecoderModel
+    from client_tpu.pool import PoolClient
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.shard import ShardFailed, ShardLayout, ShardedClient
+    from client_tpu.testing import ChaosProxy, Fault
+
+    rng = np.random.default_rng(0xC11E)
+    servers = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+               for _ in range(2)]
+    proxies = [ChaosProxy("127.0.0.1", s.port).start() for s in servers]
+    direct_urls = [f"127.0.0.1:{s.port}" for s in servers]
+    proxy_urls = [p.url for p in proxies]
+
+    out = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "replicas": 2,
+        "note": (
+            "client-driven scatter-gather (client_tpu.shard) over 2 "
+            "in-process replica servers; decoder_lm_tp_prefill exactness "
+            "vs single-process reference, non-TP prefill for the "
+            "latency/capacity comparison (in-process TP replicas "
+            "serialize on the virtual-device lock), shm-arena staging "
+            "for the steady-state arm; CPU container numbers"
+        ),
+    }
+
+    def sharded_client(urls, model_inputs, arena=None):
+        layout = ShardLayout(urls, inputs=model_inputs["inputs"],
+                             outputs=model_inputs["outputs"])
+        pool = PoolClient(urls, protocol="http", health_interval_s=None,
+                          shm_arena=arena)
+        return ShardedClient(pool, layout)
+
+    try:
+        # -- exactness: decoder_tp replicas vs single-process reference --
+        tokens = rng.integers(
+            0, 256, size=(max(2, args.rows // 2), args.prompt_tokens),
+            dtype=np.int32)
+        reference = PrefillDecoderModel(tp=False).execute(
+            {"TOKENS": tokens}, {})
+        tp_layout = {"inputs": {"TOKENS": 0},
+                     "outputs": {"LOGITS": 0, "NEXT_TOKEN": 0}}
+        client = sharded_client(direct_urls, tp_layout)
+        exact, lats = True, []
+        try:
+            for _ in range(args.exact_requests):
+                inp = httpclient.InferInput(
+                    "TOKENS", list(tokens.shape),
+                    "INT32").set_data_from_numpy(tokens)
+                t0 = time.perf_counter()
+                res = client.infer("decoder_lm_tp_prefill", [inp])
+                lats.append(time.perf_counter() - t0)
+                exact = exact and np.array_equal(
+                    res.as_numpy("LOGITS"), reference["LOGITS"]) \
+                    and np.array_equal(res.as_numpy("NEXT_TOKEN"),
+                                       reference["NEXT_TOKEN"])
+        finally:
+            client.close()
+        out["exactness"] = {
+            "model": "decoder_lm_tp_prefill",
+            "batch": list(tokens.shape),
+            "requests": args.exact_requests,
+            "bit_exact": bool(exact),
+            "sharded_latency_ms": _percentiles(lats),
+        }
+        print("exactness:", out["exactness"])
+
+        # -- scatter-gather vs single replica: latency + capacity --------
+        tokens2 = rng.integers(0, 256, size=(args.rows,
+                                             args.prompt_tokens),
+                               dtype=np.int32)
+        pf_layout = {"inputs": {"TOKENS": 0},
+                     "outputs": {"LOGITS": 0, "NEXT_TOKEN": 0}}
+
+        def drive(infer, n):
+            samples = []
+            for _ in range(n):
+                inp = httpclient.InferInput(
+                    "TOKENS", list(tokens2.shape),
+                    "INT32").set_data_from_numpy(tokens2)
+                t0 = time.perf_counter()
+                infer(inp)
+                samples.append(time.perf_counter() - t0)
+            return samples
+
+        single = httpclient.InferenceServerClient(direct_urls[0])
+        try:
+            single.infer("decoder_lm_prefill", [httpclient.InferInput(
+                "TOKENS", list(tokens2.shape),
+                "INT32").set_data_from_numpy(tokens2)])  # jit warmup
+            single_lat = drive(
+                lambda inp: single.infer("decoder_lm_prefill", [inp]),
+                args.latency_requests)
+        finally:
+            single.close()
+        client = sharded_client(direct_urls, pf_layout)
+        try:
+            drive(lambda inp: client.infer("decoder_lm_prefill", [inp]), 2)
+            sharded_lat = drive(
+                lambda inp: client.infer("decoder_lm_prefill", [inp]),
+                args.latency_requests)
+        finally:
+            client.close()
+        single_row = _percentiles(single_lat)
+        sharded_row = _percentiles(sharded_lat)
+        out["scatter_vs_single"] = {
+            "model": "decoder_lm_prefill",
+            "batch": list(tokens2.shape),
+            "requests": args.latency_requests,
+            "single_replica_latency_ms": single_row,
+            "sharded_latency_ms": sharded_row,
+            "p50_speedup": round(single_row["p50"]
+                                 / max(sharded_row["p50"], 1e-9), 2),
+            "throughput_single_rps": round(
+                len(single_lat) / sum(single_lat), 1),
+            "throughput_sharded_rps": round(
+                len(sharded_lat) / sum(sharded_lat), 1),
+        }
+        print("scatter_vs_single:", out["scatter_vs_single"])
+
+        # -- steady state: arena fast path, 0 region/registration ops ----
+        arena = ShmArena(name_prefix="bench_shard")
+        x = rng.standard_normal((args.rows, 64)).astype(np.float32)
+        client = sharded_client(
+            direct_urls, {"inputs": {"X": 0}, "outputs": {"Y": 0}},
+            arena=arena)
+        try:
+            for _ in range(10):  # warmup: carve slabs, cache registrations
+                client.infer("batched_matmul", [httpclient.InferInput(
+                    "X", list(x.shape), "FP32").set_data_from_numpy(x)]
+                ).release()
+            before = arena.stats()
+            t0 = time.perf_counter()
+            for _ in range(args.steady_requests):
+                res = client.infer(
+                    "batched_matmul", [httpclient.InferInput(
+                        "X", list(x.shape),
+                        "FP32").set_data_from_numpy(x)])
+                res.as_numpy("Y")
+                res.release()
+            elapsed = time.perf_counter() - t0
+            after = arena.stats()
+        finally:
+            client.close()
+        out["steady_state"] = {
+            "model": "batched_matmul",
+            "requests": args.steady_requests,
+            "region_creates_per_request": (
+                after["regions_created"] - before["regions_created"])
+            / args.steady_requests,
+            "registration_rpcs_per_request": (
+                after["registrations_issued"]
+                - before["registrations_issued"]) / args.steady_requests,
+            "arena_hit_rate": after["hit_rate"],
+            "residual_leased_bytes": after["leased_bytes"],
+            "throughput_rps": round(args.steady_requests / elapsed, 1),
+        }
+        print("steady_state:", out["steady_state"])
+
+        # -- chaos: kill one shard mid-run -------------------------------
+        layout = ShardLayout(proxy_urls, inputs={"X": 0},
+                             outputs={"Y": 0})
+        pool = PoolClient(proxy_urls, protocol="http",
+                          health_interval_s=None)
+        client = ShardedClient(pool, layout)
+        ref = httpclient.InferenceServerClient(direct_urls[0])
+        try:
+            want = ref.infer("batched_matmul", [httpclient.InferInput(
+                "X", list(x.shape),
+                "FP32").set_data_from_numpy(x)]).as_numpy("Y")
+            ok = affected = typed = partial = recovered = 0
+            named = True
+            kill_at = args.chaos_requests // 3
+            heal_at = 2 * args.chaos_requests // 3
+            for i in range(args.chaos_requests):
+                if i == kill_at:
+                    proxies[1].fault = Fault("reset", after_bytes=0)
+                    proxies[1].reset_active()
+                if i == heal_at:
+                    proxies[1].heal()
+                    # the killed shard's breaker opened during the fault
+                    # window (that is the fail-fast contract: a pinned
+                    # shard with an open breaker fails the logical
+                    # request in microseconds, it does not hang); wait
+                    # out recovery so the arm also proves post-heal
+                    # requests succeed again
+                    deadline = time.monotonic() + 15.0
+                    while time.monotonic() < deadline:
+                        try:
+                            client.infer(
+                                "batched_matmul",
+                                [httpclient.InferInput(
+                                    "X", list(x.shape),
+                                    "FP32").set_data_from_numpy(x)],
+                                client_timeout=5.0)
+                            break
+                        except Exception:
+                            time.sleep(0.25)
+                inp = httpclient.InferInput(
+                    "X", list(x.shape), "FP32").set_data_from_numpy(x)
+                try:
+                    res = client.infer("batched_matmul", [inp],
+                                       client_timeout=10.0)
+                except ShardFailed as e:
+                    affected += 1
+                    typed += 1
+                    named = named and e.url == proxy_urls[1] \
+                        and e.shard == 1
+                except Exception:
+                    affected += 1  # un-typed failure: the check flags it
+                else:
+                    ok += 1
+                    if i >= heal_at:
+                        recovered += 1
+                    if not np.array_equal(res.as_numpy("Y"), want):
+                        partial += 1
+                time.sleep(0.01)
+        finally:
+            ref.close()
+            client.close()
+        out["chaos"] = {
+            "model": "batched_matmul",
+            "requests": args.chaos_requests,
+            "ok": ok,
+            "affected_requests": affected,
+            "shard_failed_typed": typed,
+            "failed_shard_named": bool(named),
+            "partial_gathers": partial,
+            "recovered_after_heal": recovered,
+        }
+        print("chaos:", out["chaos"])
+    finally:
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    print(f"wrote {args.output}")
+    return check(args.output)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,12 +58,18 @@ def compare(committed_qps: float, fresh_qps: float,
     }
 
 
-def shortened_trace(doc: Dict[str, Any], duration_s: float):
+def shortened_trace(doc: Dict[str, Any], duration_s: float,
+                    arm: str = "") -> Any:
     """The committed artifact's generator spec/seed re-generated at a
-    shorter duration — the same workload shape, CI-cheap."""
+    shorter duration — the same workload shape, CI-cheap. An arm that
+    recorded its own ``trace_spec`` (the sharded arm replays sharded
+    records, not the mixed default) gets that spec back."""
     from client_tpu import trace as trace_mod
 
-    return trace_mod.generate(doc["trace"]["spec"],
+    spec = doc["trace"]["spec"]
+    if arm:
+        spec = doc.get("arms", {}).get(arm, {}).get("trace_spec", spec)
+    return trace_mod.generate(spec,
                               seed=int(doc["trace"]["seed"]),
                               duration_s=duration_s)
 
@@ -89,8 +95,10 @@ def probe_at_floor(doc: Dict[str, Any], arm: str, tolerance: float,
         # a zero committed capacity has nothing to regress from
         result["regressed"] = False
         return result
-    tr = shortened_trace(doc, duration_s)
-    slos = list(doc["slos"])
+    tr = shortened_trace(doc, duration_s, arm=arm)
+    # an arm that committed its own SLO set (sharded: no streams, no
+    # ttft objective) is re-checked against exactly that set
+    slos = list(committed.get("slos", doc["slos"]))
     search = doc.get("search", {})
     min_delivery = float(search.get(
         "min_delivery_ratio", bench.MIN_DELIVERY_RATIO))

@@ -30,15 +30,20 @@
 # (tests/test_admission.py, admission_smoke marker) offers a 3-replica
 # pool far more traffic than it can serve: admitted-traffic p99 must
 # stay inside the declared SLO while a nonzero shed fraction is
-# reported honestly in the replay row AND the Prometheus counter.
+# reported honestly in the replay row AND the Prometheus counter. The
+# sharded scatter-gather smoke (tests/test_shard.py, shard_smoke
+# marker) proves the one-logical-request-across-a-replica-mesh mode:
+# bit-exact gather vs the single-process decoder_tp reference, a killed
+# shard producing the typed ShardFailed (whole-request, zero partial
+# gathers, no silent retry), and sharded trace-record replay.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
     tests/test_dataplane_observe.py tests/test_trace_replay.py \
-    tests/test_arena.py tests/test_admission.py "$@"
+    tests/test_arena.py tests/test_admission.py tests/test_shard.py "$@"
