@@ -28,6 +28,14 @@ client libraries (triton-inference-server/client), designed TPU-first:
   compatible ``infer()`` calls into one KServe request within an
   arrival-rate-tuned window and scatters result rows back per caller
   (docs/batching.md).
+- ``client_tpu.cache``: hot-key serving — client-side singleflight
+  (concurrent identical ``infer()`` calls collapse onto one wire
+  request) plus a bounded LRU+TTL response cache whose entries are
+  zero-copy arena-lease views, with explicit/automatic invalidation and
+  typed stale-while-revalidate (``CachingClient``/``AioCachingClient``,
+  or ``.caching()`` on any frontend/pool), paired with the pool's
+  ``routing="affinity"`` rendezvous session/prefix routing
+  (docs/caching.md).
 - ``client_tpu.observe``: client-side observability — request-phase span
   tracing with sampling and Chrome trace dumps, a Prometheus/JSON metrics
   registry fed by the resilience + pool event streams, and W3C

@@ -325,6 +325,21 @@ class InferenceServerClientBase:
         cls = AioBatchingClient if self._BATCH_AIO else BatchingClient
         return cls(self, **kwargs)
 
+    # -- hot-key serving ----------------------------------------------------
+    def caching(self, **kwargs):
+        """Wrap this client in the opt-in singleflight + response-cache
+        layer (``client_tpu.cache``): concurrent identical ``infer()``
+        calls collapse onto one wire request, and repeated content keys
+        are served from a bounded LRU+TTL cache as zero-copy arena views.
+        Returns a ``CachingClient`` (or the asyncio twin for aio
+        frontends); the client's configured telemetry is adopted
+        automatically. Compose OUTSIDE ``.coalescing()`` — hits skip the
+        coalescing window, misses may still ride a batch."""
+        from .cache import AioCachingClient, CachingClient
+
+        cls = AioCachingClient if self._BATCH_AIO else CachingClient
+        return cls(self, **kwargs)
+
     def register_plugin(self, plugin: InferenceServerClientPlugin) -> None:
         if plugin is None:
             raise ValueError("cannot register a null plugin")

@@ -780,6 +780,22 @@ class ShmArena:
                 rec.on_arena_registration("invalidated")
 
     # -- convenience -------------------------------------------------------
+    def stage(self, data, family: Optional[str] = None) -> ArenaLease:
+        """Lease a slab sized for ``data`` (bytes-like) and write it in one
+        call — the response cache (``client_tpu.cache``) stages each cached
+        output's payload this way, so the entry outlives the wire buffer
+        for exactly as long as the lease is held. The lease is released on
+        a failed write (no slab can leak half-staged)."""
+        view = memoryview(data).cast("B")
+        lease = self.lease(max(len(view), 1), family=family)
+        try:
+            if len(view):
+                lease.write(view)
+        except BaseException:
+            lease.release()
+            raise
+        return lease
+
     def request_output(self, name: str, nbytes: int,
                        family: Optional[str] = None):
         """An ``InferRequestedOutput`` backed by a fresh lease: the server

@@ -97,12 +97,98 @@ __all__ = [
     "AioBatchingClient",
     "BatchingClient",
     "CoalescedInferResult",
+    "plan_request",
 ]
 
 # batch-size histogram edges (rows per dispatched wire request)
 BATCH_ROWS_BUCKETS: Tuple[float, ...] = (
     1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256,
 )
+
+def plan_request(inputs, kwargs):
+    """Shared eligibility + signature scan for the client-side wrapper
+    layers — the coalescing dispatcher here and the response cache /
+    singleflight collapser (``client_tpu.cache``) reuse ONE exclusion
+    matrix, so "what may coalesce" and "what may collapse or cache" can
+    never drift apart. (The model name is not scanned here — each layer
+    folds it into its own key.)
+
+    Returns ``(sig, rows, raw_by_name, out_sig, extra_key)`` when the
+    request is a plain, binary-staged, stateless infer:
+
+    - ``sig``: sorted ``((name, datatype, shape-tail), ...)`` per input
+    - ``rows``: the shared leading (batch) dimension
+    - ``raw_by_name``: each input's staged binary payload
+    - ``out_sig``: sorted requested-output signature (None = all outputs)
+    - ``extra_key``: a canonical repr of every other semantic kwarg
+
+    Returns None when the request must bypass: sequences (server-side
+    state transitions), per-request ``resilience=`` overrides, shm-bound
+    or JSON-staged tensors, per-tensor parameters, ragged/absent batch
+    dims, and classification or shm-placed outputs."""
+    if kwargs.get("sequence_id"):
+        return None  # sequence semantics: NEVER merged or cached
+    if kwargs.get("resilience") is not None:
+        return None  # per-request policy override: honor it verbatim
+    if not inputs:
+        return None
+    sig: List[Tuple[str, str, Tuple[int, ...]]] = []
+    raw_by_name: Dict[str, Any] = {}
+    rows: Optional[int] = None
+    try:
+        for inp in inputs:
+            raw = inp._get_binary_data()
+            if raw is None:
+                return None  # shm-bound or JSON-staged tensor
+            if inp._parameters:
+                return None  # per-tensor parameters don't stack
+            shape = inp.shape()
+            if not shape:
+                return None
+            r = int(shape[0])
+            if r < 1:
+                return None
+            if rows is None:
+                rows = r
+            elif rows != r:
+                return None  # ragged batch dims can't scatter back
+            sig.append((inp.name(), inp.datatype(),
+                        tuple(int(d) for d in shape[1:])))
+            raw_by_name[inp.name()] = raw
+    except AttributeError:
+        return None  # not the shared InferInput value model
+    if rows is None:
+        return None
+    outputs = kwargs.get("outputs")
+    out_sig = None
+    if outputs:
+        out_entries = []
+        try:
+            for out in outputs:
+                if out._in_shared_memory() or out._class_count:
+                    return None
+                out_entries.append((out.name(), bool(out._binary_data)))
+        except AttributeError:
+            return None
+        out_sig = tuple(sorted(out_entries))
+    extra = {
+        k: v for k, v in kwargs.items()
+        # request_id is caller bookkeeping; affinity_key is a ROUTING
+        # hint the pool pops before the wire — requests differing only by
+        # session key produce identical answers, so they may share a
+        # batch row, a singleflight, and a cache entry (the dispatched
+        # request carries the first caller's key)
+        if k not in ("request_id", "outputs", "resilience", "affinity_key")
+        and v is not None
+        and not (k in ("sequence_id", "sequence_start", "sequence_end",
+                       "priority") and not v)
+    }
+    try:
+        extra_key = repr(sorted(extra.items()))
+    except Exception:
+        return None
+    return tuple(sorted(sig)), rows, raw_by_name, out_sig, extra_key
+
 
 _EWMA_ALPHA = 0.2  # inter-arrival gap / service-time smoothing
 # adaptive windows never exceed this fraction of the observed wire service
@@ -437,64 +523,14 @@ class _BatchingCore:
     # -- eligibility / compatibility key -------------------------------------
     def _plan(self, model_name: str, inputs, kwargs):
         """``(key, rows, raw_by_name, sig)`` when this call may coalesce,
-        else None (bypass to the inner client unchanged)."""
-        if kwargs.get("sequence_id"):
-            return None  # sequence semantics: NEVER merged
-        if kwargs.get("resilience") is not None:
-            return None  # per-request policy override: honor it verbatim
-        if not inputs:
+        else None (bypass to the inner client unchanged). Eligibility and
+        signatures come from the shared :func:`plan_request` scan."""
+        plan = plan_request(inputs, kwargs)
+        if plan is None:
             return None
-        sig: List[Tuple[str, str, Tuple[int, ...]]] = []
-        raw_by_name: Dict[str, Any] = {}
-        rows: Optional[int] = None
-        try:
-            for inp in inputs:
-                raw = inp._get_binary_data()
-                if raw is None:
-                    return None  # shm-bound or JSON-staged tensor
-                if inp._parameters:
-                    return None  # per-tensor parameters don't stack
-                shape = inp.shape()
-                if not shape:
-                    return None
-                r = int(shape[0])
-                if r < 1:
-                    return None
-                if rows is None:
-                    rows = r
-                elif rows != r:
-                    return None  # ragged batch dims can't scatter back
-                sig.append((inp.name(), inp.datatype(),
-                            tuple(int(d) for d in shape[1:])))
-                raw_by_name[inp.name()] = raw
-        except AttributeError:
-            return None  # not the shared InferInput value model
-        if rows is None or rows >= self.batch_max_rows:
+        sig_t, rows, raw_by_name, out_sig, extra_key = plan
+        if rows >= self.batch_max_rows:
             return None  # already a full batch: nothing to gain by queueing
-        outputs = kwargs.get("outputs")
-        out_sig = None
-        if outputs:
-            out_entries = []
-            try:
-                for out in outputs:
-                    if out._in_shared_memory() or out._class_count:
-                        return None
-                    out_entries.append((out.name(), bool(out._binary_data)))
-            except AttributeError:
-                return None
-            out_sig = tuple(sorted(out_entries))
-        extra = {
-            k: v for k, v in kwargs.items()
-            if k not in ("request_id", "outputs", "resilience")
-            and v is not None
-            and not (k in ("sequence_id", "sequence_start", "sequence_end",
-                           "priority") and not v)
-        }
-        try:
-            extra_key = repr(sorted(extra.items()))
-        except Exception:
-            return None
-        sig_t = tuple(sorted(sig))
         key = (model_name, sig_t, out_sig, extra_key)
         return key, rows, raw_by_name, sig_t
 

@@ -258,6 +258,24 @@ def _arena_leased_bytes() -> int:
     return total
 
 
+def _cache_status() -> List[Dict[str, Any]]:
+    """One row per live response cache (``client_tpu.cache``): hit rate,
+    resident bytes, evictions by reason. Empty when the process never
+    loaded the cache layer — lazy, like the arena section."""
+    import sys
+
+    cache_mod = sys.modules.get("client_tpu.cache")
+    if cache_mod is None:
+        return []
+    rows = []
+    for c in cache_mod.caches():
+        try:
+            rows.append(c.stats())
+        except Exception as e:
+            rows.append({"error": str(e)[:200]})
+    return rows
+
+
 def _admission_status(tel: Telemetry) -> List[Dict[str, Any]]:
     """One row per admission controller attached to the telemetry (the
     pool wires its controller in at construction): limit, inflight,
@@ -379,6 +397,47 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
                            f"{row.get('limiter', {}).get('min_limit')} "
                            f"with an SLO burning "
                            f"(shed_total={row.get('shed_total')})")})
+    # cache thrash: the response cache is churning entries out (capacity
+    # evictions rival insertions) while barely serving hits — the cache
+    # is sized below the workload's working set, so it burns staging work
+    # for nothing. A small or cold cache with few lookups never flags.
+    for row in snap.get("cache", []) or []:
+        if "error" in row:
+            continue
+        lookups = (row.get("hits", 0) + row.get("stale_hits", 0)
+                   + row.get("misses", 0))
+        cap_evictions = (row.get("evictions") or {}).get("capacity", 0)
+        insertions = row.get("insertions", 0)
+        hit_rate = row.get("hit_rate") or 0.0
+        if (lookups >= 50 and insertions > 0
+                and cap_evictions >= 0.5 * insertions and hit_rate < 0.2):
+            flags.append({
+                "flag": "cache_thrash", "url": None,
+                "detail": (f"{cap_evictions} capacity evictions over "
+                           f"{insertions} insertions with hit rate "
+                           f"{hit_rate:.0%} — the working set exceeds "
+                           f"max_bytes={row.get('max_bytes')}")})
+    # affinity skew: one endpoint owns far more than its fair share of
+    # the affinity key universe — hot keys are concentrating (a zipfian
+    # workload's hottest keys hashed together, or the fleet shrank and
+    # re-homing piled keys onto one survivor)
+    aff = {url: stats["affinity"]
+           for url, stats in snap.get("endpoint_stats", {}).items()
+           if stats.get("affinity")}
+    if len(aff) >= 2:
+        total_keys = sum(a.get("keys", 0) for a in aff.values())
+        if total_keys >= 16:
+            url, top = max(aff.items(), key=lambda kv: kv[1].get("keys", 0))
+            share = top.get("keys", 0) / total_keys
+            # twice the fair share, clamped into (0.5, 0.9]: the 0.9 cap
+            # keeps the flag reachable on a 2-endpoint pool (where 2x
+            # fair share would be an unattainable 100%)
+            if share > min(0.9, max(0.5, 2.0 / len(aff))):
+                flags.append({
+                    "flag": "affinity_skew", "url": url,
+                    "detail": (f"owns {share:.0%} of {total_keys} tracked "
+                               f"affinity keys across {len(aff)} endpoints "
+                               f"(fair share {1.0 / len(aff):.0%})")})
     dataplane = snap.get("shm", {}).get("dataplane")
     if dataplane and churn_threshold_ops_s:
         # prefer the probe-window rate: the lifetime average of a
@@ -544,6 +603,7 @@ def collect_snapshot(
                 registry_snapshot, "client_tpu_stream_window"),
             "batch": _registry_section(
                 registry_snapshot, "client_tpu_batch"),
+            "cache": _cache_status(),
             "shm": _local_shm(recorder),
         }
         server_shm: Dict[str, Any] = {}
@@ -673,6 +733,33 @@ def render_summary(snap: Dict[str, Any]) -> str:
                 f"  {slo['name']:<20} {slo['metric']} < "
                 f"{slo['threshold_ms']:g} ms @ {slo['objective']:.0%}"
                 f"  burn {slo['burn_rate']:.2f}x  {verdict}")
+    cache_rows = snap.get("cache") or []
+    if cache_rows:
+        lines.append("")
+        lines.append("response cache:")
+        for row in cache_rows:
+            if "error" in row:
+                lines.append(f"  cache: {row['error']}")
+                continue
+            hit_rate = row.get("hit_rate")
+            ev = row.get("evictions") or {}
+            lines.append(
+                f"  entries={row.get('entries')} "
+                f"resident={row.get('bytes_resident')}B "
+                f"hit_rate={'n/a' if hit_rate is None else f'{hit_rate:.0%}'} "
+                f"evictions={sum(ev.values())} "
+                f"(capacity={ev.get('capacity', 0)} ttl={ev.get('ttl', 0)})")
+    aff_stats = {url: s["affinity"]
+                 for url, s in snap.get("endpoint_stats", {}).items()
+                 if s.get("affinity")}
+    if aff_stats:
+        lines.append("")
+        lines.append("affinity routing:")
+        for url, a in aff_stats.items():
+            lines.append(
+                f"  {url:<24} routed={a.get('routed', 0)} "
+                f"rehomed={a.get('rehomed', 0)} "
+                f"spilled={a.get('spilled', 0)} keys={a.get('keys', 0)}")
     shm = snap.get("shm", {})
     dataplane = shm.get("dataplane")
     if dataplane:

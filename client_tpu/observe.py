@@ -969,10 +969,12 @@ def parse_endpoint_load(value: Optional[str],
 #               requests of one sharded logical infer (client_tpu.shard)
 #   shard_gather   shard-response exactness checks + logical-result
 #               assembly after the last shard landed
+#   cache_lookup   response-cache/singleflight key probe (client_tpu.cache;
+#               a hit's span is ONLY this phase — no wire leg at all)
 REQUEST_PHASES = (
-    "queue", "admission_queue", "coalesce_queue", "serialize", "connect",
-    "send", "ttfb", "recv", "deserialize", "attempt",
-    "shard_scatter", "shard_gather",
+    "queue", "admission_queue", "coalesce_queue", "cache_lookup",
+    "serialize", "connect", "send", "ttfb", "recv", "deserialize",
+    "attempt", "shard_scatter", "shard_gather",
 )
 
 
@@ -2389,6 +2391,13 @@ class Telemetry:
                         "client_tpu_pool_endpoint_resilience",
                         "Per-endpoint ResilienceStats counters",
                         ("url", "counter")),
+                    "affinity": reg.gauge(
+                        "client_tpu_pool_endpoint_affinity",
+                        "Affinity-routing counters per endpoint: picks "
+                        "landed as home (routed), after deterministic "
+                        "re-homing (rehomed), after a bounded-load spill "
+                        "(spilled), and the capped distinct-key count "
+                        "(keys)", ("url", "counter")),
                 }
             self._pools.append(weakref.ref(pool))
             if first:
@@ -2427,6 +2436,8 @@ class Telemetry:
                         _BREAKER_STATE.get(state, -1))
                 for name, value in stats.get("resilience", {}).items():
                     gauges["resilience"].labels(url, name).set(value)
+                for name, value in (stats.get("affinity") or {}).items():
+                    gauges["affinity"].labels(url, name).set(value)
         if dead:
             with self._pools_lock:
                 for ref in dead:

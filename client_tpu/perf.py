@@ -119,6 +119,10 @@ class PerfRunner:
         admission_max_queue_wait_s: float = 0.05,
         endpoint_limits: bool = False,
         shard_layout=None,
+        cache: bool = False,
+        cache_ttl_s: float = 30.0,
+        singleflight: bool = False,
+        affinity_key: Optional[str] = None,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -165,6 +169,15 @@ class PerfRunner:
         self.admission_target_ms = admission_target_ms
         self.admission_max_queue_wait_s = admission_max_queue_wait_s
         self.endpoint_limits = endpoint_limits
+        # hot-key serving layer (client_tpu.cache): wrap measurement
+        # clients in the singleflight/response-cache wrapper; replay
+        # threads each record's content_key into per-key payloads so the
+        # layer has real hot keys to collapse
+        self.cache = cache
+        self.cache_ttl_s = cache_ttl_s
+        self.singleflight = singleflight
+        self.affinity_key = affinity_key
+        self.seed = seed
         # sharded scatter-gather (client_tpu.shard): a ShardLayout or a
         # spec string ("IN=0->OUT=0") resolved over --endpoints in order;
         # measurement clients become ShardedClients over the pool
@@ -265,6 +278,29 @@ class PerfRunner:
                 raise ValueError(
                     "--coalesce applies to unary infers, not "
                     "--generate-stream")
+        if self.cache or self.singleflight:
+            if protocol not in ("http", "grpc"):
+                raise ValueError(
+                    "--cache/--singleflight require a python frontend "
+                    "(http|grpc): the caching wrapper wraps the python "
+                    "clients")
+            if shared_memory != "none":
+                raise ValueError(
+                    "--cache/--singleflight require --shared-memory none: "
+                    "shm-bound tensors never cache or collapse")
+            if generate_stream:
+                raise ValueError(
+                    "--cache/--singleflight apply to unary infers, not "
+                    "--generate-stream")
+            if self.shard_layout is not None:
+                raise ValueError(
+                    "--cache/--singleflight reject --shard-layout: a "
+                    "sharded logical request has per-replica partitions, "
+                    "not one cacheable answer")
+        if self.affinity_key is not None and self.routing != "affinity":
+            raise ValueError(
+                "--affinity-key requires --routing affinity (and "
+                "--endpoints): the key only steers the affinity policy")
         if chaos is not None:
             from .testing.chaos import ChaosProxy
 
@@ -322,7 +358,7 @@ class PerfRunner:
                     pool, self.shard_layout,
                     executor_workers=max(
                         8, 2 * concurrency * self.shard_layout.n_shards))
-            return self._wrap_coalescing(pool)
+            return self._wrap_caching(self._wrap_coalescing(pool))
         if self.protocol == "http":
             client = self._client_mod.InferenceServerClient(
                 self.url, concurrency=concurrency)
@@ -335,7 +371,22 @@ class PerfRunner:
                 retry=RetryPolicy(max_attempts=self.retries + 1)))
         if self._telemetry is not None:
             client.configure_telemetry(self._telemetry)
-        return self._wrap_coalescing(client)
+        return self._wrap_caching(self._wrap_coalescing(client))
+
+    def _wrap_caching(self, client):
+        """Cache OUTSIDE batching: a hit skips the coalescing window
+        entirely, a collapsed group's one miss may still ride a batch."""
+        if not (self.cache or self.singleflight):
+            return client
+        from .cache import CachingClient
+
+        return CachingClient(
+            client,
+            cache=self.cache,
+            ttl_s=self.cache_ttl_s,
+            singleflight=self.singleflight,
+            telemetry=self._telemetry,
+        )
 
     def _wrap_coalescing(self, client):
         """ALL measurement workers share one client, so wrapping it in the
@@ -619,10 +670,14 @@ class PerfRunner:
                 stop.set()
                 return
             lock, count, limit = counter
+            # keyword only when armed: harness hooks that stub _infer_once
+            # with the bare (client, inputs, outputs) signature keep working
+            akw = ({"affinity_key": self._affinity_key_for(worker_id)}
+                   if self.affinity_key is not None else {})
             while not stop.is_set():
                 t0 = time.perf_counter()
                 try:
-                    self._infer_once(client, inputs, outputs)
+                    self._infer_once(client, inputs, outputs, **akw)
                     latencies.append(time.perf_counter() - t0)
                 except (CircuitOpenError, AdmissionRejected) as e:
                     sheds.append(str(e))  # deliberate shedding, not error
@@ -664,6 +719,8 @@ class PerfRunner:
                 stop.set()
                 return
             lock, idx = cursor
+            akw = ({"affinity_key": self._affinity_key_for(worker_id)}
+                   if self.affinity_key is not None else {})
             while not stop.is_set():
                 with lock:
                     i = idx[0]
@@ -686,7 +743,7 @@ class PerfRunner:
                 issues.append(schedule[i] + lag)
                 t1 = time.perf_counter()
                 try:
-                    self._infer_once(client, inputs, outputs)
+                    self._infer_once(client, inputs, outputs, **akw)
                     records.append(time.perf_counter() - t1)
                 except (CircuitOpenError, AdmissionRejected) as e:
                     sheds.append(str(e))  # deliberate shedding, not error
@@ -698,11 +755,23 @@ class PerfRunner:
             if own_client is not None:
                 own_client.close()
 
-    def _infer_once(self, client, inputs, outputs=None):
+    def _affinity_key_for(self, worker_id) -> Optional[str]:
+        """The closed/open-loop worker's session key: ``worker`` = one
+        key per worker (a steady per-session stream, the KV-reuse shape);
+        any other value is a shared literal key (the hot-key shape)."""
+        if self.affinity_key is None:
+            return None
+        if self.affinity_key == "worker":
+            return f"w{worker_id}"
+        return self.affinity_key
+
+    def _infer_once(self, client, inputs, outputs=None, affinity_key=None):
         if self.generate_stream:
             # one "request" = one fully-drained SSE generation session
+            kw = ({"affinity_key": affinity_key}
+                  if affinity_key is not None else {})
             for _event in client.generate_stream(
-                    self.model_name, self._stream_payload):
+                    self.model_name, self._stream_payload, **kw):
                 pass
             return
         if self.protocol == "native-grpc-async":
@@ -718,6 +787,10 @@ class PerfRunner:
                 raise RuntimeError("async infer did not complete in 120s")
             if box.get("error"):
                 raise RuntimeError(box["error"])
+            return
+        if affinity_key is not None:
+            client.infer(self.model_name, inputs, outputs=outputs,
+                         affinity_key=affinity_key)
             return
         client.infer(self.model_name, inputs, outputs=outputs)
 
@@ -858,6 +931,39 @@ class PerfRunner:
             result["client_admission"] = admission_stats
         return result
 
+    def _cache_stats_row(self, client) -> Optional[Dict[str, Any]]:
+        """The caching wrapper's snapshot, when armed — the per-arm
+        hit/collapse story every harness row carries as ``client_cache``."""
+        if not (self.cache or self.singleflight):
+            return None
+        getter = getattr(client, "cache_stats", None)
+        if getter is None:
+            return None
+        try:
+            return getter()
+        except Exception:
+            return None
+
+    @staticmethod
+    def _cache_result(result: Dict[str, Any],
+                      cache_stats: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        if cache_stats is not None:
+            result["client_cache"] = {
+                "hit_rate": cache_stats["hit_rate"],
+                "hits": cache_stats["hit"],
+                "stale_hits": cache_stats["stale"],
+                "misses": cache_stats["miss"],
+                "bypass": cache_stats["bypass"],
+                "singleflight_collapsed": cache_stats[
+                    "singleflight_collapsed"],
+                "collapse_ratio": cache_stats["collapse_ratio"],
+                "wire_requests": cache_stats["wire_requests"],
+                "logical_requests": cache_stats["logical_requests"],
+                "bytes_resident": cache_stats["bytes_resident"],
+                "entries": cache_stats["entries"],
+            }
+        return result
+
     @staticmethod
     def _batch_result(result: Dict[str, Any],
                       batch_stats: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -926,13 +1032,15 @@ class PerfRunner:
             w.join(timeout=600)
         elapsed = time.perf_counter() - t_start
         batch_stats = client.stats() if self.coalesce else None
+        cache_stats = self._cache_stats_row(client)
         admission_stats = self._admission_stats(client)
         client.close()
 
         lat_sorted = sorted(latencies)
         n = len(lat_sorted)
         issued = n + len(errors) + len(sheds)
-        return self._admission_result(self._shm_result(self._batch_result(
+        return self._cache_result(self._admission_result(
+            self._shm_result(self._batch_result(
             self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
@@ -953,7 +1061,8 @@ class PerfRunner:
             "duration_s": round(elapsed, 3),
             "infer_per_sec": round(n / elapsed, 1) if elapsed > 0 else 0.0,
             "latency_ms": _latency_ms_row(lat_sorted),
-        }), batch_stats), shm_rec, shm_before), admission_stats)
+        }), batch_stats), shm_rec, shm_before), admission_stats),
+            cache_stats)
 
     def run_rate(self, rate: float, measurement_requests: int,
                  distribution: str = "constant",
@@ -1017,6 +1126,7 @@ class PerfRunner:
             w.join(timeout=600)
         elapsed = time.perf_counter() - t0_box[0]
         batch_stats = client.stats() if self.coalesce else None
+        cache_stats = self._cache_stats_row(client)
         admission_stats = self._admission_stats(client)
         client.close()
 
@@ -1033,7 +1143,8 @@ class PerfRunner:
         # denominator for every capacity claim (a saturated pool that
         # silently under-offers would otherwise flatter its own number)
         arrival_window = max(issues) if issues else 0.0
-        return self._admission_result(self._shm_result(self._batch_result(
+        return self._cache_result(self._admission_result(
+            self._shm_result(self._batch_result(
             self._observe_result({
             "model": self.model_name,
             "protocol": self.protocol,
@@ -1062,7 +1173,8 @@ class PerfRunner:
             "latency_ms": _latency_ms_row(lat_sorted),
             "schedule_lag_ms": _lag_ms_row(lag_sorted),
             "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
-        }), batch_stats), shm_rec, shm_before), admission_stats)
+        }), batch_stats), shm_rec, shm_before), admission_stats),
+            cache_stats)
 
     # -- trace replay --------------------------------------------------------
     _SEQ_GATE_TIMEOUT_S = 60.0
@@ -1238,12 +1350,14 @@ class PerfRunner:
             outcomes = list(outcomes)
             errors = list(errors)
             batch_stats = client.stats() if self.coalesce else None
+            cache_stats = self._cache_stats_row(client)
             admission_stats = self._admission_stats(client)
         finally:
             client.close()
-        return self._admission_result(self._trace_result(
+        return self._cache_result(self._admission_result(self._trace_result(
             header, records, speed, elapsed, outcomes, errors, specs,
-            batch_stats, resources, request_slos), admission_stats)
+            batch_stats, resources, request_slos), admission_stats),
+            cache_stats)
 
     def _replay_warmup(self, client, records, resources) -> None:
         """One best-effort dispatch per distinct (kind, model) BEFORE the
@@ -1349,6 +1463,15 @@ class PerfRunner:
             if on_result is not None:
                 on_result(rec, outcome)
 
+    def _replay_affinity_kw(self, rec) -> Dict[str, Any]:
+        """The replay's session-key kwarg: with ``routing="affinity"``,
+        every keyed record (format v3 ``content_key``) routes by its key —
+        the trace-driven twin of ``--affinity-key``."""
+        if (self.routing == "affinity"
+                and getattr(rec, "content_key", None) is not None):
+            return {"affinity_key": f"k{rec.content_key}"}
+        return {}
+
     def _replay_dispatch(self, client, rec, resources):
         if rec.kind == "sharded":
             # the measurement client IS the ShardedClient in shard mode
@@ -1362,7 +1485,8 @@ class PerfRunner:
             events = []
             for event in client.generate_stream(
                     rec.model, resources.stream_payload(rec),
-                    model_version=rec.version):
+                    model_version=rec.version,
+                    **self._replay_affinity_kw(rec)):
                 events.append(event)
             return events
         inputs = resources.inputs_for(rec)
@@ -1373,7 +1497,8 @@ class PerfRunner:
                 sequence_id=rec.seq_group,
                 sequence_start=rec.seq_index == 0,
                 sequence_end=rec.seq_index == rec.seq_len - 1)
-        return client.infer(rec.model, inputs, model_version=rec.version)
+        return client.infer(rec.model, inputs, model_version=rec.version,
+                            **self._replay_affinity_kw(rec))
 
     @staticmethod
     def _kind_row(samples: Dict[Tuple[str, str], List[float]],
@@ -1528,51 +1653,68 @@ class _SeqGate:
 
 class _ReplayResources:
     """Shared read-only payload caches for one replay run: one tensor set
-    per distinct (model, layout) key and one token list per distinct
-    prompt length, all drawn from the runner's single seeded Generator —
-    so a replay is as reproducible as its trace."""
+    per distinct (model, layout, content key) and one token list per
+    distinct (prompt length, content key), all deterministic — keyless
+    records draw from the runner's single seeded Generator, keyed records
+    (the hot-key workload, format v3) from a per-key generator seeded by
+    (runner seed, key) so the SAME key always replays BYTE-IDENTICAL
+    bytes, record order be damned. That identity is what the
+    cache/singleflight layer collapses on."""
 
     def __init__(self, runner: "PerfRunner", records) -> None:
         self._mod = runner._client_mod
         self._rng = runner.rng
+        self._seed = runner.seed
         self._inputs: Dict[Any, list] = {}
-        self._tokens: Dict[int, list] = {}
+        self._tokens: Dict[Any, list] = {}
         self.seq_gates: Dict[int, _SeqGate] = {}
         for rec in records:
             if rec.kind == "sequence":
                 self.seq_gates.setdefault(rec.seq_group, _SeqGate())
             elif rec.kind == "generate_stream":
-                self.tokens_for(rec.prompt_tokens)
+                self.tokens_for(rec.prompt_tokens,
+                                getattr(rec, "content_key", None))
             if rec.shapes is not None:
                 self.inputs_for(rec)
 
+    def _rng_for(self, content_key):
+        if content_key is None:
+            return self._rng
+        from .trace import _key_rng
+
+        return _key_rng(self._seed, content_key)
+
     def inputs_for(self, rec) -> list:
-        key = (rec.model,
+        content_key = getattr(rec, "content_key", None)
+        key = (rec.model, content_key,
                tuple(sorted((name, rec.dtypes[name], tuple(shape))
                             for name, shape in rec.shapes.items())))
         inputs = self._inputs.get(key)
         if inputs is None:
+            rng = self._rng_for(content_key)
             inputs = []
             for name in sorted(rec.shapes):
                 datatype = rec.dtypes[name]
                 shape = list(rec.shapes[name])
                 inp = self._mod.InferInput(name, shape, datatype)
                 inp.set_data_from_numpy(
-                    _random_tensor(datatype, shape, self._rng))
+                    _random_tensor(datatype, shape, rng))
                 inputs.append(inp)
             self._inputs[key] = inputs
         return inputs
 
-    def tokens_for(self, prompt_tokens: int) -> list:
-        tokens = self._tokens.get(prompt_tokens)
+    def tokens_for(self, prompt_tokens: int, content_key=None) -> list:
+        key = (prompt_tokens, content_key)
+        tokens = self._tokens.get(key)
         if tokens is None:
-            tokens = self._rng.integers(
+            tokens = self._rng_for(content_key).integers(
                 0, 256, size=max(1, prompt_tokens), dtype=np.int32).tolist()
-            self._tokens[prompt_tokens] = tokens
+            self._tokens[key] = tokens
         return tokens
 
     def stream_payload(self, rec) -> Dict[str, Any]:
-        return {"TOKENS": [self.tokens_for(rec.prompt_tokens)],
+        return {"TOKENS": [self.tokens_for(
+                    rec.prompt_tokens, getattr(rec, "content_key", None))],
                 "MAX_TOKENS": int(rec.output_tokens)}
 
 
@@ -1676,11 +1818,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--routing", default=None,
         choices=("round_robin", "least_outstanding", "weighted",
-                 "orca_weighted"),
+                 "orca_weighted", "affinity"),
         help="pool routing policy (requires --endpoints); orca_weighted "
              "feeds smooth-WRR weights from the servers' ORCA "
              "endpoint-load-metrics reports, falling back to "
-             "least_outstanding while loads are stale or absent")
+             "least_outstanding while loads are stale or absent; "
+             "affinity rendezvous-hashes a session/prefix key "
+             "(--affinity-key, or a trace record's content_key) onto a "
+             "home replica with deterministic bounded-load fallback")
+    parser.add_argument(
+        "--cache", action="store_true",
+        help="wrap measurement clients in the bounded response cache "
+             "(client_tpu.cache): repeated content keys are served "
+             "client-side as zero-copy arena views; result rows gain "
+             "client_cache (hit rate, collapse ratio, resident bytes)")
+    parser.add_argument(
+        "--cache-ttl", type=float, default=30.0,
+        help="response-cache TTL in seconds (with --cache)")
+    parser.add_argument(
+        "--singleflight", action="store_true",
+        help="collapse concurrent identical infers onto one wire request "
+             "(client_tpu.cache; combine with --cache for the full "
+             "hot-key layer)")
+    parser.add_argument(
+        "--affinity-key", default=None,
+        help="session key for --routing affinity on the closed/open-loop "
+             "paths: 'worker' = one key per worker thread, anything else "
+             "= one shared literal key; trace replay instead threads "
+             "each record's content_key automatically")
     parser.add_argument(
         "--admission", action="store_true",
         help="arm the pool's adaptive admission controller "
@@ -1771,6 +1936,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         admission_target_ms=args.admission_target_ms,
         endpoint_limits=args.endpoint_limits,
         shard_layout=args.shard_layout,
+        cache=args.cache,
+        cache_ttl_s=args.cache_ttl,
+        singleflight=args.singleflight,
+        affinity_key=args.affinity_key,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside

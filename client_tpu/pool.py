@@ -72,6 +72,7 @@ delegate to a single healthy endpoint under the failover engine.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import inspect
 import math
 import random
@@ -113,6 +114,7 @@ __all__ = [
     "LEAST_OUTSTANDING",
     "WEIGHTED",
     "ORCA_WEIGHTED",
+    "AFFINITY",
     "AioPoolClient",
     "EndpointEjected",
     "EndpointHealthChanged",
@@ -129,7 +131,9 @@ ROUND_ROBIN = "round_robin"
 LEAST_OUTSTANDING = "least_outstanding"
 WEIGHTED = "weighted"
 ORCA_WEIGHTED = "orca_weighted"
-_ROUTING_POLICIES = (ROUND_ROBIN, LEAST_OUTSTANDING, WEIGHTED, ORCA_WEIGHTED)
+AFFINITY = "affinity"
+_ROUTING_POLICIES = (ROUND_ROBIN, LEAST_OUTSTANDING, WEIGHTED, ORCA_WEIGHTED,
+                     AFFINITY)
 
 # orca_weighted tuning: the weight floor keeps a slammed replica barely
 # in rotation (so its load reports keep flowing and recovery is visible);
@@ -143,6 +147,28 @@ _ORCA_SMOOTHING = 0.5
 # utilization dominates the blend when both signals exist; qps fills in
 # relative pressure between replicas reporting equal utilization
 _ORCA_QPS_BLEND = 0.3
+
+# affinity routing: a key's home may carry at most ``bound * fair-share``
+# outstanding requests before the key deterministically spills to the
+# next endpoint in its rendezvous order (bounded-load consistent hashing:
+# a drowned home sheds overflow instead of queueing hot keys behind it)
+_AFFINITY_BOUND = 2.0
+# per-endpoint distinct-key tracking cap (doctor's affinity_skew signal);
+# past it the count saturates rather than growing without bound
+_AFFINITY_KEY_CAP = 2048
+
+
+def _affinity_ranked(key_digest: bytes,
+                     endpoints: Sequence["EndpointState"],
+                     ) -> List["EndpointState"]:
+    """Rendezvous (highest-random-weight) order of ``endpoints`` for one
+    key: a pure function of (key, url) — every client ranks identically,
+    and removing an endpoint never re-homes keys owned by the others."""
+    return sorted(
+        endpoints,
+        key=lambda ep: hashlib.blake2b(
+            key_digest + ep.url.encode(), digest_size=8).digest(),
+        reverse=True)
 
 
 def load_score(load, max_qps: Optional[float] = None,
@@ -305,7 +331,8 @@ class EndpointState:
         "url", "client", "policy", "weight", "outstanding", "healthy",
         "consecutive_failures", "ejected", "ejected_until", "ejection_count",
         "last_ejection_end", "_wrr_current", "limiter", "shed_total",
-        "_orca_weight",
+        "_orca_weight", "affinity_routed", "affinity_rehomed",
+        "affinity_spilled", "_affinity_keys",
     )
 
     def __init__(self, url: str, client: Any, policy: ResiliencePolicy,
@@ -325,6 +352,15 @@ class EndpointState:
         self.limiter = limiter
         self.shed_total = 0
         self._orca_weight: Optional[float] = None
+        # affinity routing accounting (disjoint: every pick lands in ONE
+        # bucket): picks landed here as the key's home (routed), because
+        # the home was ineligible (rehomed), or because the home was over
+        # its bounded-load limit (spilled) — plus the capped distinct-key
+        # set behind the doctor's affinity_skew flag
+        self.affinity_routed = 0
+        self.affinity_rehomed = 0
+        self.affinity_spilled = 0
+        self._affinity_keys: set = set()
 
 
 class EndpointPool:
@@ -347,6 +383,7 @@ class EndpointPool:
         clock: Callable[[], float] = time.monotonic,
         on_event: Optional[Callable[[PoolEvent], None]] = None,
         load_lookup: Optional[Callable[[], Dict[str, Any]]] = None,
+        affinity_bound: float = _AFFINITY_BOUND,
     ):
         """``load_lookup`` (``orca_weighted`` routing): a zero-arg callable
         returning ``{url: observe.EndpointLoad}`` containing ONLY
@@ -371,6 +408,9 @@ class EndpointPool:
         # at most ceil(N/2) replicas may ever be ejected at once: the pool
         # must degrade (keep trying suspect replicas) before it self-blinds
         self.max_ejected = math.ceil(len(self.endpoints) / 2)
+        if affinity_bound < 1.0:
+            raise ValueError("affinity_bound must be >= 1.0")
+        self.affinity_bound = affinity_bound
         self._clock = clock
         self._on_event = on_event
         self._load_lookup = load_lookup
@@ -462,10 +502,59 @@ class EndpointPool:
             weights[id(ep)] = smoothed
         return weights
 
-    def _pick(self, candidates: List[EndpointState]) -> EndpointState:
+    def _pick_affinity(self, candidates: List[EndpointState],
+                       affinity_key: str) -> EndpointState:
+        """Rendezvous-hash the key onto its home endpoint with a
+        bounded-load spill: the winner is the highest-scoring ELIGIBLE
+        candidate whose outstanding count is under ``affinity_bound``
+        times the candidates' fair share — a saturated home sheds the
+        overflow to the key's deterministic runner-up instead of queueing
+        hot keys behind one drowning replica. Caller holds the pool lock.
+        Re-homing is deterministic: every client ranks (key, url)
+        identically, so an ejected/unhealthy/breaker-open home moves the
+        key to the SAME fallback everywhere, and the key returns home the
+        moment the home becomes eligible again."""
+        digest = hashlib.blake2b(
+            str(affinity_key).encode(), digest_size=8).digest()
+        ranked = _affinity_ranked(digest, candidates)
+        # the key's TRUE home ranks over the whole pool, eligible or not:
+        # the rehomed-vs-spilled split below must know whether the home
+        # was missing from the candidate set or merely over its bound
+        home = _affinity_ranked(digest, self.endpoints)[0]
+        total = sum(ep.outstanding for ep in candidates)
+        limit = max(1.0,
+                    self.affinity_bound * (total + 1.0) / len(candidates))
+        chosen = None
+        for ep in ranked:
+            if ep.outstanding < limit:
+                chosen = ep
+                break
+        if chosen is None:
+            chosen = ranked[0]  # every candidate over the bound: go home
+        # disjoint counters: every pick lands in exactly ONE bucket, so
+        # routed + rehomed + spilled = total affinity picks
+        if chosen is home:
+            chosen.affinity_routed += 1
+        elif home in candidates:
+            chosen.affinity_spilled += 1
+        else:
+            chosen.affinity_rehomed += 1
+        if len(chosen._affinity_keys) < _AFFINITY_KEY_CAP:
+            chosen._affinity_keys.add(digest)
+        return chosen
+
+    def _pick(self, candidates: List[EndpointState],
+              affinity_key: Optional[str] = None) -> EndpointState:
+        routing = self.routing
+        if routing == AFFINITY:
+            if affinity_key is not None:
+                # affinity accounting runs even for a lone candidate: the
+                # key-spread/rehome counters must reflect every pick
+                return self._pick_affinity(candidates, affinity_key)
+            # keyless request on an affinity pool: client-local pressure
+            routing = LEAST_OUTSTANDING
         if len(candidates) == 1:
             return candidates[0]
-        routing = self.routing
         if routing == ORCA_WEIGHTED:
             weights = self._orca_weights(candidates)
             if weights is not None:
@@ -497,10 +586,14 @@ class EndpointPool:
         self._rr += 1
         return candidates[idx]
 
-    def select(self, exclude: Sequence[EndpointState] = ()) -> EndpointState:
+    def select(self, exclude: Sequence[EndpointState] = (),
+               affinity_key: Optional[str] = None) -> EndpointState:
         """Pick an endpoint under the routing policy, honoring health,
         ejection windows, breaker admission and (when armed) each
-        endpoint's adaptive concurrency limit. ``exclude`` lists
+        endpoint's adaptive concurrency limit. ``affinity_key`` (with
+        ``routing="affinity"``) rendezvous-hashes the key onto its home
+        endpoint with deterministic bounded-load fallback — see
+        :meth:`_pick_affinity`. ``exclude`` lists
         endpoints already tried by this call's failover loop. When no
         eligible endpoint remains, panic-routes to a non-excluded endpoint
         whose breaker would still admit (degraded beats unavailable);
@@ -549,7 +642,8 @@ class EndpointPool:
                     saturated = True
                     for ep in relaxed:
                         ep.shed_total += 1
-            picked = self._pick(candidates) if candidates else None
+            picked = (self._pick(candidates, affinity_key)
+                      if candidates else None)
         self._emit_all(events)
         if picked is None:
             if saturated:
@@ -681,6 +775,16 @@ class EndpointPool:
                     "breaker_state": breaker.state if breaker is not None else None,
                     "resilience": ep.policy.stats.as_dict(),
                 }
+                if self.routing == AFFINITY:
+                    # affinity view: how many picks landed here and why,
+                    # plus the (capped) distinct-key ownership count the
+                    # doctor's affinity_skew anomaly reads
+                    out[key]["affinity"] = {
+                        "routed": ep.affinity_routed,
+                        "rehomed": ep.affinity_rehomed,
+                        "spilled": ep.affinity_spilled,
+                        "keys": len(ep._affinity_keys),
+                    }
         return out
 
 
@@ -758,6 +862,8 @@ class _PoolClientBase:
         shm_arena=None,
         admission=None,
         endpoint_limits=None,
+        affinity_bound: float = _AFFINITY_BOUND,
+        seq_pin_idle_s: Optional[float] = 300.0,
     ):
         """``urls``: N ``host:port`` replica addresses. ``client_factory``
         overrides the per-endpoint client constructor (receives the url);
@@ -788,7 +894,22 @@ class _PoolClientBase:
         (ideally with ``orca_format=`` set so the frontends opt in): the
         smooth-WRR weights come from the TTL-fresh ORCA load reports,
         falling back to least-outstanding whenever any replica's load is
-        stale or absent."""
+        stale or absent.
+
+        ``routing="affinity"`` rendezvous-hashes a caller-supplied
+        ``infer(..., affinity_key=...)`` / ``generate_stream(...,
+        affinity_key=...)`` session/prefix key onto a home endpoint with
+        deterministic bounded-load fallback (``affinity_bound`` times the
+        fair share) — replica-local state (KV caches, session prefixes)
+        keeps landing on one replica, survives that replica's ejection by
+        re-homing deterministically, and returns home on recovery.
+        Keyless requests on an affinity pool route least-outstanding.
+
+        ``seq_pin_idle_s``: sequence pins whose sequence went idle this
+        long without a ``sequence_end`` are garbage-collected (the pin is
+        dropped and the existing ``SequenceAbandoned`` event fires) — a
+        caller that died mid-sequence must not leak its pin forever.
+        ``None`` disables the GC."""
         urls = list(urls)
         if not urls:
             raise ValueError("pool needs at least one url")
@@ -797,6 +918,9 @@ class _PoolClientBase:
                 f"unknown routing policy {routing!r} (one of {_ROUTING_POLICIES})")
         if weights is not None and len(weights) != len(urls):
             raise ValueError("weights must pair 1:1 with urls")
+        if seq_pin_idle_s is not None and seq_pin_idle_s <= 0:
+            raise ValueError(
+                "seq_pin_idle_s must be > 0 (None disables the pin GC)")
         if weights is None:
             weights = [1.0] * len(urls)
         if client_factory is None:
@@ -874,6 +998,7 @@ class _PoolClientBase:
                 # absent, so the policy can never divide by a stale load
                 load_lookup=(telemetry.endpoint_loads
                              if routing == ORCA_WEIGHTED else None),
+                affinity_bound=affinity_bound,
             )
         except Exception:
             self._abandon(endpoints)
@@ -911,6 +1036,16 @@ class _PoolClientBase:
         self._seq_lock = threading.Lock()
         self._seq_pins: Dict[int, EndpointState] = {}
         self._seq_established: set = set()
+        # pin GC: a caller that dies without sequence_end must not leak
+        # its pin — pins idle past seq_pin_idle_s are swept (emitting
+        # SequenceAbandoned) on the sequence path and the prober cadence
+        self._clock = clock
+        self._seq_pin_idle_s = seq_pin_idle_s
+        self._seq_gc_interval_s = (
+            max(seq_pin_idle_s / 4.0, 0.01)
+            if seq_pin_idle_s is not None else None)
+        self._seq_last_used: Dict[int, float] = {}
+        self._seq_gc_at = clock()
         # backoff schedule for re-attempting a PINNED replica (a sequence
         # has exactly one legal endpoint, so zero-delay retries would burn
         # every attempt inside a sub-second connect blip)
@@ -1033,6 +1168,19 @@ class _PoolClientBase:
         cls = AioBatchingClient if self._AIO else BatchingClient
         return cls(self, **kwargs)
 
+    def caching(self, **kwargs):
+        """Wrap this pool in the opt-in singleflight + response-cache
+        layer (``client_tpu.cache``): hot content keys are served
+        client-side (zero wire requests), concurrent identical misses
+        collapse onto one pooled request — one routing decision, one
+        admission token — and ``load_model``/``unload_model`` broadcasts
+        invalidate the model's cached entries. The pool's telemetry is
+        adopted automatically. Compose OUTSIDE ``.coalescing()``."""
+        from .cache import AioCachingClient, CachingClient
+
+        cls = AioCachingClient if self._AIO else CachingClient
+        return cls(self, **kwargs)
+
     @classmethod
     def _is_broadcast(cls, name: str) -> bool:
         return any(name.startswith(p) for p in cls._BROADCAST_PREFIXES)
@@ -1077,15 +1225,56 @@ class _PoolClientBase:
         self.pool.emit(SequenceAbandoned(ep.url, request_id, sequence_id, exc))
 
     # -- sequence affinity helpers -------------------------------------------
+    def _seq_gc(self) -> None:
+        """Sweep pins whose sequence went idle past ``seq_pin_idle_s``
+        without a ``sequence_end`` (the caller died, or simply leaked):
+        the pin and its established mark are dropped and the existing
+        :class:`SequenceAbandoned` event fires per evicted pin. Without
+        this, ``_seq_pins``/``_seq_established`` grow unbounded under
+        caller churn. Events are emitted OUTSIDE ``_seq_lock``."""
+        if self._seq_pin_idle_s is None:
+            return
+        now = self._clock()
+        evicted: List[Tuple[int, EndpointState]] = []
+        with self._seq_lock:
+            if now - self._seq_gc_at < self._seq_gc_interval_s:
+                return
+            self._seq_gc_at = now
+            cutoff = now - self._seq_pin_idle_s
+            for sid in [sid for sid, ts in self._seq_last_used.items()
+                        if ts < cutoff]:
+                self._seq_last_used.pop(sid, None)
+                self._seq_established.discard(sid)
+                ep = self._seq_pins.pop(sid, None)
+                if ep is not None:
+                    evicted.append((sid, ep))
+        for sid, ep in evicted:
+            self.pool.emit(SequenceAbandoned(
+                ep.url, "", sid, InferenceServerException(
+                    f"sequence pin idle for > {self._seq_pin_idle_s:g}s "
+                    "with no sequence_end: pin garbage-collected (the "
+                    "server-side sequence state is abandoned)",
+                    status="SEQUENCE_PIN_EXPIRED")))
+
     def _seq_endpoint(self, sequence_id: int,
-                      exclude: Sequence[EndpointState] = ()) -> EndpointState:
+                      exclude: Sequence[EndpointState] = (),
+                      affinity_key: Optional[str] = None) -> EndpointState:
+        now = self._clock()
+        with self._seq_lock:
+            # refresh BEFORE the sweep: an idle-then-resumed sequence must
+            # never be garbage-collected by its own resuming call
+            self._seq_last_used[sequence_id] = now
+        self._seq_gc()
         with self._seq_lock:
             ep = self._seq_pins.get(sequence_id)
         if ep is not None:
             return ep
         # select OUTSIDE _seq_lock: selection emits pool events whose
-        # callbacks may re-enter the sequence path (non-reentrant lock)
-        candidate = self.pool.select(exclude=exclude)
+        # callbacks may re-enter the sequence path (non-reentrant lock).
+        # An affinity pool places the initial pin by the caller's key, so
+        # a resumed session lands back on the replica holding its state.
+        candidate = self.pool.select(exclude=exclude,
+                                     affinity_key=affinity_key)
         with self._seq_lock:
             return self._seq_pins.setdefault(sequence_id, candidate)
 
@@ -1106,6 +1295,7 @@ class _PoolClientBase:
         with self._seq_lock:
             self._seq_pins.pop(sequence_id, None)
             self._seq_established.discard(sequence_id)
+            self._seq_last_used.pop(sequence_id, None)
 
     def _seq_repin_allowed(self, sequence_id: int) -> bool:
         """A connect failure provably never reached the server: if NO
@@ -1182,6 +1372,9 @@ class PoolClient(_PoolClientBase):
     def _probe_loop(self, ep: EndpointState) -> None:
         while not self._probe_stop.wait(self._health_interval_s):
             self._probe_one(ep)
+            # the prober cadence doubles as the idle-pin sweep: a pool
+            # with no further sequence traffic must still GC leaked pins
+            self._seq_gc()
 
     def wait_healthy(self, min_healthy: Optional[int] = None,
                      timeout_s: float = 10.0) -> bool:
@@ -1215,12 +1408,16 @@ class PoolClient(_PoolClientBase):
     def _execute(self, op, idempotent: bool = True,
                  timeout_s: Optional[float] = None,
                  request_id: str = "", sequence_id: int = 0,
-                 record_latency: bool = False):
+                 record_latency: bool = False,
+                 affinity_key: Optional[str] = None):
         """Run ``op(client, remaining_timeout)`` against the pool: one
         shared deadline budget, at most ``max_failover_attempts`` distinct
         replicas, idempotency-gated re-sends. ``record_latency`` feeds the
         hedge-delay p95 window — infers only, so fast admin/metadata calls
-        don't drag the window down and trigger spurious hedges."""
+        don't drag the window down and trigger spurious hedges.
+        ``affinity_key`` steers every selection (the failover re-select
+        excludes the failed home, so the key re-homes deterministically
+        instead of retrying a dead replica)."""
         budget = AttemptBudget(self._budget_policy, timeout_s)
         tried: List[EndpointState] = []
         last: Optional[BaseException] = None
@@ -1232,7 +1429,8 @@ class PoolClient(_PoolClientBase):
                     raise deadline_exc from last
                 raise
             try:
-                ep = self.pool.select(exclude=tried)
+                ep = self.pool.select(exclude=tried,
+                                      affinity_key=affinity_key)
             except NoEndpointAvailableError:
                 if last is not None:
                     raise last
@@ -1294,13 +1492,16 @@ class PoolClient(_PoolClientBase):
         server-side state yet), and an in-flight death surfaces a
         :class:`SequenceAbandoned` event plus the original error.
         With admission armed, ONE token covers the whole failover/hedge
-        engine run; a saturated pool raises ``AdmissionRejected``."""
+        engine run; a saturated pool raises ``AdmissionRejected``.
+        ``affinity_key=`` (with ``routing="affinity"``) pins the request
+        to the key's home endpoint — never forwarded to the replica."""
         kwargs = _fold_infer_args(args, kwargs)
+        affinity_key = kwargs.pop("affinity_key", None)
         sequence_id = kwargs.get("sequence_id", 0)
         if self._admission is None:
             try:
                 return self._infer_routed(model_name, inputs, kwargs,
-                                          sequence_id)
+                                          sequence_id, affinity_key)
             except AdmissionRejected as e:
                 self._admission_note_shed(e)  # endpoint-limiter shed
                 raise
@@ -1308,7 +1509,7 @@ class PoolClient(_PoolClientBase):
         t0 = time.monotonic()
         try:
             result = self._infer_routed(model_name, inputs, kwargs,
-                                        sequence_id)
+                                        sequence_id, affinity_key)
         except BaseException as e:
             self._admission_settle(token, t0, e)
             raise
@@ -1316,17 +1517,19 @@ class PoolClient(_PoolClientBase):
         return result
 
     def _infer_routed(self, model_name: str, inputs, kwargs,
-                      sequence_id: int):
+                      sequence_id: int, affinity_key: Optional[str] = None):
         timeout_s = kwargs.get("client_timeout")
         request_id = kwargs.get("request_id", "")
         if sequence_id:
-            return self._sequence_infer(model_name, inputs, kwargs)
+            return self._sequence_infer(model_name, inputs, kwargs,
+                                        affinity_key)
         if self._hedge is not None:
             # hedged attempts run on executor threads that don't inherit
             # this context: a stashed admission phase would never be
             # claimed and could leak onto a later unrelated span
             consume_admission_phase()
-            return self._hedged_infer(model_name, inputs, kwargs, timeout_s)
+            return self._hedged_infer(model_name, inputs, kwargs, timeout_s,
+                                      affinity_key)
 
         def op(client, remaining):
             kw = dict(kwargs)
@@ -1337,9 +1540,10 @@ class PoolClient(_PoolClientBase):
         return self._execute(
             op, idempotent=True, timeout_s=timeout_s,
             request_id=request_id, sequence_id=sequence_id,
-            record_latency=True)
+            record_latency=True, affinity_key=affinity_key)
 
-    def _sequence_infer(self, model_name: str, inputs, kwargs):
+    def _sequence_infer(self, model_name: str, inputs, kwargs,
+                        affinity_key: Optional[str] = None):
         """Affinity-pinned sequence request: every request of one sequence
         lands on the pinned replica. Connect failures re-attempt (the pin
         moves only while the sequence has no established server state);
@@ -1356,7 +1560,8 @@ class PoolClient(_PoolClientBase):
                 if last is not None:
                     raise deadline_exc from last
                 raise
-            ep = self._seq_endpoint(sequence_id, exclude=tried)
+            ep = self._seq_endpoint(sequence_id, exclude=tried,
+                                    affinity_key=affinity_key)
             if ep not in tried:
                 tried.append(ep)
             self.pool.begin(ep)
@@ -1435,11 +1640,14 @@ class PoolClient(_PoolClientBase):
             return self._executor
 
     def _hedged_infer(self, model_name, inputs, kwargs,
-                      timeout_s: Optional[float]):
+                      timeout_s: Optional[float],
+                      affinity_key: Optional[str] = None):
         """Primary + up to ``max_hedges`` staggered copies on distinct
         replicas; first success wins, losers are cancelled best-effort
         (a thread-borne attempt that already started runs to completion
-        in the background and still records its outcome)."""
+        in the background and still records its outcome). With an
+        affinity key the primary goes home; hedges exclude it, so a hedge
+        is the key's deterministic rendezvous runner-up."""
         budget = AttemptBudget(self._budget_policy, timeout_s)
         hedge = self._hedge
         pool = self.pool
@@ -1466,7 +1674,7 @@ class PoolClient(_PoolClientBase):
 
         def spawn():
             remaining = budget.attempt_timeout_s()  # raises once spent
-            ep = pool.select(exclude=tried)
+            ep = pool.select(exclude=tried, affinity_key=affinity_key)
             tried.append(ep)
             future = executor.submit(attempt, ep, remaining)
             futures.append(future)
@@ -1539,9 +1747,13 @@ class PoolClient(_PoolClientBase):
         returned, before a single event streamed. With admission armed the
         stream holds one slot for its whole life (admitted on first
         iteration, like the outstanding count; released without feeding
-        the limiter — an SSE session's duration is not a unary RTT)."""
+        the limiter — an SSE session's duration is not a unary RTT).
+        ``affinity_key=`` (with ``routing="affinity"``) lands the session
+        on its key's home replica, so a re-opened generation finds its
+        KV cache."""
+        affinity_key = kwargs.pop("affinity_key", None)
         try:
-            ep = self.pool.select()
+            ep = self.pool.select(affinity_key=affinity_key)
         except AdmissionRejected as e:
             self._admission_note_shed(e)
             raise
@@ -1735,12 +1947,16 @@ class AioPoolClient(_PoolClientBase):
         while True:
             await asyncio.sleep(self._health_interval_s)
             await self._probe_once()
+            # idle-pin sweep on the prober cadence (see the sync twin);
+            # _seq_gc never blocks beyond one short lock
+            self._seq_gc()
 
     # -- failover engine ------------------------------------------------------
     async def _execute(self, op, idempotent: bool = True,
                        timeout_s: Optional[float] = None,
                        request_id: str = "", sequence_id: int = 0,
-                       record_latency: bool = False):
+                       record_latency: bool = False,
+                       affinity_key: Optional[str] = None):
         self._ensure_prober()
         budget = AttemptBudget(self._budget_policy, timeout_s)
         tried: List[EndpointState] = []
@@ -1753,7 +1969,8 @@ class AioPoolClient(_PoolClientBase):
                     raise deadline_exc from last
                 raise
             try:
-                ep = self.pool.select(exclude=tried)
+                ep = self.pool.select(exclude=tried,
+                                      affinity_key=affinity_key)
             except NoEndpointAvailableError:
                 if last is not None:
                     raise last
@@ -1802,11 +2019,12 @@ class AioPoolClient(_PoolClientBase):
         """Pool-routed async ``infer`` (same affinity/idempotency/hedging
         and admission contract as the sync twin)."""
         kwargs = _fold_infer_args(args, kwargs)
+        affinity_key = kwargs.pop("affinity_key", None)
         sequence_id = kwargs.get("sequence_id", 0)
         if self._admission is None:
             try:
                 return await self._infer_routed(model_name, inputs, kwargs,
-                                                sequence_id)
+                                                sequence_id, affinity_key)
             except AdmissionRejected as e:
                 self._admission_note_shed(e)  # endpoint-limiter shed
                 raise
@@ -1814,7 +2032,7 @@ class AioPoolClient(_PoolClientBase):
         t0 = time.monotonic()
         try:
             result = await self._infer_routed(model_name, inputs, kwargs,
-                                              sequence_id)
+                                              sequence_id, affinity_key)
         except BaseException as e:
             self._admission_settle(token, t0, e)
             raise
@@ -1822,18 +2040,20 @@ class AioPoolClient(_PoolClientBase):
         return result
 
     async def _infer_routed(self, model_name: str, inputs, kwargs,
-                            sequence_id: int):
+                            sequence_id: int,
+                            affinity_key: Optional[str] = None):
         timeout_s = kwargs.get("client_timeout")
         request_id = kwargs.get("request_id", "")
         if sequence_id:
-            return await self._sequence_infer(model_name, inputs, kwargs)
+            return await self._sequence_infer(model_name, inputs, kwargs,
+                                              affinity_key)
         if self._hedge is not None:
             # hedge tasks share this task's context, but racing attempts
             # would each claim-or-miss the one stashed phase
             # nondeterministically — drop it instead (see the sync twin)
             consume_admission_phase()
             return await self._hedged_infer(
-                model_name, inputs, kwargs, timeout_s)
+                model_name, inputs, kwargs, timeout_s, affinity_key)
 
         async def op(client, remaining):
             kw = dict(kwargs)
@@ -1844,9 +2064,10 @@ class AioPoolClient(_PoolClientBase):
         return await self._execute(
             op, idempotent=True, timeout_s=timeout_s,
             request_id=request_id, sequence_id=sequence_id,
-            record_latency=True)
+            record_latency=True, affinity_key=affinity_key)
 
-    async def _sequence_infer(self, model_name: str, inputs, kwargs):
+    async def _sequence_infer(self, model_name: str, inputs, kwargs,
+                              affinity_key: Optional[str] = None):
         """Async twin of the sync affinity-pinned sequence path."""
         self._ensure_prober()
         sequence_id = kwargs["sequence_id"]
@@ -1861,7 +2082,8 @@ class AioPoolClient(_PoolClientBase):
                 if last is not None:
                     raise deadline_exc from last
                 raise
-            ep = self._seq_endpoint(sequence_id, exclude=tried)
+            ep = self._seq_endpoint(sequence_id, exclude=tried,
+                                    affinity_key=affinity_key)
             if ep not in tried:
                 tried.append(ep)
             self.pool.begin(ep)
@@ -1928,10 +2150,12 @@ class AioPoolClient(_PoolClientBase):
         """Pool-routed async SSE generate stream; the endpoint's
         ``outstanding`` slot — and, with admission armed, one admission
         slot — is held for the life of the iteration (see the sync
-        twin)."""
+        twin). ``affinity_key=`` lands the session on its key's home
+        replica under ``routing="affinity"``."""
         self._ensure_prober()  # streaming-only pools still need health
+        affinity_key = kwargs.pop("affinity_key", None)
         try:
-            ep = self.pool.select()
+            ep = self.pool.select(affinity_key=affinity_key)
         except AdmissionRejected as e:
             self._admission_note_shed(e)
             raise
@@ -1973,7 +2197,8 @@ class AioPoolClient(_PoolClientBase):
         return stream()
 
     async def _hedged_infer(self, model_name, inputs, kwargs,
-                            timeout_s: Optional[float]):
+                            timeout_s: Optional[float],
+                            affinity_key: Optional[str] = None):
         self._ensure_prober()
         budget = AttemptBudget(self._budget_policy, timeout_s)
         hedge = self._hedge
@@ -2002,7 +2227,7 @@ class AioPoolClient(_PoolClientBase):
 
         def spawn():
             remaining = budget.attempt_timeout_s()
-            ep = pool.select(exclude=tried)
+            ep = pool.select(exclude=tried, affinity_key=affinity_key)
             tried.append(ep)
             task = asyncio.ensure_future(attempt(ep, remaining))
             tasks.add(task)

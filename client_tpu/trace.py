@@ -53,11 +53,14 @@ import numpy as np
 # a v1 reader still loads the v1-compatible records of a mixed trace, and
 # only records carrying newer-versioned semantics stamp their own ``v``
 # (the PR 8 forward-compat rule: skip-and-count, never fatal)
-TRACE_VERSION = 2
+TRACE_VERSION = 3
 BASE_VERSION = 1
 # record kinds introduced after the base format stamp their records with
 # the version that introduced them
 _KIND_VERSIONS = {"sharded": 2}
+# records carrying a zipfian ``content_key`` (the hot-key workload knob)
+# stamp v=3: a v2 loader skips exactly these, counted, and keeps the rest
+_CONTENT_KEY_VERSION = 3
 
 KINDS = ("unary", "generate_stream", "sequence", "sharded")
 
@@ -105,6 +108,12 @@ class TraceRecord:
     # sharded records: the generator's declared fan-out (informational —
     # the replayer's --shard-layout decides the real endpoints/axes)
     shards: Optional[int] = None
+    # hot-key workloads (format v3): the zipf-drawn content identity —
+    # records with equal keys replay BYTE-IDENTICAL payloads (the
+    # replayer synthesizes per-key deterministic tensors/prompts), so the
+    # client-side cache/singleflight layer has real hot keys to collapse;
+    # it also doubles as the session key for ``routing="affinity"``
+    content_key: Optional[int] = None
 
     def to_obj(self) -> Dict[str, Any]:
         obj: Dict[str, Any] = {
@@ -127,9 +136,12 @@ class TraceRecord:
             obj["seq_len"] = int(self.seq_len)
         if self.kind == "sharded" and self.shards is not None:
             obj["shards"] = int(self.shards)
-        v = _KIND_VERSIONS.get(self.kind)
-        if v is not None and v > BASE_VERSION:
-            # newer-kind records stamp their own version so a BASE_VERSION
+        v = _KIND_VERSIONS.get(self.kind, BASE_VERSION)
+        if self.content_key is not None:
+            obj["content_key"] = int(self.content_key)
+            v = max(v, _CONTENT_KEY_VERSION)
+        if v > BASE_VERSION:
+            # newer-versioned records stamp their own version so an older
             # reader skips exactly these (counted) and keeps the rest
             obj["v"] = v
         return obj
@@ -202,6 +214,14 @@ class TraceRecord:
                     line, "shards must be an integer") from None
             if kwargs["shards"] < 1:
                 raise TraceParseError(line, "shards must be >= 1")
+        if "content_key" in obj:
+            try:
+                kwargs["content_key"] = int(obj["content_key"])
+            except (TypeError, ValueError):
+                raise TraceParseError(
+                    line, "content_key must be an integer") from None
+            if kwargs["content_key"] < 0:
+                raise TraceParseError(line, "content_key must be >= 0")
         return cls(**kwargs)
 
 
@@ -384,6 +404,25 @@ def _heavy_tail_length(rng: np.random.Generator, tail: str, mean: float,
     return int(min(max(round(value), 1), clip))
 
 
+def _zipf_pmf(alpha: float, universe: int) -> "np.ndarray":
+    """The bounded zipf distribution over key ranks 1..universe: key 0 is
+    the hottest. ``alpha`` is the usual zipf exponent (1.0–1.3 matches
+    measured serving fleets; higher = hotter head)."""
+    if universe < 1:
+        raise ValueError("hot_key_universe must be >= 1 when enabled")
+    if alpha < 0.0:
+        raise ValueError("hot_key_alpha must be >= 0")
+    ranks = np.arange(1, universe + 1, dtype=np.float64)
+    p = ranks ** -alpha
+    return p / p.sum()
+
+
+def _key_rng(seed: int, content_key: int) -> np.random.Generator:
+    """The per-key generator behind "same key => byte-identical payload":
+    a pure function of (trace seed, key), independent of record order."""
+    return np.random.default_rng((int(seed), int(content_key)))
+
+
 def _layout(model: str,
             shapes: Optional[Dict[str, List[int]]] = None,
             dtypes: Optional[Dict[str, str]] = None,
@@ -419,14 +458,39 @@ def heavy_tail(seed: int = 0, duration_s: float = 10.0, rate: float = 10.0,
                prompt_sigma: float = 1.0, output_mean: float = 8.0,
                output_sigma: float = 0.8, alpha: float = 1.8,
                max_prompt: int = 96, max_output: int = 32,
-               model: str = "tiny_lm_generate") -> List[TraceRecord]:
+               model: str = "tiny_lm_generate",
+               hot_key_alpha: float = 1.1,
+               hot_key_universe: int = 0) -> List[TraceRecord]:
     """Streamed generations with heavy-tailed prompt/output token counts
-    (``lognormal`` or ``pareto``) arriving as plain Poisson at ``rate``."""
+    (``lognormal`` or ``pareto``) arriving as plain Poisson at ``rate``.
+
+    ``hot_key_universe > 0`` arms the hot-key knob: each record draws a
+    ``content_key`` from a bounded zipf(``hot_key_alpha``) over
+    ``hot_key_universe`` keys, its token counts then come from a per-key
+    generator — same key => identical record sizing AND byte-identical
+    replay payloads (the session/prefix affinity + cache proof workload).
+    The default 0 draws nothing extra, so pre-v3 specs stay
+    byte-identical."""
     if tail not in ("lognormal", "pareto"):
         raise ValueError(f"unknown tail {tail!r} (lognormal|pareto)")
     rng = np.random.default_rng(seed)
+    pmf = _zipf_pmf(hot_key_alpha, hot_key_universe) \
+        if hot_key_universe else None
     records = []
     for t in _arrival_times(rng, duration_s, rate):
+        if pmf is not None:
+            key = int(rng.choice(hot_key_universe, p=pmf))
+            krng = _key_rng(seed, key)
+            records.append(TraceRecord(
+                at_s=t, kind="generate_stream", model=model,
+                content_key=key,
+                prompt_tokens=_heavy_tail_length(
+                    krng, tail, prompt_mean, prompt_sigma, alpha,
+                    max_prompt),
+                output_tokens=_heavy_tail_length(
+                    krng, tail, output_mean, output_sigma, alpha,
+                    max_output)))
+            continue
         records.append(TraceRecord(
             at_s=t, kind="generate_stream", model=model,
             prompt_tokens=_heavy_tail_length(
@@ -450,6 +514,8 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
           shard_fraction: float = 0.0, shards: int = 2,
           shard_model: str = "decoder_lm_tp_prefill",
           shard_batch: Optional[int] = None,
+          hot_key_alpha: float = 1.1,
+          hot_key_universe: int = 0,
           shapes: Optional[Dict[str, List[int]]] = None,
           dtypes: Optional[Dict[str, str]] = None) -> List[TraceRecord]:
     """Mixed-kind bursty traffic: each Poisson-burst arrival becomes a
@@ -460,7 +526,16 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
     *arrivals* — a sequence arrival fans out into several requests, so the
     offered request rate is slightly higher. The default
     ``shard_fraction=0`` draws nothing extra from the rng, so pre-sharding
-    specs keep producing byte-identical traces."""
+    specs keep producing byte-identical traces.
+
+    ``hot_key_universe > 0`` arms the hot-key knob on unary AND stream
+    records: a zipf(``hot_key_alpha``)-drawn ``content_key`` per record
+    (format v3), threaded by the replayer into per-key deterministic
+    payload synthesis (same key => byte-identical inputs) and into
+    ``routing="affinity"`` session keys — the proof workload for the
+    client-side cache/singleflight layer. The default 0 draws nothing
+    extra, so pre-v3 specs stay byte-identical. Sequences keep their own
+    group affinity and carry no key."""
     if stream_fraction + seq_fraction + shard_fraction > 1.0:
         raise ValueError(
             "stream_fraction + seq_fraction + shard_fraction must be <= 1")
@@ -476,6 +551,8 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
             raise ValueError(f"shard_batch {shard_batch} < shards {shards}")
         shard_shapes = {k: [int(shard_batch)] + list(v[1:])
                         for k, v in shard_shapes.items()}
+    pmf = _zipf_pmf(hot_key_alpha, hot_key_universe) \
+        if hot_key_universe else None
     records: List[TraceRecord] = []
     group = 0
     for t in _arrival_times(rng, duration_s, rate, burst_factor,
@@ -488,6 +565,21 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
                 shapes=shard_shapes, dtypes=shard_dtypes, shards=shards))
             continue
         if pick < stream_fraction:
+            if pmf is not None:
+                # keyed stream: sizing comes from the per-key generator so
+                # equal keys are equal sessions (prompt AND output lengths)
+                key = int(rng.choice(hot_key_universe, p=pmf))
+                krng = _key_rng(seed, key)
+                records.append(TraceRecord(
+                    at_s=t, kind="generate_stream", model=stream_model,
+                    content_key=key,
+                    prompt_tokens=_heavy_tail_length(
+                        krng, tail, prompt_mean, prompt_sigma, alpha,
+                        max_prompt),
+                    output_tokens=_heavy_tail_length(
+                        krng, tail, output_mean, output_sigma, alpha,
+                        max_output)))
+                continue
             records.append(TraceRecord(
                 at_s=t, kind="generate_stream", model=stream_model,
                 prompt_tokens=_heavy_tail_length(
@@ -505,9 +597,12 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
                     seq_group=group, seq_index=i, seq_len=steps))
                 at += float(rng.exponential(seq_gap_s))
         else:
+            key = (int(rng.choice(hot_key_universe, p=pmf))
+                   if pmf is not None else None)
             records.append(TraceRecord(
                 at_s=t, kind="unary", model=unary_model,
-                shapes=unary_shapes, dtypes=unary_dtypes))
+                shapes=unary_shapes, dtypes=unary_dtypes,
+                content_key=key))
     # stable by arrival: equal offsets keep insertion order, so a group's
     # steps never reorder even when gaps round to the same microsecond
     records.sort(key=lambda r: r.at_s)

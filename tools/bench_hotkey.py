@@ -1,0 +1,360 @@
+"""Generate BENCH_HOTKEY.json: hot-key serving under a zipfian workload.
+
+The claim to prove: on a seeded zipfian trace, the client-side hot-key
+layer (``client_tpu.cache``: singleflight + bounded response cache) makes
+a hot key cost the fleet ~one request instead of N. Three measurements:
+
+1. **Capacity** — bisect the max sustainable replay speed of ONE seeded
+   zipfian unary trace (``hot_key_universe`` keys, zipf alpha 1.1; every
+   record's payload is a pure function of its key, so equal keys are
+   byte-identical requests) for two arms against a live in-process
+   server: ``uncached`` (bare client) and ``cached`` (cache +
+   singleflight armed). Same trace, same SLOs — the capacity ratio is
+   the fleet-level win. The cached arm's row carries ``client_cache``
+   (hit rate, collapse ratio, wire vs logical requests).
+
+2. **Matched-rate latency** — both arms replayed at the UNCACHED arm's
+   max sustainable speed: the p50 ratio at equal offered load (the
+   "same SLOs, same load" p50 improvement headline).
+
+3. **Miss-path overhead (A/B)** — a near-unique-key twin of the trace
+   (uniform over a huge universe: almost every lookup misses) replayed
+   through both arms at a modest fixed speed, plus an uncached A/A rerun
+   establishing the noise floor. The cached arm's miss-path p50 penalty
+   must sit inside that floor: the layer is pay-for-what-you-use.
+
+``--check`` re-validates the committed artifact's invariants (CI'd by
+``tests/test_hotkey_cache.py::test_bench_hotkey_artifact_claims``);
+``tools/capacity_gate.py --hotkey`` re-runs the cached arm live against
+the committed floor.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_hotkey.py [-o BENCH_HOTKEY.json]
+    JAX_PLATFORMS=cpu python tools/bench_hotkey.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# zipfian hot-key workload: unary-only (the cache layer's target shape),
+# 64-key universe at alpha 1.1 — the measured shape of production request
+# distributions; payloads are per-key deterministic so equal keys are
+# byte-identical wire requests
+TRACE_SPEC = ("mixed:duration_s=4,rate=250,stream_fraction=0,"
+              "seq_fraction=0,unary_model=batched_matmul,"
+              "hot_key_universe=64,hot_key_alpha=1.1,"
+              "burst_factor=3,period_s=1.0,duty=0.3")
+# the miss-path twin: uniform draw over a universe far larger than the
+# record count — almost every lookup is a cold miss, so the cached arm
+# pays full lookup+insert machinery with ~no hits to show for it
+UNIQUE_SPEC = ("mixed:duration_s=4,rate=100,stream_fraction=0,"
+               "seq_fraction=0,unary_model=batched_matmul,"
+               "hot_key_universe=65536,hot_key_alpha=0.0")
+TRACE_SEED = 2026
+SLOS = ["p95<200ms", "error_rate<1%"]
+OVERHEAD_SPEED = 1.0
+CACHE_TTL_S = 120.0  # longer than any probe: TTL never interferes
+
+
+@contextlib.contextmanager
+def arm_runner(name: str):
+    """One arm — a fresh in-process server, warmed model, a PerfRunner
+    with (or without) the hot-key layer armed. Shared by the capacity
+    search and tools/capacity_gate.py --hotkey, so each arm has exactly
+    one definition. Yields ``(runner, feature_description)``."""
+    import numpy as np
+
+    from client_tpu.http import InferenceServerClient, InferInput
+    from client_tpu.models import default_model_zoo
+    from client_tpu.perf import PerfRunner
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    if name not in ("uncached", "cached"):
+        raise ValueError(f"unknown arm {name!r}")
+    server = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    runner = None
+    try:
+        with InferenceServerClient(server.url) as client:
+            x = InferInput("X", [1, 64], "FP32")
+            x.set_data_from_numpy(np.zeros((1, 64), dtype=np.float32))
+            client.infer("batched_matmul", [x])  # jit warm
+        kwargs: Dict[str, Any] = {}
+        feature = "bare client (every request pays the wire)"
+        if name == "cached":
+            kwargs.update(cache=True, singleflight=True,
+                          cache_ttl_s=CACHE_TTL_S)
+            feature = ("singleflight + bounded response cache "
+                       "(client_tpu.cache): hot keys served client-side "
+                       "as zero-copy arena views")
+        runner = PerfRunner(server.url, "http", "batched_matmul",
+                            shape_overrides={"X": [1, 64]}, **kwargs)
+        yield runner, feature
+    finally:
+        if runner is not None:
+            runner.close()
+        server.stop()
+
+
+def _search(runner, tr, speed_lo, speed_hi, iters, replay_workers):
+    from tools.bench_capacity import bisect_capacity, sustainable
+
+    def evaluate(speed):
+        row = runner.run_trace(tr, speed=round(speed, 3),
+                               replay_workers=replay_workers, slos=SLOS)
+        row["delivery_ratio"] = round(
+            row["achieved_arrival_rate"] / row["offered_rate"], 3) \
+            if row["offered_rate"] else 1.0
+        row["sustainable"] = sustainable(row)
+        cc = row.get("client_cache")
+        print(f"  speed={row['speed']} offered={row['offered_rate']}/s "
+              f"p50={row['latency_ms']['p50']}ms errors={row['errors']} "
+              f"slo_ok={row['slo_ok']} sustainable={row['sustainable']}"
+              + (f" hit_rate={cc['hit_rate']} wire={cc['wire_requests']}"
+                 f"/{cc['logical_requests']}" if cc else ""),
+              flush=True)
+        return row["sustainable"], row
+
+    _, rows = bisect_capacity(evaluate, speed_lo, speed_hi, iters)
+    # confirmation pass (same discipline as bench_capacity): the committed
+    # number must be reproducible, not a lucky probe
+    candidates = sorted({r["speed"] for r in rows if r["sustainable"]},
+                        reverse=True)
+    best_row = None
+    for speed in candidates:
+        ok, row = evaluate(speed)
+        row["confirmation"] = True
+        rows.append(row)
+        if ok:
+            best_row = row
+            break
+    return {
+        "max_speed": best_row["speed"] if best_row else 0.0,
+        "max_sustainable_qps": best_row["offered_rate"] if best_row else 0.0,
+        "achieved_qps_at_max": best_row["achieved_rate"] if best_row else 0.0,
+        "p50_ms_at_max": (best_row["latency_ms"]["p50"]
+                          if best_row else None),
+        "client_cache": (best_row or {}).get("client_cache"),
+        "rows": rows,
+    }
+
+
+def _matched_rate(doc, tr, replay_workers) -> Dict[str, Any]:
+    """Both arms at the SAME offered rate (the uncached arm's max): the
+    honest equal-load p50 comparison."""
+    speed = doc["arms"]["uncached"]["max_speed"]
+    if speed <= 0:
+        return {"skipped": "uncached arm found no sustainable speed"}
+    out: Dict[str, Any] = {"speed": speed}
+    for name in ("uncached", "cached"):
+        with arm_runner(name) as (runner, _):
+            row = runner.run_trace(tr, speed=speed,
+                                   replay_workers=replay_workers, slos=SLOS)
+        out[name] = {
+            "p50_ms": row["latency_ms"]["p50"],
+            "p99_ms": row["latency_ms"]["p99"],
+            "errors": row["errors"],
+            "slo_ok": row["slo_ok"],
+            "client_cache": row.get("client_cache"),
+        }
+        print(f"matched-rate {name}: p50={row['latency_ms']['p50']}ms "
+              f"slo_ok={row['slo_ok']}", flush=True)
+    up, cp = out["uncached"]["p50_ms"], out["cached"]["p50_ms"]
+    out["p50_speedup"] = round(up / cp, 2) if cp else None
+    return out
+
+
+OVERHEAD_WORKERS = 8
+
+
+def _overhead(unique_tr, replay_workers=OVERHEAD_WORKERS,
+              reps: int = 3) -> Dict[str, Any]:
+    """Miss-path A/B on the near-unique-key twin: ``reps`` replays per
+    arm, medians compared, with the noise floor established from the
+    UNCACHED arm's own run-to-run p50 spread (a single A/A pair
+    understates it on a shared-core box). A small worker pool on
+    purpose: the row measures per-request miss-path cost, and a large
+    idle pool only adds GIL-scheduling jitter to both arms."""
+
+    def run_arm(arm: str):
+        p50s = []
+        hit_rate = None
+        for _ in range(reps):
+            with arm_runner(arm) as (runner, _):
+                row = runner.run_trace(unique_tr, speed=OVERHEAD_SPEED,
+                                       replay_workers=replay_workers,
+                                       slos=SLOS)
+            p50s.append(row["latency_ms"]["p50"])
+            cc = row.get("client_cache")
+            if cc is not None:
+                hit_rate = cc.get("hit_rate") or 0.0
+            print(f"overhead {arm}: p50={row['latency_ms']['p50']}ms",
+                  flush=True)
+        return sorted(p50s), hit_rate
+
+    uncached_p50s, _ = run_arm("uncached")
+    cached_p50s, hit_rate = run_arm("cached")
+    median = lambda xs: xs[len(xs) // 2]  # noqa: E731
+    noise_ms = round(uncached_p50s[-1] - uncached_p50s[0], 3)
+    delta_ms = round(median(cached_p50s) - median(uncached_p50s), 3)
+    return {
+        "speed": OVERHEAD_SPEED,
+        "replay_workers": replay_workers,
+        "reps": reps,
+        "p50_ms": {"uncached": uncached_p50s, "cached_misses": cached_p50s},
+        "miss_path_hit_rate": hit_rate,
+        "noise_floor_ms": noise_ms,
+        "miss_path_delta_ms": delta_ms,
+        # within noise: the cached arm's miss path costs no more than the
+        # run-to-run jitter of the bare client (negative = it was faster)
+        "within_noise": delta_ms <= noise_ms + 0.05,
+    }
+
+
+def check(doc: Dict[str, Any]) -> int:
+    """Validate the committed artifact's claims; prints each verdict and
+    returns the number of violations."""
+    failures = 0
+
+    def claim(name: str, ok: bool, detail: str) -> None:
+        nonlocal failures
+        print(f"  [{'ok' if ok else 'FAIL'}] {name}: {detail}")
+        if not ok:
+            failures += 1
+
+    cached = doc["arms"]["cached"]
+    uncached = doc["arms"]["uncached"]
+    cc = cached.get("client_cache") or {}
+    claim("collapse",
+          bool(cc) and cc["wire_requests"] < cc["logical_requests"],
+          f"wire {cc.get('wire_requests')} < logical "
+          f"{cc.get('logical_requests')} "
+          f"(collapse_ratio {cc.get('collapse_ratio')})")
+    claim("hit_rate", (cc.get("hit_rate") or 0.0) >= 0.3,
+          f"hit_rate {cc.get('hit_rate')} >= 0.3")
+    qps_ratio = (cached["max_sustainable_qps"]
+                 / uncached["max_sustainable_qps"]
+                 if uncached["max_sustainable_qps"] else None)
+    p50_speedup = (doc.get("matched_rate") or {}).get("p50_speedup")
+    claim("2x_win",
+          (qps_ratio is not None and qps_ratio >= 2.0)
+          or (p50_speedup is not None and p50_speedup >= 2.0),
+          f"capacity ratio {None if qps_ratio is None else round(qps_ratio, 2)}"
+          f" or matched-rate p50 speedup {p50_speedup} >= 2.0")
+    overhead = doc.get("overhead") or {}
+    claim("miss_path_overhead", bool(overhead.get("within_noise")),
+          f"miss-path p50 delta {overhead.get('miss_path_delta_ms')}ms "
+          f"inside noise floor {overhead.get('noise_floor_ms')}ms")
+    miss_hit_rate = overhead.get("miss_path_hit_rate")
+    claim("miss_path_is_cold",
+          miss_hit_rate is not None and miss_hit_rate <= 0.2,
+          f"unique-key twin hit rate "
+          f"{overhead.get('miss_path_hit_rate')} <= 0.2 (the A/B row "
+          "measures the miss path, not hidden hits)")
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_HOTKEY.json")
+    parser.add_argument("--speed-lo", type=float, default=0.5)
+    parser.add_argument("--speed-hi", type=float, default=8.0)
+    parser.add_argument(
+        "--cached-speed-hi", type=float, default=64.0,
+        help="separate bisection ceiling for the cached arm (hits are "
+             "~50x cheaper than wire requests; one shared ceiling would "
+             "clip the cached arm's real capacity). High enough that the "
+             "ceiling probe FAILS (scheduler-bound delivery), so the "
+             "bisection brackets the real limit with a ladder of "
+             "confirmable candidates instead of one flaky top probe")
+    parser.add_argument("--iters", type=int, default=5)
+    parser.add_argument("--replay-workers", type=int, default=32)
+    parser.add_argument("--check", action="store_true",
+                        help="validate the committed artifact's claims "
+                             "instead of re-measuring")
+    args = parser.parse_args(argv)
+
+    if args.check:
+        doc = json.loads(Path(args.output).read_text())
+        failures = check(doc)
+        print("OK" if failures == 0 else f"{failures} claim(s) failed")
+        return 1 if failures else 0
+
+    from client_tpu import trace as trace_mod
+
+    tr = trace_mod.generate(TRACE_SPEC, seed=TRACE_SEED)
+    unique_tr = trace_mod.generate(UNIQUE_SPEC, seed=TRACE_SEED)
+    out: Dict[str, Any] = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "note": (
+            "hot-key serving on a seeded zipfian trace: capacity "
+            "bisection per arm (uncached vs singleflight+cache), a "
+            "matched-rate p50 comparison at the uncached arm's max "
+            "sustainable speed, and a miss-path A/B overhead row on a "
+            "near-unique-key twin vs the uncached A/A noise floor"
+        ),
+        "trace": {
+            "spec": TRACE_SPEC,
+            "seed": TRACE_SEED,
+            "records": len(tr.records),
+            "duration_s": tr.duration_s,
+            "hot_keys": len({r.content_key for r in tr.records}),
+        },
+        "unique_trace": {
+            "spec": UNIQUE_SPEC,
+            "seed": TRACE_SEED,
+            "records": len(unique_tr.records),
+        },
+        "slos": list(SLOS),
+        "search": {
+            "speed_lo": args.speed_lo,
+            "speed_hi": args.speed_hi,
+            "cached_speed_hi": args.cached_speed_hi,
+            "iters": args.iters,
+            "replay_workers": args.replay_workers,
+            "cache_ttl_s": CACHE_TTL_S,
+        },
+        "arms": {},
+    }
+    for name in ("uncached", "cached"):
+        hi = args.cached_speed_hi if name == "cached" else args.speed_hi
+        with arm_runner(name) as (runner, feature):
+            print(f"arm {name}: {feature}", flush=True)
+            arm = _search(runner, tr, args.speed_lo, hi,
+                          args.iters, args.replay_workers)
+            arm["feature"] = feature
+        out["arms"][name] = arm
+    out["matched_rate"] = _matched_rate(out, tr, args.replay_workers)
+    out["overhead"] = _overhead(unique_tr)
+    out["capacity_ratio"] = (
+        round(out["arms"]["cached"]["max_sustainable_qps"]
+              / out["arms"]["uncached"]["max_sustainable_qps"], 2)
+        if out["arms"]["uncached"]["max_sustainable_qps"] else None)
+
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps({
+        "uncached_qps": out["arms"]["uncached"]["max_sustainable_qps"],
+        "cached_qps": out["arms"]["cached"]["max_sustainable_qps"],
+        "capacity_ratio": out["capacity_ratio"],
+        "matched_rate_p50_speedup": out["matched_rate"].get("p50_speedup"),
+        "miss_path_delta_ms": out["overhead"]["miss_path_delta_ms"],
+        "noise_floor_ms": out["overhead"]["noise_floor_ms"],
+    }, indent=2))
+    failures = check(out)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,13 +37,21 @@
 # shard producing the typed ShardFailed (whole-request, zero partial
 # gathers, no silent retry), and sharded trace-record replay.
 #
+# The hot-key smoke (tests/test_hotkey_cache.py, hotkey_smoke marker)
+# proves the serving layer for zipfian traffic: affinity routing
+# re-homes keys deterministically through a replica kill/heal cycle
+# with zero routing-attributable errors, and a zipfian trace replayed
+# through cache+singleflight issues measurably fewer wire requests
+# than logical requests.
+#
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
     tests/test_dataplane_observe.py tests/test_trace_replay.py \
-    tests/test_arena.py tests/test_admission.py tests/test_shard.py "$@"
+    tests/test_arena.py tests/test_admission.py tests/test_shard.py \
+    tests/test_hotkey_cache.py "$@"
