@@ -41,6 +41,16 @@ client libraries (triton-inference-server/client), designed TPU-first:
   registry fed by the resilience + pool event streams, and W3C
   ``traceparent`` propagation joined to server-side access records and a
   ``/metrics`` endpoint (docs/observability.md).
+- ``client_tpu.flight``: the flight recorder — always-on per-request
+  causal timelines assembled from structured events every layer emits
+  (retries, breaker flips, routing/affinity decisions, admission
+  park/shed, batch join/dispatch, cache hit/collapse, arena leases,
+  shard fan-out, stream reconnects), with **tail-based retention**: a
+  commit-time verdict keeps errored/shed/SLO-breached/slowest-percentile
+  timelines (plus a baseline sample) in a bounded ring and drops the
+  fast healthy majority wholesale; exporters, the ``tail_divergence``
+  anomaly, and ``doctor --postmortem`` bundles
+  (docs/observability.md "Flight recorder & postmortems").
 - ``client_tpu.arena``: the pooled shm arena — size-class slab allocator
   over both shared-memory packages with ref-counted leases, LRU watermark
   trimming and per-endpoint cached server registrations; the transparent

@@ -152,11 +152,17 @@ class InferenceServerClientBase:
         """A request span when telemetry is configured, else None — the
         single hot-path gate all four frontends share. A pending
         admission-queue wait stashed by the pool's admission gate is
-        claimed onto the new span as its first phase."""
+        claimed onto the new span as its first phase. With a flight
+        recorder armed, the span's trace id is bound onto the active
+        flight scratch (or a span-owned scratch opens — this frontend is
+        the outermost layer — which ``Telemetry.finish`` settles)."""
         tel = self._telemetry
         if tel is None:
             return None
         span = tel.begin(frontend, model)
+        flight = getattr(tel, "flight", None)
+        if flight is not None:
+            flight.span_begin(span, getattr(self, "_url", None))
         pending = consume_admission_phase()
         if pending is not None:
             span.phase("admission_queue", pending[0], pending[1])

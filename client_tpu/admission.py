@@ -52,6 +52,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import flight as _flight
 from .utils import InferenceServerException
 
 __all__ = [
@@ -488,6 +489,8 @@ class AdmissionController:
         return lane
 
     def _observe_admit(self, lane: str, waited_s: float) -> None:
+        _flight.note("admission", "admit", lane=lane,
+                     waited_ms=round(waited_s * 1e3, 3))
         if self.observer is not None:
             try:
                 self.observer.on_admission_admit(lane, waited_s)
@@ -503,6 +506,7 @@ class AdmissionController:
                 lane.shed_by_reason.get(reason, 0) + 1)
         exc = AdmissionRejected(reason, lane.label,
                                 retry_after_s=retry_after_s)
+        _flight.note("admission", "shed", reason=reason, lane=lane.label)
         if self.observer is not None:
             try:
                 self.observer.on_admission_shed(lane.label, reason)
@@ -708,6 +712,10 @@ class AdmissionController:
         if isinstance(parked, AdmissionToken):
             return parked
         waiter: _Waiter = parked
+        # unlocked depth read: a point-in-time queue-depth annotation on
+        # the flight timeline, not an accounting source
+        _flight.note("admission", "park", lane=waiter.lane,
+                     depth=self._lanes[waiter.lane].depth)
         waiter.event.wait(self._wait_bound_s(deadline))
         return self._finish_wait(waiter)
 
@@ -727,6 +735,8 @@ class AdmissionController:
         if isinstance(parked, AdmissionToken):
             return parked
         waiter: _Waiter = parked
+        _flight.note("admission", "park", lane=waiter.lane,
+                     depth=self._lanes[waiter.lane].depth)
         try:
             await asyncio.wait_for(
                 waiter.future, timeout=self._wait_bound_s(deadline))

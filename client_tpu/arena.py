@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import flight as _flight
 from . import observe as _observe
 from .utils import (
     serialize_bf16_tensor,
@@ -497,6 +498,7 @@ class ShmArena:
         rec = _observe._DATAPLANE
         if rec is not None:
             rec.on_arena_lease(family, class_bytes, hit)
+        _flight.note("arena", "lease", bytes=class_bytes, hit=hit)
         return ArenaLease(self, region, offset, nbytes)
 
     def _retain(self, lease: ArenaLease) -> None:
@@ -674,6 +676,9 @@ class ShmArena:
         rec = _observe._DATAPLANE
         if rec is not None:
             rec.on_arena_registration("issued")
+        # a registration RPC on the request path is exactly the kind of
+        # one-off stall a retained slow timeline should explain
+        _flight.note("arena", "register", url=url, region=region.name)
 
     def is_registered(self, client, region_name: str) -> bool:
         with self._lock:

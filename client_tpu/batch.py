@@ -89,6 +89,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import flight as _flight
 from ._base import fold_infer_args
 from ._tensor import InferInput
 from .utils import InferenceServerException, sorted_percentile
@@ -205,7 +206,7 @@ class _PendingCall:
 
     __slots__ = ("inputs", "sig", "raw", "kwargs", "rows", "span",
                  "enqueued_ns", "claimed", "done", "result", "error",
-                 "future")
+                 "future", "batch_rows", "batch_calls")
 
     def __init__(self, inputs, sig, raw, kwargs, rows, span):
         self.inputs = inputs      # the caller's original InferInput list
@@ -220,6 +221,10 @@ class _PendingCall:
         self.result = None
         self.error: Optional[BaseException] = None
         self.future = None        # aio only
+        # stamped at settle so the CALLER's thread/task can annotate its
+        # own flight timeline with the batch it rode
+        self.batch_rows = 0
+        self.batch_calls = 0
 
 
 class _SyncKeyState:
@@ -720,6 +725,18 @@ class _BatchingCore:
             return None
         return tel.begin(self._frontend, model)
 
+    # -- composition -----------------------------------------------------------
+    def caching(self, **kwargs):
+        """Wrap THIS batching client in the hot-key layer (cache outside
+        batching: hits skip the coalescing window, misses may still ride
+        a batch). Without this override ``__getattr__`` would delegate to
+        the inner client and silently compose the cache around the POOL
+        instead — dropping the batching layer from the chain."""
+        from .cache import AioCachingClient, CachingClient
+
+        cls = AioCachingClient if self._AIO else CachingClient
+        return cls(self, **kwargs)
+
     # -- generic surface delegation -------------------------------------------
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -766,6 +783,21 @@ class BatchingClient(_BatchingCore):
         key, rows, raw, sig = plan
         call = _PendingCall(inputs, sig, raw, kwargs, rows,
                             self._begin_span(model_name))
+        scratch = _flight.layer_begin(self._telemetry, "batch", model_name)
+        _flight.note("batch", "join", rows=rows)
+        if scratch is None:
+            return self._infer_queued(model_name, key, call)
+        try:
+            result = self._infer_queued(model_name, key, call)
+        except BaseException as e:
+            _flight.layer_commit(self._telemetry, scratch, error=e)
+            raise
+        _flight.layer_commit(self._telemetry, scratch)
+        return result
+
+    def _infer_queued(self, model_name: str, key, call: _PendingCall):
+        """The queue/lead/follow engine behind :meth:`infer` (split out so
+        the flight-recorder wrapper above owns one scratch per caller)."""
         state = self._state_for(key, model_name)
         with state.cond:
             self._note_arrival(state)
@@ -790,6 +822,9 @@ class BatchingClient(_BatchingCore):
             self._dispatch(state, batch)
             # the claimed batch may not include this call (row-cap
             # overflow): loop back to follow — or lead — again
+        _flight.note("batch", "dispatched", rows=call.rows,
+                     batch_rows=call.batch_rows,
+                     batch_calls=call.batch_calls)
         if call.error is not None:
             raise call.error
         return call.result
@@ -849,8 +884,12 @@ class BatchingClient(_BatchingCore):
 
     def _settle(self, state: _SyncKeyState, batch: List[_PendingCall],
                 error: Optional[BaseException]) -> None:
+        total_rows = sum(c.rows for c in batch)
+        n = len(batch)
         with state.cond:
             for call in batch:
+                call.batch_rows = total_rows
+                call.batch_calls = n
                 call.error = error
                 call.done = True
             state.cond.notify_all()
@@ -911,6 +950,8 @@ class AioBatchingClient(_BatchingCore):
         call = _PendingCall(inputs, sig, raw, kwargs, rows,
                             self._begin_span(model_name))
         call.future = asyncio.get_running_loop().create_future()
+        scratch = _flight.layer_begin(self._telemetry, "batch", model_name)
+        _flight.note("batch", "join", rows=rows)
         state = self._state_for(key, model_name)
         self._note_arrival(state)
         state.items.append(call)
@@ -919,7 +960,21 @@ class AioBatchingClient(_BatchingCore):
             state.task = asyncio.ensure_future(self._flush_loop(state))
         elif state.rows >= self.batch_max_rows:
             state.wake.set()  # cut the window short: batch is full
-        return await call.future
+        if scratch is None:
+            return await call.future
+        try:
+            result = await call.future
+        except BaseException as e:
+            _flight.note("batch", "dispatched", rows=call.rows,
+                         batch_rows=call.batch_rows,
+                         batch_calls=call.batch_calls)
+            _flight.layer_commit(self._telemetry, scratch, error=e)
+            raise
+        _flight.note("batch", "dispatched", rows=call.rows,
+                     batch_rows=call.batch_rows,
+                     batch_calls=call.batch_calls)
+        _flight.layer_commit(self._telemetry, scratch)
+        return result
 
     # -- flusher --------------------------------------------------------------
     async def _flush_loop(self, state: _AioKeyState) -> None:
@@ -965,7 +1020,10 @@ class AioBatchingClient(_BatchingCore):
             error = e
         t1 = time.perf_counter_ns()
         # settle the callers first (see the sync twin)
+        n = len(batch)
         for call in batch:
+            call.batch_rows = total_rows
+            call.batch_calls = n
             if call.future is None or call.future.done():
                 continue  # cancelled caller: nothing to deliver
             if error is not None:

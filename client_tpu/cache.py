@@ -64,6 +64,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import flight as _flight_recorder
 from ._base import fold_infer_args
 from .batch import plan_request
 from .utils import (
@@ -742,6 +743,22 @@ class CachingClient(_CachingCore):
         if key is None:
             self._count(model_name, "bypass")
             return self._inner.infer(model_name, inputs, **kwargs)
+        scratch = _flight_recorder.layer_begin(
+            self._telemetry, "cache", model_name)
+        if scratch is None:
+            return self._infer_keyed(key, model_name, inputs, kwargs)
+        try:
+            result = self._infer_keyed(key, model_name, inputs, kwargs)
+        except BaseException as e:
+            _flight_recorder.layer_commit(self._telemetry, scratch, error=e)
+            raise
+        _flight_recorder.layer_commit(self._telemetry, scratch)
+        return result
+
+    def _infer_keyed(self, key, model_name: str, inputs, kwargs):
+        """The lookup/collapse engine behind :meth:`infer` (split out so
+        the flight-recorder wrapper above owns one scratch per caller —
+        a pure cache hit's timeline is just cache events, no wire leg)."""
         span = self._begin_span(model_name)
         t0 = time.perf_counter_ns()
         cache = self._cache
@@ -750,16 +767,19 @@ class CachingClient(_CachingCore):
             t1 = time.perf_counter_ns()
             if state == "hit":
                 self._count(model_name, "hit")
+                _flight_recorder.note("cache", "hit")
                 self._finish_span(span, t0, t1, None, "hit")
                 return CachedInferResult(entry)
             if state == "stale":
                 self._count(model_name, "stale")
+                _flight_recorder.note("cache", "stale_refresh")
                 self._spawn_revalidation(key, model_name, inputs, kwargs)
                 self._finish_span(span, t0, t1, None, "stale")
                 return CachedInferResult(entry, stale=True)
         else:
             t1 = time.perf_counter_ns()
         if not self._singleflight:
+            _flight_recorder.note("cache", "miss")
             return self._miss(key, model_name, inputs, kwargs, span, t0, t1)
         with self._flights_lock:
             flight = self._flights.get(key)
@@ -771,13 +791,16 @@ class CachingClient(_CachingCore):
                 flight.followers += 1
                 leader = False
         if leader:
+            _flight_recorder.note("cache", "leader", key=key[:12])
             return self._lead(flight, key, model_name, inputs, kwargs,
                               span, t0, t1)
+        _flight_recorder.note("cache", "follower", key=key[:12])
         with flight.cond:
             while not flight.done:
                 flight.cond.wait()
         t2 = time.perf_counter_ns()
         self._count(model_name, "collapsed")
+        _flight_recorder.note("cache", "collapsed")
         self._finish_span(span, t0, t1, t2, "collapsed", error=flight.error)
         if flight.error is not None:
             raise flight.error
@@ -928,6 +951,21 @@ class AioCachingClient(_CachingCore):
         if key is None:
             self._count(model_name, "bypass")
             return await self._inner.infer(model_name, inputs, **kwargs)
+        scratch = _flight_recorder.layer_begin(
+            self._telemetry, "cache", model_name)
+        if scratch is None:
+            return await self._infer_keyed(key, model_name, inputs, kwargs)
+        try:
+            result = await self._infer_keyed(key, model_name, inputs,
+                                             kwargs)
+        except BaseException as e:
+            _flight_recorder.layer_commit(self._telemetry, scratch, error=e)
+            raise
+        _flight_recorder.layer_commit(self._telemetry, scratch)
+        return result
+
+    async def _infer_keyed(self, key, model_name: str, inputs, kwargs):
+        """Async twin of the sync ``_infer_keyed`` split."""
         span = self._begin_span(model_name)
         t0 = time.perf_counter_ns()
         cache = self._cache
@@ -936,22 +974,26 @@ class AioCachingClient(_CachingCore):
             t1 = time.perf_counter_ns()
             if state == "hit":
                 self._count(model_name, "hit")
+                _flight_recorder.note("cache", "hit")
                 self._finish_span(span, t0, t1, None, "hit")
                 return CachedInferResult(entry)
             if state == "stale":
                 self._count(model_name, "stale")
+                _flight_recorder.note("cache", "stale_refresh")
                 self._spawn_revalidation(key, model_name, inputs, kwargs)
                 self._finish_span(span, t0, t1, None, "stale")
                 return CachedInferResult(entry, stale=True)
         else:
             t1 = time.perf_counter_ns()
         if not self._singleflight:
+            _flight_recorder.note("cache", "miss")
             return await self._fetch(key, model_name, inputs, kwargs,
                                      span, t0, t1, flight=None)
         loop = asyncio.get_running_loop()
         flight = self._flights.get(key)
         if flight is not None and flight.future is not None:
             # follower: await the leader's published outcome
+            _flight_recorder.note("cache", "follower", key=key[:12])
             try:
                 outcome = await asyncio.shield(flight.future)
             except BaseException:
@@ -962,12 +1004,14 @@ class AioCachingClient(_CachingCore):
                 raise
             t2 = time.perf_counter_ns()
             self._count(model_name, "collapsed")
+            _flight_recorder.note("cache", "collapsed")
             self._finish_span(span, t0, t1, t2, "collapsed")
             entry, result = outcome
             return CachedInferResult(entry) if entry is not None else result
         flight = _Flight()
         flight.future = loop.create_future()
         self._flights[key] = flight
+        _flight_recorder.note("cache", "leader", key=key[:12])
         return await self._fetch(key, model_name, inputs, kwargs,
                                  span, t0, t1, flight=flight)
 

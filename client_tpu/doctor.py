@@ -43,7 +43,8 @@ from .observe import StatsCorrelator, Telemetry
 from .pool import PoolClient
 from .utils import InferenceServerException, sorted_percentile, triton_to_np_dtype
 
-__all__ = ["collect_snapshot", "render_summary", "main"]
+__all__ = ["collect_snapshot", "postmortem_bundle", "render_summary",
+           "main"]
 
 
 def _input_module(protocol: str):
@@ -276,6 +277,33 @@ def _cache_status() -> List[Dict[str, Any]]:
     return rows
 
 
+def _flight_status(tel: Telemetry) -> Optional[Dict[str, Any]]:
+    """The flight-recorder section: retention accounting, the rolling
+    tail-divergence verdict, and the newest anomalous timelines in
+    summary form (trace id, verdict, duration, dominant attribution) —
+    full timelines ship in the ``--postmortem`` bundle, not the
+    snapshot."""
+    recorder = getattr(tel, "flight", None)
+    if recorder is None:
+        return None
+    anomalies = []
+    for row in recorder.last_anomalies(8):
+        anomalies.append({
+            "trace_id": row["trace_id"],
+            "verdict": row["verdict"],
+            "model": row["model"],
+            "duration_ms": row["duration_ms"],
+            "error": row["error"],
+            "events": len(row["events"]),
+            "dominant": row["attribution"]["dominant"],
+        })
+    return {
+        "stats": recorder.stats(),
+        "tail_divergence": recorder.tail_divergence(),
+        "last_anomalies": anomalies,
+    }
+
+
 def _admission_status(tel: Telemetry) -> List[Dict[str, Any]]:
     """One row per admission controller attached to the telemetry (the
     pool wires its controller in at construction): limit, inflight,
@@ -438,6 +466,23 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
                     "detail": (f"owns {share:.0%} of {total_keys} tracked "
                                f"affinity keys across {len(aff)} endpoints "
                                f"(fair share {1.0 / len(aff):.0%})")})
+    # tail divergence: the flight recorder's retained slow tail shares one
+    # dominant attribution key (a layer, or a layer:endpoint pair) that
+    # the baseline traffic does not — the one-bad-replica / one-hot-lock
+    # signature, named per-request instead of inferred from aggregates
+    divergence = (snap.get("flight") or {}).get("tail_divergence")
+    if divergence:
+        url = None
+        dominant = divergence["dominant"]
+        if ":" in dominant:
+            url = dominant.split(":", 1)[1]
+        flags.append({
+            "flag": "tail_divergence", "url": url,
+            "detail": (f"{divergence['tail_share']:.0%} of "
+                       f"{divergence['tail_count']} retained slow-tail "
+                       f"timelines are dominated by {dominant!r} "
+                       f"(baseline share "
+                       f"{divergence['baseline_share']:.0%})")})
     dataplane = snap.get("shm", {}).get("dataplane")
     if dataplane and churn_threshold_ops_s:
         # prefer the probe-window rate: the lifetime average of a
@@ -604,6 +649,7 @@ def collect_snapshot(
             "batch": _registry_section(
                 registry_snapshot, "client_tpu_batch"),
             "cache": _cache_status(),
+            "flight": _flight_status(tel),
             "shm": _local_shm(recorder),
         }
         server_shm: Dict[str, Any] = {}
@@ -645,6 +691,37 @@ def collect_snapshot(
         pool.close()
         if scoped_recorder:
             observe.install_dataplane(None)
+
+
+def postmortem_bundle(snapshot: Dict[str, Any],
+                      telemetry: Optional[Telemetry] = None,
+                      ) -> Dict[str, Any]:
+    """Package one fleet snapshot into a self-contained, JSON-pure
+    postmortem artifact: the snapshot (endpoint/admission/cache/arena
+    state + anomaly flags), the flight recorder's FULL retained
+    timelines (the snapshot carries only summaries), the telemetry's
+    metrics snapshot and the SLO report. One file answers "what was the
+    fleet doing, and why were the slow requests slow" without a live
+    process to interrogate — write it the moment the incident happens,
+    not after the evidence has aged out of the rings."""
+    bundle: Dict[str, Any] = {
+        "kind": "client_tpu_postmortem",
+        "version": 1,
+        "generated_unix": int(time.time()),
+        "snapshot": snapshot,
+    }
+    recorder = getattr(telemetry, "flight", None) \
+        if telemetry is not None else None
+    if recorder is not None:
+        bundle["flight"] = {
+            "stats": recorder.stats(),
+            "tail_divergence": recorder.tail_divergence(),
+            "timelines": [t.as_dict() for t in recorder.retained()],
+        }
+    if telemetry is not None:
+        bundle["metrics"] = telemetry.registry.snapshot()
+        bundle["slo_report"] = telemetry.slo_report()
+    return bundle
 
 
 def render_summary(snap: Dict[str, Any]) -> str:
@@ -792,6 +869,20 @@ def render_summary(snap: Dict[str, Any]) -> str:
     if inventory:
         lines.append(f"  local regions: "
                      f"{', '.join(r['name'] for r in inventory)}")
+    fl = snap.get("flight")
+    if fl:
+        stats = fl["stats"]
+        lines.append("")
+        lines.append(
+            f"flight recorder: {stats['retained_total']} retained of "
+            f"{stats['requests']} requests "
+            f"(ring {stats['ring']}/{stats['capacity']}, "
+            f"dropped {stats['dropped']})")
+        for row in fl.get("last_anomalies", [])[:4]:
+            lines.append(
+                f"  {row['verdict']:<10} {row['model']:<16} "
+                f"{row['duration_ms']:.1f} ms  dominant="
+                f"{row['dominant']}  trace={row['trace_id']}")
     anomalies = snap.get("anomalies") or []
     lines.append("")
     if anomalies:
@@ -835,13 +926,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "polls, metadata and shm-status calls")
     parser.add_argument("--json", dest="json_path", default=None,
                         help="also write the snapshot JSON artifact here")
+    parser.add_argument("--postmortem", dest="postmortem_path",
+                        default=None, metavar="PATH",
+                        help="write a self-contained postmortem bundle "
+                             "(snapshot + metrics + SLO report + the "
+                             "flight recorder's full retained timelines; "
+                             "arms a flight recorder on the probe "
+                             "telemetry)")
     parser.add_argument("--fail-on-anomaly", action="store_true",
                         help="exit 1 when any anomaly is flagged")
     args = parser.parse_args(argv)
 
+    tel = None
+    if args.postmortem_path:
+        # a flight-armed probe telemetry: the probe requests themselves
+        # are recorded, so even a cold process's bundle carries per-
+        # request evidence about the fleet it just touched
+        tel = Telemetry(sample="always", orca_format=args.orca,
+                        flight=True)
     snap = collect_snapshot(
         args.urls, protocol=args.protocol, model=args.model,
         requests_per_endpoint=args.requests, orca_format=args.orca,
+        telemetry=tel,
         churn_threshold_ops_s=args.churn_threshold,
         skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout,
         shard_layout=args.shard_layout)
@@ -850,6 +956,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         with open(args.json_path, "w") as f:
             json.dump(snap, f, indent=2, default=str)
         print(f"\nsnapshot written to {args.json_path}")
+    if args.postmortem_path:
+        bundle = postmortem_bundle(snap, tel)
+        with open(args.postmortem_path, "w") as f:
+            json.dump(bundle, f, indent=2, default=str)
+        print(f"postmortem bundle written to {args.postmortem_path}")
     if args.fail_on_anomaly and snap.get("anomalies"):
         return 1
     return 0

@@ -59,6 +59,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import flight as _flight
+
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS_S",
     "DEFAULT_STREAM_MS_BUCKETS",
@@ -207,9 +209,14 @@ class _Series:
 
 
 class _HistogramSeries:
-    """One labeled histogram: cumulative-on-render fixed buckets + sum/count."""
+    """One labeled histogram: cumulative-on-render fixed buckets + sum/count.
 
-    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+    ``exemplars`` (allocated lazily, only when the owning registry opted
+    in) holds the LAST ``(trace_id, value, unix_ts)`` observed per bucket
+    — the OpenMetrics-exemplar link from a dashboard bucket straight to a
+    retained flight timeline."""
+
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count", "exemplars")
 
     def __init__(self, lock: threading.Lock, buckets: Sequence[float]):
         self._lock = lock
@@ -217,6 +224,14 @@ class _HistogramSeries:
         self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
         self.sum = 0.0
         self.count = 0
+        self.exemplars: Optional[List[Optional[Tuple[str, float, float]]]] \
+            = None
+
+    def _exemplar(self, idx: int, trace_id: str, value: float) -> None:
+        """Record one exemplar on bucket ``idx`` (caller holds the lock)."""
+        if self.exemplars is None:
+            self.exemplars = [None] * (len(self.buckets) + 1)
+        self.exemplars[idx] = (trace_id, value, time.time())
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -400,13 +415,23 @@ class MetricsRegistry:
     instrument may hold (0 disables the cap): past it, new label-sets
     fold into a single ``other`` series and
     ``client_tpu_metrics_dropped_labelsets_total{metric}`` counts the
-    overflow resolutions."""
+    overflow resolutions.
 
-    def __init__(self, max_series_per_metric: int = 512):
+    ``exemplars=True`` opts in to OpenMetrics-style exemplars: histogram
+    bucket lines grow a `` # {trace_id="..."} value ts`` suffix carrying
+    the last trace id observed in that bucket (the request/TTFT
+    histograms populate them from the active span), linking any
+    dashboard bucket straight to a retained flight timeline
+    (``FlightRecorder.find(trace_id)``). Off by default — the plain
+    0.0.4 text exposition stays byte-compatible with strict parsers."""
+
+    def __init__(self, max_series_per_metric: int = 512,
+                 exemplars: bool = False):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self._collectors: List[Callable[[], None]] = []
         self.max_series_per_metric = max(0, int(max_series_per_metric))
+        self.exemplars = bool(exemplars)
         self._dropped_labelsets: Optional[Counter] = None
 
     def _note_dropped_labelset(self, metric_name: str) -> None:
@@ -467,6 +492,19 @@ class MetricsRegistry:
 
     # -- exporters -----------------------------------------------------------
     @staticmethod
+    def _exemplar_text(exemplars, idx: int) -> str:
+        """The OpenMetrics `` # {trace_id="..."} value ts`` bucket-line
+        suffix (empty when exemplars are off or this bucket has none)."""
+        if exemplars is None:
+            return ""
+        entry = exemplars[idx]
+        if entry is None:
+            return ""
+        trace_id, value, ts = entry
+        return (f' # {{trace_id="{_escape_label(trace_id)}"}} '
+                f"{_fmt_value(value)} {ts:.3f}")
+
+    @staticmethod
     def _labels_text(labelnames, key, extra: str = "") -> str:
         parts = [f'{n}="{_escape_label(v)}"' for n, v in zip(labelnames, key)]
         if extra:
@@ -488,18 +526,24 @@ class MetricsRegistry:
                 for key in sorted(metric._series):
                     series = metric._series[key]
                     if metric.kind == "histogram":
+                        exemplars = (series.exemplars
+                                     if self.exemplars else None)
                         cum = 0
-                        for edge, n in zip(series.buckets, series.counts):
+                        for i, (edge, n) in enumerate(
+                                zip(series.buckets, series.counts)):
                             cum += n
                             labels = self._labels_text(
                                 metric.labelnames, key,
                                 f'le="{_fmt_value(edge)}"')
                             lines.append(
-                                f"{metric.name}_bucket{labels} {cum}")
+                                f"{metric.name}_bucket{labels} {cum}"
+                                + self._exemplar_text(exemplars, i))
                         labels = self._labels_text(
                             metric.labelnames, key, 'le="+Inf"')
                         lines.append(
-                            f"{metric.name}_bucket{labels} {series.count}")
+                            f"{metric.name}_bucket{labels} {series.count}"
+                            + self._exemplar_text(
+                                exemplars, len(series.buckets)))
                         base = self._labels_text(metric.labelnames, key)
                         lines.append(
                             f"{metric.name}_sum{base} "
@@ -531,12 +575,21 @@ class MetricsRegistry:
                             cum += n
                             buckets.append({"le": edge, "count": cum})
                         buckets.append({"le": "+Inf", "count": series.count})
-                        series_out.append({
+                        row = {
                             "labels": labels,
                             "count": series.count,
                             "sum": series.sum,
                             "buckets": buckets,
-                        })
+                        }
+                        if self.exemplars and series.exemplars:
+                            edges = list(series.buckets) + ["+Inf"]
+                            row["exemplars"] = [
+                                {"le": edges[i], "trace_id": ex[0],
+                                 "value": ex[1], "ts": ex[2]}
+                                for i, ex in enumerate(series.exemplars)
+                                if ex is not None
+                            ]
+                        series_out.append(row)
                     else:
                         series_out.append(
                             {"labels": labels, "value": series.value})
@@ -990,12 +1043,13 @@ class RequestSpan:
 
     __slots__ = ("trace_id", "span_id", "frontend", "model", "op",
                  "start_ns", "end_ns", "phases", "events", "sampled",
-                 "error", "tid")
+                 "error", "tid", "flight")
 
     def __init__(self, trace_id: str, span_id: str, frontend: str,
                  model: str, op: str, sampled: bool):
-        # end_ns / events / error / tid are set lazily off the hot path
-        # (finish, event(), trace retention); readers use getattr defaults
+        # end_ns / events / error / tid / flight are set lazily off the
+        # hot path (finish, event(), trace retention, flight-recorder
+        # ownership); readers use getattr defaults
         self.trace_id = trace_id
         self.span_id = span_id
         self.frontend = frontend
@@ -1548,7 +1602,13 @@ class Tracer:
     def chrome_trace(self) -> Dict[str, Any]:
         """Chrome ``trace_event`` JSON (load in chrome://tracing/Perfetto):
         one complete ("X") event per request span, nested complete events
-        per phase, instant ("i") events for retries/hedges."""
+        per phase, instant ("i") events for retries/hedges.
+
+        The ring is snapshotted under ONE lock acquire (``list(deque)``)
+        so a dump racing the hot path's ``keep`` never sees a torn deque,
+        and the emitted events are sorted by start timestamp — two
+        concurrent scrapes produce the same, time-ordered stream instead
+        of an interleaving that depends on finish order."""
         with self._lock:
             spans = list(self._ring)
         events: List[Dict[str, Any]] = []
@@ -1580,6 +1640,11 @@ class Tracer:
                     "ts": ts / 1e3, "s": "t", "pid": 1, "tid": tid,
                     "args": attrs or {},
                 })
+        # stable time-order: spans land in the ring in FINISH order, so an
+        # early-started-late-finished span would otherwise appear after
+        # requests it preceded (and a dump concurrent with another scrape
+        # would interleave differently per call)
+        events.sort(key=lambda e: e["ts"])
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def dump_json(self) -> str:
@@ -1620,6 +1685,13 @@ class Telemetry:
     export as ``client_tpu_endpoint_load{url,metric}`` gauges and surface
     in ``PoolClient.endpoint_stats()``. Endpoints silent for longer than
     ``orca_ttl_s`` have their load gauges expired at scrape time.
+
+    ``flight``: a :class:`~client_tpu.flight.FlightRecorder` (or ``True``
+    for one with defaults) arms the flight recorder: every layer records
+    a per-request causal event timeline, and a tail-based verdict at
+    completion retains the requests worth explaining (errors, sheds, SLO
+    breaches, the rolling slow tail, a baseline sample) in a bounded
+    ring — see docs/observability.md "Flight recorder & postmortems".
     """
 
     def __init__(
@@ -1633,6 +1705,7 @@ class Telemetry:
         stream_window_s: float = 300.0,
         orca_format: Optional[str] = None,
         orca_ttl_s: float = 60.0,
+        flight: Any = None,
     ):
         if sample not in _SAMPLE_MODES:
             raise ValueError(
@@ -1642,6 +1715,11 @@ class Telemetry:
                 f"unknown orca_format {orca_format!r} (one of json|text)")
         self.registry = registry or MetricsRegistry()
         self.tracer = Tracer(trace_capacity)
+        if flight is True:
+            flight = _flight.FlightRecorder()
+        self.flight = flight
+        if flight is not None:
+            flight.bind(self)
         self.sample = sample
         self.sample_ratio = sample_ratio
         self.slow_threshold_s = slow_threshold_s
@@ -1901,6 +1979,27 @@ class Telemetry:
         elif span.sampled:
             span.tid = threading.get_ident()
             self.tracer.keep(span)
+        if self.flight is not None:
+            # the wire span's completion lands on the flight timeline it
+            # was BOUND to at _obs_begin (failover/hedge outers see each
+            # attempt's end) — membership-gated, because finish() is not
+            # always called on the originating thread: the batch
+            # dispatcher settles EVERY coalesced caller's span on the
+            # leader's thread, and fanning those foreign completions onto
+            # the leader's active scratch would corrupt its timeline
+            active = _flight._SCRATCH.get()
+            if (active is not None and not active.committed
+                    and span.trace_id in active.trace_ids):
+                if error is not None:
+                    active.append("span", "finish",
+                                  ms=round(total_s * 1e3, 3),
+                                  error=type(error).__name__)
+                else:
+                    active.append("span", "finish",
+                                  ms=round(total_s * 1e3, 3))
+            scratch = getattr(span, "flight", None)
+            if scratch is not None:
+                self.flight.commit(scratch, error=error)
         if len(self._pending) >= self._FOLD_BACKLOG:
             self._fold_pending()
 
@@ -1930,12 +2029,15 @@ class Telemetry:
                     phase_series[name] = self.phase_seconds.labels(
                         span.frontend, name)
             req_hist = binding.request_seconds
+            exemplars_on = self.registry.exemplars
             with lock:
                 binding.requests.value += 1
-                req_hist.counts[
-                    bisect_right(req_hist.buckets, total_s)] += 1
+                bucket = bisect_right(req_hist.buckets, total_s)
+                req_hist.counts[bucket] += 1
                 req_hist.sum += total_s
                 req_hist.count += 1
+                if exemplars_on:
+                    req_hist._exemplar(bucket, span.trace_id, total_s)
                 if err_series is not None:
                     err_series.value += 1
                 for name, s, e in phases:
@@ -1943,9 +2045,12 @@ class Telemetry:
                     if seconds < 0.0:
                         seconds = 0.0
                     h = phase_series[name]
-                    h.counts[bisect_right(h.buckets, seconds)] += 1
+                    bucket = bisect_right(h.buckets, seconds)
+                    h.counts[bucket] += 1
                     h.sum += seconds
                     h.count += 1
+                    if exemplars_on:
+                        h._exemplar(bucket, span.trace_id, seconds)
             if self._request_slos:
                 for slo in self._request_slos:
                     if (slo.frontend is not None
@@ -1997,6 +2102,12 @@ class Telemetry:
         elif span.sampled:
             span.tid = threading.get_ident()
             self.tracer.keep(span)
+        if self.flight is not None:
+            # streams never hold a scratch open across the generator's
+            # life; the recorder synthesizes the timeline (attempts +
+            # reconnect events) from the finished span and verdicts it
+            self.flight.commit_stream(span, error=error,
+                                      abandoned=abandoned)
         if len(self._pending_streams) >= self._FOLD_BACKLOG:
             self._fold_stream_pending()
 

@@ -123,6 +123,7 @@ class PerfRunner:
         cache_ttl_s: float = 30.0,
         singleflight: bool = False,
         affinity_key: Optional[str] = None,
+        flight: bool = False,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -159,6 +160,10 @@ class PerfRunner:
         self.hedge_delay_s = hedge_delay_s
         self.observe = observe
         self.observe_sample = observe_sample
+        # --flight: attach a flight recorder to every measurement run's
+        # telemetry and append a client_flight row (events/request,
+        # retained fraction, commit cost) to each result
+        self.flight = flight
         self.generate_stream = generate_stream
         self.coalesce = coalesce
         self.batch_window_us = batch_window_us
@@ -806,14 +811,17 @@ class PerfRunner:
         """A fresh Telemetry per measurement run (sample=always, ring sized
         to hold every request) so each result row's phase breakdown covers
         exactly that run."""
-        if not self.observe:
+        if not (self.observe or self.flight):
             return
         from .observe import Telemetry
 
         self._telemetry = Telemetry(
-            sample=self.observe_sample,
+            # --flight without --observe keeps span retention off: the
+            # recorder's own tail ring is the retention mechanism
+            sample=self.observe_sample if self.observe else "off",
             trace_capacity=max(measurement_requests, 1024),
-            orca_format=self._orca_format)
+            orca_format=self._orca_format,
+            flight=self._make_flight())
 
     def _arm_dataplane(self):
         """Scoped shm accounting for shm-mode runs: reuse an already
@@ -981,14 +989,43 @@ class PerfRunner:
             }
         return result
 
+    def _make_flight(self):
+        """A fresh FlightRecorder per measurement run under ``--flight``
+        (None otherwise), so each row's retention accounting covers
+        exactly that run."""
+        if not self.flight:
+            return None
+        from .flight import FlightRecorder
+
+        return FlightRecorder()
+
     def _observe_result(self, result: Dict[str, Any]) -> Dict[str, Any]:
         if self._telemetry is not None:
-            result["client_phase_ms"] = self._telemetry.phase_breakdown()
-            stream = self._telemetry.stream_breakdown()
-            if stream:
-                # streaming runs: ttft/itl/duration p50/p99 from the exact
-                # StreamSpan samples in the trace ring
-                result["client_stream_ms"] = stream
+            # --flight without --observe runs sample="off": the empty
+            # trace ring yields empty breakdowns, skip the rows entirely
+            if self.observe or self._telemetry.sample != "off":
+                result["client_phase_ms"] = \
+                    self._telemetry.phase_breakdown()
+                stream = self._telemetry.stream_breakdown()
+                if stream:
+                    # streaming runs: ttft/itl/duration p50/p99 from the
+                    # exact StreamSpan samples in the trace ring
+                    result["client_stream_ms"] = stream
+            recorder = getattr(self._telemetry, "flight", None)
+            if recorder is not None:
+                stats = recorder.stats()
+                result["client_flight"] = {
+                    "requests": stats["requests"],
+                    "events_per_request": stats["events_per_request"],
+                    "retained": stats["retained"],
+                    "retained_total": stats["retained_total"],
+                    "retained_fraction": stats["retained_fraction"],
+                    "dropped": stats["dropped"],
+                    "ring": stats["ring"],
+                    "capacity": stats["capacity"],
+                    "commit_retained_ns": stats.get("commit_retained_ns"),
+                    "commit_dropped_ns": stats.get("commit_dropped_ns"),
+                }
         return result
 
     # -- sweep -------------------------------------------------------------
@@ -1258,7 +1295,8 @@ class PerfRunner:
             sample="always",
             trace_capacity=len(records) + 64,
             stream_window_s=window_s,
-            orca_format=self._orca_format)
+            orca_format=self._orca_format,
+            flight=self._make_flight())
         # request_ms SLOs are fed PER TRACE RECORD from the replay's own
         # outcome accounting, NOT from telemetry spans: under coalescing
         # every batch adds an inner-dispatch span and under hedging every
@@ -1794,6 +1832,13 @@ def main(argv: Optional[List[str]] = None) -> int:
              "(client_stream_ms)",
     )
     parser.add_argument(
+        "--flight", action="store_true",
+        help="attach a flight recorder (client_tpu.flight) to every "
+             "measurement run and append a client_flight row "
+             "(events/request, retained fraction by verdict, commit "
+             "p50/p99 cost) to each result",
+    )
+    parser.add_argument(
         "--generate-stream", action="store_true",
         help="measure streamed generations instead of unary infers: each "
              "request drives one generate-extension SSE session to "
@@ -1940,6 +1985,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         cache_ttl_s=args.cache_ttl,
         singleflight=args.singleflight,
         affinity_key=args.affinity_key,
+        flight=args.flight,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside

@@ -82,6 +82,7 @@ from collections import deque
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from . import flight as _flight
 from ._base import (
     INFER_POSITIONAL_PREFIX,
     consume_admission_phase,
@@ -535,10 +536,15 @@ class EndpointPool:
         # routed + rehomed + spilled = total affinity picks
         if chosen is home:
             chosen.affinity_routed += 1
+            _flight.note("pool", "affinity", outcome="home", url=chosen.url)
         elif home in candidates:
             chosen.affinity_spilled += 1
+            _flight.note("pool", "affinity", outcome="spill",
+                         url=chosen.url, home=home.url)
         else:
             chosen.affinity_rehomed += 1
+            _flight.note("pool", "affinity", outcome="rehome",
+                         url=chosen.url, home=home.url)
         if len(chosen._affinity_keys) < _AFFINITY_KEY_CAP:
             chosen._affinity_keys.add(digest)
         return chosen
@@ -1222,6 +1228,8 @@ class _PoolClientBase:
 
     def _sequence_event(self, ep: EndpointState, request_id: str,
                         sequence_id: int, exc: BaseException) -> None:
+        _flight.note("pool", "sequence_abandoned", url=ep.url,
+                     sequence_id=sequence_id)
         self.pool.emit(SequenceAbandoned(ep.url, request_id, sequence_id, exc))
 
     # -- sequence affinity helpers -------------------------------------------
@@ -1436,12 +1444,15 @@ class PoolClient(_PoolClientBase):
                     raise last
                 raise
             tried.append(ep)
+            _flight.note("pool", "route", url=ep.url, attempt=len(tried))
             self.pool.begin(ep)
             t0 = time.monotonic()
             try:
                 result = op(ep.client, remaining)
             except CircuitOpenError as e:
                 last = e  # raced an opening breaker; nothing was sent
+                _flight.note("pool", "failover", url=ep.url,
+                             domain="circuit_open")
                 continue
             except Exception as e:
                 domain = self._record_attempt_failure(ep, e)
@@ -1453,6 +1464,7 @@ class PoolClient(_PoolClientBase):
                 if domain in (TRANSIENT, TIMEOUT) and not idempotent:
                     self._sequence_event(ep, request_id, sequence_id, e)
                     raise
+                _flight.note("pool", "failover", url=ep.url, domain=domain)
                 continue
             finally:
                 self.pool.done(ep)
@@ -1496,6 +1508,21 @@ class PoolClient(_PoolClientBase):
         ``affinity_key=`` (with ``routing="affinity"``) pins the request
         to the key's home endpoint — never forwarded to the replica."""
         kwargs = _fold_infer_args(args, kwargs)
+        scratch = _flight.layer_begin(self._telemetry, "pool", model_name)
+        if scratch is None:
+            return self._infer_gated(model_name, inputs, kwargs)
+        try:
+            result = self._infer_gated(model_name, inputs, kwargs)
+        except BaseException as e:
+            _flight.layer_commit(self._telemetry, scratch, error=e)
+            raise
+        _flight.layer_commit(self._telemetry, scratch)
+        return result
+
+    def _infer_gated(self, model_name: str, inputs, kwargs):
+        """The admission-gated engine behind :meth:`infer` (split out so
+        the flight-recorder wrapper above owns exactly one scratch per
+        logical pool request, sheds included)."""
         affinity_key = kwargs.pop("affinity_key", None)
         sequence_id = kwargs.get("sequence_id", 0)
         if self._admission is None:
@@ -1564,6 +1591,8 @@ class PoolClient(_PoolClientBase):
                                     affinity_key=affinity_key)
             if ep not in tried:
                 tried.append(ep)
+            _flight.note("pool", "route", url=ep.url,
+                         sequence_id=sequence_id)
             self.pool.begin(ep)
             t0 = time.monotonic()
             try:
@@ -1676,6 +1705,7 @@ class PoolClient(_PoolClientBase):
             remaining = budget.attempt_timeout_s()  # raises once spent
             ep = pool.select(exclude=tried, affinity_key=affinity_key)
             tried.append(ep)
+            _flight.note("pool", "route", url=ep.url, attempt=len(tried))
             future = executor.submit(attempt, ep, remaining)
             futures.append(future)
             return future
@@ -1707,9 +1737,13 @@ class PoolClient(_PoolClientBase):
                 else:
                     for p in futures:
                         p.cancel()
-                    if tel is not None and hedge_futures:
+                    if hedge_futures:
                         # a hedge raced this request: did it beat the primary?
-                        tel.on_hedge_result(f in hedge_futures)
+                        _flight.note(
+                            "hedge",
+                            "win" if f in hedge_futures else "loss")
+                        if tel is not None:
+                            tel.on_hedge_result(f in hedge_futures)
                     return result
             firing = hedges_left > 0 and time.monotonic() >= hedge_at
             if futures and not firing:
@@ -1732,6 +1766,7 @@ class PoolClient(_PoolClientBase):
                 raise
             if firing:
                 hedge_futures.add(spawned)
+                _flight.note("hedge", "launch", url=tried[-1].url)
                 if tel is not None:
                     tel.on_hedge_fired()
                 hedges_left -= 1
@@ -1976,12 +2011,15 @@ class AioPoolClient(_PoolClientBase):
                     raise last
                 raise
             tried.append(ep)
+            _flight.note("pool", "route", url=ep.url, attempt=len(tried))
             self.pool.begin(ep)
             t0 = time.monotonic()
             try:
                 result = await op(ep.client, remaining)
             except CircuitOpenError as e:
                 last = e
+                _flight.note("pool", "failover", url=ep.url,
+                             domain="circuit_open")
                 continue
             except Exception as e:
                 domain = self._record_attempt_failure(ep, e)
@@ -1991,6 +2029,7 @@ class AioPoolClient(_PoolClientBase):
                 if domain in (TRANSIENT, TIMEOUT) and not idempotent:
                     self._sequence_event(ep, request_id, sequence_id, e)
                     raise
+                _flight.note("pool", "failover", url=ep.url, domain=domain)
                 continue
             finally:
                 self.pool.done(ep)
@@ -2019,6 +2058,19 @@ class AioPoolClient(_PoolClientBase):
         """Pool-routed async ``infer`` (same affinity/idempotency/hedging
         and admission contract as the sync twin)."""
         kwargs = _fold_infer_args(args, kwargs)
+        scratch = _flight.layer_begin(self._telemetry, "pool", model_name)
+        if scratch is None:
+            return await self._infer_gated(model_name, inputs, kwargs)
+        try:
+            result = await self._infer_gated(model_name, inputs, kwargs)
+        except BaseException as e:
+            _flight.layer_commit(self._telemetry, scratch, error=e)
+            raise
+        _flight.layer_commit(self._telemetry, scratch)
+        return result
+
+    async def _infer_gated(self, model_name: str, inputs, kwargs):
+        """Async twin of the sync ``_infer_gated`` split."""
         affinity_key = kwargs.pop("affinity_key", None)
         sequence_id = kwargs.get("sequence_id", 0)
         if self._admission is None:
@@ -2086,6 +2138,8 @@ class AioPoolClient(_PoolClientBase):
                                     affinity_key=affinity_key)
             if ep not in tried:
                 tried.append(ep)
+            _flight.note("pool", "route", url=ep.url,
+                         sequence_id=sequence_id)
             self.pool.begin(ep)
             t0 = time.monotonic()
             try:
@@ -2229,6 +2283,7 @@ class AioPoolClient(_PoolClientBase):
             remaining = budget.attempt_timeout_s()
             ep = pool.select(exclude=tried, affinity_key=affinity_key)
             tried.append(ep)
+            _flight.note("pool", "route", url=ep.url, attempt=len(tried))
             task = asyncio.ensure_future(attempt(ep, remaining))
             tasks.add(task)
             return task
@@ -2269,8 +2324,12 @@ class AioPoolClient(_PoolClientBase):
                         failures.append(e)
                     else:
                         await cancel_pending()
-                        if tel is not None and hedge_tasks:
-                            tel.on_hedge_result(t in hedge_tasks)
+                        if hedge_tasks:
+                            _flight.note(
+                                "hedge",
+                                "win" if t in hedge_tasks else "loss")
+                            if tel is not None:
+                                tel.on_hedge_result(t in hedge_tasks)
                         return result
                 firing = hedges_left > 0 and time.monotonic() >= hedge_at
                 if tasks and not firing:
@@ -2291,6 +2350,7 @@ class AioPoolClient(_PoolClientBase):
                     raise
                 if firing:
                     hedge_tasks.add(spawned)
+                    _flight.note("hedge", "launch", url=tried[-1].url)
                     if tel is not None:
                         tel.on_hedge_fired()
                     hedges_left -= 1

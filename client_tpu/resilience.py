@@ -35,6 +35,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from . import flight as _flight
 from .utils import InferenceServerException
 
 __all__ = [
@@ -310,7 +311,13 @@ class CircuitBreaker:
             return self._state
 
     def _notify(self, state: Optional[str]) -> None:
-        if state is None or self.on_transition is None:
+        if state is None:
+            return
+        # the transition lands on the flight timeline of whichever request
+        # caused it (the allow()/record() caller) — a breaker flip is a
+        # per-request causal fact, not only a fleet counter
+        _flight.note("breaker", "transition", state=state)
+        if self.on_transition is None:
             return
         try:
             self.on_transition(state)
@@ -613,6 +620,7 @@ class ResiliencePolicy:
                     self.breaker.allow()
                 except CircuitOpenError:
                     self.stats._bump(fast_fails=1)
+                    _flight.note("breaker", "fast_fail")
                     if self.observer is not None:
                         try:
                             self.observer.on_fast_fail()
@@ -631,6 +639,9 @@ class ResiliencePolicy:
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 self.stats._bump(retries=1)
+                _flight.note("retry", "attempt", n=attempt + 1,
+                             delay_ms=round(delay * 1e3, 3),
+                             error=type(exc).__name__)
                 if self.observer is not None:
                     try:
                         self.observer.on_retry(attempt, exc, delay)
@@ -670,6 +681,7 @@ class ResiliencePolicy:
                     self.breaker.allow()
                 except CircuitOpenError:
                     self.stats._bump(fast_fails=1)
+                    _flight.note("breaker", "fast_fail")
                     if self.observer is not None:
                         try:
                             self.observer.on_fast_fail()
@@ -688,6 +700,9 @@ class ResiliencePolicy:
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 self.stats._bump(retries=1)
+                _flight.note("retry", "attempt", n=attempt + 1,
+                             delay_ms=round(delay * 1e3, 3),
+                             error=type(exc).__name__)
                 if self.observer is not None:
                     try:
                         self.observer.on_retry(attempt, exc, delay)

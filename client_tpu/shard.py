@@ -71,6 +71,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from . import flight as _flight
 from ._base import fold_infer_args
 from .pool import _PoolClientBase, AioPoolClient, PoolClient
 from .utils import InferenceServerException, triton_to_np_dtype
@@ -831,6 +832,23 @@ class ShardedClient(_ShardedBase):
               **kwargs) -> ShardedInferResult:
         kwargs = fold_infer_args(args, kwargs)
         self._check_kwargs(kwargs)
+        scratch = _flight.layer_begin(
+            self.inner.telemetry(), "shard", model_name)
+        if scratch is None:
+            return self._infer_admitted(model_name, inputs, kwargs)
+        try:
+            result = self._infer_admitted(model_name, inputs, kwargs)
+        except BaseException as e:
+            _flight.layer_commit(self.inner.telemetry(), scratch, error=e)
+            raise
+        _flight.layer_commit(self.inner.telemetry(), scratch)
+        return result
+
+    def _infer_admitted(self, model_name: str, inputs,
+                        kwargs) -> ShardedInferResult:
+        """The admission-gated engine behind :meth:`infer` (split out so
+        the flight-recorder wrapper above owns one scratch per LOGICAL
+        sharded request)."""
         inner = self.inner
         ctrl = inner.admission()
         if ctrl is None:
@@ -896,8 +914,11 @@ class ShardedClient(_ShardedBase):
 
             executor = self._get_executor()
             futures: List[Any] = []
+            _flight.note("shard", "fanout", shards=layout.n_shards)
             try:
                 for i in range(layout.n_shards):
+                    _flight.note("shard", "dispatch", shard=i,
+                                 url=layout.endpoints[i])
                     futures.append(executor.submit(run_shard, i))
             except BaseException:
                 # a shard that never dispatched still owns staged leases
@@ -932,6 +953,7 @@ class ShardedClient(_ShardedBase):
                 raise ShardFailed(shard_i, layout.endpoints[shard_i],
                                   cause)
             gather_t0 = time.perf_counter_ns()
+            _flight.note("shard", "gather", shards=layout.n_shards)
             result = self._gather([f.result() for f in futures])
             if span is not None:
                 span.phase("shard_gather", gather_t0,
@@ -990,6 +1012,21 @@ class AioShardedClient(_ShardedBase):
                     **kwargs) -> ShardedInferResult:
         kwargs = fold_infer_args(args, kwargs)
         self._check_kwargs(kwargs)
+        scratch = _flight.layer_begin(
+            self.inner.telemetry(), "shard", model_name)
+        if scratch is None:
+            return await self._infer_admitted(model_name, inputs, kwargs)
+        try:
+            result = await self._infer_admitted(model_name, inputs, kwargs)
+        except BaseException as e:
+            _flight.layer_commit(self.inner.telemetry(), scratch, error=e)
+            raise
+        _flight.layer_commit(self.inner.telemetry(), scratch)
+        return result
+
+    async def _infer_admitted(self, model_name: str, inputs,
+                              kwargs) -> ShardedInferResult:
+        """Async twin of the sync ``_infer_admitted`` split."""
         inner = self.inner
         ctrl = inner.admission()
         if ctrl is None:
@@ -1052,6 +1089,7 @@ class AioShardedClient(_ShardedBase):
                 marks.append((t_start, time.perf_counter_ns()))
                 return res
 
+            _flight.note("shard", "fanout", shards=layout.n_shards)
             tasks = [asyncio.ensure_future(run_shard(i))
                      for i in range(layout.n_shards)]
             if span is not None:
@@ -1088,6 +1126,7 @@ class AioShardedClient(_ShardedBase):
                         pass
                 raise
             gather_t0 = time.perf_counter_ns()
+            _flight.note("shard", "gather", shards=layout.n_shards)
             result = self._gather([t.result() for t in tasks])
             if span is not None:
                 span.phase("shard_gather", gather_t0,

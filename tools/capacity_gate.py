@@ -22,6 +22,13 @@ of the trace and exits 1 when the committed invariants (admitted-traffic
 p99 inside the declared SLO, honest nonzero shed, delivery improved over
 the un-admitted baseline) no longer hold live.
 
+With ``--flight`` the gate proves the flight recorder is
+pay-for-what-you-use: the capacity arm replayed recorder-OFF at the
+standard floor must sustain (else INCONCLUSIVE — plain capacity
+regressed), and the same arm recorder-ON at ``floor * (1 - 0.05)`` must
+also sustain, i.e. recorder-on capacity stays within 5% of the
+recorder-off floor demonstrated in the same session.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/capacity_gate.py \
@@ -29,6 +36,8 @@ Usage::
         [--tolerance 0.15] [--duration-s 3.0] [--attempts 2]
     JAX_PLATFORMS=cpu python tools/capacity_gate.py --admission \
         [--admission-baseline BENCH_ADMISSION.json] [--duration-s 2.0]
+    JAX_PLATFORMS=cpu python tools/capacity_gate.py --flight \
+        [--flight-tolerance 0.05]
 """
 
 from __future__ import annotations
@@ -230,6 +239,102 @@ def hotkey_recheck(baseline: str, tolerance: float, duration_s: float,
     return 0
 
 
+def flight_recheck(baseline: str, arm: str, tolerance: float,
+                   duration_s: float, replay_workers: int,
+                   attempts: int, flight_tolerance: float = 0.05) -> int:
+    """Recorder-on capacity must stay within ``flight_tolerance``
+    (default 5%) of the recorder-OFF floor, demonstrated LIVE in the
+    same session so environment drift never masquerades as recorder
+    cost: (1) the committed capacity arm replayed recorder-OFF at the
+    standard gate floor (``max_speed * (1 - tolerance)``) must sustain —
+    else the verdict is INCONCLUSIVE (exit 2: capacity itself regressed;
+    that is the plain gate's business, not the recorder's); (2) the same
+    arm replayed recorder-ON at ``floor * (1 - flight_tolerance)`` must
+    also sustain AND actually record. An always-on forensic layer that
+    costs real capacity would be a lie about being
+    pay-for-what-you-use."""
+    import tools.bench_capacity as bench
+
+    doc = json.loads(Path(baseline).read_text())
+    if arm not in doc["arms"]:
+        print(f"arm {arm!r} not in {baseline} (has: {sorted(doc['arms'])})")
+        return 2
+    committed = doc["arms"][arm]
+    floor_speed = round(float(committed["max_speed"]) * (1.0 - tolerance), 3)
+    on_speed = round(floor_speed * (1.0 - flight_tolerance), 3)
+    result: Dict[str, Any] = {
+        "arm": arm,
+        "committed_max_speed": committed["max_speed"],
+        "committed_qps": committed["max_sustainable_qps"],
+        "recorder_off_floor_speed": floor_speed,
+        "flight_tolerance": flight_tolerance,
+        "recorder_on_speed": on_speed,
+        "off_attempts": [],
+        "on_attempts": [],
+    }
+    if floor_speed <= 0.0:
+        print(json.dumps(result, indent=2))
+        print("OK: zero committed capacity has nothing to regress from")
+        return 0
+    tr = shortened_trace(doc, duration_s, arm=arm)
+    slos = list(committed.get("slos", doc["slos"]))
+    search = doc.get("search", {})
+    min_delivery = float(search.get(
+        "min_delivery_ratio", bench.MIN_DELIVERY_RATIO))
+    chaos_latency_s = float(search.get("chaos_latency_s", 0.01))
+    replay_workers = int(search.get("replay_workers", replay_workers))
+
+    def probe(runner, speed, out_rows):
+        ok = False
+        for _ in range(max(1, attempts)):
+            row = runner.run_trace(tr, speed=speed,
+                                   replay_workers=replay_workers,
+                                   slos=slos)
+            fl = row.get("client_flight") or {}
+            ok = bench.sustainable(row, min_delivery)
+            out_rows.append({
+                "speed": speed,
+                "offered_rate": row["offered_rate"],
+                "achieved_rate": row["achieved_rate"],
+                "errors": row["errors"],
+                "slo_ok": row["slo_ok"],
+                "flight_requests": fl.get("requests"),
+                "flight_retained": fl.get("retained_total"),
+                "sustainable": ok,
+            })
+            if ok:
+                return True
+        return ok
+
+    off_ok = on_ok = recording = False
+    with bench.arm_runner(arm, chaos_latency_s) as (runner, feature):
+        result["feature"] = feature
+        # warm-first discipline (see probe_at_floor), recorder off
+        runner.run_trace(tr, speed=min(1.0, floor_speed),
+                         replay_workers=replay_workers, slos=slos)
+        off_ok = probe(runner, floor_speed, result["off_attempts"])
+        if off_ok:
+            runner.flight = True
+            on_ok = probe(runner, on_speed, result["on_attempts"])
+            recording = any((r.get("flight_requests") or 0) > 0
+                            for r in result["on_attempts"])
+    print(json.dumps(result, indent=2))
+    if not off_ok:
+        print("INCONCLUSIVE: the arm no longer sustains its committed "
+              "recorder-OFF floor — capacity itself regressed; run the "
+              "plain capacity gate")
+        return 2
+    if not on_ok or not recording:
+        print(f"FAIL: with the flight recorder attached, {arm} no longer "
+              f"sustains {(1 - flight_tolerance) * 100:.0f}% of the "
+              f"recorder-off floor it just demonstrated "
+              f"(or the recorder recorded nothing)")
+        return 1
+    print("OK: recorder-on capacity within "
+          f"{flight_tolerance * 100:.0f}% of the recorder-off floor")
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--baseline", default="BENCH_CAPACITY.json")
@@ -249,8 +354,20 @@ def main() -> int:
                              "at its committed floor speed must still "
                              "attain SLOs AND collapse wire requests")
     parser.add_argument("--hotkey-baseline", default="BENCH_HOTKEY.json")
+    parser.add_argument("--flight", action="store_true",
+                        help="re-check that recorder-ON capacity stays "
+                             "within --flight-tolerance (5%%) of the "
+                             "committed recorder-off floor: the capacity "
+                             "arm at floor speed with a flight recorder "
+                             "attached must still attain its SLOs")
+    parser.add_argument("--flight-tolerance", type=float, default=0.05)
     args = parser.parse_args()
 
+    if args.flight:
+        return flight_recheck(args.baseline, args.arm, args.tolerance,
+                              args.duration_s, args.replay_workers,
+                              args.attempts,
+                              flight_tolerance=args.flight_tolerance)
     if args.hotkey:
         return hotkey_recheck(args.hotkey_baseline, args.tolerance,
                               args.duration_s, args.attempts)
