@@ -36,6 +36,18 @@ client libraries (triton-inference-server/client), designed TPU-first:
   or ``.caching()`` on any frontend/pool), paired with the pool's
   ``routing="affinity"`` rendezvous session/prefix routing
   (docs/caching.md).
+- ``client_tpu.federation``: multi-cell federation —
+  ``FederatedClient``/``AioFederatedClient`` over NAMED cells (each an
+  existing pool client): locality-first routing with transparent
+  spillover when the home cell is saturated (admission sheds become
+  spill triggers under a shed-rate hysteresis), down (per-cell circuit
+  breakers) or blackholed — under one shared attempt budget, with
+  sequences/streams pinned to their established cell (typed
+  ``CellSequenceAbandoned``, never a silent cross-cell re-send) — plus
+  weighted rollout primitives: shadow mirroring (sampled duplicates
+  compared bit-for-bit, never returned, never billed) and canary with
+  SLO-burn auto-rollback (typed ``CanaryRolledBack``)
+  (docs/federation.md).
 - ``client_tpu.observe``: client-side observability — request-phase span
   tracing with sampling and Chrome trace dumps, a Prometheus/JSON metrics
   registry fed by the resilience + pool event streams, and W3C

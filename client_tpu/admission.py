@@ -68,7 +68,9 @@ __all__ = [
     "SHED_QUEUE_FULL",
     "SHED_QUEUE_TIMEOUT",
     "SHED_SATURATED",
+    "SPILL_REASONS",
     "default_lane_map",
+    "is_spill_signal",
 ]
 
 # shed reasons (the {reason} label on client_tpu_admission_shed_total)
@@ -85,6 +87,31 @@ LANE_LOW = "low"
 # the controller's exception status; resilience.classify_fault keys the
 # SHED domain off this string so the two modules never import each other
 ADMISSION_REJECTED_STATUS = "ADMISSION_REJECTED"
+
+# shed reasons that double as CAPACITY signals: every one of them means
+# "this cell/pool cannot take the request right now", so a multi-cell
+# layer (client_tpu.federation) may answer it by SPILLING the request to
+# another cell instead of surfacing the shed to the caller. A future
+# rejection reason that is NOT about capacity (a policy/quota denial,
+# say) must be left out of this set so it never silently moves traffic.
+SPILL_REASONS = frozenset({
+    SHED_SATURATED,
+    SHED_DEADLINE,
+    SHED_QUEUE_FULL,
+    SHED_QUEUE_TIMEOUT,
+    SHED_ENDPOINT_SATURATED,
+})
+
+
+def is_spill_signal(exc: BaseException) -> bool:
+    """Whether this fault is an admission shed a locality-spillover
+    layer may answer by re-routing to another cell (see
+    ``SPILL_REASONS``). The federation layer calls this on every
+    ``AdmissionRejected`` its home cell raises — the shed→spill bridge
+    that turns saturation into graceful degradation instead of a
+    user-visible error."""
+    return (isinstance(exc, AdmissionRejected)
+            and exc.reason in SPILL_REASONS)
 
 
 class AdmissionRejected(InferenceServerException):

@@ -304,6 +304,22 @@ def _flight_status(tel: Telemetry) -> Optional[Dict[str, Any]]:
     }
 
 
+def _federation_status(tel: Telemetry) -> List[Dict[str, Any]]:
+    """One row per federation attached to the telemetry (the federation
+    wires itself in at construction): per-cell role/health/breaker/spill
+    state plus the shadow and canary views. Empty when no multi-cell
+    client is armed."""
+    rows = []
+    for fed, scope in getattr(tel, "federations", lambda: [])():
+        try:
+            row = dict(fed.federation_stats())
+        except Exception as e:
+            row = {"error": str(e)[:200]}
+        row["scope"] = scope
+        rows.append(row)
+    return rows
+
+
 def _admission_status(tel: Telemetry) -> List[Dict[str, Any]]:
     """One row per admission controller attached to the telemetry (the
     pool wires its controller in at construction): limit, inflight,
@@ -425,6 +441,47 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
                            f"{row.get('limiter', {}).get('min_limit')} "
                            f"with an SLO burning "
                            f"(shed_total={row.get('shed_total')})")})
+    # multi-cell federation: a SERVING cell with nothing routable (or a
+    # cell breaker open) is a whole-site outage in progress — every
+    # request that preferred it is spilling or failing; spillover-active
+    # means the shed-rate hysteresis is currently steering new traffic
+    # past a cell (capacity is degraded even though users see no errors);
+    # canary_burning means the canary's SLO burn tripped (or is tripping)
+    # — the rollout is bad and the auto-rollback is the only thing
+    # between it and the users
+    for fedrow in snap.get("cells", []) or []:
+        for name, cell in (fedrow.get("cells") or {}).items():
+            pool = cell.get("pool") or {}
+            breaker = cell.get("breaker_state")
+            if cell.get("role") == "serve" and (
+                    pool.get("available") is False or breaker == "open"):
+                problems = []
+                if pool.get("available") is False:
+                    problems.append(
+                        f"{pool.get('healthy', 0)}/"
+                        f"{pool.get('endpoints', '?')} endpoints routable")
+                if breaker and breaker != "closed":
+                    problems.append(f"cell breaker {breaker}")
+                flags.append({
+                    "flag": "cell_down", "url": name,
+                    "detail": ", ".join(problems) or "cell unavailable"})
+            if cell.get("spill_active"):
+                flags.append({
+                    "flag": "spillover_active", "url": name,
+                    "detail": (f"shed rate {cell.get('shed_rate')} over "
+                               f"the hysteresis window; spill_out="
+                               f"{sum((cell.get('spill_out') or {}).values())}")})
+        canary = fedrow.get("canary")
+        if canary and (canary.get("breached") or canary.get("rolled_back")):
+            state = ("rolled back" if canary.get("rolled_back")
+                     else "burning")
+            flags.append({
+                "flag": "canary_burning", "url": canary.get("cell"),
+                "detail": (f"canary {state}: burn "
+                           f"{canary.get('burn_rate')}x over "
+                           f"{canary.get('ok', 0) + canary.get('bad', 0)} "
+                           f"events (weight now "
+                           f"{canary.get('weight')})")})
     # cache thrash: the response cache is churning entries out (capacity
     # evictions rival insertions) while barely serving hits — the cache
     # is sized below the workload's working set, so it burns staging work
@@ -570,6 +627,7 @@ def collect_snapshot(
     probe_timeout_s: float = 10.0,
     client_factory: Optional[Callable[[str], Any]] = None,
     shard_layout=None,
+    cells=None,
 ) -> Dict[str, Any]:
     """Probe the fleet and return the full snapshot dict (JSON-ready).
 
@@ -583,7 +641,26 @@ def collect_snapshot(
     string, resolved over ``urls`` in order) describing a sharded
     deployment — adds a ``shard`` topology section and flags
     ``shard_degraded`` when any pinned endpoint is unhealthy, ejected or
-    breaker-open."""
+    breaker-open.
+
+    ``cells``: a ``{name: [urls]}`` dict (or its spec string,
+    ``"a=u1+u2;b=u3"``) describing a multi-cell federation
+    (``client_tpu.federation``): the doctor stands up a probe
+    ``FederatedClient`` over the cells, direct-probes every cell's
+    endpoints, and the snapshot gains a ``cells`` section (per-cell
+    health, breaker state, spill/shadow/canary counters, SLO burn) plus
+    the ``cell_down``/``spillover_active``/``canary_burning`` anomaly
+    flags. With an empty ``urls``, the per-endpoint probe section covers
+    the cells' urls. A caller-supplied ``telemetry`` that already has an
+    application federation attached surfaces it in the same section —
+    its LIVE spill counters, not the probe's."""
+    if isinstance(cells, str):
+        from .federation import parse_cells_spec
+
+        cells = parse_cells_spec(cells)
+    urls = list(urls)
+    if cells and not urls:
+        urls = [u for cell_urls in cells.values() for u in cell_urls]
     if isinstance(shard_layout, str):
         from .shard import ShardLayout
 
@@ -608,10 +685,22 @@ def collect_snapshot(
     mod = _input_module(protocol)
     if client_factory is None:
         client_factory = _bounded_client_factory(protocol, probe_timeout_s)
+    fed = None
     pool = PoolClient(list(urls), protocol=protocol, telemetry=tel,
                       health_interval_s=None,
                       client_factory=client_factory)
     try:
+        if cells:
+            from .federation import FederatedClient
+
+            # a probe federation: attaches itself to ``tel`` so the
+            # cells section below reads it like any application
+            # federation; every transport call is bounded by the probe
+            # factory/timeouts
+            fed = FederatedClient(
+                cells, protocol=protocol, telemetry=tel,
+                pool_kwargs={"health_interval_s": None,
+                             "client_factory": client_factory})
         correlator = StatsCorrelator(tel, pool,
                                      call_timeout_s=probe_timeout_s)
         correlator.poll_once()  # baseline for the decomposition deltas
@@ -627,6 +716,12 @@ def collect_snapshot(
             # endpoint_stats reflects what the doctor just observed
             pool.pool.set_health(ep, report.get("ready", False))
             endpoints.append(report)
+        if fed is not None:
+            # direct-probe every cell's endpoints so the cells section
+            # reflects what is routable RIGHT NOW, not construction-time
+            # optimism (wait_healthy probes each endpoint once and feeds
+            # pool.set_health — bounded by probe_timeout_s per call)
+            fed.wait_healthy(timeout_s=probe_timeout_s)
         correlator.poll_once()
         tel.flush()
         registry_snapshot = tel.registry.snapshot()
@@ -644,6 +739,7 @@ def collect_snapshot(
                 for ep in endpoints if "probe_latency_ms" in ep}),
             "slos": _slo_status(tel),
             "admission": _admission_status(tel),
+            "cells": _federation_status(tel),
             "stream_windows": _registry_section(
                 registry_snapshot, "client_tpu_stream_window"),
             "batch": _registry_section(
@@ -689,6 +785,8 @@ def collect_snapshot(
         return snap
     finally:
         pool.close()
+        if fed is not None:
+            fed.close()
         if scoped_recorder:
             observe.install_dataplane(None)
 
@@ -784,6 +882,52 @@ def render_summary(snap: Dict[str, Any]) -> str:
             lines.append(
                 f"  shard {row['shard']}: {row['url']:<24} {state}"
                 f"{('  ' + ' '.join(extra)) if extra else ''}")
+    for fedrow in snap.get("cells") or []:
+        if "error" in fedrow:
+            lines.append("")
+            lines.append(f"cells ({fedrow.get('scope')}): {fedrow['error']}")
+            continue
+        lines.append("")
+        lines.append(
+            f"cells ({fedrow.get('scope', 'federation')}; home "
+            f"{fedrow.get('home')}, order "
+            f"{'->'.join(fedrow.get('order', []))}):")
+        for name, cell in (fedrow.get("cells") or {}).items():
+            pool_row = cell.get("pool") or {}
+            state = ("UP" if pool_row.get("available")
+                     else ("DOWN" if pool_row else "?"))
+            extra = []
+            breaker = cell.get("breaker_state")
+            if breaker and breaker != "closed":
+                extra.append(f"breaker={breaker}")
+            if cell.get("spill_active"):
+                extra.append(f"SPILLING (shed {cell.get('shed_rate')})")
+            spills = sum((cell.get("spill_out") or {}).values())
+            lines.append(
+                f"  {name:<10} {cell.get('role', 'serve'):<7} {state:<5}"
+                f" healthy {pool_row.get('healthy', '?')}/"
+                f"{pool_row.get('endpoints', '?')}"
+                f"  served={cell.get('served', 0)}"
+                f" spill_out={spills} spill_in={cell.get('spill_in', 0)}"
+                f"{('  ' + ' '.join(extra)) if extra else ''}")
+        shadow = fedrow.get("shadow")
+        if shadow:
+            lines.append(
+                f"  shadow -> {shadow['cell']} ratio={shadow['ratio']:g} "
+                f"sent={shadow['sent']} matched={shadow['matched']} "
+                f"diverged={shadow['diverged']} errors={shadow['errors']} "
+                f"skipped={shadow['skipped']}")
+        canary = fedrow.get("canary")
+        if canary:
+            state = ("ROLLED BACK" if canary.get("rolled_back")
+                     else ("BURNING" if canary.get("breached") else "ok"))
+            lines.append(
+                f"  canary -> {canary['cell']} weight="
+                f"{canary.get('weight'):g} "
+                f"(declared {canary.get('declared_weight'):g}) "
+                f"routed={canary.get('routed', 0)} "
+                f"ok={canary.get('ok', 0)} bad={canary.get('bad', 0)} "
+                f"burn={canary.get('burn_rate')}x  {state}")
     admission = snap.get("admission") or []
     if admission:
         lines.append("")
@@ -901,7 +1045,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         description="One-command fleet snapshot for a client_tpu "
                     "deployment (health, breakers, ORCA load, latency "
                     "decomposition, shm inventory, anomalies).")
-    parser.add_argument("urls", nargs="+", help="replica host:port urls")
+    parser.add_argument("urls", nargs="*", default=[],
+                        help="replica host:port urls (optional when "
+                             "--cells is given: the cells' urls are "
+                             "probed)")
     parser.add_argument("--protocol", choices=("http", "grpc"),
                         default="http")
     parser.add_argument("--model", default="simple",
@@ -920,6 +1067,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "'TOKENS=0->LOGITS=0,NEXT_TOKEN=0': adds the "
                              "shard topology section and the "
                              "shard_degraded anomaly (client_tpu.shard)")
+    parser.add_argument("--cells", default=None, metavar="SPEC",
+                        help="multi-cell federated snapshot: "
+                             "'a=u1+u2;b=u3' stands up a probe "
+                             "FederatedClient over the named cells and "
+                             "adds the per-cell section (health, breaker, "
+                             "spill/shadow/canary counters, SLO burn) "
+                             "plus the cell_down/spillover_active/"
+                             "canary_burning anomaly flags "
+                             "(client_tpu.federation)")
     parser.add_argument("--timeout", type=float, default=10.0,
                         help="per-call timeout (s) bounding every snapshot "
                              "RPC: health probes, probe infers, stats "
@@ -936,6 +1092,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--fail-on-anomaly", action="store_true",
                         help="exit 1 when any anomaly is flagged")
     args = parser.parse_args(argv)
+    if not args.urls and not args.cells:
+        parser.error("give replica urls, or --cells 'a=u1+u2;b=u3'")
 
     tel = None
     if args.postmortem_path:
@@ -950,7 +1108,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         telemetry=tel,
         churn_threshold_ops_s=args.churn_threshold,
         skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout,
-        shard_layout=args.shard_layout)
+        shard_layout=args.shard_layout, cells=args.cells)
     print(render_summary(snap))
     if args.json_path:
         with open(args.json_path, "w") as f:

@@ -38,8 +38,13 @@ other layer reports into:
   with the fleet snapshot into one self-contained bundle.
 
 Wiring: ``Telemetry(flight=FlightRecorder())`` (or ``flight=True``)
-arms it; the frontends, pool, admission, batching, cache, arena and
-shard layers all emit automatically. See docs/observability.md
+arms it; the frontends, pool, admission, batching, cache, arena, shard
+and federation layers all emit automatically (the federation layer
+stamps every event with its ``cell`` and contributes ``route`` /
+``cell_spill`` / ``spill_engaged``/``spill_released`` /
+``canary_route``/``canary_rollback`` / ``shadow_mirror``/
+``shadow_diverged`` / ``sequence_abandoned`` — a divergent shadow
+response is retained on its OWN timeline). See docs/observability.md
 "Flight recorder & postmortems".
 """
 

@@ -1836,6 +1836,24 @@ class Telemetry:
             ("scope", "lane"))
         self._admission_ctrls: List[Any] = []  # (weakref, scope) pairs
         self._admission_collector_installed = False
+        # -- multi-cell federation (client_tpu.federation) --------------------
+        self.federation_spill_total = reg.counter(
+            "client_tpu_federation_spill_total",
+            "Requests the home cell could not serve that transparently "
+            "landed on another cell, by home cell, target cell and spill "
+            "reason (saturated/down/error)", ("cell", "target", "reason"))
+        self.federation_shadow_total = reg.counter(
+            "client_tpu_federation_shadow_total",
+            "Shadow-mirrored requests by outcome (matched/diverged/"
+            "errors are compared responses; skipped = mirror dropped at "
+            "the pending bound)", ("outcome",))
+        self.federation_canary_total = reg.counter(
+            "client_tpu_federation_canary_total",
+            "Canary-split outcomes (routed/fallback/rollback)",
+            ("outcome",))
+        self._federations: List[Any] = []  # (weakref, scope) pairs
+        self._federation_collector_installed = False
+        self._federation_gauges: Optional[Dict[str, Gauge]] = None
         self._bindings: Dict[str, _FrontendBinding] = {}
         self._pools: List[Any] = []
         self._pools_lock = threading.Lock()
@@ -2438,6 +2456,109 @@ class Telemetry:
                 for entry in dead:
                     try:
                         self._admission_ctrls.remove(entry)
+                    except ValueError:
+                        pass
+
+    # -- federation bridge ----------------------------------------------------
+    def on_cell_spill(self, cell: str, target: str, reason: str) -> None:
+        self.federation_spill_total.labels(cell, target, reason).inc()
+
+    def on_shadow_result(self, outcome: str) -> None:
+        self.federation_shadow_total.labels(outcome).inc()
+
+    def on_canary(self, outcome: str) -> None:
+        self.federation_canary_total.labels(outcome).inc()
+
+    def attach_federation(self, fed, scope: str = "federation") -> Any:
+        """Wire a ``federation.FederatedClient`` into this telemetry:
+        spills/shadow verdicts/canary transitions feed the
+        ``client_tpu_federation_*`` counters (the federation calls the
+        ``on_*`` hooks above directly, exactly once per event), and the
+        per-cell health/spill-state/canary-weight gauges export at
+        scrape time from ``federation_stats()`` (held by weak reference,
+        like pools). Called by the federation constructor; returns the
+        federation for chaining."""
+        with self._pools_lock:
+            if self._federation_gauges is None:
+                reg = self.registry
+                self._federation_gauges = {
+                    "healthy": reg.gauge(
+                        "client_tpu_federation_cell_healthy",
+                        "Healthy (routable) endpoints per cell", ("cell",)),
+                    "spill_active": reg.gauge(
+                        "client_tpu_federation_cell_spill_active",
+                        "1 while the cell's shed-rate hysteresis keeps "
+                        "new traffic spilling past it", ("cell",)),
+                    "shed_rate": reg.gauge(
+                        "client_tpu_federation_cell_shed_rate",
+                        "Windowed home-attempt shed rate per cell",
+                        ("cell",)),
+                    "breaker_state": reg.gauge(
+                        "client_tpu_federation_cell_breaker_state",
+                        "Cell breaker state (0 closed, 1 half-open, "
+                        "2 open)", ("cell",)),
+                    "canary_weight": reg.gauge(
+                        "client_tpu_federation_canary_weight",
+                        "Live canary traffic weight (0 after rollback)",
+                        ("cell",)),
+                }
+            self._federations.append((weakref.ref(fed), scope))
+            if not self._federation_collector_installed:
+                self._federation_collector_installed = True
+                self.registry.add_collector(self._collect_federations)
+        return fed
+
+    def federations(self) -> List[Any]:
+        """The live attached federations as ``(fed, scope)`` pairs —
+        doctor's ``cells`` section reads their ``federation_stats()``."""
+        with self._pools_lock:
+            refs = list(self._federations)
+        out = []
+        for ref, scope in refs:
+            fed = ref()
+            if fed is not None:
+                out.append((fed, scope))
+        return out
+
+    def _collect_federations(self) -> None:
+        _BREAKER_STATE = {"closed": 0, "half_open": 1, "open": 2}
+        with self._pools_lock:
+            refs = list(self._federations)
+            gauges = self._federation_gauges
+        if gauges is None:
+            return
+        dead = []
+        for entry in refs:
+            ref, _scope = entry
+            fed = ref()
+            if fed is None:
+                dead.append(entry)
+                continue
+            try:
+                stats = fed.federation_stats()
+            except Exception:
+                continue  # one sick federation must not break the scrape
+            for name, row in stats.get("cells", {}).items():
+                pool = row.get("pool") or {}
+                gauges["healthy"].labels(name).set(pool.get("healthy", 0))
+                gauges["spill_active"].labels(name).set(
+                    1.0 if row.get("spill_active") else 0.0)
+                rate = row.get("shed_rate")
+                if rate is not None:
+                    gauges["shed_rate"].labels(name).set(rate)
+                state = row.get("breaker_state")
+                if state is not None:
+                    gauges["breaker_state"].labels(name).set(
+                        _BREAKER_STATE.get(state, -1))
+            canary = stats.get("canary")
+            if canary:
+                gauges["canary_weight"].labels(canary["cell"]).set(
+                    canary.get("weight", 0.0))
+        if dead:
+            with self._pools_lock:
+                for entry in dead:
+                    try:
+                        self._federations.remove(entry)
                     except ValueError:
                         pass
 

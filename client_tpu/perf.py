@@ -124,6 +124,16 @@ class PerfRunner:
         singleflight: bool = False,
         affinity_key: Optional[str] = None,
         flight: bool = False,
+        cells: Optional[Dict[str, List[str]]] = None,
+        home_cell: Optional[str] = None,
+        shadow_cell: Optional[str] = None,
+        shadow_ratio: float = 0.05,
+        canary_cell: Optional[str] = None,
+        canary_weight: float = 0.1,
+        canary_slo: Optional[str] = None,
+        canary_min_events: int = 20,
+        cells_deadline_s: Optional[float] = 5.0,
+        cells_attempt_timeout_s: Optional[float] = None,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -182,6 +192,25 @@ class PerfRunner:
         self.cache_ttl_s = cache_ttl_s
         self.singleflight = singleflight
         self.affinity_key = affinity_key
+        # multi-cell federation (client_tpu.federation): measurement
+        # clients become FederatedClients over named cells, each cell its
+        # own PoolClient (routing/admission/endpoint-limit flags apply
+        # PER CELL); shadow/canary arm the rollout primitives and every
+        # result row gains a ``client_federation`` block
+        if isinstance(cells, str):
+            from .federation import parse_cells_spec
+
+            cells = parse_cells_spec(cells)
+        self.cells = cells
+        self.home_cell = home_cell
+        self.shadow_cell = shadow_cell
+        self.shadow_ratio = shadow_ratio
+        self.canary_cell = canary_cell
+        self.canary_weight = canary_weight
+        self.canary_slo = canary_slo
+        self.canary_min_events = canary_min_events
+        self.cells_deadline_s = cells_deadline_s
+        self.cells_attempt_timeout_s = cells_attempt_timeout_s
         self.seed = seed
         # sharded scatter-gather (client_tpu.shard): a ShardLayout or a
         # spec string ("IN=0->OUT=0") resolved over --endpoints in order;
@@ -251,10 +280,12 @@ class PerfRunner:
                 "one ChaosProxy per replica instead (tools/bench_pool.py)")
         if self.hedge and not self.endpoints:
             raise ValueError("--hedge requires --endpoints")
-        if (routing or admission or endpoint_limits) and not self.endpoints:
+        if (routing or admission or endpoint_limits) and not (
+                self.endpoints or cells):
             raise ValueError(
                 "--routing/--admission/--endpoint-limits require "
-                "--endpoints: they are pool-level policies")
+                "--endpoints (pool-level policies) or --cells (applied "
+                "to every cell's pool)")
         if self.shard_layout is not None:
             if not self.endpoints:
                 raise ValueError(
@@ -306,6 +337,41 @@ class PerfRunner:
             raise ValueError(
                 "--affinity-key requires --routing affinity (and "
                 "--endpoints): the key only steers the affinity policy")
+        if self.cells:
+            if protocol not in ("http", "grpc"):
+                raise ValueError(
+                    "--cells requires a python frontend (http|grpc): the "
+                    "federation wraps per-cell PoolClients")
+            if self.endpoints:
+                raise ValueError(
+                    "--cells and --endpoints are mutually exclusive: each "
+                    "cell already declares its own replica urls")
+            if shared_memory != "none":
+                raise ValueError(
+                    "--cells requires --shared-memory none (same rule as "
+                    "--endpoints)")
+            if chaos is not None:
+                raise ValueError(
+                    "--chaos proxies a single url; with --cells, stand up "
+                    "one ChaosProxy per replica and group them per cell "
+                    "(testing.ChaosCell / tools/bench_federation.py)")
+            if self.hedge or self.coalesce or self.cache or self.singleflight:
+                raise ValueError(
+                    "--cells rejects --hedge/--coalesce/--cache/"
+                    "--singleflight: compose them per cell (each cell IS "
+                    "a PoolClient) rather than across cells")
+            if self.shard_layout is not None:
+                raise ValueError(
+                    "--cells rejects --shard-layout: a shard layout pins "
+                    "replicas of ONE pool")
+            for name in (self.home_cell, self.shadow_cell,
+                         self.canary_cell):
+                if name is not None and name not in self.cells:
+                    raise ValueError(
+                        f"cell {name!r} is not declared in --cells")
+        elif (self.home_cell or self.shadow_cell or self.canary_cell):
+            raise ValueError(
+                "--home-cell/--shadow-cell/--canary-cell require --cells")
         if chaos is not None:
             from .testing.chaos import ChaosProxy
 
@@ -348,6 +414,8 @@ class PerfRunner:
             from client_tpu.native import NativeGrpcClient
 
             return NativeGrpcClient(self.url)
+        if self.cells:
+            return self._make_federated_client(concurrency)
         if self.endpoints:
             pool = self._make_pool_client(concurrency)
             if self.shard_layout is not None:
@@ -422,6 +490,56 @@ class PerfRunner:
                 self._arena = ShmArena(promote_inputs=False,
                                        name_prefix="perf_shard")
             return self._arena
+
+    def _make_federated_client(self, concurrency: int):
+        """A FederatedClient over ``--cells``: per-cell PoolClients with
+        the pool-level flags (routing/admission/endpoint limits/retries)
+        applied to EVERY cell, plus the shadow/canary rollout policies
+        when named."""
+        from .federation import CanaryPolicy, FederatedClient, ShadowPolicy
+        from .resilience import RetryPolicy
+
+        factory = None
+        if self.protocol == "http":
+            mod = self._client_mod
+
+            def factory(url):
+                return mod.InferenceServerClient(url, concurrency=concurrency)
+
+        pool_kwargs: Dict[str, Any] = {
+            "client_factory": factory,
+            "routing": self.routing or "round_robin",
+            "health_interval_s": 0.5,
+            "probe_timeout_s": 0.5,
+            "endpoint_retry": (RetryPolicy(max_attempts=self.retries + 1)
+                               if self.retries else None),
+            # admission=True builds a FRESH controller inside each cell's
+            # pool — one shared controller would meter the cells jointly
+            # and hide exactly the per-cell saturation the federation
+            # spills on
+            "admission": True if self.admission else None,
+            "endpoint_limits": True if self.endpoint_limits else None,
+        }
+        shadow = None
+        if self.shadow_cell:
+            shadow = ShadowPolicy(self.shadow_cell, ratio=self.shadow_ratio)
+        canary = None
+        if self.canary_cell:
+            canary = CanaryPolicy(
+                self.canary_cell, weight=self.canary_weight,
+                slo=self.canary_slo or "p95<250ms",
+                min_events=self.canary_min_events)
+        return FederatedClient(
+            self.cells,
+            home=self.home_cell,
+            protocol=self.protocol,
+            telemetry=self._telemetry,
+            shadow=shadow,
+            canary=canary,
+            default_deadline_s=self.cells_deadline_s,
+            per_attempt_timeout_s=self.cells_attempt_timeout_s,
+            pool_kwargs=pool_kwargs,
+        )
 
     def _make_pool_client(self, concurrency: int):
         from .pool import HedgePolicy, PoolClient
@@ -939,6 +1057,44 @@ class PerfRunner:
             result["client_admission"] = admission_stats
         return result
 
+    def _federation_stats(self, client) -> Optional[Dict[str, Any]]:
+        """The federation snapshot (per-cell spill/serve counters plus
+        the shadow/canary views) when ``--cells`` is armed — appended to
+        result rows as ``client_federation`` so artifacts carry the
+        spillover/rollout story."""
+        if not self.cells:
+            return None
+        getter = getattr(client, "federation_stats", None)
+        if getter is None:
+            return None
+        try:
+            # let in-flight shadow mirrors settle so the row's counters
+            # cover the run (bounded; mirrors are themselves bounded)
+            drain = getattr(client, "shadow_drain", None)
+            if drain is not None and self.shadow_cell:
+                drain(timeout_s=5.0)
+            return getter()
+        except Exception:
+            return None
+
+    @staticmethod
+    def _federation_result(result: Dict[str, Any],
+                           fed_stats: Optional[Dict[str, Any]],
+                           ) -> Dict[str, Any]:
+        if fed_stats is not None:
+            cells = fed_stats.get("cells", {})
+            result["client_federation"] = {
+                "home": fed_stats.get("home"),
+                "order": fed_stats.get("order"),
+                "spills": sum(
+                    n for row in cells.values()
+                    for n in (row.get("spill_out") or {}).values()),
+                "cells": cells,
+                "shadow": fed_stats.get("shadow"),
+                "canary": fed_stats.get("canary"),
+            }
+        return result
+
     def _cache_stats_row(self, client) -> Optional[Dict[str, Any]]:
         """The caching wrapper's snapshot, when armed — the per-arm
         hit/collapse story every harness row carries as ``client_cache``."""
@@ -1071,12 +1227,14 @@ class PerfRunner:
         batch_stats = client.stats() if self.coalesce else None
         cache_stats = self._cache_stats_row(client)
         admission_stats = self._admission_stats(client)
+        fed_stats = self._federation_stats(client)
         client.close()
 
         lat_sorted = sorted(latencies)
         n = len(lat_sorted)
         issued = n + len(errors) + len(sheds)
-        return self._cache_result(self._admission_result(
+        return self._federation_result(self._cache_result(
+            self._admission_result(
             self._shm_result(self._batch_result(
             self._observe_result({
             "model": self.model_name,
@@ -1099,7 +1257,7 @@ class PerfRunner:
             "infer_per_sec": round(n / elapsed, 1) if elapsed > 0 else 0.0,
             "latency_ms": _latency_ms_row(lat_sorted),
         }), batch_stats), shm_rec, shm_before), admission_stats),
-            cache_stats)
+            cache_stats), fed_stats)
 
     def run_rate(self, rate: float, measurement_requests: int,
                  distribution: str = "constant",
@@ -1165,6 +1323,7 @@ class PerfRunner:
         batch_stats = client.stats() if self.coalesce else None
         cache_stats = self._cache_stats_row(client)
         admission_stats = self._admission_stats(client)
+        fed_stats = self._federation_stats(client)
         client.close()
 
         lat_sorted = sorted(records)
@@ -1180,7 +1339,8 @@ class PerfRunner:
         # denominator for every capacity claim (a saturated pool that
         # silently under-offers would otherwise flatter its own number)
         arrival_window = max(issues) if issues else 0.0
-        return self._cache_result(self._admission_result(
+        return self._federation_result(self._cache_result(
+            self._admission_result(
             self._shm_result(self._batch_result(
             self._observe_result({
             "model": self.model_name,
@@ -1211,7 +1371,7 @@ class PerfRunner:
             "schedule_lag_ms": _lag_ms_row(lag_sorted),
             "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
         }), batch_stats), shm_rec, shm_before), admission_stats),
-            cache_stats)
+            cache_stats), fed_stats)
 
     # -- trace replay --------------------------------------------------------
     _SEQ_GATE_TIMEOUT_S = 60.0
@@ -1390,12 +1550,14 @@ class PerfRunner:
             batch_stats = client.stats() if self.coalesce else None
             cache_stats = self._cache_stats_row(client)
             admission_stats = self._admission_stats(client)
+            fed_stats = self._federation_stats(client)
         finally:
             client.close()
-        return self._cache_result(self._admission_result(self._trace_result(
-            header, records, speed, elapsed, outcomes, errors, specs,
-            batch_stats, resources, request_slos), admission_stats),
-            cache_stats)
+        return self._federation_result(self._cache_result(
+            self._admission_result(self._trace_result(
+                header, records, speed, elapsed, outcomes, errors, specs,
+                batch_stats, resources, request_slos), admission_stats),
+            cache_stats), fed_stats)
 
     def _replay_warmup(self, client, records, resources) -> None:
         """One best-effort dispatch per distinct (kind, model) BEFORE the
@@ -1922,6 +2084,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--stream-output-tokens", type=int, default=16,
         help="generated tokens per --generate-stream session")
     parser.add_argument(
+        "--cells", default=None, metavar="SPEC",
+        help="multi-cell federation: 'a=u1+u2;b=u3' builds a "
+             "FederatedClient over named cells, each its own PoolClient "
+             "(routing/admission/endpoint-limit flags apply per cell); "
+             "locality-first with transparent spillover "
+             "(client_tpu.federation); result rows gain "
+             "client_federation")
+    parser.add_argument(
+        "--home-cell", default=None,
+        help="the locality-preferred cell (default: first in --cells)")
+    parser.add_argument(
+        "--shadow-cell", default=None,
+        help="mirror a sampled fraction of successful infers to this "
+             "cell (responses compared+counted, never returned)")
+    parser.add_argument(
+        "--shadow-ratio", type=float, default=0.05,
+        help="sampled mirror fraction for --shadow-cell")
+    parser.add_argument(
+        "--canary-cell", default=None,
+        help="weighted canary split to this cell with SLO-burn "
+             "auto-rollback")
+    parser.add_argument(
+        "--canary-weight", type=float, default=0.1,
+        help="canary traffic weight in [0,1]")
+    parser.add_argument(
+        "--canary-slo", default=None,
+        help="canary burn objective, e.g. 'p95<100ms' "
+             "(default p95<250ms)")
+    parser.add_argument(
+        "--canary-min-events", type=int, default=20,
+        help="canary outcomes required before a burn may roll back")
+    parser.add_argument(
         "--seed", type=int, default=0,
         help="seed for EVERY stochastic path: generated tensors, the "
              "open-loop poisson schedule, and --trace-gen traces all draw "
@@ -1986,6 +2180,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         singleflight=args.singleflight,
         affinity_key=args.affinity_key,
         flight=args.flight,
+        cells=args.cells,
+        home_cell=args.home_cell,
+        shadow_cell=args.shadow_cell,
+        shadow_ratio=args.shadow_ratio,
+        canary_cell=args.canary_cell,
+        canary_weight=args.canary_weight,
+        canary_slo=args.canary_slo,
+        canary_min_events=args.canary_min_events,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside

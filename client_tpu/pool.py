@@ -1192,6 +1192,41 @@ class _PoolClientBase:
         return any(name.startswith(p) for p in cls._BROADCAST_PREFIXES)
 
     # -- shared helpers ------------------------------------------------------
+    def health_summary(self) -> Dict[str, Any]:
+        """The CELL-level aggregate over :meth:`endpoint_stats`: how many
+        replicas this pool can actually route to right now, and the
+        pressure counters a federation layer (or the doctor's ``--cells``
+        snapshot) judges the whole cell by. ``available`` is the binary
+        verdict: at least one replica is healthy, un-ejected and not
+        breaker-open."""
+        snap = self.pool.snapshot()
+        healthy = ejected = breaker_open = 0
+        outstanding = shed_total = 0
+        for stats in snap.values():
+            if stats["ejected"]:
+                ejected += 1
+            state = stats.get("breaker_state")
+            # only a fully-open breaker is unroutable: half_open is MID
+            # RECOVERY and actively admitting probes — counting it down
+            # would raise a false whole-cell outage alarm exactly while
+            # the cell is healing
+            open_breaker = state == "open"
+            if open_breaker:
+                breaker_open += 1
+            if stats["healthy"] and not stats["ejected"] and not open_breaker:
+                healthy += 1
+            outstanding += stats["outstanding"]
+            shed_total += stats.get("shed_total", 0)
+        return {
+            "endpoints": len(snap),
+            "healthy": healthy,
+            "ejected": ejected,
+            "breaker_open": breaker_open,
+            "outstanding": outstanding,
+            "shed_total": shed_total,
+            "available": healthy > 0,
+        }
+
     def endpoint_stats(self) -> Dict[str, Dict[str, Any]]:
         """Per-endpoint snapshot: health, ejection, breaker state,
         outstanding count, the endpoint's ResilienceStats counters — and,

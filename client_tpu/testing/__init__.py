@@ -1,7 +1,8 @@
 """Test-support subsystems shipped with the framework (importable by user
 test suites, not only this repo's): currently the chaos fault-injection
-proxy that proves the resilience layer end-to-end."""
+proxy that proves the resilience layer end-to-end, and the cell-scale
+``ChaosCell`` grouping that faults a whole replica group atomically."""
 
-from .chaos import ChaosProxy, Fault
+from .chaos import ChaosCell, ChaosProxy, Fault
 
-__all__ = ["ChaosProxy", "Fault"]
+__all__ = ["ChaosCell", "ChaosProxy", "Fault"]

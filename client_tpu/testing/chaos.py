@@ -41,9 +41,9 @@ import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
-__all__ = ["ChaosProxy", "Fault"]
+__all__ = ["ChaosCell", "ChaosProxy", "Fault"]
 
 _KINDS = ("latency", "reset", "blackhole", "stall", "flap")
 
@@ -313,3 +313,101 @@ class ChaosProxy:
                 self._conns = [c for c in self._conns if not c._dead]
                 self._conns.append(conn)
             conn.run()
+
+
+class ChaosCell:
+    """Cell-scale fault orchestration: fault a GROUP of proxies as one.
+
+    A multi-cell federation test needs to kill a whole cell — every
+    replica's proxy, in one call, mid-replay — not flip proxies one by
+    one while traffic threads the gaps. ``ChaosCell`` groups existing
+    :class:`ChaosProxy` instances (one per replica of the "cell") and
+    applies each fault verb to all of them atomically: the fault rule is
+    installed on EVERY proxy first, and only then are the established
+    connections of every proxy reset — so no request accepted after the
+    call sees a healthy replica of a cell that is supposed to be dead.
+
+    Reuses the per-proxy fault vocabulary verbatim::
+
+        cell = ChaosCell([proxy_a1, proxy_a2])
+        cell.blackhole()        # the whole cell goes dark mid-flight
+        cell.heal()             # and comes back
+        cell.kill()             # RST storm: reset at accept + live RSTs
+        cell.latency(0.05)      # uniform 50 ms added per forwarded chunk
+        cell.flap(3)            # every 3rd connection RSTs at accept
+
+    Independent of the federation layer: any test driving a pool (or a
+    bare client) across several proxies can group them."""
+
+    def __init__(self, proxies: Sequence[ChaosProxy]):
+        if not proxies:
+            raise ValueError("a chaos cell needs at least one proxy")
+        self.proxies: List[ChaosProxy] = list(proxies)
+
+    @property
+    def urls(self) -> List[str]:
+        return [p.url for p in self.proxies]
+
+    def _apply(self, fault_factory, reset_active: bool) -> None:
+        """Install one independently-constructed Fault per proxy (a
+        shared Fault object would pool its ``limit``/counters across the
+        cell), then reset established connections — faults first, so a
+        connection racing the call lands on an already-faulted proxy."""
+        for proxy in self.proxies:
+            proxy.fault = fault_factory()
+        if reset_active:
+            for proxy in self.proxies:
+                proxy.reset_active()
+
+    def blackhole(self, reset_active: bool = True) -> None:
+        """The whole cell goes dark: new connections are accepted and
+        swallowed (never answered), established ones are RST (unless
+        ``reset_active=False`` — then in-flight requests run out their
+        own timeouts, the slow-blackhole shape)."""
+        self._apply(lambda: Fault("blackhole"), reset_active)
+
+    def kill(self) -> None:
+        """RST storm: every new connection resets immediately after
+        accept, every established one resets now."""
+        self._apply(lambda: Fault("reset", after_bytes=0), True)
+
+    def latency(self, latency_s: float) -> None:
+        """Uniform added latency per forwarded chunk, cell-wide."""
+        self._apply(
+            lambda: Fault("latency", latency_s=latency_s), False)
+
+    def flap(self, every: int = 2) -> None:
+        """Connection flapping cell-wide (every ``every``-th accept
+        RSTs)."""
+        self._apply(lambda: Fault("flap", every=every), False)
+
+    def heal(self, reset_active: bool = False) -> None:
+        """Clear every proxy's fault (and un-pause forwarding);
+        subsequent connections pass through clean. ``reset_active=True``
+        also drops connections established while faulted — a blackholed
+        socket a client is still waiting on does NOT recover by itself."""
+        for proxy in self.proxies:
+            proxy.fault = None
+            proxy.pause_forwarding = False
+        if reset_active:
+            for proxy in self.proxies:
+                proxy.reset_active()
+
+    def pause(self) -> None:
+        """Freeze every established flow (bytes buffer, nothing
+        forwarded) without killing anything; :meth:`heal` releases."""
+        for proxy in self.proxies:
+            proxy.pause_forwarding = True
+
+    def reset_active(self) -> None:
+        """RST every currently-established connection, cell-wide."""
+        for proxy in self.proxies:
+            proxy.reset_active()
+
+    def stats(self) -> Dict[str, int]:
+        """Aggregated accept/fault counters across the cell's proxies."""
+        out = {"connections": 0, "faulted": 0}
+        for proxy in self.proxies:
+            for key in out:
+                out[key] += proxy.stats.get(key, 0)
+        return out

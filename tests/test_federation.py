@@ -1,0 +1,935 @@
+"""Multi-cell federation tests: locality preference, spillover (zero
+user-visible errors on saturation AND blackhole), sequence/stream cell
+pinning with typed abandonment, shadow never-returned/never-billed,
+canary SLO-burn auto-rollback, metrics/flight exactly-once — plus the
+ChaosCell orchestration unit tests (independent of federation) and the
+committed BENCH_FEDERATION.json artifact claims."""
+
+import asyncio
+import json
+import random
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu._base import InferenceServerClientBase
+from client_tpu.admission import (
+    AdmissionController,
+    AdmissionRejected,
+    SHED_ENDPOINT_SATURATED,
+    is_spill_signal,
+)
+from client_tpu.federation import (
+    AioFederatedClient,
+    CanaryPolicy,
+    CanaryRolledBack,
+    CellSequenceAbandoned,
+    CellSpill,
+    FederatedClient,
+    NoCellAvailableError,
+    ShadowDiverged,
+    ShadowPolicy,
+    parse_cells_spec,
+)
+from client_tpu.models import default_model_zoo
+from client_tpu.observe import Telemetry
+from client_tpu.pool import AioPoolClient, PoolClient
+from client_tpu.resilience import CircuitBreaker
+from client_tpu.server import HttpInferenceServer, ServerCore
+from client_tpu.testing import ChaosCell, ChaosProxy, Fault
+from client_tpu.utils import InferenceServerException
+
+SEEDED_RNG = lambda: random.Random(0xFEDE)  # noqa: E731
+
+
+# -- stub plumbing ------------------------------------------------------------
+def _connect_error():
+    try:
+        raise ConnectionRefusedError("refused")
+    except ConnectionRefusedError as e:
+        raise InferenceServerException("connection error: refused") from e
+
+
+def _transient_error():
+    try:
+        raise ConnectionResetError("reset")
+    except ConnectionResetError as e:
+        raise InferenceServerException("connection error: reset") from e
+
+
+class FakeResult:
+    """Quacks like an InferResult for the shadow comparison path."""
+
+    def __init__(self, value, name="OUT"):
+        self.value = np.asarray(value)
+        self.name = name
+
+    def get_response(self):
+        return {"outputs": [{"name": self.name}]}
+
+    def as_numpy(self, name):
+        return self.value if name == self.name else None
+
+
+class StubClient(InferenceServerClientBase):
+    def __init__(self, url, behavior=None):
+        super().__init__()
+        self.url = url
+        self.behavior = behavior or (lambda **kw: "ok")
+        self.calls = []
+
+    def infer(self, model_name, inputs=None, **kwargs):
+        self.calls.append(dict(kwargs))
+        idempotent = kwargs.get("sequence_id", 0) == 0
+        op = lambda: self.behavior(**kwargs)  # noqa: E731
+        if self._resilience is not None:
+            return self._resilience.execute(op, idempotent=idempotent)
+        return op()
+
+    def generate_stream(self, model_name, payload=None, **kwargs):
+        self.calls.append({"stream": True, **kwargs})
+        behavior = self.behavior
+
+        def gen():
+            for item in behavior(stream=True, **kwargs):
+                yield item
+
+        return gen()
+
+    def is_server_ready(self, probe=False, client_timeout=None, **kw):
+        return True
+
+    def close(self):
+        pass
+
+
+class AioStubClient(InferenceServerClientBase):
+    def __init__(self, url, behavior=None):
+        super().__init__()
+        self.url = url
+        self.behavior = behavior or (lambda **kw: "ok")
+        self.calls = []
+
+    async def infer(self, model_name, inputs=None, **kwargs):
+        self.calls.append(dict(kwargs))
+        idempotent = kwargs.get("sequence_id", 0) == 0
+        op = lambda: self.behavior(**kwargs)  # noqa: E731
+
+        async def aop():
+            return op()
+
+        if self._resilience is not None:
+            return await self._resilience.execute_async(
+                aop, idempotent=idempotent)
+        return op()
+
+    async def is_server_ready(self, probe=False, client_timeout=None, **kw):
+        return True
+
+    async def close(self):
+        pass
+
+
+def _stub_pool(behaviors, aio=False, **kwargs):
+    urls = list(behaviors)
+    stubs = {}
+    cls = AioPoolClient if aio else PoolClient
+    stub_cls = AioStubClient if aio else StubClient
+
+    def factory(url):
+        stubs[url] = stub_cls(url, behaviors[url])
+        return stubs[url]
+
+    kwargs.setdefault("health_interval_s", None)
+    kwargs.setdefault("rng", SEEDED_RNG())
+    return cls(urls, client_factory=factory, **kwargs), stubs
+
+
+def _fed(cell_behaviors, aio=False, **fed_kwargs):
+    """{cell: {url: behavior}} -> (FederatedClient, {cell: stubs})."""
+    pools = {}
+    stubs = {}
+    for name, behaviors in cell_behaviors.items():
+        pools[name], stubs[name] = _stub_pool(behaviors, aio=aio)
+    fed_kwargs.setdefault("rng", SEEDED_RNG())
+    cls = AioFederatedClient if aio else FederatedClient
+    return cls(pools, **fed_kwargs), stubs
+
+
+def _shed(**kw):
+    raise AdmissionRejected(SHED_ENDPOINT_SATURATED, lane="endpoint")
+
+
+# -- ChaosCell: cell-scale fault orchestration (independent of federation) ----
+def test_chaos_cell_validates_and_aggregates():
+    with pytest.raises(ValueError):
+        ChaosCell([])
+    proxies = [ChaosProxy("127.0.0.1", 1).start() for _ in range(2)]
+    try:
+        cell = ChaosCell(proxies)
+        assert cell.urls == [p.url for p in proxies]
+        assert cell.stats() == {"connections": 0, "faulted": 0}
+    finally:
+        for p in proxies:
+            p.stop()
+
+
+def test_chaos_cell_blackhole_heal_kill_atomic():
+    """One call faults EVERY proxy of the cell; heal restores them all."""
+    cores = [ServerCore(default_model_zoo()) for _ in range(2)]
+    servers = [HttpInferenceServer(c).start() for c in cores]
+    proxies = [ChaosProxy("127.0.0.1", s.port).start() for s in servers]
+    cell = ChaosCell(proxies)
+    clients = [httpclient.InferenceServerClient(p.url) for p in proxies]
+    try:
+        assert all(
+            c.is_server_ready(probe=True, client_timeout=2.0)
+            for c in clients)
+        cell.blackhole()
+        # fresh clients: the probe's pooled connection was just RST
+        down = [httpclient.InferenceServerClient(p.url) for p in proxies]
+        assert not any(
+            c.is_server_ready(probe=True, client_timeout=0.5)
+            for c in down)
+        # every proxy carries the fault — not just the first
+        assert all(p.fault is not None and p.fault.kind == "blackhole"
+                   for p in proxies)
+        cell.heal(reset_active=True)
+        healed = [httpclient.InferenceServerClient(p.url) for p in proxies]
+        assert all(
+            c.is_server_ready(probe=True, client_timeout=2.0)
+            for c in healed)
+        cell.kill()
+        assert all(p.fault is not None and p.fault.kind == "reset"
+                   for p in proxies)
+        # per-proxy Fault objects are independent (no shared limit pool)
+        assert len({id(p.fault) for p in proxies}) == len(proxies)
+    finally:
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_chaos_cell_latency_and_flap_apply_cellwide():
+    cores = [ServerCore(default_model_zoo()) for _ in range(2)]
+    servers = [HttpInferenceServer(c).start() for c in cores]
+    proxies = [ChaosProxy("127.0.0.1", s.port).start() for s in servers]
+    cell = ChaosCell(proxies)
+    try:
+        cell.latency(0.05)
+        assert all(p.fault.kind == "latency" and p.fault.latency_s == 0.05
+                   for p in proxies)
+        cell.flap(3)
+        assert all(p.fault.kind == "flap" and p.fault.every == 3
+                   for p in proxies)
+    finally:
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+
+
+# -- config & spec ------------------------------------------------------------
+def test_parse_cells_spec():
+    assert parse_cells_spec("a=h1:8000+h2:8000;b=h3:8000") == {
+        "a": ["h1:8000", "h2:8000"], "b": ["h3:8000"]}
+    with pytest.raises(ValueError):
+        parse_cells_spec("nourls=")
+    with pytest.raises(ValueError):
+        parse_cells_spec("a=h1;a=h2")
+    with pytest.raises(ValueError):
+        parse_cells_spec("")
+
+
+def test_federation_config_validation():
+    pool_a, _ = _stub_pool({"a1": lambda **kw: "ok"})
+    pool_b, _ = _stub_pool({"b1": lambda **kw: "ok"})
+    with pytest.raises(ValueError):
+        FederatedClient({"a": pool_a, "b": pool_b}, home="nope")
+    with pytest.raises(ValueError):
+        FederatedClient({"a": pool_a, "b": pool_b},
+                        shadow=ShadowPolicy("zz", ratio=1.0))
+    with pytest.raises(ValueError):
+        # the shadow cell leaves the serve plan; home must serve
+        FederatedClient({"a": pool_a, "b": pool_b}, home="b",
+                        shadow=ShadowPolicy("b", ratio=1.0))
+    with pytest.raises(ValueError):
+        FederatedClient({"a": pool_a, "b": pool_b},
+                        shadow=ShadowPolicy("b", ratio=1.0),
+                        canary=CanaryPolicy("b"))
+    with pytest.raises(ValueError):
+        FederatedClient({"a": pool_a}, spill_probe_ratio=0.0)
+    fed = FederatedClient({"a": pool_a, "b": pool_b})
+    try:
+        with pytest.raises(InferenceServerException):
+            fed.configure_resilience(None)
+        with pytest.raises(InferenceServerException):
+            fed.configure_telemetry(None)
+    finally:
+        fed.close()
+    pool_a.close()
+    pool_b.close()
+
+
+def test_pool_health_summary():
+    pool, _ = _stub_pool({"a1": lambda **kw: "ok", "a2": lambda **kw: "ok"})
+    try:
+        row = pool.health_summary()
+        assert row["endpoints"] == 2 and row["healthy"] == 2
+        assert row["available"] is True
+        pool.pool.set_health(pool.pool.endpoints[0], False)
+        row = pool.health_summary()
+        assert row["healthy"] == 1 and row["available"] is True
+        pool.pool.set_health(pool.pool.endpoints[1], False)
+        assert pool.health_summary()["available"] is False
+    finally:
+        pool.close()
+
+
+# -- locality & spillover -----------------------------------------------------
+def test_locality_preference_home_serves_everything():
+    fed, stubs = _fed({"a": {"a1": lambda **kw: "from-a"},
+                       "b": {"b1": lambda **kw: "from-b"}}, home="a")
+    try:
+        for _ in range(20):
+            assert fed.infer("m", []) == "from-a"
+        assert len(stubs["a"]["a1"].calls) == 20
+        assert len(stubs["b"]["b1"].calls) == 0
+        assert fed.serve_order() == ["a", "b"]
+        assert fed.spill_total() == 0
+    finally:
+        fed.close()
+
+
+def test_spill_on_saturation_zero_user_errors_and_hysteresis():
+    """Home sheds every request: callers see zero errors (all served by
+    the next cell), spills are counted+emitted exactly once each, and
+    the shed-rate hysteresis engages (home preempted) then RELEASES via
+    the probe fraction once home heals."""
+    home_ok = {"value": False}
+
+    def flappy_home(**kw):
+        if not home_ok["value"]:
+            _shed()
+        return "from-a"
+
+    events = []
+    tel = Telemetry(sample="off")
+    fed, stubs = _fed(
+        {"a": {"a1": flappy_home}, "b": {"b1": lambda **kw: "from-b"}},
+        home="a", telemetry=tel, on_event=events.append,
+        spill_min_samples=4, shed_window=8, spill_probe_ratio=0.5)
+    try:
+        for _ in range(30):
+            assert fed.infer("m", []) in ("from-a", "from-b")
+        spills = [e for e in events if isinstance(e, CellSpill)]
+        stats = fed.federation_stats()
+        assert stats["cells"]["a"]["spill_active"] is True
+        assert spills, "no spill events"
+        assert sum(stats["cells"]["a"]["spill_out"].values()) == len(spills)
+        counter = sum(
+            s.value for s in
+            tel.federation_spill_total._series.values())
+        assert counter == len(spills), "metric != events (not exactly-once)"
+        assert stats["cells"]["b"]["spill_in"] == len(spills)
+        # heal home: probe-fraction home attempts refresh the window and
+        # release the hysteresis; traffic returns home
+        home_ok["value"] = True
+        for _ in range(80):
+            fed.infer("m", [])
+        stats = fed.federation_stats()
+        assert stats["cells"]["a"]["spill_active"] is False, stats
+        served_before = stats["cells"]["a"]["served"]
+        for _ in range(10):
+            assert fed.infer("m", []) == "from-a"
+        assert fed.federation_stats()["cells"]["a"]["served"] == \
+            served_before + 10
+    finally:
+        fed.close()
+
+
+def test_spill_signal_contract():
+    assert is_spill_signal(
+        AdmissionRejected(SHED_ENDPOINT_SATURATED, lane="endpoint"))
+    assert is_spill_signal(AdmissionRejected("queue_full"))
+    assert not is_spill_signal(AdmissionRejected("some_future_policy_deny"))
+    assert not is_spill_signal(InferenceServerException("nope"))
+
+
+def test_fatal_answers_never_spill():
+    def fatal(**kw):
+        raise InferenceServerException("bad input", status="400")
+
+    fed, stubs = _fed({"a": {"a1": fatal},
+                       "b": {"b1": lambda **kw: "from-b"}}, home="a")
+    try:
+        with pytest.raises(InferenceServerException):
+            fed.infer("m", [])
+        assert len(stubs["b"]["b1"].calls) == 0, \
+            "a FATAL answer must not be retried in another cell"
+    finally:
+        fed.close()
+
+
+def test_all_cells_down_raises_last_error():
+    fed, _ = _fed({"a": {"a1": lambda **kw: _connect_error()},
+                   "b": {"b1": lambda **kw: _connect_error()}}, home="a")
+    try:
+        with pytest.raises(InferenceServerException):
+            fed.infer("m", [])
+    finally:
+        fed.close()
+
+
+@pytest.mark.federation_smoke
+def test_spill_on_blackhole_zero_errors_live():
+    """The headline chaos proof: a 2-cell fleet where the WHOLE home
+    cell blackholes mid-run (one ChaosCell call) — every request still
+    succeeds (spilled transparently), the cell breaker opens, and after
+    heal traffic returns home."""
+    cores = [ServerCore(default_model_zoo()) for _ in range(2)]
+    servers = [HttpInferenceServer(c).start() for c in cores]
+    proxies = [ChaosProxy("127.0.0.1", s.port).start() for s in servers]
+    cell_a = ChaosCell([proxies[0]])
+    events = []
+    tel = Telemetry(sample="off")
+    fed = FederatedClient(
+        {"a": [proxies[0].url], "b": [proxies[1].url]}, home="a",
+        protocol="http", telemetry=tel, on_event=events.append,
+        cell_breaker_factory=lambda: CircuitBreaker(
+            min_calls=2, recovery_time_s=0.5),
+        default_deadline_s=8.0, per_attempt_timeout_s=0.5,
+        rng=SEEDED_RNG(),
+        pool_kwargs={"health_interval_s": 0.1, "probe_timeout_s": 0.3,
+                     "rng": SEEDED_RNG()})
+    expected, inputs = None, None
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    in0.set_data_from_numpy(a)
+    in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    in1.set_data_from_numpy(b)
+    inputs, expected = [in0, in1], a + b
+    try:
+        errors = []
+        for i in range(45):
+            if i == 10:
+                cell_a.blackhole()  # the whole home cell goes dark
+            if i == 30:
+                cell_a.heal(reset_active=True)
+            try:
+                result = fed.infer("simple", inputs, client_timeout=8.0)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), expected)
+            except Exception as e:  # pragma: no cover - assertion target
+                errors.append(f"request {i}: {e}")
+            time.sleep(0.02)
+        assert errors == [], errors
+        stats = fed.federation_stats()
+        spills = sum(stats["cells"]["a"]["spill_out"].values())
+        assert spills > 0, stats
+        assert any(isinstance(e, CellSpill) for e in events)
+        # after heal + breaker recovery, home serves again
+        deadline = time.monotonic() + 10.0
+        served = stats["cells"]["a"]["served"]
+        while time.monotonic() < deadline:
+            fed.infer("simple", inputs, client_timeout=8.0)
+            now_served = fed.federation_stats()["cells"]["a"]["served"]
+            if now_served > served:
+                break
+            time.sleep(0.05)
+        assert fed.federation_stats()["cells"]["a"]["served"] > served, \
+            "traffic never returned to the healed home cell"
+    finally:
+        fed.close()
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+
+
+# -- sequences ----------------------------------------------------------------
+def test_sequence_pins_to_cell_and_never_crosses_on_inflight_death():
+    flaky = {"fail": False}
+
+    def home(**kw):
+        if flaky["fail"]:
+            _transient_error()
+        return "a-seq"
+
+    events = []
+    fed, stubs = _fed({"a": {"a1": home},
+                       "b": {"b1": lambda **kw: "b-seq"}},
+                      home="a", on_event=events.append)
+    try:
+        assert fed.infer("m", [], sequence_id=7,
+                         sequence_start=True) == "a-seq"
+        assert fed.infer("m", [], sequence_id=7) == "a-seq"
+        flaky["fail"] = True
+        with pytest.raises(InferenceServerException):
+            fed.infer("m", [], sequence_id=7)
+        abandoned = [e for e in events
+                     if isinstance(e, CellSequenceAbandoned)]
+        assert len(abandoned) == 1
+        assert abandoned[0].cell == "a"
+        assert abandoned[0].sequence_id == 7
+        # the established sequence was NEVER re-sent across cells
+        assert not any(kw.get("sequence_id") == 7
+                       for kw in stubs["b"]["b1"].calls), \
+            stubs["b"]["b1"].calls
+    finally:
+        fed.close()
+
+
+def test_sequence_pin_moves_only_before_established():
+    def dead(**kw):
+        _connect_error()
+
+    fed, stubs = _fed({"a": {"a1": dead},
+                       "b": {"b1": lambda **kw: "b-seq"}}, home="a")
+    try:
+        # first request of the sequence: connect failure on home may move
+        # the pin (no cell-local state exists yet) — no error, no event
+        assert fed.infer("m", [], sequence_id=9,
+                         sequence_start=True) == "b-seq"
+        assert fed.infer("m", [], sequence_id=9) == "b-seq"
+        assert fed.infer("m", [], sequence_id=9, sequence_end=True) == "b-seq"
+        seq_calls = [kw for kw in stubs["b"]["b1"].calls
+                     if kw.get("sequence_id") == 9]
+        assert len(seq_calls) == 3
+    finally:
+        fed.close()
+
+
+# -- streams ------------------------------------------------------------------
+def test_stream_pins_after_first_event_and_fails_over_before():
+    def home_stream(stream=False, **kw):
+        raise InferenceServerException("boom 503", status="503")
+
+    def b_stream(stream=False, **kw):
+        return iter(["e1", "e2", "e3"])
+
+    events = []
+    fed, stubs = _fed({"a": {"a1": home_stream},
+                       "b": {"b1": b_stream}}, home="a",
+                      on_event=events.append)
+    try:
+        out = list(fed.generate_stream("m", {"x": 1}))
+        assert out == ["e1", "e2", "e3"]
+        spills = [e for e in events if isinstance(e, CellSpill)]
+        assert len(spills) == 1 and spills[0].target == "b"
+    finally:
+        fed.close()
+
+
+def test_stream_error_after_first_event_raises_no_cross_cell_resume():
+    def half_stream(stream=False, **kw):
+        def gen():
+            yield "e1"
+            _transient_error()
+        return gen()
+
+    fed, stubs = _fed({"a": {"a1": half_stream},
+                       "b": {"b1": lambda stream=False, **kw:
+                             iter(["never"])}}, home="a")
+    try:
+        it = fed.generate_stream("m", {"x": 1})
+        assert next(it) == "e1"
+        with pytest.raises(InferenceServerException):
+            list(it)
+        assert not any(kw.get("stream") for kw in stubs["b"]["b1"].calls), \
+            "a mid-stream death must never resume in another cell"
+    finally:
+        fed.close()
+
+
+# -- shadow -------------------------------------------------------------------
+def test_shadow_never_returned_never_billed():
+    """Every response comes from home; the mirror rides the shadow
+    cell's pool AFTER the caller's latency settled and takes no token
+    from the home admission controller."""
+    ctrl = AdmissionController()
+
+    def slow_shadow(**kw):
+        time.sleep(0.05)
+        return FakeResult([1, 2, 3])
+
+    pool_a, stubs_a = _stub_pool(
+        {"a1": lambda **kw: FakeResult([1, 2, 3])}, admission=ctrl)
+    pool_s, stubs_s = _stub_pool({"s1": slow_shadow})
+    tel = Telemetry(sample="off")
+    fed = FederatedClient({"a": pool_a, "s": pool_s}, home="a",
+                          telemetry=tel,
+                          shadow=ShadowPolicy("s", ratio=1.0),
+                          rng=SEEDED_RNG())
+    try:
+        n = 8
+        t0 = time.monotonic()
+        for _ in range(n):
+            result = fed.infer("m", [])
+            assert np.array_equal(result.as_numpy("OUT"), [1, 2, 3])
+        caller_s = (time.monotonic() - t0) / n
+        assert fed.shadow_drain(10.0)
+        status = fed.shadow_status()
+        assert status["sent"] == n
+        assert status["matched"] == n and status["diverged"] == 0
+        assert len(stubs_s["s1"].calls) == n
+        # never billed: the 50 ms mirror latency is not on the caller
+        assert caller_s < 0.04, f"caller paid the mirror: {caller_s:.3f}s"
+        # never billed (admission): exactly one home token per request
+        assert ctrl.snapshot()["admitted_total"] == n
+        assert tel.federation_shadow_total.labels("matched").get() == n
+    finally:
+        fed.close()
+
+
+def test_shadow_divergence_counted_and_typed_never_raised():
+    events = []
+    tel = Telemetry(sample="off", flight=True)
+    fed, _ = _fed({"a": {"a1": lambda **kw: FakeResult([1, 2, 3])},
+                   "s": {"s1": lambda **kw: FakeResult([9, 9, 9])}},
+                  home="a", telemetry=tel,
+                  shadow=ShadowPolicy("s", ratio=1.0),
+                  on_event=events.append)
+    try:
+        for _ in range(5):
+            result = fed.infer("m", [])  # never raises on divergence
+            assert np.array_equal(result.as_numpy("OUT"), [1, 2, 3])
+        assert fed.shadow_drain(10.0)
+        diverged = [e for e in events if isinstance(e, ShadowDiverged)]
+        assert len(diverged) == 5
+        assert diverged[0].output == "OUT"
+        assert tel.federation_shadow_total.labels("diverged").get() == 5
+        # each divergence is retained on its own flight timeline
+        retained = tel.flight.retained()
+        shadow_lines = [t for t in retained if t.op == "shadow"]
+        assert len(shadow_lines) == 5
+        assert all(t.verdict == "error" for t in shadow_lines)
+    finally:
+        fed.close()
+
+
+def test_shadow_bounded_pending_skips_never_queues():
+    release = threading.Event()
+
+    def stuck_shadow(**kw):
+        release.wait(5.0)
+        return FakeResult([1])
+
+    fed, _ = _fed({"a": {"a1": lambda **kw: FakeResult([1])},
+                   "s": {"s1": stuck_shadow}},
+                  home="a",
+                  shadow=ShadowPolicy("s", ratio=1.0, max_pending=2))
+    try:
+        for _ in range(10):
+            fed.infer("m", [])
+        status = fed.shadow_status()
+        assert status["pending"] <= 2
+        assert status["skipped"] >= 6, status
+    finally:
+        release.set()
+        fed.close()
+
+
+def test_shadow_compare_off_counts_uncompared_not_matched():
+    tel = Telemetry(sample="off")
+    fed, _ = _fed({"a": {"a1": lambda **kw: FakeResult([1])},
+                   "s": {"s1": lambda **kw: FakeResult([2])}},
+                  home="a", telemetry=tel,
+                  shadow=ShadowPolicy("s", ratio=1.0, compare=False))
+    try:
+        for _ in range(4):
+            fed.infer("m", [])
+        assert fed.shadow_drain(10.0)
+        status = fed.shadow_status()
+        # never-compared mirrors must not masquerade as matched (the
+        # shadow responses here genuinely differ)
+        assert status["matched"] == 0 and status["diverged"] == 0
+        assert status["uncompared"] == 4 and status["sent"] == 4
+        assert tel.federation_shadow_total.labels("uncompared").get() == 4
+    finally:
+        fed.close()
+
+
+def test_canary_served_responses_never_shadow_mirrored():
+    fed, stubs = _fed(
+        {"a": {"a1": lambda **kw: FakeResult([1])},
+         "c": {"c1": lambda **kw: FakeResult([1])},
+         "s": {"s1": lambda **kw: FakeResult([1])}},
+        home="a", shadow=ShadowPolicy("s", ratio=1.0),
+        canary=CanaryPolicy("c", weight=1.0, slo="p95<10s",
+                            min_events=1000))
+    try:
+        for _ in range(10):
+            fed.infer("m", [])  # weight 1.0: every request canary-served
+        assert fed.shadow_drain(10.0)
+        assert fed.canary_status()["routed"] == 10
+        # a canary version's output is not a shadow-consistency sample
+        assert len(stubs["s"]["s1"].calls) == 0
+        assert fed.shadow_status()["sent"] == 0
+    finally:
+        fed.close()
+
+
+def test_sequence_heavy_workload_releases_hysteresis():
+    """Home-served SEQUENCE successes must refresh the shed window too:
+    an engaged spill on a sequence-only workload releases once home
+    heals (regression for a latch-forever bug)."""
+    home_ok = {"value": False}
+
+    def flappy_home(**kw):
+        if not home_ok["value"]:
+            _shed()
+        return "a-seq"
+
+    fed, _ = _fed({"a": {"a1": flappy_home},
+                   "b": {"b1": lambda **kw: "b-seq"}},
+                  home="a", spill_min_samples=4, shed_window=8,
+                  spill_probe_ratio=0.5)
+    try:
+        # unary sheds engage the hysteresis
+        for _ in range(12):
+            fed.infer("m", [])
+        assert fed.federation_stats()["cells"]["a"]["spill_active"] is True
+        home_ok["value"] = True
+        # a sequence-only phase: one home-pinned sequence per iteration
+        for sid in range(1, 90):
+            fed.infer("m", [], sequence_id=sid, sequence_start=True,
+                      sequence_end=True)
+        assert fed.federation_stats()["cells"]["a"]["spill_active"] is False
+    finally:
+        fed.close()
+
+
+# -- canary -------------------------------------------------------------------
+def test_canary_rollback_on_slo_burn_zero_user_errors():
+    def slow_canary(**kw):
+        time.sleep(0.02)
+        return "from-canary"
+
+    events = []
+    tel = Telemetry(sample="off")
+    fed, _ = _fed({"a": {"a1": lambda **kw: "from-a"},
+                   "c": {"c1": slow_canary}},
+                  home="a", telemetry=tel,
+                  canary=CanaryPolicy("c", weight=1.0, slo="p95<5ms",
+                                      min_events=5),
+                  on_event=events.append)
+    try:
+        for _ in range(30):
+            assert fed.infer("m", []) in ("from-a", "from-canary")
+        status = fed.canary_status()
+        assert status["rolled_back"] is True
+        assert status["weight"] == 0.0
+        rollbacks = [e for e in events if isinstance(e, CanaryRolledBack)]
+        assert len(rollbacks) == 1, "rollback must fire exactly once"
+        assert rollbacks[0].cell == "c"
+        assert rollbacks[0].burn_rate > 1.0
+        assert tel.federation_canary_total.labels("rollback").get() == 1
+        # post-rollback: no more canary routing
+        routed = status["routed"]
+        for _ in range(10):
+            assert fed.infer("m", []) == "from-a"
+        assert fed.canary_status()["routed"] == routed
+        # re-arm is explicit
+        fed.canary_arm(0.5)
+        assert fed.canary_status()["weight"] == 0.5
+        assert fed.canary_status()["rolled_back"] is False
+    finally:
+        fed.close()
+
+
+def test_canary_failure_falls_back_home_zero_user_errors():
+    def dead_canary(**kw):
+        _connect_error()
+
+    events = []
+    fed, _ = _fed({"a": {"a1": lambda **kw: "from-a"},
+                   "c": {"c1": dead_canary}},
+                  home="a",
+                  canary=CanaryPolicy("c", weight=1.0, slo="p95<100ms",
+                                      min_events=4),
+                  on_event=events.append)
+    try:
+        for _ in range(20):
+            assert fed.infer("m", []) == "from-a"  # zero user errors
+        status = fed.canary_status()
+        assert status["bad"] >= 4
+        assert status["fallbacks"] == status["routed"]
+        assert status["rolled_back"] is True  # errors burn the SLO too
+        assert len([e for e in events
+                    if isinstance(e, CanaryRolledBack)]) == 1
+    finally:
+        fed.close()
+
+
+def test_canary_slo_spec_must_be_request_latency():
+    with pytest.raises(ValueError):
+        CanaryPolicy("c", slo="ttft_p95<100ms").build_slo()
+    slo = CanaryPolicy("c", slo="p99<50ms").build_slo()
+    assert slo.threshold_ms == 50.0 and slo.objective == 0.99
+
+
+# -- flight recorder ----------------------------------------------------------
+def test_flight_timeline_carries_federation_events():
+    from client_tpu.flight import FlightRecorder
+
+    tel = Telemetry(sample="off",
+                    flight=FlightRecorder(baseline_ratio=1.0))
+    fed, _ = _fed({"a": {"a1": _shed},
+                   "b": {"b1": lambda **kw: "from-b"}},
+                  home="a", telemetry=tel)
+    try:
+        assert fed.infer("m", []) == "from-b"
+        retained = tel.flight.retained()
+        assert retained, "baseline_ratio=1.0 must retain the request"
+        layers = [(layer, event) for t in retained
+                  for _, layer, event, _ in t.events]
+        assert ("federation", "route") in layers
+        assert ("federation", "cell_spill") in layers
+        spill_events = [attrs for t in retained
+                        for _, layer, event, attrs in t.events
+                        if layer == "federation" and event == "cell_spill"]
+        assert spill_events[0]["cell"] == "a"
+        assert spill_events[0]["target"] == "b"
+    finally:
+        fed.close()
+
+
+# -- asyncio twin -------------------------------------------------------------
+def test_aio_spill_and_canary_rollback():
+    async def run():
+        def slow_canary(**kw):
+            time.sleep(0.02)  # sync sleep inside stub: fine for the test
+            return "from-canary"
+
+        events = []
+        fed, stubs = _fed(
+            {"a": {"a1": _shed}, "b": {"b1": lambda **kw: "from-b"}},
+            aio=True, home="a", on_event=events.append)
+        try:
+            for _ in range(10):
+                assert await fed.infer("m", []) == "from-b"
+            stats = fed.federation_stats()
+            assert sum(stats["cells"]["a"]["spill_out"].values()) == 10
+            assert any(isinstance(e, CellSpill) for e in events)
+        finally:
+            await fed.close()
+
+        events2 = []
+        fed2, _ = _fed(
+            {"a": {"a1": lambda **kw: "from-a"}, "c": {"c1": slow_canary}},
+            aio=True, home="a", on_event=events2.append,
+            canary=CanaryPolicy("c", weight=1.0, slo="p95<5ms",
+                                min_events=5))
+        try:
+            for _ in range(20):
+                assert await fed2.infer("m", []) in ("from-a",
+                                                     "from-canary")
+            assert fed2.canary_status()["rolled_back"] is True
+            assert len([e for e in events2
+                        if isinstance(e, CanaryRolledBack)]) == 1
+        finally:
+            await fed2.close()
+
+    asyncio.run(run())
+
+
+def test_aio_shadow_mirrors_and_settles():
+    async def run():
+        fed, stubs = _fed(
+            {"a": {"a1": lambda **kw: FakeResult([5])},
+             "s": {"s1": lambda **kw: FakeResult([5])}},
+            aio=True, home="a", shadow=ShadowPolicy("s", ratio=1.0))
+        try:
+            for _ in range(6):
+                result = await fed.infer("m", [])
+                assert np.array_equal(result.as_numpy("OUT"), [5])
+            assert await fed.shadow_drain(10.0)
+            status = fed.shadow_status()
+            assert status["sent"] == 6 and status["matched"] == 6
+            assert len(stubs["s"]["s1"].calls) == 6
+        finally:
+            await fed.close()
+
+    asyncio.run(run())
+
+
+# -- doctor & artifact --------------------------------------------------------
+def test_doctor_cells_section_and_cell_down_anomaly():
+    import socket
+
+    from client_tpu.doctor import collect_snapshot, render_summary
+
+    core = ServerCore(default_model_zoo())
+    server = HttpInferenceServer(core).start()
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead_url = f"127.0.0.1:{dead.getsockname()[1]}"
+    dead.close()  # a port nobody answers: the down cell
+    try:
+        snap = collect_snapshot(
+            [], cells={"up": [f"127.0.0.1:{server.port}"],
+                       "down": [dead_url]},
+            model="simple", requests_per_endpoint=1, probe_timeout_s=3.0)
+        assert snap["cells"], "cells section missing"
+        cells = snap["cells"][0]["cells"]
+        assert cells["up"]["pool"]["available"] is True
+        assert cells["down"]["pool"]["available"] is False
+        flags = {f["flag"] for f in snap["anomalies"]}
+        assert "cell_down" in flags, snap["anomalies"]
+        down_flags = [f for f in snap["anomalies"]
+                      if f["flag"] == "cell_down"]
+        assert down_flags[0]["url"] == "down"
+        summary = render_summary(snap)
+        assert "cells (" in summary and "cell_down" in summary
+    finally:
+        server.stop()
+
+
+def test_doctor_canary_burning_and_spillover_flags():
+    """Anomaly logic over a live federation attached to the snapshot's
+    telemetry: a rolled-back canary and an engaged spill both flag."""
+    from client_tpu.doctor import _anomalies
+
+    def slow(**kw):
+        time.sleep(0.01)
+        return "ok"
+
+    tel = Telemetry(sample="off")
+    fed, _ = _fed({"a": {"a1": _shed}, "b": {"b1": lambda **kw: "ok"},
+                   "c": {"c1": slow}},
+                  home="a", telemetry=tel,
+                  canary=CanaryPolicy("c", weight=0.5, slo="p95<1ms",
+                                      min_events=3),
+                  spill_min_samples=2, shed_window=8)
+    try:
+        for _ in range(30):
+            fed.infer("m", [])
+        from client_tpu.doctor import _federation_status
+
+        snap = {"endpoints": [], "endpoint_stats": {}, "slos": [],
+                "cells": _federation_status(tel)}
+        flags = {f["flag"] for f in _anomalies(snap, 0.0, 250.0)}
+        assert "spillover_active" in flags
+        assert "canary_burning" in flags
+    finally:
+        fed.close()
+
+
+def test_bench_federation_artifact_claims():
+    """The committed BENCH_FEDERATION.json must still satisfy every
+    invariant its --check validator enforces (CI's guard against a
+    hand-edited or stale artifact)."""
+    path = Path(__file__).resolve().parent.parent / "BENCH_FEDERATION.json"
+    assert path.exists(), "BENCH_FEDERATION.json not committed"
+    doc = json.loads(path.read_text())
+    import tools.bench_federation as bench
+
+    problems = bench.check_artifact(doc)
+    assert problems == [], problems

@@ -1,0 +1,403 @@
+"""Generate BENCH_FEDERATION.json: graceful degradation under cell-scale
+failure, measured open-loop — plus the canary-burn rollback transcript.
+
+The claims under test (ROADMAP item 5 / the federation ISSUE):
+
+1. **Blackhole arm** — a 2-cell fleet (2 replicas per cell, every
+   replica behind a ChaosProxy) replays one seeded open-loop unary
+   trace while the WHOLE home cell blackholes mid-trace (one
+   ``ChaosCell.blackhole()`` call):
+
+   - ``single_cell`` baseline: a plain ``PoolClient`` over the home
+     cell only. Expected: the run collapses — a large error fraction,
+     failed SLOs, delivery ratio far below 1.
+   - ``federated``: a ``FederatedClient`` over both cells, home-first.
+     Expected: user-visible error rate ~0 (requests transparently spill
+     to the surviving cell), the declared SLOs attained, delivery ratio
+     ~1, and a nonzero spill count with the home cell's breaker open.
+
+2. **Canary-burn arm** — the home cell healthy, a canary cell behind a
+   latency fault, ``CanaryPolicy(weight=0.3, slo="p95<100ms")``.
+   Expected: the burn watcher rolls the canary back to weight 0
+   mid-replay (typed ``CanaryRolledBack``), ZERO user-visible errors
+   attributable to the rollout or its rollback, and no canary routing
+   after the rollback (the transcript records the event).
+
+Methodology notes (honest-measurement rules from tools/bench_capacity.py):
+open-loop arrivals (arXiv:2210.04323 — capacity under failure must be
+offered, not self-throttled), both arms replay the SAME seeded trace,
+servers are pre-warmed so jit never bills an SLO, and the artifact
+keeps every arm's full replay row so the binding SLO is inspectable.
+
+``--check`` re-validates the committed artifact's invariants (CI runs it
+via tests/test_federation.py::test_bench_federation_artifact_claims);
+``tools/capacity_gate.py --federation`` re-RUNS the federated blackhole
+arm live on a shortened twin and fails when the invariants stop holding.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_federation.py [-o BENCH_FEDERATION.json]
+    JAX_PLATFORMS=cpu python tools/bench_federation.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import platform
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# one seeded unary trace for every arm: numbers are apples-to-apples
+TRACE_SPEC = ("poisson_burst:duration_s=5,rate=40,burst_factor=1,"
+              "model=simple")
+TRACE_SEED = 2033
+# the blackhole lands at this fraction of the (speed-adjusted) replay
+# window — far enough in that both arms have a healthy baseline, early
+# enough that most of the trace runs under the failure
+BLACKHOLE_AT_FRACTION = 0.4
+# declared SLOs: p95 must absorb the spill-transition cohort (requests
+# in flight toward the dying cell pay one bounded home attempt before
+# spilling — see CELL_ATTEMPT_TIMEOUT_S), error budget is the headline
+SLOS = ["p95<750ms", "error_rate<1%"]
+# what the federated arm's transition cohort pays per doomed home try
+CELL_ATTEMPT_TIMEOUT_S = 0.4
+CELL_DEADLINE_S = 6.0
+REPLAY_WORKERS = 32
+# canary arm: latency fault + burn objective + split weight
+CANARY_TRACE_SPEC = ("poisson_burst:duration_s=4,rate=30,burst_factor=1,"
+                     "model=simple")
+CANARY_LATENCY_S = 0.25
+CANARY_SLO = "p95<100ms"
+CANARY_WEIGHT = 0.3
+CANARY_MIN_EVENTS = 10
+# ceilings the committed artifact must beat (validated by --check)
+FED_MAX_ERROR_RATE = 0.01
+FED_MIN_DELIVERY = 0.95
+BASELINE_MAX_DELIVERY = 0.75  # the collapse must be visible
+
+
+@contextlib.contextmanager
+def two_cells(replicas_per_cell: int = 2):
+    """(cells dict, ChaosCell per cell) over live threaded HTTP servers,
+    every replica behind its own ChaosProxy."""
+    from client_tpu.models import default_model_zoo
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing import ChaosCell, ChaosProxy
+
+    n = 2 * replicas_per_cell
+    cores = [ServerCore(default_model_zoo()) for _ in range(n)]
+    servers = [HttpInferenceServer(c).start() for c in cores]
+    proxies = [ChaosProxy("127.0.0.1", s.port).start() for s in servers]
+    cell_a = ChaosCell(proxies[:replicas_per_cell])
+    cell_b = ChaosCell(proxies[replicas_per_cell:])
+    try:
+        yield ({"a": cell_a.urls, "b": cell_b.urls},
+               {"a": cell_a, "b": cell_b})
+    finally:
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+
+
+def _warm(url: str) -> None:
+    """Pre-warm one server (jit compile) before the measured window."""
+    import numpy as np
+
+    import client_tpu.http as httpclient
+
+    client = httpclient.InferenceServerClient(url)
+    try:
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        in0.set_data_from_numpy(a)
+        in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+        in1.set_data_from_numpy(a)
+        for _ in range(2):
+            client.infer("simple", [in0, in1], client_timeout=10.0)
+    finally:
+        client.close()
+
+
+def _blackhole_timer(cell, delay_s: float, transcript: List[Dict[str, Any]]):
+    def fire():
+        transcript.append({"event": "cell_blackhole", "cell": "a",
+                           "at_s": round(delay_s, 3)})
+        cell.blackhole()
+
+    timer = threading.Timer(delay_s, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
+def run_blackhole_arm(cells: Dict[str, List[str]], chaos,
+                      federated: bool, duration_s: Optional[float] = None,
+                      speed: float = 1.0) -> Dict[str, Any]:
+    """One open-loop replay with the home cell blackholed mid-trace.
+
+    ``federated=False`` is the single-cell baseline: the SAME client
+    stack over the home cell only — identical attempt budget and
+    per-attempt patience, the only difference is having no second cell
+    to spill to. That keeps the comparison about AVAILABILITY (a tighter
+    timeout or a different engine would smuggle in a second variable)."""
+    from client_tpu import trace as trace_mod
+    from client_tpu.perf import PerfRunner
+
+    spec = TRACE_SPEC
+    if duration_s is not None:
+        spec = spec.replace("duration_s=5", f"duration_s={duration_s:g}")
+    tr = trace_mod.generate(spec, seed=TRACE_SEED)
+    for url in [u for urls in cells.values() for u in urls]:
+        _warm(url)
+    arm_cells = dict(cells) if federated else {"a": cells["a"]}
+    runner = PerfRunner(
+        cells["a"][0], "http", "simple",
+        cells=arm_cells, home_cell="a",
+        cells_deadline_s=CELL_DEADLINE_S,
+        cells_attempt_timeout_s=CELL_ATTEMPT_TIMEOUT_S)
+    trace_window = tr.duration_s / speed
+    transcript: List[Dict[str, Any]] = []
+    timer = _blackhole_timer(
+        chaos["a"], BLACKHOLE_AT_FRACTION * trace_window, transcript)
+    try:
+        row = runner.run_trace(tr, speed=speed,
+                               replay_workers=REPLAY_WORKERS,
+                               slos=list(SLOS))
+    finally:
+        timer.cancel()
+        runner.close()
+        chaos["a"].heal(reset_active=True)
+    issued = row["issued"] or 1
+    out = {
+        "arm": "federated" if federated else "single_cell",
+        "slos": list(SLOS),
+        "blackhole_at_s": round(BLACKHOLE_AT_FRACTION * trace_window, 3),
+        "delivery_ratio": round(row["requests"] / issued, 4),
+        "error_rate": row["error_rate"],
+        "shed_rate": row["shed_rate"],
+        "slo_ok": row["slo_ok"],
+        "row": row,
+    }
+    if federated:
+        fed = row.get("client_federation") or {}
+        out["spills"] = fed.get("spills", 0)
+        out["home_breaker"] = (fed.get("cells", {}).get("a") or {}).get(
+            "breaker_state")
+    out["transcript"] = transcript
+    return out
+
+
+def run_canary_arm(cells: Dict[str, List[str]], chaos,
+                   duration_s: Optional[float] = None) -> Dict[str, Any]:
+    """Home healthy, canary cell behind a latency fault: the replay must
+    finish with zero errors, the canary rolled back mid-run, and no
+    canary routing after the rollback."""
+    from client_tpu import trace as trace_mod
+    from client_tpu.perf import PerfRunner
+
+    spec = CANARY_TRACE_SPEC
+    if duration_s is not None:
+        spec = spec.replace("duration_s=4", f"duration_s={duration_s:g}")
+    tr = trace_mod.generate(spec, seed=TRACE_SEED + 1)
+    for url in [u for urls in cells.values() for u in urls]:
+        _warm(url)
+    chaos["b"].latency(CANARY_LATENCY_S)  # the bad rollout
+    transcript: List[Dict[str, Any]] = []
+    runner = PerfRunner(
+        cells["a"][0], "http", "simple",
+        cells=cells, home_cell="a",
+        canary_cell="b", canary_weight=CANARY_WEIGHT,
+        canary_slo=CANARY_SLO, canary_min_events=CANARY_MIN_EVENTS,
+        cells_deadline_s=CELL_DEADLINE_S,
+        cells_attempt_timeout_s=2.0)
+    try:
+        t0 = time.monotonic()
+        row = runner.run_trace(tr, speed=1.0,
+                               replay_workers=REPLAY_WORKERS,
+                               slos=["error_rate<0.5%"])
+    finally:
+        runner.close()
+        chaos["b"].heal()
+    canary = (row.get("client_federation") or {}).get("canary") or {}
+    if canary.get("rolled_back"):
+        transcript.append({
+            "event": "canary_rolled_back",
+            "cell": canary.get("cell"),
+            "burn_rate": canary.get("burn_rate"),
+            "events_at_decision": canary.get("ok", 0) + canary.get("bad", 0),
+            "within_s": round(time.monotonic() - t0, 3),
+        })
+    return {
+        "arm": "canary_burn",
+        "canary_slo": CANARY_SLO,
+        "canary_weight": CANARY_WEIGHT,
+        "canary_latency_fault_s": CANARY_LATENCY_S,
+        "error_rate": row["error_rate"],
+        "rolled_back": bool(canary.get("rolled_back")),
+        "weight_after": canary.get("weight"),
+        "routed": canary.get("routed", 0),
+        "fallbacks": canary.get("fallbacks", 0),
+        "rollbacks": canary.get("rollbacks", 0),
+        "transcript": transcript,
+        "row": row,
+    }
+
+
+def generate(out_path: str) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {
+        "kind": "client_tpu_bench_federation",
+        "version": 1,
+        "generated_unix": int(time.time()),
+        "host": {"platform": platform.platform(),
+                 "python": platform.python_version()},
+        "trace": {"spec": TRACE_SPEC, "seed": TRACE_SEED},
+        "slos": SLOS,
+        "search": {
+            "blackhole_at_fraction": BLACKHOLE_AT_FRACTION,
+            "cell_attempt_timeout_s": CELL_ATTEMPT_TIMEOUT_S,
+            "cell_deadline_s": CELL_DEADLINE_S,
+            "replay_workers": REPLAY_WORKERS,
+            "canary": {"spec": CANARY_TRACE_SPEC,
+                       "seed": TRACE_SEED + 1,
+                       "latency_fault_s": CANARY_LATENCY_S,
+                       "slo": CANARY_SLO, "weight": CANARY_WEIGHT,
+                       "min_events": CANARY_MIN_EVENTS},
+        },
+        "arms": {},
+    }
+    print("== single_cell baseline (home cell only, blackholed mid-trace)")
+    with two_cells() as (cells, chaos):
+        doc["arms"]["single_cell"] = run_blackhole_arm(
+            cells, chaos, federated=False)
+    arm = doc["arms"]["single_cell"]
+    print(f"   delivery={arm['delivery_ratio']} error_rate="
+          f"{arm['error_rate']} slo_ok={arm['slo_ok']}")
+    print("== federated (2 cells, home blackholed mid-trace)")
+    with two_cells() as (cells, chaos):
+        doc["arms"]["federated"] = run_blackhole_arm(
+            cells, chaos, federated=True)
+    arm = doc["arms"]["federated"]
+    print(f"   delivery={arm['delivery_ratio']} error_rate="
+          f"{arm['error_rate']} slo_ok={arm['slo_ok']} "
+          f"spills={arm['spills']} home_breaker={arm['home_breaker']}")
+    print("== canary burn (latency-faulted canary cell, auto-rollback)")
+    with two_cells() as (cells, chaos):
+        doc["arms"]["canary_burn"] = run_canary_arm(cells, chaos)
+    arm = doc["arms"]["canary_burn"]
+    print(f"   rolled_back={arm['rolled_back']} error_rate="
+          f"{arm['error_rate']} routed={arm['routed']} "
+          f"weight_after={arm['weight_after']}")
+    problems = check_artifact(doc)
+    if problems:
+        print("INVARIANT FAILURES (artifact NOT written):")
+        for p in problems:
+            print(f"  - {p}")
+        raise SystemExit(1)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"written: {out_path}")
+    return doc
+
+
+def check_artifact(doc: Dict[str, Any]) -> List[str]:
+    """Every claim the committed artifact makes, re-validated. Returns
+    the list of violated invariants (empty = artifact holds)."""
+    problems: List[str] = []
+    arms = doc.get("arms", {})
+    single = arms.get("single_cell")
+    fed = arms.get("federated")
+    canary = arms.get("canary_burn")
+    if not (single and fed and canary):
+        return ["artifact missing one of single_cell/federated/"
+                "canary_burn arms"]
+    # -- the federated arm holds under the blackhole
+    if fed["error_rate"] > FED_MAX_ERROR_RATE:
+        problems.append(
+            f"federated error_rate {fed['error_rate']} > "
+            f"{FED_MAX_ERROR_RATE}: spillover did not hold errors at ~0")
+    if not fed["slo_ok"]:
+        problems.append("federated arm missed a declared SLO")
+    if fed["delivery_ratio"] < FED_MIN_DELIVERY:
+        problems.append(
+            f"federated delivery {fed['delivery_ratio']} < "
+            f"{FED_MIN_DELIVERY}")
+    if fed.get("spills", 0) <= 0:
+        problems.append("federated arm recorded no spills — the "
+                        "blackhole never exercised the spillover path")
+    if fed.get("home_breaker") not in ("open", "half_open"):
+        problems.append(
+            f"home cell breaker {fed.get('home_breaker')!r} after the "
+            "blackhole (expected open/half_open)")
+    # -- the baseline visibly collapses (the comparison that makes the
+    #    federated number a claim instead of a tautology)
+    if single["slo_ok"]:
+        problems.append("single_cell baseline attained its SLOs under "
+                        "the blackhole — no collapse to degrade "
+                        "gracefully from")
+    collapsed = (single["delivery_ratio"] <= BASELINE_MAX_DELIVERY
+                 or single["error_rate"] >= 0.1)
+    if not collapsed:
+        problems.append(
+            f"single_cell baseline neither lost delivery "
+            f"(ratio {single['delivery_ratio']}) nor errored "
+            f"(rate {single['error_rate']}) — the blackhole arm "
+            "proved nothing")
+    if fed["delivery_ratio"] <= single["delivery_ratio"]:
+        problems.append("federated delivery did not beat the baseline")
+    # -- canary: rolled back, zero user-visible errors, routing stopped
+    if not canary["rolled_back"]:
+        problems.append("canary never rolled back under the burn")
+    if canary["error_rate"] > 0.005:
+        problems.append(
+            f"canary arm error_rate {canary['error_rate']}: the rollout/"
+            "rollback leaked user-visible errors")
+    if canary.get("weight_after") != 0.0:
+        problems.append(
+            f"canary weight after rollback is "
+            f"{canary.get('weight_after')!r}, not 0.0")
+    if canary.get("rollbacks") != 1:
+        problems.append(
+            f"canary rollbacks {canary.get('rollbacks')} != 1 "
+            "(must fire exactly once)")
+    if canary.get("routed", 0) < CANARY_MIN_EVENTS:
+        problems.append(
+            "canary routed fewer requests than min_events — the burn "
+            "verdict was never reachable")
+    if not canary.get("transcript"):
+        problems.append("canary arm carries no rollback transcript")
+    return problems
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--out", default="BENCH_FEDERATION.json")
+    parser.add_argument("--check", action="store_true",
+                        help="validate the committed artifact's "
+                             "invariants instead of regenerating")
+    args = parser.parse_args()
+    if args.check:
+        with open(args.out) as f:
+            doc = json.load(f)
+        problems = check_artifact(doc)
+        if problems:
+            print("ARTIFACT CHECK FAILED:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        print(f"{args.out}: all invariants hold")
+        return 0
+    generate(args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

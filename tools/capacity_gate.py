@@ -22,6 +22,13 @@ of the trace and exits 1 when the committed invariants (admitted-traffic
 p99 inside the declared SLO, honest nonzero shed, delivery improved over
 the un-admitted baseline) no longer hold live.
 
+With ``--federation`` the gate re-runs the committed multi-cell
+blackhole proof live (``BENCH_FEDERATION.json``,
+tools/bench_federation.py): a fresh 2-cell fleet replays a shortened
+twin of the committed trace with the WHOLE home cell blackholed
+mid-replay, and exits 1 when the federated arm no longer spills with
+~0 user-visible errors, attains its declared SLOs and delivers.
+
 With ``--flight`` the gate proves the flight recorder is
 pay-for-what-you-use: the capacity arm replayed recorder-OFF at the
 standard floor must sustain (else INCONCLUSIVE — plain capacity
@@ -335,6 +342,69 @@ def flight_recheck(baseline: str, arm: str, tolerance: float,
     return 0
 
 
+def federation_recheck(baseline: str, duration_s: float,
+                       attempts: int) -> int:
+    """Re-RUN the committed federation blackhole proof live
+    (``BENCH_FEDERATION.json``, tools/bench_federation.py): a fresh
+    2-cell fleet, a shortened twin of the committed trace, the whole
+    home cell blackholed mid-replay — the federated arm must still hold
+    user-visible errors at ~0, attain the declared SLOs, deliver, and
+    actually spill. Retried ``attempts`` times so one scheduling hiccup
+    on a shared-core CI box doesn't false-fail; the canary/baseline arms
+    are validated from the committed artifact by ``--check``/CI, not
+    re-run here (the blackhole arm is the availability claim)."""
+    import tools.bench_federation as bench
+
+    doc = json.loads(Path(baseline).read_text())
+    problems_committed = bench.check_artifact(doc)
+    if problems_committed:
+        print("committed artifact already violates its invariants:")
+        for p in problems_committed:
+            print(f"  - {p}")
+        return 1
+    rows = []
+    for attempt in range(max(1, attempts)):
+        with bench.two_cells() as (cells, chaos):
+            arm = bench.run_blackhole_arm(
+                cells, chaos, federated=True, duration_s=duration_s)
+        problems = []
+        if arm["error_rate"] > bench.FED_MAX_ERROR_RATE:
+            problems.append(
+                f"error_rate {arm['error_rate']} > "
+                f"{bench.FED_MAX_ERROR_RATE}")
+        if not arm["slo_ok"]:
+            problems.append("declared SLOs missed")
+        if arm["delivery_ratio"] < bench.FED_MIN_DELIVERY:
+            problems.append(
+                f"delivery {arm['delivery_ratio']} < "
+                f"{bench.FED_MIN_DELIVERY}")
+        if arm.get("spills", 0) <= 0:
+            problems.append("no spills recorded (blackhole never "
+                            "exercised spillover)")
+        rows.append({
+            "attempt": attempt + 1,
+            "delivery_ratio": arm["delivery_ratio"],
+            "error_rate": arm["error_rate"],
+            "slo_ok": arm["slo_ok"],
+            "spills": arm.get("spills"),
+            "home_breaker": arm.get("home_breaker"),
+            "problems": problems,
+        })
+        if not problems:
+            break
+    print(json.dumps({"federation": rows}, indent=2))
+    if rows[-1]["problems"]:
+        print("FAIL: the federated blackhole arm no longer degrades "
+              "gracefully:")
+        for p in rows[-1]["problems"]:
+            print(f"  - {p}")
+        return 1
+    print("OK: cell blackhole still degrades gracefully "
+          f"(delivery {rows[-1]['delivery_ratio']}, error_rate "
+          f"{rows[-1]['error_rate']}, spills {rows[-1]['spills']})")
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--baseline", default="BENCH_CAPACITY.json")
@@ -361,8 +431,20 @@ def main() -> int:
                              "arm at floor speed with a flight recorder "
                              "attached must still attain its SLOs")
     parser.add_argument("--flight-tolerance", type=float, default=0.05)
+    parser.add_argument("--federation", action="store_true",
+                        help="re-run the committed federation blackhole "
+                             "proof live (BENCH_FEDERATION.json): a "
+                             "fresh 2-cell fleet with the whole home "
+                             "cell blackholed mid-replay must still "
+                             "spill with ~0 user-visible errors and "
+                             "attain the declared SLOs")
+    parser.add_argument("--federation-baseline",
+                        default="BENCH_FEDERATION.json")
     args = parser.parse_args()
 
+    if args.federation:
+        return federation_recheck(args.federation_baseline,
+                                  args.duration_s, args.attempts)
     if args.flight:
         return flight_recheck(args.baseline, args.arm, args.tolerance,
                               args.duration_s, args.replay_workers,
