@@ -36,6 +36,17 @@ client libraries (triton-inference-server/client), designed TPU-first:
   or ``.caching()`` on any frontend/pool), paired with the pool's
   ``routing="affinity"`` rendezvous session/prefix routing
   (docs/caching.md).
+- ``client_tpu.tenancy``: multi-tenant QoS — declared per-tenant
+  contracts (``TenantSpec``: WFQ weight, token-bucket rate/burst quota,
+  latency SLO, cache byte budget) enforced end to end: every frontend
+  and wrapper accepts ``infer(..., tenant=...)``; the admission
+  controller drains per-tenant virtual queues weighted-fair and sheds
+  over-quota tenants with the typed ``over_quota`` reason and an honest
+  ``retry_after_s`` (``SHED`` domain — never retried, never spilled
+  cross-cell); the tenant is folded into the shared content key so
+  cache/singleflight/batching partition per tenant, with per-tenant
+  cache byte budgets; per-tenant SLO burn windows feed telemetry and
+  the doctor's ``noisy_neighbor`` anomaly (docs/tenancy.md).
 - ``client_tpu.federation``: multi-cell federation —
   ``FederatedClient``/``AioFederatedClient`` over NAMED cells (each an
   existing pool client): locality-first routing with transparent

@@ -35,6 +35,19 @@ item 2's admission half:
   retried, never counted against breakers or outlier ejection) and the
   perf/replay harnesses count it as ``shed``, not ``error``.
 
+- **Tenancy** (``AdmissionController(tenancy=...)``, see
+  ``client_tpu.tenancy``): each lane's waiter stack becomes per-tenant
+  virtual queues drained weighted-fair — the tenant with the smallest
+  virtual finish time drains next (its vtime advances by ``1/weight``
+  per admit), LIFO within the tenant, so one tenant's backlog can no
+  longer starve its lane-mates while a single tenant sees the exact
+  legacy LIFO order. Token-bucket quotas shed over-quota requests at
+  the door with the typed reason ``over_quota`` and an HONEST
+  ``retry_after_s`` (the bucket's refill eta). ``over_quota`` is a
+  POLICY denial, deliberately absent from ``SPILL_REASONS`` — a
+  federation layer must never launder a quota away by spilling the
+  excess to another cell.
+
 Wiring lives in ``client_tpu.pool`` (``PoolClient(admission=...)``
 acquires one token per pooled infer — one token covers the whole
 failover/hedge engine run, and a coalesced batch from
@@ -65,6 +78,7 @@ __all__ = [
     "LANE_LOW",
     "SHED_DEADLINE",
     "SHED_ENDPOINT_SATURATED",
+    "SHED_OVER_QUOTA",
     "SHED_QUEUE_FULL",
     "SHED_QUEUE_TIMEOUT",
     "SHED_SATURATED",
@@ -79,6 +93,7 @@ SHED_DEADLINE = "deadline"              # could not possibly meet its deadline
 SHED_QUEUE_FULL = "queue_full"          # lane queue at capacity
 SHED_QUEUE_TIMEOUT = "queue_timeout"    # waited max_queue_wait_s, still saturated
 SHED_ENDPOINT_SATURATED = "endpoint_saturated"  # every replica at its limit
+SHED_OVER_QUOTA = "over_quota"          # tenant token-bucket quota exhausted
 
 LANE_HIGH = "high"
 LANE_DEFAULT = "default"
@@ -91,9 +106,11 @@ ADMISSION_REJECTED_STATUS = "ADMISSION_REJECTED"
 # shed reasons that double as CAPACITY signals: every one of them means
 # "this cell/pool cannot take the request right now", so a multi-cell
 # layer (client_tpu.federation) may answer it by SPILLING the request to
-# another cell instead of surfacing the shed to the caller. A future
-# rejection reason that is NOT about capacity (a policy/quota denial,
-# say) must be left out of this set so it never silently moves traffic.
+# another cell instead of surfacing the shed to the caller. A rejection
+# reason that is NOT about capacity must be left out of this set so it
+# never silently moves traffic — concretely, SHED_OVER_QUOTA is a POLICY
+# denial: spilling a tenant's over-quota excess to a sibling cell would
+# launder the quota away, so it stays out of this set by design.
 SPILL_REASONS = frozenset({
     SHED_SATURATED,
     SHED_DEADLINE,
@@ -118,19 +135,28 @@ class AdmissionRejected(InferenceServerException):
     """A request shed by admission control before it touched the wire.
 
     ``reason`` is one of the ``SHED_*`` constants, ``lane`` the priority
-    lane it was judged in. ``retry_after_s`` (when known) hints how long
-    until capacity may free up. ``classify_fault`` maps this to the
-    ``SHED`` domain: never retried, never a breaker/ejection signal, and
-    counted as ``shed`` (not ``error``) by the perf/replay harnesses."""
+    lane it was judged in, ``tenant`` the tenant it was judged AS (None
+    for tenantless traffic). ``retry_after_s`` is an honest backpressure
+    hint when known: the token bucket's refill eta for ``over_quota``
+    sheds, the limiter's minRTT eta for capacity sheds. ``classify_fault``
+    maps this to the ``SHED`` domain: never retried, never a
+    breaker/ejection signal, and counted as ``shed`` (not ``error``) by
+    the perf/replay harnesses."""
 
     def __init__(self, reason: str, lane: str = LANE_DEFAULT,
                  msg: Optional[str] = None,
-                 retry_after_s: Optional[float] = None):
+                 retry_after_s: Optional[float] = None,
+                 tenant: Optional[str] = None):
         super().__init__(
-            msg or f"admission rejected ({reason}; lane={lane})",
+            msg or (f"admission rejected ({reason}; lane={lane}"
+                    + (f"; tenant={tenant}" if tenant is not None else "")
+                    + (f"; retry_after={retry_after_s:.3f}s"
+                       if retry_after_s is not None else "")
+                    + ")"),
             status=ADMISSION_REJECTED_STATUS)
         self.reason = reason
         self.lane = lane
+        self.tenant = tenant
         self.retry_after_s = retry_after_s
         # set True once a telemetry counter has seen this instance, so a
         # shed that crosses layers (endpoint select -> pool wrapper) is
@@ -349,12 +375,14 @@ class _Waiter:
     controller lock — the event/future is a wakeup hint, never the
     authority on who owns the admission slot."""
 
-    __slots__ = ("lane", "rank", "deadline", "enqueued_ns", "state",
-                 "event", "loop", "future", "shed_reason")
+    __slots__ = ("lane", "rank", "tenant", "deadline", "enqueued_ns",
+                 "state", "event", "loop", "future", "shed_reason")
 
-    def __init__(self, lane: str, rank: int, deadline: Optional[float]):
+    def __init__(self, lane: str, rank: int, deadline: Optional[float],
+                 tenant: Optional[str] = None):
         self.lane = lane
         self.rank = rank
+        self.tenant = tenant
         self.deadline = deadline
         self.enqueued_ns = time.perf_counter_ns()
         self.state = _WAITING
@@ -382,19 +410,42 @@ class _Waiter:
             self.future.set_result(True)
 
 
-class _Lane:
-    """One priority lane: a LIFO stack of waiters plus its counters.
-    Mutations happen under the controller lock; cancelled waiters stay in
-    the stack (marked) and are skipped lazily at drain time."""
+class _TenantQueue:
+    """One tenant's LIFO waiter stack within a lane, plus its WFQ
+    virtual finish time. ``vtime`` advances by ``1/weight`` per admitted
+    waiter; the drain always serves the smallest-vtime tenant next, so
+    service converges to weight-proportional shares under contention.
+    Mutations happen under the controller lock."""
 
-    __slots__ = ("label", "rank", "stack", "depth", "admitted_total",
-                 "shed_by_reason")
+    __slots__ = ("stack", "depth", "vtime", "weight")
+
+    def __init__(self, weight: float):
+        self.stack: deque = deque()
+        self.depth = 0  # live (non-cancelled) waiters of this tenant
+        self.vtime = 0.0
+        self.weight = weight
+
+
+class _Lane:
+    """One priority lane: per-tenant LIFO waiter queues drained
+    weighted-fair, plus the lane's counters. ``vclock`` is the lane's
+    virtual clock — the vtime of the last served tenant; a tenant whose
+    queue went idle re-enters at ``max(its vtime, vclock)`` so idling
+    never banks catch-up credit (the classic WFQ start-time rule). With
+    a single tenant the drain degenerates to the exact legacy
+    LIFO-within-lane order. Mutations happen under the controller lock;
+    cancelled waiters stay in their stack (marked) and are skipped
+    lazily at drain time."""
+
+    __slots__ = ("label", "rank", "queues", "depth", "vclock",
+                 "admitted_total", "shed_by_reason")
 
     def __init__(self, label: str, rank: int):
         self.label = label
         self.rank = rank
-        self.stack: deque = deque()
-        self.depth = 0  # live (non-cancelled) waiters
+        self.queues: Dict[Optional[str], _TenantQueue] = {}
+        self.depth = 0  # live (non-cancelled) waiters across tenants
+        self.vclock = 0.0
         self.admitted_total = 0
         self.shed_by_reason: Dict[str, int] = {}
 
@@ -405,12 +456,13 @@ class AdmissionToken:
     outcome was ok; ``latency_s=None`` with ``ok=True`` releases without
     feeding (nothing was learned). Double release raises."""
 
-    __slots__ = ("_ctrl", "lane", "waited_s", "_released")
+    __slots__ = ("_ctrl", "lane", "tenant", "waited_s", "_released")
 
     def __init__(self, ctrl: "AdmissionController", lane: str,
-                 waited_s: float):
+                 waited_s: float, tenant: Optional[str] = None):
         self._ctrl = ctrl
         self.lane = lane
+        self.tenant = tenant
         self.waited_s = waited_s
         self._released = False
 
@@ -420,7 +472,7 @@ class AdmissionToken:
             raise InferenceServerException(
                 "admission token released twice", status="ADMISSION_TOKEN")
         self._released = True
-        self._ctrl._release(latency_s, ok)
+        self._ctrl._release(latency_s, ok, self.tenant)
 
 
 class AdmissionController:
@@ -448,6 +500,7 @@ class AdmissionController:
         shed_low_when_saturated: bool = True,
         eta_factor: float = 1.0,
         lane_map: Callable[[int], Tuple[str, int]] = default_lane_map,
+        tenancy: Optional[Any] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         """``limiter`` defaults to ``AdaptiveLimiter(mode=mode,
@@ -455,11 +508,20 @@ class AdmissionController:
         stack; ``max_queue_wait_s`` bounds how long any waiter parks
         before it sheds (also clamped by the request's own deadline minus
         the limiter's service-time estimate). ``eta_factor`` scales the
-        estimate in the deadline-feasibility test (>1 sheds earlier)."""
+        estimate in the deadline-feasibility test (>1 sheds earlier).
+        ``tenancy`` — a ``client_tpu.tenancy.TenancyPolicy`` (or a spec
+        string for ``parse_tenancy_spec``) arming per-tenant quotas and
+        weighted-fair drain; None keeps the controller tenant-blind
+        (tenants still get separate queues but equal weight and no
+        quota)."""
         if max_queue < 0:
             raise ValueError("max_queue must be >= 0")
         if max_queue_wait_s < 0:
             raise ValueError("max_queue_wait_s must be >= 0")
+        if isinstance(tenancy, str):
+            from .tenancy import parse_tenancy_spec
+            tenancy = parse_tenancy_spec(tenancy, clock=clock)
+        self.tenancy = tenancy
         self.limiter = limiter or AdaptiveLimiter(
             mode=mode, target_ms=target_ms)
         self.max_queue = int(max_queue)
@@ -488,15 +550,23 @@ class AdmissionController:
     def snapshot(self) -> Dict[str, Any]:
         limiter = self.limiter.snapshot()
         with self._lock:
-            lanes = {
-                label: {
+            lanes = {}
+            for label, lane in self._lanes.items():
+                row: Dict[str, Any] = {
                     "depth": lane.depth,
                     "admitted_total": lane.admitted_total,
                     "shed": dict(lane.shed_by_reason),
                 }
-                for label, lane in self._lanes.items()
-            }
-            return {
+                # per-tenant queue depths, only once a real (non-None)
+                # tenant has queued here — tenantless snapshots stay
+                # byte-identical to the pre-tenancy schema
+                if any(t is not None for t in lane.queues):
+                    row["tenants"] = {
+                        (t if t is not None else "_default"): tq.depth
+                        for t, tq in lane.queues.items()
+                    }
+                lanes[label] = row
+            snap = {
                 "limit": limiter["limit"],
                 "inflight": self._inflight,
                 "admitted_total": self.admitted_total,
@@ -507,6 +577,10 @@ class AdmissionController:
                 "lanes": lanes,
                 "limiter": limiter,
             }
+        if self.tenancy is not None:
+            # outside the controller lock: the policy takes its own
+            snap["tenancy"] = self.tenancy.snapshot()
+        return snap
 
     # -- internals ------------------------------------------------------------
     def _lane(self, label: str, rank: int) -> _Lane:
@@ -515,9 +589,16 @@ class AdmissionController:
             lane = self._lanes[label] = _Lane(label, rank)
         return lane
 
-    def _observe_admit(self, lane: str, waited_s: float) -> None:
-        _flight.note("admission", "admit", lane=lane,
-                     waited_ms=round(waited_s * 1e3, 3))
+    def _observe_admit(self, lane: str, waited_s: float,
+                       tenant: Optional[str] = None) -> None:
+        if tenant is not None:
+            _flight.note("admission", "admit", lane=lane, tenant=tenant,
+                         waited_ms=round(waited_s * 1e3, 3))
+        else:
+            _flight.note("admission", "admit", lane=lane,
+                         waited_ms=round(waited_s * 1e3, 3))
+        if self.tenancy is not None:
+            self.tenancy.on_admit(tenant)
         if self.observer is not None:
             try:
                 self.observer.on_admission_admit(lane, waited_s)
@@ -525,15 +606,24 @@ class AdmissionController:
                 pass  # an observer must never break the data path
 
     def _shed(self, lane: _Lane, reason: str,
-              retry_after_s: Optional[float] = None) -> AdmissionRejected:
+              retry_after_s: Optional[float] = None,
+              tenant: Optional[str] = None) -> AdmissionRejected:
         """Count one shed and build (not raise) the typed rejection."""
         with self._lock:
             self.shed_total += 1
             lane.shed_by_reason[reason] = (
                 lane.shed_by_reason.get(reason, 0) + 1)
         exc = AdmissionRejected(reason, lane.label,
-                                retry_after_s=retry_after_s)
-        _flight.note("admission", "shed", reason=reason, lane=lane.label)
+                                retry_after_s=retry_after_s,
+                                tenant=tenant)
+        if tenant is not None:
+            _flight.note("admission", "shed", reason=reason,
+                         lane=lane.label, tenant=tenant)
+        else:
+            _flight.note("admission", "shed", reason=reason,
+                         lane=lane.label)
+        if self.tenancy is not None:
+            self.tenancy.on_shed(tenant, reason)
         if self.observer is not None:
             try:
                 self.observer.on_admission_shed(lane.label, reason)
@@ -568,20 +658,45 @@ class AdmissionController:
         self._inflight += 1
         return True
 
+    def _tenant_queue_locked(self, lane: _Lane,
+                             tenant: Optional[str]) -> _TenantQueue:
+        tq = lane.queues.get(tenant)
+        if tq is None:
+            weight = (self.tenancy.weight(tenant)
+                      if self.tenancy is not None else 1.0)
+            tq = lane.queues[tenant] = _TenantQueue(weight)
+        return tq
+
+    def _park_locked(self, lane: _Lane, waiter: _Waiter) -> None:
+        tq = self._tenant_queue_locked(lane, waiter.tenant)
+        if tq.depth == 0:
+            # the WFQ start-time rule: an idle tenant re-enters at the
+            # lane's virtual clock, so idling never banks catch-up credit
+            tq.vtime = max(tq.vtime, lane.vclock)
+        tq.stack.append(waiter)
+        tq.depth += 1
+        lane.depth += 1
+
     def _drain_locked(self) -> List[_Waiter]:
         """Admit queued waiters while slots are free: lanes by rank
-        (high first), NEWEST waiter first within a lane. Waiters whose
-        deadline became infeasible while parked are shed instead of
-        admitted (their slot stays free). Returns waiters to notify
-        OUTSIDE the lock."""
+        (high first); within a lane, the tenant with the smallest virtual
+        finish time drains next (weighted-fair — its vtime advances by
+        ``1/weight`` per admit), NEWEST waiter first within the tenant.
+        Waiters whose deadline became infeasible while parked are shed
+        instead of admitted (their slot stays free, and the shed does not
+        advance the tenant's vtime — no service was rendered). Returns
+        waiters to notify OUTSIDE the lock."""
         to_notify: List[_Waiter] = []
         now = self._clock()
         lanes = sorted(self._lanes.values(), key=lambda l: l.rank)
         for lane in lanes:
-            while lane.stack and self._inflight < self.limiter.limit_int():
-                waiter = lane.stack.pop()  # LIFO: newest first
+            while lane.depth > 0 and self._inflight < self.limiter.limit_int():
+                tq = min((q for q in lane.queues.values() if q.depth > 0),
+                         key=lambda q: q.vtime)
+                waiter = tq.stack.pop()  # LIFO: newest first
                 if waiter.state != _WAITING:
-                    continue  # cancelled: depth already decremented
+                    continue  # cancelled: depths already decremented
+                tq.depth -= 1
                 lane.depth -= 1
                 if self._deadline_infeasible(waiter.deadline, now):
                     waiter.state = _SHED
@@ -589,14 +704,20 @@ class AdmissionController:
                     to_notify.append(waiter)
                     continue
                 waiter.state = _ADMITTED
+                lane.vclock = max(lane.vclock, tq.vtime)
+                tq.vtime += 1.0 / tq.weight
                 self._inflight += 1
                 lane.admitted_total += 1
                 self.admitted_total += 1
                 to_notify.append(waiter)
         return to_notify
 
-    def _release(self, latency_s: Optional[float], ok: bool) -> None:
+    def _release(self, latency_s: Optional[float], ok: bool,
+                 tenant: Optional[str] = None) -> None:
         self.limiter.on_result(latency_s, ok)
+        if self.tenancy is not None and not (latency_s is None and ok):
+            # neutral releases (no signal) skip the tenant's SLO window
+            self.tenancy.on_result(tenant, latency_s, ok)
         with self._lock:
             self._inflight = max(0, self._inflight - 1)
             to_notify = self._drain_locked()
@@ -614,7 +735,7 @@ class AdmissionController:
                 to_notify = self._drain_locked()
 
     def _admit_or_park(self, priority: int, deadline: Optional[float],
-                       loop=None) -> Any:
+                       loop=None, tenant: Optional[str] = None) -> Any:
         """Shared front half of the sync/async acquire: fast-path admit
         (returns a token), immediate shed (raises), or a parked waiter
         (returned for the caller to wait on). One lock acquisition
@@ -624,6 +745,17 @@ class AdmissionController:
         the waiter is published, so a racing wakeup always has something
         to notify)."""
         label, rank = self._lane_map(priority or 0)
+        # the quota gate runs FIRST and unconditionally — even on an idle
+        # controller. A quota is policy, not a load response: an
+        # over-quota tenant is denied whether or not capacity is free,
+        # with the bucket's refill eta as the honest retry hint
+        if self.tenancy is not None:
+            quota_ok, refill_eta = self.tenancy.try_take(tenant)
+            if not quota_ok:
+                with self._lock:
+                    lane = self._lane(label, rank)
+                raise self._shed(lane, SHED_OVER_QUOTA,
+                                 retry_after_s=refill_eta, tenant=tenant)
         # deadline feasibility is judged ONLY when saturated (below): an
         # idle controller always admits, even a request the minRTT EWMA
         # says is doomed — a wrong estimate then costs one admitted
@@ -645,24 +777,28 @@ class AdmissionController:
                 shed_reason = SHED_DEADLINE
             elif self.shed_low_when_saturated and label == LANE_LOW:
                 shed_reason = SHED_SATURATED
-            elif self.max_queue == 0 or lane.depth >= self.max_queue:
+            elif (self.max_queue == 0
+                  or self._tenant_queue_locked(lane, tenant).depth
+                  >= self.max_queue):
+                # the bound is per TENANT queue: one tenant's backlog
+                # fills its own queue, never the whole lane's
                 shed_reason = SHED_QUEUE_FULL
             else:
-                waiter = _Waiter(label, rank, deadline)
+                waiter = _Waiter(label, rank, deadline, tenant)
                 if loop is None:
                     waiter.event = threading.Event()
                 else:
                     waiter.loop = loop
                     waiter.future = loop.create_future()
-                lane.stack.append(waiter)
-                lane.depth += 1
+                self._park_locked(lane, waiter)
         if admitted:
-            self._observe_admit(label, 0.0)
-            return AdmissionToken(self, label, 0.0)
+            self._observe_admit(label, 0.0, tenant)
+            return AdmissionToken(self, label, 0.0, tenant)
         if waiter is not None:
             return waiter
         raise self._shed(lane, shed_reason,
-                         retry_after_s=self.limiter.eta_s())
+                         retry_after_s=self.limiter.eta_s(),
+                         tenant=tenant)
 
     def _wait_bound_s(self, deadline: Optional[float]) -> float:
         """How long a waiter may park: the queue-wait cap, clamped so a
@@ -686,14 +822,18 @@ class AdmissionController:
                 waiter.state = _CANCELLED
                 lane = self._lanes[waiter.lane]
                 lane.depth -= 1
-                # remove the tombstone NOW: drain pops newest-first, so a
-                # cancelled waiter buried under live ones would otherwise
-                # sit in the deque forever — unbounded growth exactly
-                # during the sustained saturation this module exists for
-                try:
-                    lane.stack.remove(waiter)
-                except ValueError:
-                    pass  # already popped (and skipped) by a drain
+                tq = lane.queues.get(waiter.tenant)
+                if tq is not None:
+                    tq.depth -= 1
+                    # remove the tombstone NOW: drain pops newest-first,
+                    # so a cancelled waiter buried under live ones would
+                    # otherwise sit in the deque forever — unbounded
+                    # growth exactly during the sustained saturation this
+                    # module exists for
+                    try:
+                        tq.stack.remove(waiter)
+                    except ValueError:
+                        pass  # already popped (and skipped) by a drain
                 return _CANCELLED, None
             return state, reason
 
@@ -704,38 +844,48 @@ class AdmissionController:
         lane = self._lanes[waiter.lane]
         if state == _ADMITTED:
             waited = (time.perf_counter_ns() - waiter.enqueued_ns) * 1e-9
-            self._observe_admit(waiter.lane, waited)
-            return AdmissionToken(self, waiter.lane, waited)
+            self._observe_admit(waiter.lane, waited, waiter.tenant)
+            return AdmissionToken(self, waiter.lane, waited, waiter.tenant)
         if state == _SHED:
-            raise self._shed(lane, reason or SHED_DEADLINE)
+            raise self._shed(lane, reason or SHED_DEADLINE,
+                             tenant=waiter.tenant)
         raise self._shed(lane, SHED_QUEUE_TIMEOUT,
-                         retry_after_s=self.limiter.eta_s())
+                         retry_after_s=self.limiter.eta_s(),
+                         tenant=waiter.tenant)
 
-    def _force_admit(self, priority: int) -> AdmissionToken:
+    def _force_admit(self, priority: int,
+                     tenant: Optional[str] = None) -> AdmissionToken:
         """Unconditional admission (still counted in-flight): established
         sequences use it — shedding step k of a sequence the server
         already holds state for would poison replica-local state, which
-        is strictly worse than the overload it would relieve."""
+        is strictly worse than the overload it would relieve. The
+        tenant's quota IS still charged (debt bounded at one burst), so
+        a long sequence consumes quota without ever being shed."""
         label, rank = self._lane_map(priority or 0)
+        if self.tenancy is not None:
+            self.tenancy.charge(tenant)
         with self._lock:
             lane = self._lane(label, rank)
             self._inflight += 1
             lane.admitted_total += 1
             self.admitted_total += 1
-        self._observe_admit(label, 0.0)
-        return AdmissionToken(self, label, 0.0)
+        self._observe_admit(label, 0.0, tenant)
+        return AdmissionToken(self, label, 0.0, tenant)
 
     # -- sync acquire ---------------------------------------------------------
     def acquire(self, priority: int = 0,
                 deadline: Optional[float] = None,
-                force: bool = False) -> AdmissionToken:
+                force: bool = False,
+                tenant: Optional[str] = None) -> AdmissionToken:
         """Admit one request or raise :class:`AdmissionRejected`.
         ``deadline`` is an absolute ``time.monotonic`` instant (the
         request's budget), enabling deadline-aware shedding. ``force``
-        admits unconditionally (never sheds, still counts in-flight)."""
+        admits unconditionally (never sheds, still counts in-flight).
+        ``tenant`` selects the tenant's virtual queue and quota (None:
+        the tenantless default queue)."""
         if force:
-            return self._force_admit(priority)
-        parked = self._admit_or_park(priority, deadline)
+            return self._force_admit(priority, tenant)
+        parked = self._admit_or_park(priority, deadline, tenant=tenant)
         if isinstance(parked, AdmissionToken):
             return parked
         waiter: _Waiter = parked
@@ -749,16 +899,18 @@ class AdmissionController:
     # -- async acquire --------------------------------------------------------
     async def acquire_async(self, priority: int = 0,
                             deadline: Optional[float] = None,
-                            force: bool = False) -> AdmissionToken:
+                            force: bool = False,
+                            tenant: Optional[str] = None) -> AdmissionToken:
         """Asyncio twin of :meth:`acquire`. Cancellation mid-wait returns
         the slot if the wakeup raced the cancel — a cancelled caller can
         never leak admission."""
         import asyncio
 
         if force:
-            return self._force_admit(priority)
+            return self._force_admit(priority, tenant)
         parked = self._admit_or_park(
-            priority, deadline, loop=asyncio.get_running_loop())
+            priority, deadline, loop=asyncio.get_running_loop(),
+            tenant=tenant)
         if isinstance(parked, AdmissionToken):
             return parked
         waiter: _Waiter = parked
@@ -773,12 +925,12 @@ class AdmissionController:
             state, reason = self._settle_waiter(waiter)
             if state == _ADMITTED:
                 # the wakeup won the race: give the slot back
-                self._release(None, True)
+                self._release(None, True, waiter.tenant)
             elif state == _SHED:
                 # a drain shed this waiter just before the cancel landed:
                 # the shed HAPPENED — count it (the built exception is
                 # discarded; the caller sees its CancelledError)
                 self._shed(self._lanes[waiter.lane],
-                           reason or SHED_DEADLINE)
+                           reason or SHED_DEADLINE, tenant=waiter.tenant)
             raise
         return self._finish_wait(waiter)

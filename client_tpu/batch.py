@@ -178,7 +178,13 @@ def plan_request(inputs, kwargs):
         # hint the pool pops before the wire — requests differing only by
         # session key produce identical answers, so they may share a
         # batch row, a singleflight, and a cache entry (the dispatched
-        # request carries the first caller's key)
+        # request carries the first caller's key).
+        # tenant= is deliberately NOT excluded: folding it here is THE
+        # cross-tenant isolation point — cache keys, singleflight groups
+        # and coalesced batches all partition by tenant in this one
+        # place, so tenant A can never be served (or collapse onto)
+        # tenant B's response. Tenantless callers (tenant=None) fall
+        # under the `v is not None` filter and keep byte-identical keys.
         if k not in ("request_id", "outputs", "resilience", "affinity_key")
         and v is not None
         and not (k in ("sequence_id", "sequence_start", "sequence_end",

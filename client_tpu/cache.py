@@ -117,11 +117,12 @@ class _CacheEntry:
     releases them, after which views raise ``ArenaLeaseReleased``."""
 
     __slots__ = ("key", "model", "response", "outputs", "nbytes",
-                 "inserted_at", "hits")
+                 "inserted_at", "hits", "tenant")
 
     def __init__(self, key: str, model: str, response: Dict[str, Any],
                  outputs: Dict[str, Tuple[str, Tuple[int, ...], Any]],
-                 nbytes: int, inserted_at: float):
+                 nbytes: int, inserted_at: float,
+                 tenant: Optional[str] = None):
         self.key = key
         self.model = model
         self.response = response
@@ -129,6 +130,7 @@ class _CacheEntry:
         self.nbytes = nbytes
         self.inserted_at = inserted_at
         self.hits = 0
+        self.tenant = tenant
 
     def release(self) -> None:
         from .arena import ArenaError
@@ -217,7 +219,19 @@ class ResponseCache:
 
     Entries are arena-staged (``ShmArena.stage``) so hits serve zero-copy
     lease-pinned views. Thread-safe; all methods are one short lock.
-    ``clock`` is injectable for deterministic TTL tests."""
+    ``clock`` is injectable for deterministic TTL tests.
+
+    **Tenant partitioning**: the byte/entry watermarks are split into
+    per-tenant PARTITIONS — eviction only ever reclaims within the
+    inserting tenant's partition, so one tenant's zipf churn can never
+    evict another tenant's hot set. A tenant's byte budget is its
+    ``TenantSpec.cache_bytes`` when a ``tenancy`` policy declares one,
+    else an equal share (``max_bytes // partitions``); entry budgets are
+    always equal shares. With a single partition (the tenantless default)
+    the split is the whole watermark — byte-identical legacy behavior.
+    Isolation of CONTENT (tenant A never *served* tenant B's response)
+    does not live here: the tenant is folded into the content key by
+    ``batch.plan_request``, so cross-tenant keys never collide."""
 
     def __init__(
         self,
@@ -226,6 +240,7 @@ class ResponseCache:
         max_entries: int = 4096,
         stale_while_revalidate_s: float = 0.0,
         arena=None,
+        tenancy=None,
         clock=time.monotonic,
     ):
         if ttl_s <= 0:
@@ -243,10 +258,17 @@ class ResponseCache:
         self.max_entries = int(max_entries)
         self.stale_while_revalidate_s = float(stale_while_revalidate_s)
         self.arena = arena
+        self.tenancy = tenancy
         self._clock = clock
         self._lock = threading.Lock()
         self._entries: "OrderedDict[str, _CacheEntry]" = OrderedDict()
         self._bytes = 0
+        # tenant partitions: a partition exists from the first insert
+        # under that tenant and persists (budgets stay stable even when a
+        # partition momentarily empties)
+        self._partitions: set = set()
+        self._tenant_bytes: Dict[Optional[str], int] = {}
+        self._tenant_entries: Dict[Optional[str], int] = {}
         self._stats = {
             "hits": 0, "misses": 0, "stale_hits": 0, "insertions": 0,
             "uncacheable": 0, "invalidations": 0,
@@ -254,6 +276,70 @@ class ResponseCache:
                           "oversize": 0},
         }
         _CACHES.add(self)
+
+    # -- partition accounting ----------------------------------------------
+    def _account_remove_locked(self, entry: _CacheEntry) -> None:
+        self._bytes -= entry.nbytes
+        t = entry.tenant
+        self._tenant_bytes[t] = self._tenant_bytes.get(t, 0) - entry.nbytes
+        self._tenant_entries[t] = self._tenant_entries.get(t, 0) - 1
+
+    def _account_add_locked(self, entry: _CacheEntry) -> None:
+        self._bytes += entry.nbytes
+        t = entry.tenant
+        self._tenant_bytes[t] = self._tenant_bytes.get(t, 0) + entry.nbytes
+        self._tenant_entries[t] = self._tenant_entries.get(t, 0) + 1
+
+    def _partition_budget_locked(
+            self, tenant: Optional[str]) -> Tuple[int, int]:
+        """The partition's ``(byte_budget, entry_budget)``: the declared
+        ``cache_bytes`` when a tenancy policy carries one for this
+        tenant, else an equal share of the watermark. One partition
+        (the tenantless default) gets the whole cache."""
+        nparts = max(1, len(self._partitions))
+        byte_budget = self.max_bytes // nparts
+        entry_budget = max(1, self.max_entries // nparts)
+        if self.tenancy is not None:
+            declared = self.tenancy.spec(tenant).cache_bytes
+            if declared:
+                byte_budget = declared
+        return max(1, byte_budget), entry_budget
+
+    def _evict_tenant_locked(self, tenant: Optional[str],
+                             victims: List[_CacheEntry],
+                             newcomer: Optional[_CacheEntry] = None) -> None:
+        """Reclaim the tenant's partition down to its budget — oldest of
+        THIS tenant first, other tenants' entries untouchable."""
+        byte_budget, entry_budget = self._partition_budget_locked(tenant)
+        while (self._tenant_bytes.get(tenant, 0) > byte_budget
+               or self._tenant_entries.get(tenant, 0) > entry_budget):
+            victim_key = next(
+                (k for k, e in self._entries.items() if e.tenant == tenant),
+                None)
+            if victim_key is None:
+                break
+            victim = self._entries[victim_key]
+            if victim is newcomer:
+                # the newcomer alone busts the partition against a hot
+                # survivor set: stop — nothing older of ours remains
+                break
+            del self._entries[victim_key]
+            self._account_remove_locked(victim)
+            self._stats["evictions"]["capacity"] += 1
+            victims.append(victim)
+
+    def _register_partition_locked(self, tenant: Optional[str],
+                                   victims: List[_CacheEntry]) -> None:
+        """First insert under a new tenant: the equal-share budgets
+        shrank for every existing partition — trim them NOW so the new
+        tenant's guaranteed share is actually free, not hostage to
+        whoever filled the cache first."""
+        if tenant in self._partitions:
+            return
+        self._partitions.add(tenant)
+        for other in self._partitions:
+            if other != tenant:
+                self._evict_tenant_locked(other, victims)
 
     # -- lookup ------------------------------------------------------------
     def lookup(self, key: str) -> Tuple[str, Optional[_CacheEntry]]:
@@ -282,7 +368,7 @@ class ResponseCache:
                     self._stats["stale_hits"] += 1
                     return "stale", entry
                 released = self._entries.pop(key)
-                self._bytes -= released.nbytes
+                self._account_remove_locked(released)
                 self._stats["evictions"]["ttl"] += 1
                 self._stats["misses"] += 1
                 return "miss", None
@@ -304,11 +390,14 @@ class ResponseCache:
             return s.item() if s.size else b""
         return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
 
-    def insert(self, key: str, model: str, result) -> Optional[_CacheEntry]:
+    def insert(self, key: str, model: str, result,
+               tenant: Optional[str] = None) -> Optional[_CacheEntry]:
         """Stage one successful response into the cache; returns the new
         entry, or None when the response is uncacheable (an output whose
         payload the client cannot decode — e.g. a non-arena shm region).
-        Errors must never reach here: the wrapper only inserts successes."""
+        Errors must never reach here: the wrapper only inserts successes.
+        ``tenant`` selects the partition charged (and reclaimed from) —
+        eviction never crosses into another tenant's partition."""
         outputs: Dict[str, Tuple[str, Tuple[int, ...], Any]] = {}
         out_rows: List[Dict[str, Any]] = []
         nbytes = 0
@@ -346,41 +435,37 @@ class ResponseCache:
             for _, _, lease in outputs.values():
                 lease.release()
             raise
-        if nbytes > self.max_bytes:
-            for _, _, lease in outputs.values():
-                lease.release()
-            with self._lock:
-                self._stats["evictions"]["oversize"] += 1
-            return None
         header = {k: v for k, v in response.items()
                   if k != "raw_output_contents"}
         header["outputs"] = out_rows
         entry = _CacheEntry(key, model, header, outputs, nbytes,
-                            self._clock())
+                            self._clock(), tenant)
         victims: List[_CacheEntry] = []
+        oversize = False
         with self._lock:
-            old = self._entries.pop(key, None)
-            if old is not None:
-                victims.append(old)
-                self._bytes -= old.nbytes
-                self._stats["evictions"]["replaced"] += 1
-            self._entries[key] = entry
-            self._bytes += entry.nbytes
-            self._stats["insertions"] += 1
-            while (self._bytes > self.max_bytes
-                   or len(self._entries) > self.max_entries):
-                victim_key, victim = self._entries.popitem(last=False)
-                if victim is entry:
-                    # the newcomer alone busts the watermark against a
-                    # hot survivor set: re-admit nothing, count it evicted
-                    self._entries[victim_key] = victim
-                    break
-                victims.append(victim)
-                self._bytes -= victim.nbytes
-                self._stats["evictions"]["capacity"] += 1
+            self._register_partition_locked(tenant, victims)
+            byte_budget, _ = self._partition_budget_locked(tenant)
+            if nbytes > byte_budget:
+                # oversize is judged against the PARTITION's budget: a
+                # response no amount of own-partition eviction could fit
+                self._stats["evictions"]["oversize"] += 1
+                oversize = True
+            else:
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    victims.append(old)
+                    self._account_remove_locked(old)
+                    self._stats["evictions"]["replaced"] += 1
+                self._entries[key] = entry
+                self._account_add_locked(entry)
+                self._stats["insertions"] += 1
+                self._evict_tenant_locked(tenant, victims, newcomer=entry)
+        if oversize:
+            for _, _, lease in outputs.values():
+                lease.release()
         for victim in victims:
             victim.release()
-        return entry
+        return None if oversize else entry
 
     # -- invalidation ------------------------------------------------------
     def invalidate(self, model: Optional[str] = None,
@@ -398,7 +483,7 @@ class ResponseCache:
                           if model is None or e.model == model]:
                     victims.append(self._entries.pop(k))
             for victim in victims:
-                self._bytes -= victim.nbytes
+                self._account_remove_locked(victim)
             self._stats["invalidations"] += len(victims)
         for victim in victims:
             victim.release()
@@ -419,6 +504,19 @@ class ResponseCache:
             lookups = s["hits"] + s["stale_hits"] + s["misses"]
             s["hit_rate"] = (round((s["hits"] + s["stale_hits"]) / lookups, 4)
                              if lookups else None)
+            # per-tenant partition rows, only once a real (non-None)
+            # tenant has inserted — tenantless stats stay byte-identical
+            if any(t is not None for t in self._partitions):
+                s["tenants"] = {
+                    (t if t is not None else "_default"): {
+                        "bytes_resident": self._tenant_bytes.get(t, 0),
+                        "entries": self._tenant_entries.get(t, 0),
+                        "byte_budget":
+                            self._partition_budget_locked(t)[0],
+                    }
+                    for t in sorted(self._partitions,
+                                    key=lambda t: (t is None, t or ""))
+                }
         return s
 
 
@@ -488,6 +586,7 @@ class _CachingCore:
         max_entries: int = 4096,
         stale_while_revalidate_s: float = 0.0,
         arena=None,
+        tenancy=None,
         telemetry=None,
     ):
         """``client``: an existing frontend/pool/batching client to wrap,
@@ -495,7 +594,10 @@ class _CachingCore:
         :class:`ResponseCache` to share, ``True`` to build one from
         ``ttl_s``/``max_bytes``/``max_entries``/
         ``stale_while_revalidate_s``/``arena``, or ``None``/``False`` for
-        singleflight-only operation (no entries retained). ``telemetry``:
+        singleflight-only operation (no entries retained). ``tenancy``:
+        a ``client_tpu.tenancy.TenancyPolicy`` whose per-tenant
+        ``cache_bytes`` declarations size the cache's tenant partitions
+        (forwarded to the built :class:`ResponseCache`). ``telemetry``:
         an ``observe.Telemetry``; when omitted the inner client's is
         adopted."""
         if isinstance(client, str):
@@ -507,7 +609,7 @@ class _CachingCore:
             cache = ResponseCache(
                 ttl_s=ttl_s, max_bytes=max_bytes, max_entries=max_entries,
                 stale_while_revalidate_s=stale_while_revalidate_s,
-                arena=arena)
+                arena=arena, tenancy=tenancy)
         elif cache is False:
             cache = None
         self._cache: Optional[ResponseCache] = cache
@@ -816,7 +918,8 @@ class CachingClient(_CachingCore):
             error = e
         t2 = time.perf_counter_ns()
         if error is None and self._cache is not None:
-            entry = self._cache.insert(key, model_name, result)
+            entry = self._cache.insert(key, model_name, result,
+                                           tenant=kwargs.get("tenant"))
         self._count(model_name, "miss")
         self._finish_span(span, t0, t1, t2, "miss", error=error)
         if error is not None:
@@ -833,7 +936,8 @@ class CachingClient(_CachingCore):
         t2 = time.perf_counter_ns()
         if error is None and self._cache is not None:
             try:
-                entry = self._cache.insert(key, model_name, result)
+                entry = self._cache.insert(key, model_name, result,
+                                           tenant=kwargs.get("tenant"))
             except BaseException as e:
                 # a broken insert (arena closed mid-flight) must not turn
                 # a SERVED answer into an error — serve the wire result
@@ -877,7 +981,8 @@ class CachingClient(_CachingCore):
                 error = e
             if error is None and self._cache is not None:
                 try:
-                    entry = self._cache.insert(key, model_name, result)
+                    entry = self._cache.insert(key, model_name, result,
+                                           tenant=kwargs.get("tenant"))
                 except Exception:
                     entry = None
             with self._flights_lock:
@@ -1026,7 +1131,8 @@ class AioCachingClient(_CachingCore):
         t2 = time.perf_counter_ns()
         if error is None and self._cache is not None:
             try:
-                entry = self._cache.insert(key, model_name, result)
+                entry = self._cache.insert(key, model_name, result,
+                                           tenant=kwargs.get("tenant"))
             except Exception:
                 entry = None
         if flight is not None:
@@ -1065,7 +1171,8 @@ class AioCachingClient(_CachingCore):
                 error = e
             if error is None and self._cache is not None:
                 try:
-                    entry = self._cache.insert(key, model_name, result)
+                    entry = self._cache.insert(key, model_name, result,
+                                           tenant=kwargs.get("tenant"))
                 except Exception:
                     entry = None
             self._flights.pop(key, None)

@@ -277,6 +277,25 @@ def _cache_status() -> List[Dict[str, Any]]:
     return rows
 
 
+def _tenancy_status() -> List[Dict[str, Any]]:
+    """One row per live tenancy policy (``client_tpu.tenancy``): per-tenant
+    admitted/shed totals, quota token level, SLO burn window and the
+    noisy-neighbor verdicts. Empty when the process never loaded the
+    tenancy layer — lazy, like the cache section."""
+    import sys
+
+    tenancy_mod = sys.modules.get("client_tpu.tenancy")
+    if tenancy_mod is None:
+        return []
+    rows = []
+    for policy in tenancy_mod.policies():
+        try:
+            rows.append(policy.snapshot())
+        except Exception as e:
+            rows.append({"error": str(e)[:200]})
+    return rows
+
+
 def _flight_status(tel: Telemetry) -> Optional[Dict[str, Any]]:
     """The flight-recorder section: retention accounting, the rolling
     tail-divergence verdict, and the newest anomalous timelines in
@@ -502,6 +521,27 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
                            f"{insertions} insertions with hit rate "
                            f"{hit_rate:.0%} — the working set exceeds "
                            f"max_bytes={row.get('max_bytes')}")})
+    # noisy neighbor: a tenant's over-quota sheds dwarf what it was
+    # admitted — it is offering far beyond its declared rate, and only
+    # the tenancy layer (token buckets + weighted-fair queues) stands
+    # between its excess and the compliant tenants' capacity. Named per
+    # tenant: the verdict comes from the policy's own counters, so it
+    # holds even when the neighbors' latencies look healthy (isolation
+    # working is not a reason to hide who is being isolated).
+    for row in snap.get("tenancy", []) or []:
+        if "error" in row:
+            continue
+        for verdict in row.get("noisy_neighbors", []) or []:
+            flags.append({
+                "flag": "noisy_neighbor", "url": None,
+                "tenant": verdict.get("tenant"),
+                "detail": (f"tenant {verdict.get('tenant')!r}: "
+                           f"{verdict.get('over_quota_sheds')} over-quota "
+                           f"sheds vs {verdict.get('admitted_total')} "
+                           f"admitted (offered/admitted ~"
+                           f"{verdict.get('offered_over_admitted')}x) — "
+                           f"quotas are shedding its excess; compliant "
+                           f"tenants keep their weighted share")})
     # affinity skew: one endpoint owns far more than its fair share of
     # the affinity key universe — hot keys are concentrating (a zipfian
     # workload's hottest keys hashed together, or the fleet shrank and
@@ -745,6 +785,7 @@ def collect_snapshot(
             "batch": _registry_section(
                 registry_snapshot, "client_tpu_batch"),
             "cache": _cache_status(),
+            "tenancy": _tenancy_status(),
             "flight": _flight_status(tel),
             "shm": _local_shm(recorder),
         }
@@ -970,6 +1011,25 @@ def render_summary(snap: Dict[str, Any]) -> str:
                 f"hit_rate={'n/a' if hit_rate is None else f'{hit_rate:.0%}'} "
                 f"evictions={sum(ev.values())} "
                 f"(capacity={ev.get('capacity', 0)} ttl={ev.get('ttl', 0)})")
+    tenancy_rows = snap.get("tenancy") or []
+    if tenancy_rows:
+        lines.append("")
+        lines.append("tenancy:")
+        for row in tenancy_rows:
+            if "error" in row:
+                lines.append(f"  tenancy: {row['error']}")
+                continue
+            for label, t in sorted((row.get("tenants") or {}).items()):
+                window = t.get("window") or {}
+                sheds = sum((t.get("shed") or {}).values())
+                tokens = t.get("quota_tokens")
+                burn = window.get("burn_rate")
+                lines.append(
+                    f"  {label:<16} admitted={t.get('admitted_total', 0)} "
+                    f"shed={sheds} "
+                    f"tokens={'n/a' if tokens is None else f'{tokens:.1f}'} "
+                    f"burn={'n/a' if burn is None else f'{burn:.2f}x'}"
+                    f"{'  BREACHED' if window.get('breached') else ''}")
     aff_stats = {url: s["affinity"]
                  for url, s in snap.get("endpoint_stats", {}).items()
                  if s.get("affinity")}

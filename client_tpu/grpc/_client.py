@@ -501,8 +501,13 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters: Optional[Dict[str, Any]] = None,
         compression_algorithm: Optional[str] = None,
         resilience=None,
+        tenant: Optional[str] = None,
     ) -> InferResult:
         span = self._obs_begin(self._FRONTEND, model_name)
+        if span is not None and tenant is not None:
+            # client-side QoS attribution only (see client_tpu.tenancy);
+            # the tenant is never sent on the wire
+            span.event("tenant", tenant=tenant)
         timers = RequestTimers()
         timers.capture(RequestTimers.REQUEST_START)
         actx = None

@@ -360,8 +360,13 @@ class InferenceServerClient(InferenceServerClientBase):
         parameters: Optional[Dict[str, Any]] = None,
         compression_algorithm: Optional[str] = None,
         resilience=None,
+        tenant: Optional[str] = None,
     ) -> InferResult:
         span = self._obs_begin(self._FRONTEND, model_name)
+        if span is not None and tenant is not None:
+            # client-side QoS attribution only (see client_tpu.tenancy);
+            # the tenant is never sent on the wire
+            span.event("tenant", tenant=tenant)
         actx = None
         try:
             # arena data plane: promote staged binary inputs into leased

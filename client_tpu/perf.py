@@ -117,6 +117,7 @@ class PerfRunner:
         admission_mode: str = "aimd",
         admission_target_ms: Optional[float] = None,
         admission_max_queue_wait_s: float = 0.05,
+        tenancy: Optional[str] = None,
         endpoint_limits: bool = False,
         shard_layout=None,
         cache: bool = False,
@@ -183,6 +184,11 @@ class PerfRunner:
         self.admission_mode = admission_mode
         self.admission_target_ms = admission_target_ms
         self.admission_max_queue_wait_s = admission_max_queue_wait_s
+        # multi-tenant QoS (client_tpu.tenancy): a parse_tenancy_spec
+        # string arming per-tenant weighted-fair queueing + quotas on the
+        # pool's admission controller; trace replay threads each record's
+        # ``tenant`` (format v4) through the client stack
+        self.tenancy = tenancy
         self.endpoint_limits = endpoint_limits
         # hot-key serving layer (client_tpu.cache): wrap measurement
         # clients in the singleflight/response-cache wrapper; replay
@@ -337,6 +343,10 @@ class PerfRunner:
             raise ValueError(
                 "--affinity-key requires --routing affinity (and "
                 "--endpoints): the key only steers the affinity policy")
+        if self.tenancy is not None and not self.admission:
+            raise ValueError(
+                "--tenancy requires --admission: tenant quotas and "
+                "weighted-fair queueing live in the admission controller")
         if self.cells:
             if protocol not in ("http", "grpc"):
                 raise ValueError(
@@ -513,11 +523,18 @@ class PerfRunner:
             "probe_timeout_s": 0.5,
             "endpoint_retry": (RetryPolicy(max_attempts=self.retries + 1)
                                if self.retries else None),
-            # admission=True builds a FRESH controller inside each cell's
-            # pool — one shared controller would meter the cells jointly
-            # and hide exactly the per-cell saturation the federation
+            # admission=True (or the kwargs-dict form, when tenancy is
+            # armed) builds a FRESH controller inside each cell's pool —
+            # one shared controller would meter the cells jointly and
+            # hide exactly the per-cell saturation the federation
             # spills on
-            "admission": True if self.admission else None,
+            "admission": (
+                {"mode": self.admission_mode,
+                 "target_ms": self.admission_target_ms,
+                 "max_queue_wait_s": self.admission_max_queue_wait_s,
+                 "tenancy": self.tenancy}
+                if self.admission and self.tenancy is not None
+                else True if self.admission else None),
             "endpoint_limits": True if self.endpoint_limits else None,
         }
         shadow = None
@@ -571,7 +588,8 @@ class PerfRunner:
             admission = AdmissionController(
                 mode=self.admission_mode,
                 target_ms=self.admission_target_ms,
-                max_queue_wait_s=self.admission_max_queue_wait_s)
+                max_queue_wait_s=self.admission_max_queue_wait_s,
+                tenancy=self.tenancy)
         return PoolClient(
             self.endpoints,
             protocol=self.protocol,
@@ -1515,7 +1533,9 @@ class PerfRunner:
             wait_healthy = getattr(client, "wait_healthy", None)
             if wait_healthy is not None:
                 wait_healthy(timeout_s=10.0)
-            outcomes: List[Tuple[str, str, float, float, float]] = []
+            outcomes: List[Tuple[str, str, float, float, float,
+                                 Optional[str], Optional[str],
+                                 Optional[float]]] = []
             errors: List[str] = []
             stop = threading.Event()
             barrier = threading.Barrier(replay_workers + 1)
@@ -1657,9 +1677,16 @@ class PerfRunner:
                             gate.broken = True
                         gate.next = max(gate.next, rec.seq_index + 1)
                         gate.cond.notify_all()
+            # shed attribution rides the outcome tuple: the typed
+            # rejection's reason and honest retry_after hint (possibly
+            # wrapped in a sharded failure's ``cause``)
+            shed_exc = (getattr(outcome, "cause", None) or outcome
+                        if status == "shed" else None)
             outcomes.append(
                 (rec.kind, status, time.perf_counter() - t1, lag,
-                 rec.at_s / speed))
+                 rec.at_s / speed, getattr(rec, "tenant", None),
+                 getattr(shed_exc, "reason", None),
+                 getattr(shed_exc, "retry_after_s", None)))
             if on_result is not None:
                 on_result(rec, outcome)
 
@@ -1672,12 +1699,24 @@ class PerfRunner:
             return {"affinity_key": f"k{rec.content_key}"}
         return {}
 
+    def _replay_tenant_kw(self, rec) -> Dict[str, Any]:
+        """The replay's tenant kwarg: a tenant-attributed record (format
+        v4) carries its tenant through the whole client stack — admission
+        queues/quotas, cache partitions and batch compat keys all judge
+        it as that tenant. Tenantless records pass no kwarg at all, so a
+        mixed trace exercises both paths."""
+        tenant = getattr(rec, "tenant", None)
+        if tenant is not None:
+            return {"tenant": tenant}
+        return {}
+
     def _replay_dispatch(self, client, rec, resources):
         if rec.kind == "sharded":
             # the measurement client IS the ShardedClient in shard mode
             return client.infer(
                 rec.model, resources.inputs_for(rec),
-                model_version=rec.version)
+                model_version=rec.version,
+                **self._replay_tenant_kw(rec))
         # non-sharded kinds bypass the scatter-gather wrapper (a sharded
         # client types-rejects streams and would scatter plain unaries)
         client = getattr(client, "inner", client)
@@ -1686,7 +1725,8 @@ class PerfRunner:
             for event in client.generate_stream(
                     rec.model, resources.stream_payload(rec),
                     model_version=rec.version,
-                    **self._replay_affinity_kw(rec)):
+                    **self._replay_affinity_kw(rec),
+                    **self._replay_tenant_kw(rec)):
                 events.append(event)
             return events
         inputs = resources.inputs_for(rec)
@@ -1696,9 +1736,11 @@ class PerfRunner:
                 model_version=rec.version,
                 sequence_id=rec.seq_group,
                 sequence_start=rec.seq_index == 0,
-                sequence_end=rec.seq_index == rec.seq_len - 1)
+                sequence_end=rec.seq_index == rec.seq_len - 1,
+                **self._replay_tenant_kw(rec))
         return client.infer(rec.model, inputs, model_version=rec.version,
-                            **self._replay_affinity_kw(rec))
+                            **self._replay_affinity_kw(rec),
+                            **self._replay_tenant_kw(rec))
 
     @staticmethod
     def _kind_row(samples: Dict[Tuple[str, str], List[float]],
@@ -1723,12 +1765,34 @@ class PerfRunner:
         lags: List[float] = []
         all_ok_lat: List[float] = []
         arrival_window = 0.0
-        for kind, status, lat_s, lag_s, at_rel_s in outcomes:
+        # per-tenant accounting (format v4 records): status counts, ok
+        # latencies and shed-reason breakdown, keyed by tenant label
+        tenant_rows: Dict[str, Dict[str, Any]] = {}
+        retry_hints: List[float] = []
+        for (kind, status, lat_s, lag_s, at_rel_s,
+             tenant, shed_reason, retry_after_s) in outcomes:
             kind_counts[kind] = kind_counts.get(kind, 0) + 1
             counts[(kind, status)] = counts.get((kind, status), 0) + 1
             samples.setdefault((kind, status), []).append(lat_s)
             if status == "ok":
                 all_ok_lat.append(lat_s)
+            if retry_after_s is not None:
+                retry_hints.append(float(retry_after_s))
+            if tenant is not None:
+                row = tenant_rows.setdefault(tenant, {
+                    "issued": 0, "ok": 0, "errors": 0, "shed": 0,
+                    "shed_by_reason": {}, "_lat": []})
+                row["issued"] += 1
+                if status == "ok":
+                    row["ok"] += 1
+                    row["_lat"].append(lat_s)
+                elif status == "shed":
+                    row["shed"] += 1
+                    reason = shed_reason or "unknown"
+                    row["shed_by_reason"][reason] = (
+                        row["shed_by_reason"].get(reason, 0) + 1)
+                else:
+                    row["errors"] += 1
             lags.append(lag_s)
             # actual arrival offset (scheduled + slip): the window the
             # schedule was REALLY issued over, free of the service/drain
@@ -1833,6 +1897,25 @@ class PerfRunner:
             "slo": slo_rows,
             "slo_ok": all(row["attained"] for row in slo_rows),
         }
+        if tenant_rows:
+            # only when the trace carried tenant-attributed records:
+            # tenantless replays keep byte-identical result rows
+            result["tenants"] = {
+                t: {
+                    "issued": row["issued"],
+                    "ok": row["ok"],
+                    "errors": row["errors"],
+                    "shed": row["shed"],
+                    "shed_by_reason": row["shed_by_reason"],
+                    "latency_ms": _latency_ms_row(sorted(row["_lat"])),
+                }
+                for t, row in sorted(tenant_rows.items())
+            }
+        if retry_hints:
+            # the honest backpressure story: every shed's retry_after_s
+            # hint (bucket refill eta / limiter minRTT eta), as ms
+            result["shed_retry_after_ms"] = _latency_ms_row(
+                sorted(retry_hints))
         return self._batch_result(self._observe_result(result), batch_stats)
 
 
@@ -2066,6 +2149,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="SLO latency target the limiter defends (default: a minRTT "
              "EWMA tolerance band)")
     parser.add_argument(
+        "--tenancy", default=None,
+        help="per-tenant QoS spec for the admission controller "
+             "(client_tpu.tenancy; requires --admission), e.g. "
+             "'t0,rate=50,weight=2;adv0,rate=50': weighted-fair "
+             "queueing + token-bucket quotas; over-quota requests shed "
+             "typed over_quota with an honest retry_after. Trace replay "
+             "threads each record's tenant (format v4) automatically")
+    parser.add_argument(
         "--endpoint-limits", action="store_true",
         help="arm a per-endpoint adaptive concurrency limit (selection "
              "skips replicas at their limit; requires --endpoints)")
@@ -2173,6 +2264,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         admission=args.admission,
         admission_mode=args.admission_mode,
         admission_target_ms=args.admission_target_ms,
+        tenancy=args.tenancy,
         endpoint_limits=args.endpoint_limits,
         shard_layout=args.shard_layout,
         cache=args.cache,

@@ -942,6 +942,11 @@ class _PoolClientBase:
         self._telemetry = telemetry
         if admission is True:
             admission = AdmissionController()
+        elif isinstance(admission, dict):
+            # kwargs form, so layers that build one pool per cell
+            # (federation's pool_kwargs) can arm per-pool controllers —
+            # sharing one instance would merge queues across cells
+            admission = AdmissionController(**admission)
         self._admission = admission
         if endpoint_limits is True:
             endpoint_limits = AdaptiveLimiter
@@ -1016,6 +1021,9 @@ class _PoolClientBase:
             if self._admission is not None:
                 # shed/admit counters + limit/inflight/queue-depth gauges
                 telemetry.attach_admission(self._admission)
+                if getattr(self._admission, "tenancy", None) is not None:
+                    # per-tenant admitted/shed/quota/burn gauges
+                    self._admission.tenancy.attach_telemetry(telemetry)
         self._hedge = hedge
         self._hedge_executor_workers = (
             hedge_executor_workers
@@ -1510,7 +1518,8 @@ class PoolClient(_PoolClientBase):
         raise last
 
     # -- admission gate -------------------------------------------------------
-    def _admission_begin(self, kwargs, sequence_id: int):
+    def _admission_begin(self, kwargs, sequence_id: int,
+                         tenant: Optional[str] = None):
         """Acquire the pool-level admission slot (or raise the typed
         ``AdmissionRejected``). Established sequences force-admit:
         shedding a step of server-held sequence state would poison it.
@@ -1521,7 +1530,8 @@ class PoolClient(_PoolClientBase):
         deadline = self._admission_deadline(kwargs.get("client_timeout"))
         t0_ns = time.perf_counter_ns()
         token = ctrl.acquire(
-            kwargs.get("priority") or 0, deadline, force=force)
+            kwargs.get("priority") or 0, deadline, force=force,
+            tenant=tenant)
         if token.waited_s and self._telemetry is not None:
             # only worth stashing when a span can claim it; an unclaimed
             # stash would sit in the contextvar waiting to pollute some
@@ -1559,6 +1569,9 @@ class PoolClient(_PoolClientBase):
         the flight-recorder wrapper above owns exactly one scratch per
         logical pool request, sheds included)."""
         affinity_key = kwargs.pop("affinity_key", None)
+        # the tenant is a CLIENT-side QoS dimension (like affinity_key):
+        # popped here so it never reaches the wire, judged by admission
+        tenant = kwargs.pop("tenant", None)
         sequence_id = kwargs.get("sequence_id", 0)
         if self._admission is None:
             try:
@@ -1567,7 +1580,7 @@ class PoolClient(_PoolClientBase):
             except AdmissionRejected as e:
                 self._admission_note_shed(e)  # endpoint-limiter shed
                 raise
-        token = self._admission_begin(kwargs, sequence_id)
+        token = self._admission_begin(kwargs, sequence_id, tenant)
         t0 = time.monotonic()
         try:
             result = self._infer_routed(model_name, inputs, kwargs,
@@ -1822,6 +1835,7 @@ class PoolClient(_PoolClientBase):
         on its key's home replica, so a re-opened generation finds its
         KV cache."""
         affinity_key = kwargs.pop("affinity_key", None)
+        tenant = kwargs.pop("tenant", None)
         try:
             ep = self.pool.select(affinity_key=affinity_key)
         except AdmissionRejected as e:
@@ -1836,7 +1850,7 @@ class PoolClient(_PoolClientBase):
             token = None
             if self._admission is not None:
                 try:
-                    token = self._admission.acquire()
+                    token = self._admission.acquire(tenant=tenant)
                 except AdmissionRejected as e:
                     self._admission_note_shed(e)
                     raise
@@ -2075,14 +2089,16 @@ class AioPoolClient(_PoolClientBase):
         raise last
 
     # -- admission gate -------------------------------------------------------
-    async def _admission_begin(self, kwargs, sequence_id: int):
+    async def _admission_begin(self, kwargs, sequence_id: int,
+                               tenant: Optional[str] = None):
         """Async twin of the sync gate (see ``PoolClient._admission_begin``)."""
         ctrl = self._admission
         force = bool(sequence_id) and not self._seq_repin_allowed(sequence_id)
         deadline = self._admission_deadline(kwargs.get("client_timeout"))
         t0_ns = time.perf_counter_ns()
         token = await ctrl.acquire_async(
-            kwargs.get("priority") or 0, deadline, force=force)
+            kwargs.get("priority") or 0, deadline, force=force,
+            tenant=tenant)
         if token.waited_s and self._telemetry is not None:
             # see the sync twin: stash only when a span can claim it
             stash_admission_phase(t0_ns, time.perf_counter_ns())
@@ -2107,6 +2123,7 @@ class AioPoolClient(_PoolClientBase):
     async def _infer_gated(self, model_name: str, inputs, kwargs):
         """Async twin of the sync ``_infer_gated`` split."""
         affinity_key = kwargs.pop("affinity_key", None)
+        tenant = kwargs.pop("tenant", None)
         sequence_id = kwargs.get("sequence_id", 0)
         if self._admission is None:
             try:
@@ -2115,7 +2132,7 @@ class AioPoolClient(_PoolClientBase):
             except AdmissionRejected as e:
                 self._admission_note_shed(e)  # endpoint-limiter shed
                 raise
-        token = await self._admission_begin(kwargs, sequence_id)
+        token = await self._admission_begin(kwargs, sequence_id, tenant)
         t0 = time.monotonic()
         try:
             result = await self._infer_routed(model_name, inputs, kwargs,
@@ -2243,6 +2260,7 @@ class AioPoolClient(_PoolClientBase):
         replica under ``routing="affinity"``."""
         self._ensure_prober()  # streaming-only pools still need health
         affinity_key = kwargs.pop("affinity_key", None)
+        tenant = kwargs.pop("tenant", None)
         try:
             ep = self.pool.select(affinity_key=affinity_key)
         except AdmissionRejected as e:
@@ -2255,7 +2273,7 @@ class AioPoolClient(_PoolClientBase):
             token = None
             if self._admission is not None:
                 try:
-                    token = await self._admission.acquire_async()
+                    token = await self._admission.acquire_async(tenant=tenant)
                 except AdmissionRejected as e:
                     self._admission_note_shed(e)
                     raise

@@ -19,7 +19,10 @@ gives the perf harness something replayable:
   rules) and issue its steps in order. ``sharded`` records (format v2,
   stamped per record so v1 loaders skip-and-count them) are logical
   scatter-gather requests replayed through ``perf.py --shard-layout``
-  (``client_tpu.shard``).
+  (``client_tpu.shard``). Records may carry a ``tenant`` attribution
+  (format v4, stamped per record) that the replayer threads through the
+  client's admission/cache/batch layers as the multi-tenant QoS
+  dimension — it never reaches the wire.
 
 - **Versioning**: the header's ``version`` is the format version; a
   *record* may carry its own ``v`` — records (and whole traces) from a
@@ -28,7 +31,8 @@ gives the perf harness something replayable:
   1-based line number (:class:`TraceParseError`).
 
 - **Generators** (:func:`poisson_burst`, :func:`heavy_tail`,
-  :func:`mixed`, or :func:`generate` from a ``name:k=v,...`` spec string):
+  :func:`mixed`, :func:`multi_tenant`, or :func:`generate` from a
+  ``name:k=v,...`` spec string):
   each is a pure function of ``(seed, duration, params)`` over ONE
   ``numpy.random.Generator`` — the same seed and spec always produce a
   byte-identical trace (see :func:`dumps_trace`), so traces are
@@ -53,7 +57,7 @@ import numpy as np
 # a v1 reader still loads the v1-compatible records of a mixed trace, and
 # only records carrying newer-versioned semantics stamp their own ``v``
 # (the PR 8 forward-compat rule: skip-and-count, never fatal)
-TRACE_VERSION = 3
+TRACE_VERSION = 4
 BASE_VERSION = 1
 # record kinds introduced after the base format stamp their records with
 # the version that introduced them
@@ -61,6 +65,11 @@ _KIND_VERSIONS = {"sharded": 2}
 # records carrying a zipfian ``content_key`` (the hot-key workload knob)
 # stamp v=3: a v2 loader skips exactly these, counted, and keeps the rest
 _CONTENT_KEY_VERSION = 3
+# records carrying a ``tenant`` attribution (the multi-tenant QoS knob)
+# stamp v=4 — same rule: an older loader skips exactly the tenant-stamped
+# records (counted), and tenantless specs keep producing byte-identical
+# traces (no tenant field, no version stamp)
+_TENANT_VERSION = 4
 
 KINDS = ("unary", "generate_stream", "sequence", "sharded")
 
@@ -114,6 +123,11 @@ class TraceRecord:
     # client-side cache/singleflight layer has real hot keys to collapse;
     # it also doubles as the session key for ``routing="affinity"``
     content_key: Optional[int] = None
+    # multi-tenant workloads (format v4): the requesting tenant — the
+    # replayer threads it as ``infer(tenant=...)`` so admission quotas,
+    # weighted-fair drain and cache partitions see the same tenant mix
+    # the generator declared. None (the default) stamps nothing.
+    tenant: Optional[str] = None
 
     def to_obj(self) -> Dict[str, Any]:
         obj: Dict[str, Any] = {
@@ -140,6 +154,9 @@ class TraceRecord:
         if self.content_key is not None:
             obj["content_key"] = int(self.content_key)
             v = max(v, _CONTENT_KEY_VERSION)
+        if self.tenant is not None:
+            obj["tenant"] = str(self.tenant)
+            v = max(v, _TENANT_VERSION)
         if v > BASE_VERSION:
             # newer-versioned records stamp their own version so an older
             # reader skips exactly these (counted) and keeps the rest
@@ -222,6 +239,12 @@ class TraceRecord:
                     line, "content_key must be an integer") from None
             if kwargs["content_key"] < 0:
                 raise TraceParseError(line, "content_key must be >= 0")
+        if "tenant" in obj:
+            tenant = obj["tenant"]
+            if not isinstance(tenant, str) or not tenant:
+                raise TraceParseError(
+                    line, "tenant must be a non-empty string")
+            kwargs["tenant"] = tenant
         return cls(**kwargs)
 
 
@@ -638,11 +661,67 @@ def sharded(seed: int = 0, duration_s: float = 10.0, rate: float = 20.0,
                                     period_s, duty)]
 
 
+def multi_tenant(seed: int = 0, duration_s: float = 10.0,
+                 tenants: int = 2, rate: float = 20.0,
+                 adversaries: int = 0, adversary_factor: float = 10.0,
+                 burst_factor: float = 1.0, period_s: float = 2.0,
+                 duty: float = 0.25, model: str = "simple",
+                 hot_key_alpha: float = 1.1,
+                 hot_key_universe: int = 0,
+                 shapes: Optional[Dict[str, List[int]]] = None,
+                 dtypes: Optional[Dict[str, str]] = None
+                 ) -> List[TraceRecord]:
+    """Multi-tenant unary traffic (format v4): ``tenants`` compliant
+    tenants (``t0..tN-1``) each arriving Poisson at ``rate`` req/s, plus
+    ``adversaries`` adversarial tenants (``adv0..``) each offering
+    ``rate * adversary_factor`` — the noisy neighbor whose excess a
+    quota must shed. Each tenant's arrival stream (and key draws) comes
+    from its OWN child generator ``default_rng((seed, index))``, so
+    adding an adversary never perturbs the compliant tenants' arrivals —
+    the isolated and adversarial bench arms replay literally identical
+    compliant traffic.
+
+    ``hot_key_universe > 0`` draws a zipf ``content_key`` per record
+    from a universe DELIBERATELY SHARED across tenants: two tenants
+    constantly request the same hot content, so any cache hit, collapse
+    or coalesce that crosses a tenant boundary would be exercised — the
+    tenant-in-key isolation (``batch.plan_request``) is what this
+    workload proves."""
+    if tenants < 1:
+        raise ValueError("tenants must be >= 1")
+    if adversaries < 0:
+        raise ValueError("adversaries must be >= 0")
+    if adversary_factor <= 0.0:
+        raise ValueError("adversary_factor must be > 0")
+    shapes, dtypes = _layout(model, shapes, dtypes)
+    pmf = _zipf_pmf(hot_key_alpha, hot_key_universe) \
+        if hot_key_universe else None
+    names = [f"t{i}" for i in range(tenants)]
+    names += [f"adv{i}" for i in range(adversaries)]
+    records: List[TraceRecord] = []
+    for index, name in enumerate(names):
+        trng = np.random.default_rng((int(seed), int(index)))
+        tenant_rate = rate * (adversary_factor
+                              if name.startswith("adv") else 1.0)
+        for t in _arrival_times(trng, duration_s, tenant_rate,
+                                burst_factor, period_s, duty):
+            key = (int(trng.choice(hot_key_universe, p=pmf))
+                   if pmf is not None else None)
+            records.append(TraceRecord(
+                at_s=t, kind="unary", model=model,
+                shapes=shapes, dtypes=dtypes,
+                content_key=key, tenant=name))
+    # stable by arrival: equal offsets keep per-tenant insertion order
+    records.sort(key=lambda r: r.at_s)
+    return records
+
+
 GENERATORS = {
     "poisson_burst": poisson_burst,
     "heavy_tail": heavy_tail,
     "mixed": mixed,
     "sharded": sharded,
+    "multi_tenant": multi_tenant,
 }
 
 # spec params that must stay strings when parsed from a spec

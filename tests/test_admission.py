@@ -600,7 +600,9 @@ def test_cancelled_waiters_leave_no_tombstones():
     for t in threads:
         t.join()
     with ctrl._lock:
-        assert all(len(lane.stack) == 0 for lane in ctrl._lanes.values())
+        assert all(len(tq.stack) == 0
+                   for lane in ctrl._lanes.values()
+                   for tq in lane.queues.values())
         assert all(lane.depth == 0 for lane in ctrl._lanes.values())
     tok.release(0.01)
 

@@ -775,10 +775,13 @@ def test_hot_key_records_round_trip_and_forward_compat():
     loaded = trace_mod.loads_trace(text)
     assert [r.content_key for r in loaded.records] == \
         [r.content_key for r in recs]
-    # a record from a NEWER format (v4) is skipped, counted, never fatal
+    # a record from a NEWER format than this loader understands is
+    # skipped, counted, never fatal (version-relative so format bumps
+    # cannot silently turn the probe record into a loadable one)
+    future_v = trace_mod.TRACE_VERSION + 1
     newer = text + ('{"at_s":0.5,"content_key":1,"kind":"unary",'
                     '"model":"m","dtypes":{"X":"FP32"},"shapes":{"X":[1]},'
-                    '"type":"request","v":4}\n')
+                    '"type":"request","v":%d}\n' % future_v)
     l2 = trace_mod.loads_trace(newer)
     assert l2.skipped == 1 and len(l2.records) == len(recs)
 

@@ -405,6 +405,27 @@ def federation_recheck(baseline: str, duration_s: float,
     return 0
 
 
+def tenancy_recheck(duration_s: float, attempts: int) -> int:
+    """Re-RUN the committed multi-tenant isolation proof live
+    (``BENCH_TENANCY.json``, tools/bench_tenancy.py): both arms on a
+    shortened twin of the workload — the compliant tenants must keep
+    >=95% of their isolated-arm capacity under the 10x-quota adversary,
+    the adversary's rejects must stay cleanly typed ``over_quota``, and
+    the tenancy snapshot must still name the noisy neighbor."""
+    import tools.bench_tenancy as bench
+
+    verdict = bench.probe_isolation(duration_s=duration_s,
+                                    attempts=attempts)
+    print(json.dumps({"attempts": verdict["attempts"]}, indent=2))
+    if verdict["problems"]:
+        print("FAIL: multi-tenant isolation no longer holds live:")
+        for p in verdict["problems"]:
+            print(f"  - {p}")
+        return 1
+    print("OK: tenant isolation proof reproduces")
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--baseline", default="BENCH_CAPACITY.json")
@@ -440,8 +461,16 @@ def main() -> int:
                              "attain the declared SLOs")
     parser.add_argument("--federation-baseline",
                         default="BENCH_FEDERATION.json")
+    parser.add_argument("--tenancy", action="store_true",
+                        help="re-run the committed multi-tenant isolation "
+                             "proof live (BENCH_TENANCY.json): compliant "
+                             "capacity within 5%% of isolated under the "
+                             "10x-quota adversary, sheds typed over_quota, "
+                             "noisy neighbor named")
     args = parser.parse_args()
 
+    if args.tenancy:
+        return tenancy_recheck(args.duration_s, args.attempts)
     if args.federation:
         return federation_recheck(args.federation_baseline,
                                   args.duration_s, args.attempts)

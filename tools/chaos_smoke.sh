@@ -51,17 +51,23 @@
 # federation_smoke marker) runs a 2-cell fleet whose WHOLE home cell
 # blackholes mid-run (one ChaosCell call): every request must still
 # succeed via transparent spillover, the cell breaker must open, and
-# traffic must return home after heal.
+# traffic must return home after heal. The tenancy smoke (tests/
+# test_tenancy.py, tenancy_smoke marker) replays an adversarial tenant
+# at 10x its quota against compliant tenants through the weighted-fair
+# admission controller: compliant capacity within 5% of the isolated
+# baseline, zero compliant SLO breaches, the adversary's rejects all
+# typed over_quota, and the noisy neighbor named in the tenancy
+# snapshot.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
     tests/test_dataplane_observe.py tests/test_trace_replay.py \
     tests/test_arena.py tests/test_admission.py tests/test_shard.py \
     tests/test_hotkey_cache.py tests/test_flight.py \
-    tests/test_federation.py "$@"
+    tests/test_federation.py tests/test_tenancy.py "$@"
