@@ -86,6 +86,15 @@ client libraries (triton-inference-server/client), designed TPU-first:
   pool, staged zero-copy via the arena, and gathered with exactness
   asserts; a lost shard fails the whole request with a typed
   ``ShardFailed`` (docs/sharding.md).
+- ``client_tpu.disagg``: disaggregated prefill/decode serving —
+  ``DisaggClient``/``AioDisaggClient`` route the prefill infer to a
+  ``role="prefill"`` replica and the decode stream to a ``role="decode"``
+  one, handing the KV cache off through the shared arena under a
+  digest-verified ``KvHandoff`` manifest (mismatch = typed
+  ``HandoffCorrupt``); a decode replica dying mid-stream recovers by
+  idempotent re-prefill with every token delivered exactly once, and a
+  degraded role falls back to monolithic serving behind a typed
+  ``RoleFallback`` event (docs/disaggregation.md).
 - ``client_tpu.utils``: Triton<->numpy dtype mapping with *native* bfloat16
   (via ml_dtypes), BYTES/BF16 wire serialization.
 - ``client_tpu.utils.shared_memory``: POSIX system shared memory data plane.

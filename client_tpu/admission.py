@@ -735,7 +735,8 @@ class AdmissionController:
                 to_notify = self._drain_locked()
 
     def _admit_or_park(self, priority: int, deadline: Optional[float],
-                       loop=None, tenant: Optional[str] = None) -> Any:
+                       loop=None, tenant: Optional[str] = None,
+                       lane: Optional[Tuple[str, int]] = None) -> Any:
         """Shared front half of the sync/async acquire: fast-path admit
         (returns a token), immediate shed (raises), or a parked waiter
         (returned for the caller to wait on). One lock acquisition
@@ -743,8 +744,12 @@ class AdmissionController:
         sections could otherwise strand a fresh waiter until timeout.
         ``loop`` non-None builds an asyncio waiter (future created BEFORE
         the waiter is published, so a racing wakeup always has something
-        to notify)."""
-        label, rank = self._lane_map(priority or 0)
+        to notify). ``lane`` overrides the priority→lane mapping with an
+        explicit ``(label, rank)`` — the disaggregated prefill/decode
+        layer charges its two legs to separate lanes this way (their
+        SLOs differ); lanes are created lazily, no registration needed."""
+        label, rank = lane if lane is not None \
+            else self._lane_map(priority or 0)
         # the quota gate runs FIRST and unconditionally — even on an idle
         # controller. A quota is policy, not a load response: an
         # over-quota tenant is denied whether or not capacity is free,
@@ -854,14 +859,16 @@ class AdmissionController:
                          tenant=waiter.tenant)
 
     def _force_admit(self, priority: int,
-                     tenant: Optional[str] = None) -> AdmissionToken:
+                     tenant: Optional[str] = None,
+                     lane: Optional[Tuple[str, int]] = None) -> AdmissionToken:
         """Unconditional admission (still counted in-flight): established
         sequences use it — shedding step k of a sequence the server
         already holds state for would poison replica-local state, which
         is strictly worse than the overload it would relieve. The
         tenant's quota IS still charged (debt bounded at one burst), so
         a long sequence consumes quota without ever being shed."""
-        label, rank = self._lane_map(priority or 0)
+        label, rank = lane if lane is not None \
+            else self._lane_map(priority or 0)
         if self.tenancy is not None:
             self.tenancy.charge(tenant)
         with self._lock:
@@ -876,16 +883,21 @@ class AdmissionController:
     def acquire(self, priority: int = 0,
                 deadline: Optional[float] = None,
                 force: bool = False,
-                tenant: Optional[str] = None) -> AdmissionToken:
+                tenant: Optional[str] = None,
+                lane: Optional[Tuple[str, int]] = None) -> AdmissionToken:
         """Admit one request or raise :class:`AdmissionRejected`.
         ``deadline`` is an absolute ``time.monotonic`` instant (the
         request's budget), enabling deadline-aware shedding. ``force``
         admits unconditionally (never sheds, still counts in-flight).
         ``tenant`` selects the tenant's virtual queue and quota (None:
-        the tenantless default queue)."""
+        the tenantless default queue). ``lane`` is an explicit
+        ``(label, rank)`` override of the priority→lane mapping (lanes
+        are created lazily): the disaggregated prefill/decode layer
+        charges its legs to separate lanes whose SLOs differ."""
         if force:
-            return self._force_admit(priority, tenant)
-        parked = self._admit_or_park(priority, deadline, tenant=tenant)
+            return self._force_admit(priority, tenant, lane=lane)
+        parked = self._admit_or_park(priority, deadline, tenant=tenant,
+                                     lane=lane)
         if isinstance(parked, AdmissionToken):
             return parked
         waiter: _Waiter = parked
@@ -900,17 +912,19 @@ class AdmissionController:
     async def acquire_async(self, priority: int = 0,
                             deadline: Optional[float] = None,
                             force: bool = False,
-                            tenant: Optional[str] = None) -> AdmissionToken:
+                            tenant: Optional[str] = None,
+                            lane: Optional[Tuple[str, int]] = None,
+                            ) -> AdmissionToken:
         """Asyncio twin of :meth:`acquire`. Cancellation mid-wait returns
         the slot if the wakeup raced the cancel — a cancelled caller can
         never leak admission."""
         import asyncio
 
         if force:
-            return self._force_admit(priority, tenant)
+            return self._force_admit(priority, tenant, lane=lane)
         parked = self._admit_or_park(
             priority, deadline, loop=asyncio.get_running_loop(),
-            tenant=tenant)
+            tenant=tenant, lane=lane)
         if isinstance(parked, AdmissionToken):
             return parked
         waiter: _Waiter = parked

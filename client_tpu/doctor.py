@@ -40,7 +40,7 @@ import numpy as np
 
 from . import observe
 from .observe import StatsCorrelator, Telemetry
-from .pool import PoolClient
+from .pool import EndpointSpec, PoolClient
 from .utils import InferenceServerException, sorted_percentile, triton_to_np_dtype
 
 __all__ = ["collect_snapshot", "postmortem_bundle", "render_summary",
@@ -440,6 +440,20 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
                            "has zero failover headroom — every logical "
                            "request fails (typed ShardFailed) until this "
                            "replica recovers")})
+    # disaggregated prefill/decode: a serving role with members but ZERO
+    # routable ones means every role-aware session is degrading to the
+    # monolithic fallback path — correct but silent capacity loss; the
+    # pool's RoleFallback counter is the traffic-is-actually-flowing proof
+    for role, row in (snap.get("roles") or {}).items():
+        if row.get("endpoints", 0) > 0 and not row.get("available"):
+            fallbacks = row.get("fallbacks", 0)
+            detail = (f"role {role!r}: 0/{row['endpoints']} endpoints "
+                      f"routable — role-aware traffic is falling back to "
+                      f"monolithic serving")
+            if fallbacks:
+                detail += f" ({fallbacks} RoleFallback events counted)"
+            flags.append({"flag": "role_degraded", "url": None,
+                          "role": role, "detail": detail})
     for slo in snap.get("slos", []):
         if slo["breached"]:
             flags.append({
@@ -668,6 +682,7 @@ def collect_snapshot(
     client_factory: Optional[Callable[[str], Any]] = None,
     shard_layout=None,
     cells=None,
+    roles=None,
 ) -> Dict[str, Any]:
     """Probe the fleet and return the full snapshot dict (JSON-ready).
 
@@ -693,14 +708,35 @@ def collect_snapshot(
     flags. With an empty ``urls``, the per-endpoint probe section covers
     the cells' urls. A caller-supplied ``telemetry`` that already has an
     application federation attached surfaces it in the same section —
-    its LIVE spill counters, not the probe's."""
+    its LIVE spill counters, not the probe's.
+
+    ``roles``: a ``{role: [urls]}`` dict (or its spec string,
+    ``"prefill=u1+u2;decode=u3"``) labeling endpoints with serving
+    roles (``client_tpu.disagg``): the probe pool is built with
+    role-labeled ``EndpointSpec``s, the snapshot gains a ``roles``
+    section (per-role endpoint/healthy counts, availability, counted
+    RoleFallback events), and ``role_degraded`` is flagged for any role
+    with members but zero routable ones — the state in which every
+    role-aware session silently degrades to monolithic serving. With an
+    empty ``urls``, the probe covers the roles' urls."""
     if isinstance(cells, str):
         from .federation import parse_cells_spec
 
         cells = parse_cells_spec(cells)
+    if isinstance(roles, str):
+        # same "name=u1+u2;name2=u3" grammar as --cells
+        from .federation import parse_cells_spec
+
+        roles = parse_cells_spec(roles)
     urls = list(urls)
     if cells and not urls:
         urls = [u for cell_urls in cells.values() for u in cell_urls]
+    if roles and not urls:
+        urls = [u for role_urls in roles.values() for u in role_urls]
+    role_by_url: Dict[str, str] = {}
+    for role, role_urls in (roles or {}).items():
+        for u in role_urls:
+            role_by_url[u] = role
     if isinstance(shard_layout, str):
         from .shard import ShardLayout
 
@@ -726,7 +762,8 @@ def collect_snapshot(
     if client_factory is None:
         client_factory = _bounded_client_factory(protocol, probe_timeout_s)
     fed = None
-    pool = PoolClient(list(urls), protocol=protocol, telemetry=tel,
+    pool_urls = [EndpointSpec(u, role=role_by_url.get(u)) for u in urls]
+    pool = PoolClient(pool_urls, protocol=protocol, telemetry=tel,
                       health_interval_s=None,
                       client_factory=client_factory)
     try:
@@ -795,6 +832,9 @@ def collect_snapshot(
                                                     probe_timeout_s)
         if shard_layout is not None:
             snap["shard"] = _shard_section(shard_layout, snap)
+        role_summary = pool.health_summary().get("roles")
+        if role_summary:
+            snap["roles"] = role_summary
         snap["shm"]["server_regions"] = server_shm
         dp = snap["shm"]["dataplane"]
         if dp is not None and dataplane_before is not None:
@@ -923,6 +963,19 @@ def render_summary(snap: Dict[str, Any]) -> str:
             lines.append(
                 f"  shard {row['shard']}: {row['url']:<24} {state}"
                 f"{('  ' + ' '.join(extra)) if extra else ''}")
+    roles = snap.get("roles")
+    if roles:
+        lines.append("")
+        lines.append("roles (disaggregated prefill/decode):")
+        for role, row in roles.items():
+            state = "available" if row.get("available") else "DEGRADED"
+            extra = ""
+            if row.get("fallbacks"):
+                extra = f"  fallbacks={row['fallbacks']}"
+            lines.append(
+                f"  {role:<10} {state:<10} healthy "
+                f"{row.get('healthy', '?')}/{row.get('endpoints', '?')}"
+                f"{extra}")
     for fedrow in snap.get("cells") or []:
         if "error" in fedrow:
             lines.append("")
@@ -1136,6 +1189,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "plus the cell_down/spillover_active/"
                              "canary_burning anomaly flags "
                              "(client_tpu.federation)")
+    parser.add_argument("--roles", default=None, metavar="SPEC",
+                        help="role-labeled snapshot for a disaggregated "
+                             "prefill/decode fleet: "
+                             "'prefill=u1+u2;decode=u3' labels the probe "
+                             "pool's endpoints, adds the per-role section "
+                             "(healthy counts, availability, RoleFallback "
+                             "events) and flags role_degraded for any "
+                             "role with zero routable members "
+                             "(client_tpu.disagg)")
     parser.add_argument("--timeout", type=float, default=10.0,
                         help="per-call timeout (s) bounding every snapshot "
                              "RPC: health probes, probe infers, stats "
@@ -1152,8 +1214,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--fail-on-anomaly", action="store_true",
                         help="exit 1 when any anomaly is flagged")
     args = parser.parse_args(argv)
-    if not args.urls and not args.cells:
-        parser.error("give replica urls, or --cells 'a=u1+u2;b=u3'")
+    if not args.urls and not args.cells and not args.roles:
+        parser.error("give replica urls, --cells 'a=u1+u2;b=u3', or "
+                     "--roles 'prefill=u1;decode=u2'")
 
     tel = None
     if args.postmortem_path:
@@ -1168,7 +1231,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         telemetry=tel,
         churn_threshold_ops_s=args.churn_threshold,
         skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout,
-        shard_layout=args.shard_layout, cells=args.cells)
+        shard_layout=args.shard_layout, cells=args.cells,
+        roles=args.roles)
     print(render_summary(snap))
     if args.json_path:
         with open(args.json_path, "w") as f:

@@ -11,6 +11,7 @@ TPU VM: the same wire contracts, but the compute runs on XLA.
 from .base import Model, TensorSpec
 from .decoder_batched import BatchedDecoderModel
 from .decoder_prefill import PrefillDecoderModel
+from .disagg import DisaggPrefillModel, KvDecodeModel
 from .ensemble import EnsembleModel, EnsembleStep, build_image_ensemble
 from .generate import TinyGenerateModel
 from .simple import (
@@ -25,9 +26,11 @@ from .simple import (
 __all__ = [
     "AddSubModel",
     "BatchedDecoderModel",
+    "DisaggPrefillModel",
     "EnsembleModel",
     "EnsembleStep",
     "IdentityModel",
+    "KvDecodeModel",
     "Model",
     "PrefillDecoderModel",
     "RepeatModel",

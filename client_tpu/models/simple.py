@@ -210,6 +210,7 @@ def default_model_zoo() -> List[Model]:
     from .decoder import TinyDecoderModel
     from .decoder_batched import BatchedDecoderModel
     from .decoder_prefill import PrefillDecoderModel
+    from .disagg import DisaggPrefillModel, KvDecodeModel
     from .generate import TinyGenerateModel
 
     decoder = TinyDecoderModel()
@@ -232,4 +233,9 @@ def default_model_zoo() -> List[Model]:
         # scatter-gather client's batch-axis targets (client_tpu/shard.py)
         PrefillDecoderModel(tp=False),
         PrefillDecoderModel(tp=True),
+        # disaggregated prefill/decode pair (client_tpu/disagg.py): KV
+        # export + decode-from-handed-off-KV, sharing the zoo decoder's
+        # weights so the split stream is bit-exact vs tiny_lm_generate
+        DisaggPrefillModel(decoder=decoder),
+        KvDecodeModel(decoder=decoder),
     ]

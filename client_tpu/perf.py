@@ -135,6 +135,7 @@ class PerfRunner:
         canary_min_events: int = 20,
         cells_deadline_s: Optional[float] = 5.0,
         cells_attempt_timeout_s: Optional[float] = None,
+        roles=None,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -217,6 +218,16 @@ class PerfRunner:
         self.canary_min_events = canary_min_events
         self.cells_deadline_s = cells_deadline_s
         self.cells_attempt_timeout_s = cells_attempt_timeout_s
+        # disaggregated prefill/decode (client_tpu.disagg): a
+        # {role: [urls]} dict or its spec string
+        # ("prefill=u1+u2;decode=u3") labeling replay endpoints with
+        # serving roles; trace replay drives ``prefill_decode`` records
+        # (format v5) through a DisaggClient over them
+        if isinstance(roles, str):
+            from .federation import parse_cells_spec
+
+            roles = parse_cells_spec(roles)
+        self.roles = roles
         self.seed = seed
         # sharded scatter-gather (client_tpu.shard): a ShardLayout or a
         # spec string ("IN=0->OUT=0") resolved over --endpoints in order;
@@ -1458,6 +1469,17 @@ class PerfRunner:
                 "trace contains sharded records: configure --shard-layout "
                 "(with --endpoints) so the replayer can scatter them "
                 "(client_tpu.shard)")
+        if any(r.kind == "prefill_decode" for r in records):
+            if self.protocol != "http":
+                raise ValueError(
+                    "trace contains prefill_decode records: the decode "
+                    "leg is an HTTP SSE surface (use -i http)")
+            if not self.roles:
+                raise ValueError(
+                    "trace contains prefill_decode records: configure "
+                    "--roles 'prefill=u1;decode=u2' so the replayer can "
+                    "build a DisaggClient over role-labeled endpoints "
+                    "(client_tpu.disagg)")
         specs: List[SLOSpec] = [
             spec if isinstance(spec, SLOSpec) else parse_slo_spec(spec)
             for spec in slos]
@@ -1510,6 +1532,23 @@ class PerfRunner:
                             specs, on_result, warmup, trace_duration,
                             request_slos) -> Dict[str, Any]:
         resources = _ReplayResources(self, records)
+        if any(r.kind == "prefill_decode" for r in records):
+            # one role-labeled DisaggClient for the whole replay
+            # (telemetry-free: prefill_decode sessions feed request_ms
+            # SLOs per record, like unaries, so warmup sessions land
+            # nothing in the per-run Telemetry)
+            resources.disagg = self._make_disagg_client()
+        try:
+            return self._run_trace_workers(
+                header, records, speed, replay_workers, specs, on_result,
+                warmup, trace_duration, request_slos, resources)
+        finally:
+            if resources.disagg is not None:
+                resources.disagg.close()
+
+    def _run_trace_workers(self, header, records, speed, replay_workers,
+                           specs, on_result, warmup, trace_duration,
+                           request_slos, resources) -> Dict[str, Any]:
         if warmup:
             # warm through a SEPARATE telemetry-free client: server-side
             # jit / model setup is what warmup exists for, and warmup
@@ -1533,6 +1572,8 @@ class PerfRunner:
             wait_healthy = getattr(client, "wait_healthy", None)
             if wait_healthy is not None:
                 wait_healthy(timeout_s=10.0)
+            if resources.disagg is not None:
+                resources.disagg.wait_healthy(timeout_s=10.0)
             outcomes: List[Tuple[str, str, float, float, float,
                                  Optional[str], Optional[str],
                                  Optional[float]]] = []
@@ -1578,6 +1619,21 @@ class PerfRunner:
                 header, records, speed, elapsed, outcomes, errors, specs,
                 batch_stats, resources, request_slos), admission_stats),
             cache_stats), fed_stats)
+
+    def _make_disagg_client(self):
+        """The replay's disaggregated client: a DisaggClient over the
+        ``--roles`` urls (role-labeled) plus any role-less ``--endpoints``
+        (eligible only for the monolithic fallback path)."""
+        from .disagg import DisaggClient
+        from .pool import EndpointSpec
+
+        role_by_url = {u: role for role, urls in self.roles.items()
+                       for u in urls}
+        urls = list(dict.fromkeys(
+            [u for role_urls in self.roles.values() for u in role_urls]
+            + (self.endpoints or [])))
+        specs = [EndpointSpec(u, role=role_by_url.get(u)) for u in urls]
+        return DisaggClient(specs, protocol=self.protocol)
 
     def _replay_warmup(self, client, records, resources) -> None:
         """One best-effort dispatch per distinct (kind, model) BEFORE the
@@ -1717,6 +1773,13 @@ class PerfRunner:
                 rec.model, resources.inputs_for(rec),
                 model_version=rec.version,
                 **self._replay_tenant_kw(rec))
+        if rec.kind == "prefill_decode":
+            # the disagg session runs on its own role-labeled pool; the
+            # measurement client plays no part in either leg
+            tokens = resources.tokens_for(
+                rec.prompt_tokens, getattr(rec, "content_key", None))
+            return list(resources.disagg.generate_stream(
+                tokens, max_tokens=int(rec.output_tokens)))
         # non-sharded kinds bypass the scatter-gather wrapper (a sharded
         # client types-rejects streams and would scatter plain unaries)
         client = getattr(client, "inner", client)
@@ -1951,10 +2014,13 @@ class _ReplayResources:
         self._inputs: Dict[Any, list] = {}
         self._tokens: Dict[Any, list] = {}
         self.seq_gates: Dict[int, _SeqGate] = {}
+        # the replay's DisaggClient (set by the runner when the trace
+        # carries prefill_decode records; closed by the runner)
+        self.disagg = None
         for rec in records:
             if rec.kind == "sequence":
                 self.seq_gates.setdefault(rec.seq_group, _SeqGate())
-            elif rec.kind == "generate_stream":
+            elif rec.kind in ("generate_stream", "prefill_decode"):
                 self.tokens_for(rec.prompt_tokens,
                                 getattr(rec, "content_key", None))
             if rec.shapes is not None:
@@ -2183,6 +2249,13 @@ def main(argv: Optional[List[str]] = None) -> int:
              "(client_tpu.federation); result rows gain "
              "client_federation")
     parser.add_argument(
+        "--roles", default=None, metavar="SPEC",
+        help="role-labeled endpoints for disaggregated prefill/decode "
+             "replay: 'prefill=u1+u2;decode=u3' builds a DisaggClient "
+             "over them so 'prefill_decode' trace records (format v5) "
+             "replay as two-leg sessions (client_tpu.disagg; see "
+             "docs/disaggregation.md)")
+    parser.add_argument(
         "--home-cell", default=None,
         help="the locality-preferred cell (default: first in --cells)")
     parser.add_argument(
@@ -2280,6 +2353,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         canary_weight=args.canary_weight,
         canary_slo=args.canary_slo,
         canary_min_events=args.canary_min_events,
+        roles=args.roles,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside

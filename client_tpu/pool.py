@@ -121,9 +121,11 @@ __all__ = [
     "EndpointHealthChanged",
     "EndpointPool",
     "EndpointReadmitted",
+    "EndpointSpec",
     "HedgePolicy",
     "NoEndpointAvailableError",
     "PoolClient",
+    "RoleFallback",
     "SequenceAbandoned",
     "load_score",
 ]
@@ -276,6 +278,50 @@ class SequenceAbandoned(PoolEvent):
         self.cause = cause
 
 
+class RoleFallback(PoolEvent):
+    """A role-scoped selection found its role empty, saturated or fully
+    unavailable and the caller degraded to role-less (monolithic)
+    serving. Emitted by the disaggregated prefill/decode layer through
+    ``pool.emit`` — degradation is typed and observable, never a silent
+    behavior change. ``url`` is the fallback endpoint that absorbed the
+    request ('' when even the fallback selection failed)."""
+
+    __slots__ = ("role", "reason")
+
+    def __init__(self, url: str, role: str, reason: str):
+        super().__init__(url)
+        self.role = role
+        self.reason = reason
+
+
+class EndpointSpec:
+    """One replica address plus its serving role.
+
+    Pass instances in a pool's ``urls`` list to label endpoints for
+    role-aware selection (disaggregated prefill/decode serving routes
+    prefill and decode to differently-labeled replicas)::
+
+        PoolClient([EndpointSpec("h1:8000", role="prefill"),
+                    EndpointSpec("h2:8000", role="decode")])
+
+    Plain strings stay role-less (``role=None``) and behave exactly as
+    before; role-less endpoints are eligible for every role-less
+    selection and serve as the monolithic fallback tier."""
+
+    __slots__ = ("url", "role")
+
+    def __init__(self, url: str, role: Optional[str] = None):
+        if not url or not isinstance(url, str):
+            raise ValueError("EndpointSpec needs a non-empty url string")
+        if role is not None and (not role or not isinstance(role, str)):
+            raise ValueError("role must be a non-empty string (or None)")
+        self.url = url
+        self.role = role
+
+    def __repr__(self) -> str:
+        return f"EndpointSpec({self.url!r}, role={self.role!r})"
+
+
 class HedgePolicy:
     """When and how to hedge an idempotent infer.
 
@@ -329,7 +375,7 @@ class EndpointState:
     ``orca_weighted`` routing weight (None until the first fresh load)."""
 
     __slots__ = (
-        "url", "client", "policy", "weight", "outstanding", "healthy",
+        "url", "client", "policy", "weight", "role", "outstanding", "healthy",
         "consecutive_failures", "ejected", "ejected_until", "ejection_count",
         "last_ejection_end", "_wrr_current", "limiter", "shed_total",
         "_orca_weight", "affinity_routed", "affinity_rehomed",
@@ -337,11 +383,13 @@ class EndpointState:
     )
 
     def __init__(self, url: str, client: Any, policy: ResiliencePolicy,
-                 weight: float = 1.0, limiter: Optional[AdaptiveLimiter] = None):
+                 weight: float = 1.0, limiter: Optional[AdaptiveLimiter] = None,
+                 role: Optional[str] = None):
         self.url = url
         self.client = client
         self.policy = policy  # breaker + per-endpoint ResilienceStats
         self.weight = weight
+        self.role = role  # serving role label (None = role-less/monolithic)
         self.outstanding = 0
         self.healthy = True
         self.consecutive_failures = 0
@@ -409,6 +457,9 @@ class EndpointPool:
         # at most ceil(N/2) replicas may ever be ejected at once: the pool
         # must degrade (keep trying suspect replicas) before it self-blinds
         self.max_ejected = math.ceil(len(self.endpoints) / 2)
+        # RoleFallback emissions per role (role-aware callers degrading to
+        # monolithic serving); read by health_summary/doctor
+        self.role_fallbacks: Dict[str, int] = {}
         if affinity_bound < 1.0:
             raise ValueError("affinity_bound must be >= 1.0")
         self.affinity_bound = affinity_bound
@@ -428,6 +479,13 @@ class EndpointPool:
 
     # -- events --------------------------------------------------------------
     def emit(self, event: PoolEvent) -> None:
+        if isinstance(event, RoleFallback):
+            # counted whether or not anyone listens: the doctor's
+            # role_degraded anomaly reads this to prove fallback traffic
+            # is actually flowing while a role has no healthy member
+            with self._lock:
+                self.role_fallbacks[event.role] = (
+                    self.role_fallbacks.get(event.role, 0) + 1)
         if self._on_event is None:
             return
         try:
@@ -592,15 +650,30 @@ class EndpointPool:
         self._rr += 1
         return candidates[idx]
 
+    def roles(self) -> Dict[Optional[str], int]:
+        """Endpoint count per role label (``None`` = role-less)."""
+        out: Dict[Optional[str], int] = {}
+        with self._lock:
+            for ep in self.endpoints:
+                out[ep.role] = out.get(ep.role, 0) + 1
+        return out
+
     def select(self, exclude: Sequence[EndpointState] = (),
-               affinity_key: Optional[str] = None) -> EndpointState:
+               affinity_key: Optional[str] = None,
+               role: Optional[str] = None) -> EndpointState:
         """Pick an endpoint under the routing policy, honoring health,
         ejection windows, breaker admission and (when armed) each
         endpoint's adaptive concurrency limit. ``affinity_key`` (with
         ``routing="affinity"``) rendezvous-hashes the key onto its home
         endpoint with deterministic bounded-load fallback — see
         :meth:`_pick_affinity`. ``exclude`` lists
-        endpoints already tried by this call's failover loop. When no
+        endpoints already tried by this call's failover loop.
+        ``role`` restricts the whole selection (healthy AND panic tier)
+        to endpoints carrying that role label — the disaggregated
+        prefill/decode layer routes each leg this way; a role with no
+        members at all raises :class:`NoEndpointAvailableError`
+        immediately (the caller owns the typed fallback to role-less
+        serving). When no
         eligible endpoint remains, panic-routes to a non-excluded endpoint
         whose breaker would still admit (degraded beats unavailable);
         raises :class:`NoEndpointAvailableError` when even that is empty.
@@ -616,12 +689,17 @@ class EndpointPool:
         with self._lock:
             now = self._clock()
             self._readmit_expired(now, events)
+            members = (self.endpoints if role is None
+                       else [ep for ep in self.endpoints if ep.role == role])
+            if role is not None and not members:
+                raise NoEndpointAvailableError(
+                    f"no endpoint with role {role!r} in the pool")
             # healthy tier first, WITHOUT the limiter: whether the pool
             # enters the panic tier must depend on health/ejection/breaker
             # alone — healthy replicas transiently at their adaptive limit
             # must shed, never spill traffic onto an ejected outlier
             healthy = [
-                ep for ep in self.endpoints
+                ep for ep in members
                 if id(ep) not in excluded and not ep.ejected and ep.healthy
                 and (ep.policy.breaker is None
                      or ep.policy.breaker.would_admit())
@@ -638,7 +716,7 @@ class EndpointPool:
                 # ejection, still skip endpoints whose breaker would
                 # fast-fail without touching a socket
                 relaxed = [
-                    ep for ep in self.endpoints
+                    ep for ep in members
                     if id(ep) not in excluded
                     and (ep.policy.breaker is None
                          or ep.policy.breaker.would_admit())
@@ -762,6 +840,7 @@ class EndpointPool:
                 ejected = ep.ejected and ep.ejected_until > now
                 key = ep.url if ep.url not in out else f"{ep.url}#{i}"
                 out[key] = {
+                    "role": ep.role,
                     "healthy": ep.healthy,
                     "ejected": ejected,
                     "ejected_for_s": round(max(0.0, ep.ejected_until - now), 3)
@@ -818,13 +897,17 @@ def _default_client_factory(protocol: str, aio: bool):
 
 def _arena_event_observer(arena, chain=None):
     """Chainable pool observer invalidating the arena's cached shm
-    registrations whenever a replica is ejected or probed unhealthy (it
-    may have restarted and dropped its server-side registrations)."""
+    registrations on BOTH edges of a replica's availability: ejection or
+    an unhealthy probe (it may be about to restart), AND readmission or
+    a healthy-again probe — a replica that healed may have restarted
+    DURING the outage, so a re-prefill (or any re-homed request) landing
+    on the newly-healed endpoint must re-verify its registration instead
+    of trusting the pre-outage cache entry."""
 
     def observer(event: PoolEvent) -> None:
-        if isinstance(event, EndpointEjected) or (
-                isinstance(event, EndpointHealthChanged)
-                and not event.healthy):
+        if isinstance(
+                event, (EndpointEjected, EndpointReadmitted,
+                        EndpointHealthChanged)):
             try:
                 arena.invalidate_endpoint(event.url)
             except Exception:
@@ -916,7 +999,12 @@ class _PoolClientBase:
         dropped and the existing ``SequenceAbandoned`` event fires) — a
         caller that died mid-sequence must not leak its pin forever.
         ``None`` disables the GC."""
-        urls = list(urls)
+        # ``urls`` entries may be plain strings (role-less) or
+        # EndpointSpec instances carrying a serving-role label for
+        # role-aware selection (disaggregated prefill/decode)
+        specs = [u if isinstance(u, EndpointSpec) else EndpointSpec(u)
+                 for u in urls]
+        urls = [s.url for s in specs]
         if not urls:
             raise ValueError("pool needs at least one url")
         if routing not in _ROUTING_POLICIES:
@@ -969,7 +1057,8 @@ class _PoolClientBase:
             on_event = telemetry.pool_observer(chain=on_event)
         endpoints: List[EndpointState] = []
         try:
-            for url, weight in zip(urls, weights):
+            for spec, weight in zip(specs, weights):
+                url = spec.url
                 policy = ResiliencePolicy(
                     retry=endpoint_retry, breaker=breaker_factory())
                 if telemetry is not None:
@@ -989,7 +1078,8 @@ class _PoolClientBase:
                     client.configure_arena(shm_arena)
                 endpoints.append(EndpointState(
                     url, client, policy, weight,
-                    limiter=limiter_factory() if limiter_factory else None))
+                    limiter=limiter_factory() if limiter_factory else None,
+                    role=spec.role))
         except Exception:
             self._abandon(endpoints)
             raise
@@ -1210,6 +1300,7 @@ class _PoolClientBase:
         snap = self.pool.snapshot()
         healthy = ejected = breaker_open = 0
         outstanding = shed_total = 0
+        roles: Dict[str, Dict[str, Any]] = {}
         for stats in snap.values():
             if stats["ejected"]:
                 ejected += 1
@@ -1221,11 +1312,21 @@ class _PoolClientBase:
             open_breaker = state == "open"
             if open_breaker:
                 breaker_open += 1
-            if stats["healthy"] and not stats["ejected"] and not open_breaker:
+            routable = (stats["healthy"] and not stats["ejected"]
+                        and not open_breaker)
+            if routable:
                 healthy += 1
             outstanding += stats["outstanding"]
             shed_total += stats.get("shed_total", 0)
-        return {
+            role = stats.get("role")
+            if role is not None:
+                r = roles.setdefault(
+                    role, {"endpoints": 0, "healthy": 0, "available": False})
+                r["endpoints"] += 1
+                if routable:
+                    r["healthy"] += 1
+                    r["available"] = True
+        out = {
             "endpoints": len(snap),
             "healthy": healthy,
             "ejected": ejected,
@@ -1234,6 +1335,16 @@ class _PoolClientBase:
             "shed_total": shed_total,
             "available": healthy > 0,
         }
+        if roles:
+            # per-role availability (disaggregated prefill/decode): a
+            # role with zero routable members is the doctor's
+            # ``role_degraded`` trigger when fallback traffic flows —
+            # ``fallbacks`` counts the RoleFallback events that prove it
+            with self.pool._lock:
+                for role, r in roles.items():
+                    r["fallbacks"] = self.pool.role_fallbacks.get(role, 0)
+            out["roles"] = roles
+        return out
 
     def endpoint_stats(self) -> Dict[str, Dict[str, Any]]:
         """Per-endpoint snapshot: health, ejection, breaker state,
@@ -1707,6 +1818,34 @@ class PoolClient(_PoolClientBase):
             self.pool.done(ep)
         self.pool.record_success(ep, time.monotonic() - t0)
         return result
+
+    def pinned_generate_stream(self, url: str, *args, **kwargs):
+        """One SSE generate stream against the named replica: no routing,
+        no failover and no pool-level admission gate — the disaggregated
+        prefill/decode layer (``client_tpu.disagg``) pins its decode leg
+        here and owns retry/admission per LOGICAL session. The endpoint's
+        ``outstanding`` slot is held for the life of the iteration and
+        the outcome feeds its breaker/outlier/latency accounting exactly
+        like a routed stream."""
+        ep = self.pool.endpoint_by_url(url)
+        inner = ep.client.generate_stream(*args, **kwargs)  # lazy: no I/O yet
+
+        def stream():
+            self.pool.begin(ep)
+            ok = True
+            try:
+                for item in inner:
+                    yield item
+            except Exception as e:
+                ok = False
+                self._record_attempt_failure(ep, e)
+                raise
+            finally:
+                self.pool.done(ep)
+                if ok:
+                    self.pool.record_success(ep)
+
+        return stream()
 
     def _get_executor(self) -> ThreadPoolExecutor:
         with self._executor_lock:
@@ -2298,6 +2437,30 @@ class AioPoolClient(_PoolClientBase):
                 self.pool.done(ep)
                 if token is not None:
                     token.release()
+                if ok:
+                    self.pool.record_success(ep)
+
+        return stream()
+
+    def pinned_generate_stream(self, url: str, *args, **kwargs):
+        """Async twin of the sync :meth:`PoolClient.pinned_generate_stream`
+        (the disaggregated decode leg's replica-pinned SSE stream)."""
+        self._ensure_prober()
+        ep = self.pool.endpoint_by_url(url)
+        inner = ep.client.generate_stream(*args, **kwargs)  # lazy: no I/O yet
+
+        async def stream():
+            self.pool.begin(ep)
+            ok = True
+            try:
+                async for item in inner:
+                    yield item
+            except Exception as e:
+                ok = False
+                self._record_attempt_failure(ep, e)
+                raise
+            finally:
+                self.pool.done(ep)
                 if ok:
                     self.pool.record_success(ep)
 

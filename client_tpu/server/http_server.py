@@ -44,6 +44,14 @@ def _generate_core_request(model, payload: Any) -> Dict[str, Any]:
     scalar or (nested) list. Shapes are conformed to the model's metadata
     by prepending singleton dims ([1,2,3] -> [1,3] for an INT32[1,-1]
     input), the KServe analog of the reference's flat-JSON mapping.
+
+    Extension over the reference: an OBJECT value referencing a
+    registered shared-memory region (``{"shared_memory_region": ...,
+    "shared_memory_byte_size": ..., "shared_memory_offset": ...,
+    "shape": [...]}``) resolves the tensor from that region exactly like
+    the infer path's shm input parameters — the disaggregated
+    prefill/decode client hands a multi-hundred-KiB KV cache to the
+    decode stream this way instead of inflating it into JSON.
     Shared by the threaded and aio frontends.
     """
     if not isinstance(payload, dict):
@@ -63,6 +71,30 @@ def _generate_core_request(model, payload: Any) -> Dict[str, Any]:
             raise InferError(
                 f"unexpected generate input '{key}' for model "
                 f"'{model.name}'", 400)
+        if isinstance(value, dict):
+            if "shared_memory_region" not in value:
+                raise InferError(
+                    f"generate input '{key}': object values must carry a "
+                    "'shared_memory_region' reference", 400)
+            shape = value.get("shape")
+            if (not isinstance(shape, list) or not shape
+                    or not all(isinstance(d, int) and not isinstance(d, bool)
+                               and d >= 0 for d in shape)):
+                raise InferError(
+                    f"generate input '{key}': a shared-memory reference "
+                    "needs an explicit 'shape' (list of non-negative "
+                    "ints) — raw region bytes carry no shape", 400)
+            req["inputs"].append({
+                "name": key,
+                "datatype": spec.datatype,
+                "shape": list(shape),
+                "shm": (
+                    value["shared_memory_region"],
+                    value.get("shared_memory_byte_size", 0),
+                    value.get("shared_memory_offset", 0),
+                ),
+            })
+            continue
         if spec.datatype == "BYTES":
             shaped = np.asarray(value, dtype=object)
 

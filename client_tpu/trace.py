@@ -11,7 +11,8 @@ gives the perf harness something replayable:
   carrying the format version and generator provenance; every following
   line is a ``type: "request"`` record with an arrival offset (``at_s``,
   seconds from replay start), a ``kind`` (``unary`` | ``generate_stream``
-  | ``sequence`` | ``sharded``), the target model/version, and
+  | ``sequence`` | ``sharded`` | ``prefill_decode``), the target
+  model/version, and
   kind-specific payload sizing — tensor ``shapes``/``dtypes`` for unary,
   sequence and sharded records, ``prompt_tokens``/``output_tokens`` for
   streams. Sequence records carry ``(seq_group, seq_index, seq_len)`` so
@@ -22,7 +23,12 @@ gives the perf harness something replayable:
   (``client_tpu.shard``). Records may carry a ``tenant`` attribution
   (format v4, stamped per record) that the replayer threads through the
   client's admission/cache/batch layers as the multi-tenant QoS
-  dimension — it never reaches the wire.
+  dimension — it never reaches the wire. ``prefill_decode`` records
+  (format v5, stamped per record so v4 loaders skip-and-count them) are
+  disaggregated prefill/decode sessions — ``prompt_tokens`` /
+  ``output_tokens`` sizing plus optional ``prefill_role`` /
+  ``decode_role`` hints — replayed through
+  ``client_tpu.disagg.DisaggClient`` (``perf.py --roles``).
 
 - **Versioning**: the header's ``version`` is the format version; a
   *record* may carry its own ``v`` — records (and whole traces) from a
@@ -57,11 +63,11 @@ import numpy as np
 # a v1 reader still loads the v1-compatible records of a mixed trace, and
 # only records carrying newer-versioned semantics stamp their own ``v``
 # (the PR 8 forward-compat rule: skip-and-count, never fatal)
-TRACE_VERSION = 4
+TRACE_VERSION = 5
 BASE_VERSION = 1
 # record kinds introduced after the base format stamp their records with
 # the version that introduced them
-_KIND_VERSIONS = {"sharded": 2}
+_KIND_VERSIONS = {"sharded": 2, "prefill_decode": 5}
 # records carrying a zipfian ``content_key`` (the hot-key workload knob)
 # stamp v=3: a v2 loader skips exactly these, counted, and keeps the rest
 _CONTENT_KEY_VERSION = 3
@@ -71,7 +77,8 @@ _CONTENT_KEY_VERSION = 3
 # traces (no tenant field, no version stamp)
 _TENANT_VERSION = 4
 
-KINDS = ("unary", "generate_stream", "sequence", "sharded")
+KINDS = ("unary", "generate_stream", "sequence", "sharded",
+         "prefill_decode")
 
 # default tensor layouts per well-known zoo model, so generator specs can
 # name a model without restating its wire contract
@@ -128,6 +135,11 @@ class TraceRecord:
     # weighted-fair drain and cache partitions see the same tenant mix
     # the generator declared. None (the default) stamps nothing.
     tenant: Optional[str] = None
+    # prefill_decode records (format v5): role hints for the replayer's
+    # DisaggClient — which pool role serves each leg. None lets the
+    # replayer's own defaults ("prefill"/"decode") apply.
+    prefill_role: Optional[str] = None
+    decode_role: Optional[str] = None
 
     def to_obj(self) -> Dict[str, Any]:
         obj: Dict[str, Any] = {
@@ -141,9 +153,14 @@ class TraceRecord:
         if self.shapes is not None:
             obj["shapes"] = {k: list(v) for k, v in self.shapes.items()}
             obj["dtypes"] = dict(self.dtypes or {})
-        if self.kind == "generate_stream":
+        if self.kind in ("generate_stream", "prefill_decode"):
             obj["prompt_tokens"] = int(self.prompt_tokens)
             obj["output_tokens"] = int(self.output_tokens)
+        if self.kind == "prefill_decode":
+            if self.prefill_role is not None:
+                obj["prefill_role"] = str(self.prefill_role)
+            if self.decode_role is not None:
+                obj["decode_role"] = str(self.decode_role)
         if self.kind == "sequence":
             obj["seq_group"] = int(self.seq_group)
             obj["seq_index"] = int(self.seq_index)
@@ -200,16 +217,24 @@ class TraceRecord:
             if missing:
                 raise TraceParseError(
                     line, f"shapes without dtypes: {sorted(missing)}")
-        if kind == "generate_stream":
+        if kind in ("generate_stream", "prefill_decode"):
             try:
                 kwargs["prompt_tokens"] = int(obj["prompt_tokens"])
                 kwargs["output_tokens"] = int(obj["output_tokens"])
             except (KeyError, TypeError, ValueError):
                 raise TraceParseError(
-                    line, "generate_stream requires integer "
+                    line, f"{kind} requires integer "
                     "prompt_tokens/output_tokens") from None
             if kwargs["prompt_tokens"] < 1 or kwargs["output_tokens"] < 1:
                 raise TraceParseError(line, "token counts must be >= 1")
+        if kind == "prefill_decode":
+            for field in ("prefill_role", "decode_role"):
+                if field in obj:
+                    role = obj[field]
+                    if not isinstance(role, str) or not role:
+                        raise TraceParseError(
+                            line, f"{field} must be a non-empty string")
+                    kwargs[field] = role
         if kind == "sequence":
             try:
                 kwargs["seq_group"] = int(obj["seq_group"])
@@ -537,6 +562,8 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
           shard_fraction: float = 0.0, shards: int = 2,
           shard_model: str = "decoder_lm_tp_prefill",
           shard_batch: Optional[int] = None,
+          disagg_fraction: float = 0.0,
+          disagg_model: str = "decoder_lm_kv_decode",
           hot_key_alpha: float = 1.1,
           hot_key_universe: int = 0,
           shapes: Optional[Dict[str, List[int]]] = None,
@@ -558,10 +585,20 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
     ``routing="affinity"`` session keys — the proof workload for the
     client-side cache/singleflight layer. The default 0 draws nothing
     extra, so pre-v3 specs stay byte-identical. Sequences keep their own
-    group affinity and carry no key."""
-    if stream_fraction + seq_fraction + shard_fraction > 1.0:
+    group affinity and carry no key.
+
+    ``disagg_fraction > 0`` carves a slice of arrivals into
+    ``prefill_decode`` records (format v5, stamped per record so v4
+    loaders skip-and-count them): disaggregated prefill/decode sessions
+    the replayer drives through ``client_tpu.disagg.DisaggClient``
+    (``--roles``), sized by the same heavy-tail prompt/output draws as
+    streams. The default 0 draws nothing extra, so pre-v5 specs keep
+    producing byte-identical traces."""
+    if (stream_fraction + seq_fraction + shard_fraction
+            + disagg_fraction > 1.0):
         raise ValueError(
-            "stream_fraction + seq_fraction + shard_fraction must be <= 1")
+            "stream_fraction + seq_fraction + shard_fraction + "
+            "disagg_fraction must be <= 1")
     if seq_len_min < 1 or seq_len_max < seq_len_min:
         raise ValueError("need 1 <= seq_len_min <= seq_len_max")
     rng = np.random.default_rng(seed)
@@ -586,6 +623,19 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
             records.append(TraceRecord(
                 at_s=t, kind="sharded", model=shard_model,
                 shapes=shard_shapes, dtypes=shard_dtypes, shards=shards))
+            continue
+        disagg_lo = stream_fraction + seq_fraction + shard_fraction
+        if disagg_fraction and disagg_lo <= pick \
+                < disagg_lo + disagg_fraction:
+            # sized exactly like a stream (same heavy-tail draws), but
+            # replayed as a two-leg disaggregated session
+            records.append(TraceRecord(
+                at_s=t, kind="prefill_decode", model=disagg_model,
+                prompt_tokens=_heavy_tail_length(
+                    rng, tail, prompt_mean, prompt_sigma, alpha, max_prompt),
+                output_tokens=_heavy_tail_length(
+                    rng, tail, output_mean, output_sigma, alpha, max_output),
+                prefill_role="prefill", decode_role="decode"))
             continue
         if pick < stream_fraction:
             if pmf is not None:
@@ -726,7 +776,7 @@ GENERATORS = {
 
 # spec params that must stay strings when parsed from a spec
 _STR_PARAMS = {"model", "unary_model", "stream_model", "seq_model",
-               "shard_model", "tail"}
+               "shard_model", "disagg_model", "tail"}
 
 
 def parse_gen_spec(spec: str) -> Tuple[str, Dict[str, Any]]:

@@ -299,10 +299,17 @@ def test_arena_event_observer_chains():
     seen = []
     obs = _arena_event_observer(fake, chain=seen.append)
     obs(EndpointEjected("u1", 1.0, 3, 1))
-    obs(EndpointHealthChanged("u2", healthy=True))   # healthy: no drop
+    # BOTH health edges drop: a replica that just healed may have
+    # restarted during the outage, so a request re-homed onto it (a
+    # disagg re-prefill, say) must re-verify its registration instead of
+    # trusting the pre-outage cache entry
+    obs(EndpointHealthChanged("u2", healthy=True))
     obs(EndpointHealthChanged("u3", healthy=False))
-    assert fake.invalidated == ["u1", "u3"]
-    assert len(seen) == 3  # caller's observer still sees every event
+    from client_tpu.pool import EndpointReadmitted
+
+    obs(EndpointReadmitted("u4"))
+    assert fake.invalidated == ["u1", "u2", "u3", "u4"]
+    assert len(seen) == 4  # caller's observer still sees every event
 
 
 # -- transparent fast path ----------------------------------------------------

@@ -1,0 +1,514 @@
+"""Disaggregated prefill/decode serving tests (ISSUE 17).
+
+The failure matrix the tentpole claims: (a) disaggregated sessions are
+BIT-exact vs monolithic ``tiny_lm_generate`` on sync AND aio frontends
+(both halves share the zoo decoder); (b) steady-state handoffs do zero
+region creates and zero registration RPCs; (c) a tampered handoff raises
+typed ``HandoffCorrupt`` before any token is emitted — never garbage
+tokens; (d) a missing/unavailable role degrades to monolithic serving
+with a typed ``RoleFallback`` pool event, never silently; (e) a decode
+replica RST mid-stream recovers via re-prefill on the shared
+``AttemptBudget`` with every token delivered exactly once (the
+``disagg_smoke`` chaos marker), and an unrecoverable death raises
+``DecodeAbandoned`` naming the lost replica; (f) admission charges the
+two legs to separate ``disagg:prefill``/``disagg:decode`` lanes; (g) the
+flight recorder retains ``disagg.*`` events; (h) the doctor flags
+``role_degraded``; (i) the committed BENCH_DISAGG.json still claims what
+CI enforces; (j) trace v5 ``prefill_decode`` records round-trip, stay
+byte-identical for old specs, skip forward-compatibly, and replay.
+"""
+
+import asyncio
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu import trace as trace_mod
+from client_tpu.admission import AdmissionController
+from client_tpu.disagg import (
+    AioDisaggClient,
+    DecodeAbandoned,
+    DisaggClient,
+    DisaggConfigError,
+    HandoffCorrupt,
+)
+from client_tpu.doctor import collect_snapshot, render_summary
+from client_tpu.flight import FlightRecorder
+from client_tpu.models import default_model_zoo
+from client_tpu.observe import Telemetry
+from client_tpu.pool import (
+    EndpointSpec,
+    NoEndpointAvailableError,
+    PoolClient,
+    RoleFallback,
+)
+from client_tpu.resilience import AttemptBudget
+from client_tpu.server import HttpInferenceServer, ServerCore
+from client_tpu.testing import ChaosProxy, Fault
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+MAX_TOKENS = 16
+
+
+@pytest.fixture(scope="module")
+def servers():
+    svs = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+           for _ in range(3)]
+    yield svs
+    for s in svs:
+        s.stop()
+
+
+@pytest.fixture(scope="module")
+def monolithic(servers):
+    """The bit-exactness reference: tiny_lm_generate on one replica."""
+    pool = PoolClient([f"127.0.0.1:{servers[0].port}"], protocol="http",
+                      health_interval_s=None)
+    try:
+        events = list(pool.generate_stream(
+            "tiny_lm_generate",
+            {"TOKENS": [PROMPT], "MAX_TOKENS": MAX_TOKENS}))
+    finally:
+        pool.close()
+    return [int(e["NEXT_TOKEN"]) for e in events]
+
+
+def _role_specs(servers):
+    return [EndpointSpec(f"127.0.0.1:{servers[0].port}", role="prefill"),
+            EndpointSpec(f"127.0.0.1:{servers[1].port}", role="decode")]
+
+
+def _drain(stream):
+    tokens, indices = [], []
+    for event in stream:
+        tokens.append(int(event["NEXT_TOKEN"]))
+        indices.append(int(event["INDEX"]))
+    return tokens, indices
+
+
+# -- (a) bit-exactness + (b) steady state -------------------------------------
+def test_disagg_bit_exact_and_steady_state_zero_rpcs(servers, monolithic):
+    client = DisaggClient(_role_specs(servers), protocol="http",
+                          health_interval_s=None)
+    try:
+        tokens, indices = _drain(client.generate_stream(
+            PROMPT, max_tokens=MAX_TOKENS))
+        assert tokens == monolithic
+        assert indices == list(range(MAX_TOKENS))
+        # steady state: warm (above) -> further handoffs lease cached
+        # slabs and reuse cached registrations on BOTH legs
+        before = client.arena().stats()
+        for _ in range(3):
+            tokens, _ = _drain(client.generate_stream(
+                PROMPT, max_tokens=MAX_TOKENS))
+            assert tokens == monolithic
+        after = client.arena().stats()
+        assert after["regions_created"] == before["regions_created"]
+        assert (after["registrations_issued"]
+                == before["registrations_issued"])
+        assert after["leased_bytes"] == 0  # every handoff lease returned
+    finally:
+        client.close()
+
+
+def test_disagg_bit_exact_aio(servers, monolithic):
+    async def go():
+        client = AioDisaggClient(_role_specs(servers), protocol="http",
+                                 health_interval_s=None)
+        try:
+            tokens, indices = [], []
+            async for event in client.generate_stream(
+                    PROMPT, max_tokens=MAX_TOKENS):
+                tokens.append(int(event["NEXT_TOKEN"]))
+                indices.append(int(event["INDEX"]))
+            return tokens, indices
+        finally:
+            await client.close()
+
+    tokens, indices = asyncio.run(go())
+    assert tokens == monolithic
+    assert indices == list(range(MAX_TOKENS))
+
+
+def test_end_id_stops_both_paths(servers, monolithic):
+    end_id = monolithic[3]
+    client = DisaggClient(_role_specs(servers), protocol="http",
+                          health_interval_s=None)
+    try:
+        tokens, _ = _drain(client.generate_stream(
+            PROMPT, max_tokens=MAX_TOKENS, end_id=end_id))
+        assert tokens == monolithic[:4]  # stops ON the end token
+    finally:
+        client.close()
+
+
+# -- (c) verified handoff ------------------------------------------------------
+def test_tampered_handoff_raises_typed_corrupt(servers):
+    client = DisaggClient(_role_specs(servers), protocol="http",
+                          health_interval_s=None)
+    try:
+        budget = AttemptBudget(client.inner._budget_policy, None)
+        handoff = client._prefill_leg(PROMPT, budget, 0, "")
+        try:
+            handoff.verify("ok")  # pristine slab passes
+            view = handoff.lease.memoryview()
+            view[7] = (view[7] + 1) % 256  # one flipped byte
+            with pytest.raises(HandoffCorrupt) as ei:
+                handoff.verify("127.0.0.1:1")
+            assert ei.value.field == "digest"
+            assert "127.0.0.1:1" in str(ei.value)
+        finally:
+            handoff.release()
+            handoff.release()  # idempotent
+        assert client.arena().stats()["leased_bytes"] == 0
+    finally:
+        client.close()
+
+
+def test_corrupt_handoff_never_streams_tokens(servers):
+    """End-to-end: a slab corrupted between prefill and decode fails the
+    session typed, with ZERO tokens emitted."""
+    client = DisaggClient(_role_specs(servers), protocol="http",
+                          health_interval_s=None)
+    real_leg = DisaggClient._prefill_leg
+
+    def tampering_leg(self, tokens_full, budget, priority, request_id):
+        handoff = real_leg(self, tokens_full, budget, priority, request_id)
+        view = handoff.lease.memoryview()
+        view[0] = (view[0] + 1) % 256
+        return handoff
+
+    try:
+        client._prefill_leg = tampering_leg.__get__(client)
+        emitted = []
+        with pytest.raises(HandoffCorrupt):
+            for event in client.generate_stream(PROMPT, max_tokens=4):
+                emitted.append(event)
+        assert emitted == []
+        assert client.arena().stats()["leased_bytes"] == 0
+    finally:
+        client.close()
+
+
+def test_accept_event_dedups_and_types_gaps(servers):
+    client = DisaggClient(_role_specs(servers), protocol="http",
+                          health_interval_s=None)
+    try:
+        emitted = [7, 8]
+        # same-content replay of a delivered index: dedup, no emission
+        assert client._accept_event(
+            {"NEXT_TOKEN": 8, "INDEX": 1}, emitted, "u") is None
+        assert emitted == [7, 8]
+        # replayed index with DIFFERENT content is corruption
+        with pytest.raises(HandoffCorrupt) as ei:
+            client._accept_event({"NEXT_TOKEN": 9, "INDEX": 0}, emitted, "u")
+        assert ei.value.field == "token"
+        # a gap (index beyond the next slot) is corruption, not a drop
+        with pytest.raises(HandoffCorrupt) as ei:
+            client._accept_event({"NEXT_TOKEN": 1, "INDEX": 5}, emitted, "u")
+        assert ei.value.field == "index"
+        # the in-order next event is emitted
+        assert client._accept_event(
+            {"NEXT_TOKEN": 4, "INDEX": 2}, emitted, "u") == (4, 2)
+        assert emitted == [7, 8, 4]
+    finally:
+        client.close()
+
+
+# -- (d) typed role fallback ---------------------------------------------------
+def test_missing_decode_role_falls_back_typed(servers, monolithic):
+    events = []
+    client = DisaggClient(
+        [EndpointSpec(f"127.0.0.1:{servers[0].port}", role="prefill")],
+        protocol="http", health_interval_s=None, on_event=events.append)
+    try:
+        tokens, indices = _drain(client.generate_stream(
+            PROMPT, max_tokens=MAX_TOKENS))
+        assert tokens == monolithic  # degraded, not different
+        assert indices == list(range(MAX_TOKENS))
+        falls = [e for e in events if isinstance(e, RoleFallback)]
+        assert len(falls) == 1
+        assert falls[0].role == "decode"
+        assert falls[0].reason == "unavailable"
+        assert client.inner.pool.role_fallbacks == {"decode": 1}
+        # satellite: the fallback count is surfaced per-role
+        roles = client.inner.health_summary()["roles"]
+        assert roles["prefill"]["available"] is True
+        assert client.arena().stats()["leased_bytes"] == 0
+    finally:
+        client.close()
+
+
+def test_missing_prefill_role_falls_back_before_any_leg(servers, monolithic):
+    events = []
+    client = DisaggClient(
+        [EndpointSpec(f"127.0.0.1:{servers[1].port}", role="decode")],
+        protocol="http", health_interval_s=None, on_event=events.append)
+    try:
+        tokens, _ = _drain(client.generate_stream(
+            PROMPT, max_tokens=MAX_TOKENS))
+        assert tokens == monolithic
+        falls = [e for e in events if isinstance(e, RoleFallback)]
+        assert [f.role for f in falls] == ["prefill"]
+    finally:
+        client.close()
+
+
+def test_config_errors_are_typed(servers):
+    url = f"127.0.0.1:{servers[0].port}"
+    with pytest.raises(DisaggConfigError, match="substrate"):
+        DisaggClient(httpclient.InferenceServerClient(url))
+    with pytest.raises(DisaggConfigError, match="shm_arena"):
+        DisaggClient([url], protocol="http", shm_arena=None,
+                     health_interval_s=None)
+    pool = PoolClient([url], protocol="http", shm_arena=True,
+                      health_interval_s=None)
+    try:
+        with pytest.raises(DisaggConfigError, match="pool kwargs"):
+            DisaggClient(pool, health_interval_s=None)
+    finally:
+        pool.close()
+
+
+# -- (e) re-prefill recovery + DecodeAbandoned --------------------------------
+@pytest.mark.disagg_smoke
+def test_decode_killed_mid_stream_recovers_exactly_once(monolithic):
+    """The chaos proof: RST the decode replica mid-stream; the session
+    must finish on the surviving decode replica via re-prefill with every
+    token delivered exactly once, and the flight recorder must retain the
+    decode_died -> reprefill -> resumed-route causal chain."""
+    svs = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+           for _ in range(3)]
+    proxy = ChaosProxy("127.0.0.1", svs[1].port).start()
+    tel = Telemetry(flight=FlightRecorder(baseline_ratio=1.0))
+    client = DisaggClient(
+        [EndpointSpec(f"127.0.0.1:{svs[0].port}", role="prefill"),
+         EndpointSpec(proxy.url, role="decode"),
+         EndpointSpec(f"127.0.0.1:{svs[2].port}", role="decode")],
+        protocol="http", health_interval_s=None, routing="round_robin",
+        telemetry=tel)
+    kills = 0
+    try:
+        for _ in range(6):
+            conns = proxy.stats["connections"]
+            tokens, indices, killed = [], [], False
+            for event in client.generate_stream(PROMPT, max_tokens=MAX_TOKENS):
+                tokens.append(int(event["NEXT_TOKEN"]))
+                indices.append(int(event["INDEX"]))
+                if (not killed and len(tokens) == 4
+                        and proxy.stats["connections"] > conns):
+                    proxy.fault = Fault("reset", after_bytes=0)
+                    proxy.reset_active()
+                    killed = True
+            if killed:
+                kills += 1
+                proxy.heal()
+            # exactly once, bit-exact, through the kill and without it
+            assert tokens == monolithic
+            assert indices == list(range(MAX_TOKENS))
+            if kills:
+                break
+        assert kills >= 1, "no session was provably on the proxied decode"
+        names = {(e[1], e[2]) for t in tel.flight.retained()
+                 for e in t.events}
+        assert ("disagg", "decode_died") in names
+        assert ("disagg", "reprefill") in names
+        assert ("disagg", "handoff") in names
+        assert ("disagg", "verify") in names
+        assert client.arena().stats()["leased_bytes"] == 0
+    finally:
+        client.close()
+        proxy.stop()
+        for s in svs:
+            s.stop()
+
+
+def test_unrecoverable_decode_death_names_replica():
+    """Only ONE decode replica, killed mid-stream and kept dead: recovery
+    is impossible and the typed DecodeAbandoned names it plus how many
+    tokens were already delivered exactly once."""
+    svs = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+           for _ in range(2)]
+    proxy = ChaosProxy("127.0.0.1", svs[1].port).start()
+    client = DisaggClient(
+        [EndpointSpec(f"127.0.0.1:{svs[0].port}", role="prefill"),
+         EndpointSpec(proxy.url, role="decode")],
+        protocol="http", health_interval_s=None)
+    try:
+        got = []
+        with pytest.raises(DecodeAbandoned) as ei:
+            for event in client.generate_stream(PROMPT, max_tokens=MAX_TOKENS):
+                got.append(int(event["NEXT_TOKEN"]))
+                if len(got) == 3:
+                    proxy.fault = Fault("reset", after_bytes=0)
+                    proxy.reset_active()
+        assert ei.value.url == proxy.url
+        assert ei.value.emitted == len(got)
+        assert len(got) >= 3
+        assert client.arena().stats()["leased_bytes"] == 0
+    finally:
+        client.close()
+        proxy.stop()
+        for s in svs:
+            s.stop()
+
+
+def test_empty_prompt_and_bad_max_tokens_rejected(servers):
+    client = DisaggClient(_role_specs(servers), protocol="http",
+                          health_interval_s=None)
+    try:
+        with pytest.raises(Exception, match="empty prompt"):
+            client.generate_stream([])
+        with pytest.raises(Exception, match="max_tokens"):
+            client.generate_stream(PROMPT, max_tokens=0)
+    finally:
+        client.close()
+
+
+# -- (f) admission lanes -------------------------------------------------------
+def test_admission_charges_separate_lanes(servers):
+    ctrl = AdmissionController()
+    client = DisaggClient(_role_specs(servers), protocol="http",
+                          health_interval_s=None, admission=ctrl)
+    try:
+        _drain(client.generate_stream(PROMPT, max_tokens=4))
+        lanes = ctrl.snapshot()["lanes"]
+        assert lanes["disagg:prefill"]["admitted_total"] >= 1
+        assert lanes["disagg:decode"]["admitted_total"] >= 1
+    finally:
+        client.close()
+
+
+# -- (h) doctor ----------------------------------------------------------------
+def test_doctor_flags_role_degraded(servers):
+    up = f"127.0.0.1:{servers[0].port}"
+    snap = collect_snapshot(
+        [], roles={"prefill": [up], "decode": ["127.0.0.1:9"]},
+        requests_per_endpoint=1, probe_timeout_s=2.0)
+    assert snap["roles"]["prefill"]["available"] is True
+    assert snap["roles"]["decode"]["available"] is False
+    flags = [f for f in snap["anomalies"] if f["flag"] == "role_degraded"]
+    assert len(flags) == 1
+    assert flags[0]["role"] == "decode"
+    text = render_summary(snap)
+    assert "roles (disaggregated prefill/decode):" in text
+    assert "DEGRADED" in text
+
+
+def test_doctor_roles_spec_string(servers):
+    up0 = f"127.0.0.1:{servers[0].port}"
+    up1 = f"127.0.0.1:{servers[1].port}"
+    snap = collect_snapshot(
+        [], roles=f"prefill={up0};decode={up1}",
+        requests_per_endpoint=1, probe_timeout_s=5.0)
+    assert snap["roles"]["prefill"]["available"] is True
+    assert snap["roles"]["decode"]["available"] is True
+    assert not [f for f in snap["anomalies"]
+                if f["flag"] == "role_degraded"]
+
+
+# -- (i) committed artifact claims ---------------------------------------------
+def test_bench_disagg_artifact_claims():
+    """CI re-validates the committed BENCH_DISAGG.json: the bench's own
+    --check invariants plus the headline claims pinned explicitly."""
+    import tools.bench_disagg as bench
+
+    doc = json.loads(
+        (Path(__file__).resolve().parent.parent
+         / "BENCH_DISAGG.json").read_text())
+    assert bench.check_doc(doc) == []
+    assert doc["ttft_itl"]["bit_exact"] is True
+    assert doc["steady_state"]["region_creates_per_handoff"] == 0
+    assert doc["steady_state"]["registration_rpcs_per_handoff"] == 0
+    chaos = doc["chaos"]
+    assert chaos["delivery_ratio"] == 1.0
+    assert chaos["kills"] > 0
+    assert chaos["repeated_tokens"] == 0
+    assert chaos["dropped_tokens"] == 0
+    assert chaos["bit_exact"] is True
+
+
+# -- (j) trace v5 --------------------------------------------------------------
+def test_trace_v5_prefill_decode_round_trip(tmp_path):
+    rec = trace_mod.TraceRecord(
+        at_s=0.25, kind="prefill_decode", model="decoder_lm_kv_decode",
+        prompt_tokens=12, output_tokens=24,
+        prefill_role="prefill", decode_role="decode")
+    path = tmp_path / "t.jsonl"
+    trace_mod.dump_trace([rec], str(path))
+    line = json.loads(path.read_text().splitlines()[1])
+    assert line["v"] == 5 and line["kind"] == "prefill_decode"
+    loaded = trace_mod.load_trace(str(path))
+    assert loaded.skipped == 0
+    [r] = loaded.records
+    assert (r.kind, r.prompt_tokens, r.output_tokens) == (
+        "prefill_decode", 12, 24)
+    assert (r.prefill_role, r.decode_role) == ("prefill", "decode")
+
+
+def test_trace_v5_future_records_skip_and_count(tmp_path):
+    rec = trace_mod.TraceRecord(
+        at_s=0.25, kind="prefill_decode", model="decoder_lm_kv_decode",
+        prompt_tokens=12, output_tokens=24)
+    old = trace_mod.TraceRecord(at_s=0.5, kind="generate_stream",
+                                model="tiny_lm_generate",
+                                prompt_tokens=4, output_tokens=2)
+    path = tmp_path / "t.jsonl"
+    trace_mod.dump_trace([rec, old], str(path))
+    bumped = [json.loads(l) for l in path.read_text().splitlines()]
+    bumped[1]["v"] = 99  # a future format's record
+    path.write_text("\n".join(json.dumps(o) for o in bumped) + "\n")
+    loaded = trace_mod.load_trace(str(path))
+    assert loaded.skipped == 1
+    assert [r.kind for r in loaded.records] == ["generate_stream"]
+
+
+def test_mixed_disagg_fraction_zero_is_byte_identical():
+    a = trace_mod.dumps_trace(trace_mod.mixed(
+        duration_s=3.0, rate=20.0, seed=7))
+    b = trace_mod.dumps_trace(trace_mod.mixed(
+        duration_s=3.0, rate=20.0, seed=7, disagg_fraction=0.0))
+    assert a == b
+
+
+def test_mixed_emits_disagg_records():
+    records = trace_mod.mixed(duration_s=3.0, rate=30.0, seed=7,
+                              disagg_fraction=0.5)
+    disagg = [r for r in records if r.kind == "prefill_decode"]
+    assert disagg
+    assert all(r.prompt_tokens >= 1 and r.output_tokens >= 1
+               for r in disagg)
+    assert all(r.prefill_role == "prefill" and r.decode_role == "decode"
+               for r in disagg)
+
+
+@pytest.mark.disagg_smoke
+def test_replay_drives_disagg_sessions(servers):
+    from client_tpu.perf import PerfRunner
+
+    u0 = f"127.0.0.1:{servers[0].port}"
+    u1 = f"127.0.0.1:{servers[1].port}"
+    tr = trace_mod.generate(
+        "mixed:duration_s=2,rate=12,stream_fraction=0.1,seq_fraction=0,"
+        "disagg_fraction=0.5,max_prompt=20,max_output=6,unary_model=simple",
+        seed=11)
+    n_disagg = tr.kind_counts()["prefill_decode"]
+    assert n_disagg > 0
+    runner = PerfRunner(u0, "http", "simple", endpoints=[u0, u1],
+                        roles=f"prefill={u0};decode={u1}")
+    res = runner.run_trace(tr, speed=4.0, replay_workers=8)
+    assert res["errors"] == 0
+    assert res["kinds"]["prefill_decode"]["ok"] == n_disagg
+
+
+def test_replay_without_roles_is_typed(servers):
+    from client_tpu.perf import PerfRunner
+
+    tr = trace_mod.generate(
+        "mixed:duration_s=1,rate=10,disagg_fraction=0.5", seed=3)
+    runner = PerfRunner(f"127.0.0.1:{servers[0].port}", "http", "simple")
+    with pytest.raises(ValueError, match="--roles"):
+        runner.run_trace(tr, speed=4.0)

@@ -1,0 +1,358 @@
+"""Generate BENCH_DISAGG.json: the disaggregated prefill/decode proof.
+
+Three arms over in-process replica servers (the same topology every other
+bench in this repo uses — CPU container numbers, honest about it):
+
+- **ttft_itl**: TTFT/ITL split of disaggregated sessions (prefill on a
+  prefill-role endpoint, decode streamed from a decode-role endpoint via
+  the verified KV handoff) vs the monolithic ``tiny_lm_generate`` path on
+  one replica — and every disagg session's token stream must be
+  BIT-identical to the monolithic reference (the two paths share the zoo
+  decoder's weights; models/disagg.py).
+- **steady_state**: after warmup, N handoffs through the shared arena
+  must issue ZERO region creates and ZERO registration RPCs — the KV
+  slab is leased from cached slabs and both endpoints' registrations are
+  cached per (endpoint, region).
+- **chaos**: a decode replica is RST mid-stream (ChaosProxy) while a
+  second decode replica stays healthy; every killed session must finish
+  via re-prefill recovery (delivery 1.0) with ZERO repeated and ZERO
+  dropped tokens (indices contiguous, stream bit-exact vs monolithic),
+  and at least one actual mid-stream kill must have happened.
+
+``--check`` re-validates an existing artifact's acceptance invariants and
+exits nonzero on violation (tests/test_disagg.py pins the same claims);
+``tools/capacity_gate.py --disagg`` re-RUNS the chaos arm live:
+
+    JAX_PLATFORMS=cpu python tools/bench_disagg.py [-o BENCH_DISAGG.json]
+    JAX_PLATFORMS=cpu python tools/bench_disagg.py --check BENCH_DISAGG.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+PROMPT_TOKENS = 12
+MAX_TOKENS = 24
+
+
+def _percentiles(samples_s):
+    xs = sorted(samples_s)
+    n = len(xs)
+    if not n:
+        return {}
+    pick = lambda q: xs[min(n - 1, int(q * (n - 1) + 0.5))]  # noqa: E731
+    return {
+        "avg": round(1e3 * sum(xs) / n, 3),
+        "p50": round(1e3 * pick(0.50), 3),
+        "p90": round(1e3 * pick(0.90), 3),
+        "p99": round(1e3 * pick(0.99), 3),
+    }
+
+
+def _drive_session(stream):
+    """Iterate one token stream; returns (tokens, indices, ttft_s, itls_s)."""
+    tokens, indices, itls = [], [], []
+    t0 = time.perf_counter()
+    ttft = None
+    last = t0
+    for event in stream:
+        now = time.perf_counter()
+        if ttft is None:
+            ttft = now - t0
+        else:
+            itls.append(now - last)
+        last = now
+        tokens.append(int(event["NEXT_TOKEN"]))
+        indices.append(int(event["INDEX"]))
+    return tokens, indices, ttft, itls
+
+
+def monolithic_tokens(url, prompt, max_tokens):
+    """The monolithic reference stream (``tiny_lm_generate``) for a
+    prompt: the bit-exactness baseline every disagg session is held to."""
+    from client_tpu.pool import PoolClient
+
+    pool = PoolClient([url], protocol="http", health_interval_s=None)
+    try:
+        return _drive_session(pool.generate_stream(
+            "tiny_lm_generate",
+            {"TOKENS": [list(prompt)], "MAX_TOKENS": int(max_tokens)}))
+    finally:
+        pool.close()
+
+
+def session_problems(tokens, indices, want_tokens, max_tokens):
+    """Per-session token-integrity verdict: (repeated, dropped, exact)."""
+    repeated = sum(1 for i, idx in enumerate(indices) if idx in indices[:i])
+    dropped = max(0, max_tokens - len(tokens))
+    exact = tokens == want_tokens and indices == list(range(max_tokens))
+    return repeated, dropped, exact
+
+
+def run_chaos_arm(sessions: int = 8, prompt_tokens: int = PROMPT_TOKENS,
+                  max_tokens: int = MAX_TOKENS, kill_after: int = 5,
+                  seed: int = 0xD15A):
+    """The mid-stream decode-kill proof, self-contained so
+    ``capacity_gate.py --disagg`` can re-run it live: one prefill
+    replica, one decode replica behind a ChaosProxy, one direct decode
+    replica. Every even session arms a mid-stream RST of the proxied
+    decode leg once its stream is provably flowing through the proxy;
+    the session must finish via re-prefill + resumed decode elsewhere."""
+    from client_tpu.disagg import DisaggClient
+    from client_tpu.models import default_model_zoo
+    from client_tpu.pool import EndpointSpec
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing import ChaosProxy, Fault
+
+    rng = np.random.default_rng(seed)
+    prompt = rng.integers(0, 256, size=prompt_tokens, dtype=np.int32).tolist()
+    servers = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+               for _ in range(3)]
+    proxy = ChaosProxy("127.0.0.1", servers[1].port).start()
+    urls = [f"127.0.0.1:{s.port}" for s in servers]
+    want, _, _, _ = monolithic_tokens(urls[0], prompt, max_tokens)
+    client = DisaggClient(
+        [EndpointSpec(urls[0], role="prefill"),
+         EndpointSpec(proxy.url, role="decode"),
+         EndpointSpec(urls[2], role="decode")],
+        protocol="http", health_interval_s=None, routing="round_robin")
+    row = {"sessions": sessions, "max_tokens": max_tokens,
+           "completed": 0, "kills": 0, "repeated_tokens": 0,
+           "dropped_tokens": 0, "bit_exact": True, "abandoned": 0}
+    try:
+        for i in range(sessions):
+            arm_kill = i % 2 == 0
+            conns_before = proxy.stats["connections"]
+            tokens, indices, killed = [], [], False
+            try:
+                for event in client.generate_stream(
+                        prompt, max_tokens=max_tokens):
+                    tokens.append(int(event["NEXT_TOKEN"]))
+                    indices.append(int(event["INDEX"]))
+                    if (arm_kill and not killed and len(tokens) == kill_after
+                            and proxy.stats["connections"] > conns_before):
+                        # the decode stream is provably on the proxied
+                        # replica: kill it mid-stream and keep it dead so
+                        # recovery MUST land elsewhere
+                        proxy.fault = Fault("reset", after_bytes=0)
+                        proxy.reset_active()
+                        killed = True
+            except Exception:
+                row["abandoned"] += 1
+            else:
+                row["completed"] += 1
+            if killed:
+                row["kills"] += 1
+                proxy.heal()
+            repeated, dropped, exact = session_problems(
+                tokens, indices, want, max_tokens)
+            row["repeated_tokens"] += repeated
+            row["dropped_tokens"] += dropped
+            row["bit_exact"] = row["bit_exact"] and exact
+    finally:
+        client.close()
+        proxy.stop()
+        for s in servers:
+            s.stop()
+    row["delivery_ratio"] = round(row["completed"] / sessions, 4)
+    return row
+
+
+def chaos_problems(row) -> list:
+    """The chaos arm's acceptance invariants (shared by --check and the
+    live capacity_gate --disagg re-run)."""
+    problems = []
+    if row["sessions"] <= 0:
+        problems.append("chaos arm ran no sessions")
+    if row["kills"] <= 0:
+        problems.append("no decode replica was actually killed mid-stream")
+    if row["delivery_ratio"] != 1.0:
+        problems.append(
+            f"delivery {row['delivery_ratio']} != 1.0: a killed decode "
+            "leg lost whole sessions instead of recovering via re-prefill")
+    if row["repeated_tokens"] != 0:
+        problems.append(f"{row['repeated_tokens']} repeated tokens "
+                        "delivered across the decode handover")
+    if row["dropped_tokens"] != 0:
+        problems.append(f"{row['dropped_tokens']} tokens dropped across "
+                        "the decode handover")
+    if row["bit_exact"] is not True:
+        problems.append("recovered streams are not bit-exact vs the "
+                        "monolithic reference")
+    if row.get("abandoned", 0) != 0:
+        problems.append(f"{row['abandoned']} sessions abandoned")
+    return problems
+
+
+def check_doc(data) -> list:
+    failures = []
+    split = data["ttft_itl"]
+    if split["sessions"] <= 0:
+        failures.append("ttft_itl arm measured no sessions")
+    if split["bit_exact"] is not True:
+        failures.append("disagg sessions are not bit-exact vs the "
+                        "monolithic reference")
+    for arm in ("monolithic", "disagg"):
+        if not split[arm].get("ttft_ms") or not split[arm].get("itl_ms"):
+            failures.append(f"ttft_itl arm missing {arm} percentiles")
+    steady = data["steady_state"]
+    if steady["handoffs"] <= 0:
+        failures.append("steady-state arm measured no handoffs")
+    if steady["region_creates_per_handoff"] != 0:
+        failures.append("steady-state handoffs created shm regions")
+    if steady["registration_rpcs_per_handoff"] != 0:
+        failures.append("steady-state handoffs issued registration RPCs")
+    failures.extend(chaos_problems(data["chaos"]))
+    return failures
+
+
+def check(path: str) -> int:
+    failures = check_doc(json.loads(Path(path).read_text()))
+    for msg in failures:
+        print(f"CHECK FAILED: {msg}", file=sys.stderr)
+    if not failures:
+        print(f"{path}: all disaggregated prefill/decode acceptance "
+              "invariants hold")
+    return 1 if failures else 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_DISAGG.json")
+    parser.add_argument("--split-sessions", type=int, default=20)
+    parser.add_argument("--steady-sessions", type=int, default=30)
+    parser.add_argument("--chaos-sessions", type=int, default=8)
+    parser.add_argument("--prompt-tokens", type=int, default=PROMPT_TOKENS)
+    parser.add_argument("--max-tokens", type=int, default=MAX_TOKENS)
+    parser.add_argument("--check", metavar="ARTIFACT",
+                        help="validate an existing artifact instead of "
+                             "benchmarking")
+    args = parser.parse_args()
+    if args.check:
+        return check(args.check)
+
+    from client_tpu.disagg import DisaggClient
+    from client_tpu.models import default_model_zoo
+    from client_tpu.pool import EndpointSpec, PoolClient
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    rng = np.random.default_rng(0xD15A)
+    prompt = rng.integers(0, 256, size=args.prompt_tokens,
+                          dtype=np.int32).tolist()
+    servers = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+               for _ in range(2)]
+    urls = [f"127.0.0.1:{s.port}" for s in servers]
+
+    out = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "note": (
+            "disaggregated prefill/decode (client_tpu.disagg) over "
+            "in-process replica servers: prefill-role KV export, "
+            "digest-verified shared-arena handoff, decode-role streamed "
+            "resume; monolithic baseline is tiny_lm_generate on one "
+            "replica (same zoo decoder weights => bit-exactness is "
+            "checkable); CPU container numbers"
+        ),
+        "prompt_tokens": args.prompt_tokens,
+        "max_tokens": args.max_tokens,
+    }
+
+    try:
+        # -- ttft/itl split + bit-exactness ------------------------------
+        want, _, _, _ = monolithic_tokens(urls[0], prompt, args.max_tokens)
+        mono = PoolClient([urls[0]], protocol="http",
+                          health_interval_s=None)
+        mono_ttft, mono_itl = [], []
+        try:
+            payload = {"TOKENS": [list(prompt)],
+                       "MAX_TOKENS": int(args.max_tokens)}
+            _drive_session(mono.generate_stream(
+                "tiny_lm_generate", payload))  # jit warmup
+            for _ in range(args.split_sessions):
+                _, _, ttft, itls = _drive_session(mono.generate_stream(
+                    "tiny_lm_generate", payload))
+                mono_ttft.append(ttft)
+                mono_itl.extend(itls)
+        finally:
+            mono.close()
+        client = DisaggClient(
+            [EndpointSpec(urls[0], role="prefill"),
+             EndpointSpec(urls[1], role="decode")],
+            protocol="http", health_interval_s=None)
+        dis_ttft, dis_itl, exact = [], [], True
+        try:
+            _drive_session(client.generate_stream(
+                prompt, max_tokens=args.max_tokens))  # jit warmup
+            for _ in range(args.split_sessions):
+                tokens, indices, ttft, itls = _drive_session(
+                    client.generate_stream(
+                        prompt, max_tokens=args.max_tokens))
+                dis_ttft.append(ttft)
+                dis_itl.extend(itls)
+                _, _, ok = session_problems(
+                    tokens, indices, want, args.max_tokens)
+                exact = exact and ok
+
+            out["ttft_itl"] = {
+                "sessions": args.split_sessions,
+                "bit_exact": bool(exact),
+                "monolithic": {"ttft_ms": _percentiles(mono_ttft),
+                               "itl_ms": _percentiles(mono_itl)},
+                "disagg": {"ttft_ms": _percentiles(dis_ttft),
+                           "itl_ms": _percentiles(dis_itl)},
+            }
+            print("ttft_itl:", json.dumps(out["ttft_itl"]))
+
+            # -- steady state: 0 region creates / registration RPCs ------
+            arena = client.arena()
+            before = arena.stats()
+            t0 = time.perf_counter()
+            for _ in range(args.steady_sessions):
+                _drive_session(client.generate_stream(
+                    prompt, max_tokens=args.max_tokens))
+            elapsed = time.perf_counter() - t0
+            after = arena.stats()
+            out["steady_state"] = {
+                "handoffs": args.steady_sessions,
+                "region_creates_per_handoff": (
+                    after["regions_created"] - before["regions_created"])
+                / args.steady_sessions,
+                "registration_rpcs_per_handoff": (
+                    after["registrations_issued"]
+                    - before["registrations_issued"])
+                / args.steady_sessions,
+                "arena_hit_rate": after["hit_rate"],
+                "residual_leased_bytes": after["leased_bytes"],
+                "sessions_per_s": round(args.steady_sessions / elapsed, 1),
+            }
+            print("steady_state:", json.dumps(out["steady_state"]))
+        finally:
+            client.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+    # -- chaos: decode replica killed mid-stream (own stack) -------------
+    out["chaos"] = run_chaos_arm(sessions=args.chaos_sessions,
+                                 prompt_tokens=args.prompt_tokens,
+                                 max_tokens=args.max_tokens)
+    print("chaos:", json.dumps(out["chaos"]))
+
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    print(f"wrote {args.output}")
+    return check(args.output)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,12 @@ twin of the committed trace with the WHOLE home cell blackholed
 mid-replay, and exits 1 when the federated arm no longer spills with
 ~0 user-visible errors, attains its declared SLOs and delivers.
 
+With ``--disagg`` the gate re-runs the committed disaggregated
+prefill/decode decode-kill proof live (``BENCH_DISAGG.json``,
+tools/bench_disagg.py): a decode replica RST mid-stream must still
+recover via re-prefill with delivery 1.0, zero repeated/dropped tokens,
+bit-exact vs the monolithic reference.
+
 With ``--flight`` the gate proves the flight recorder is
 pay-for-what-you-use: the capacity arm replayed recorder-OFF at the
 standard floor must sustain (else INCONCLUSIVE — plain capacity
@@ -426,6 +432,53 @@ def tenancy_recheck(duration_s: float, attempts: int) -> int:
     return 0
 
 
+def disagg_recheck(baseline: str, attempts: int) -> int:
+    """Re-RUN the committed disaggregated prefill/decode chaos proof
+    live (``BENCH_DISAGG.json``, tools/bench_disagg.py): a fresh
+    prefill replica + two decode replicas (one behind a ChaosProxy),
+    decode RST mid-stream — every killed session must still finish via
+    re-prefill recovery with delivery 1.0, zero repeated and zero
+    dropped tokens, bit-exact vs the monolithic reference. Retried
+    ``attempts`` times; the split/steady-state arms are validated from
+    the committed artifact by ``--check``/CI, not re-run here (the
+    decode-kill arm is the robustness claim)."""
+    import tools.bench_disagg as bench
+
+    doc = json.loads(Path(baseline).read_text())
+    problems_committed = bench.check_doc(doc)
+    if problems_committed:
+        print("committed artifact already violates its invariants:")
+        for p in problems_committed:
+            print(f"  - {p}")
+        return 1
+    rows = []
+    for attempt in range(max(1, attempts)):
+        arm = bench.run_chaos_arm()
+        problems = bench.chaos_problems(arm)
+        rows.append({
+            "attempt": attempt + 1,
+            "delivery_ratio": arm["delivery_ratio"],
+            "kills": arm["kills"],
+            "repeated_tokens": arm["repeated_tokens"],
+            "dropped_tokens": arm["dropped_tokens"],
+            "bit_exact": arm["bit_exact"],
+            "problems": problems,
+        })
+        if not problems:
+            break
+    print(json.dumps({"disagg": rows}, indent=2))
+    if rows[-1]["problems"]:
+        print("FAIL: mid-stream decode death no longer recovers "
+              "losslessly:")
+        for p in rows[-1]["problems"]:
+            print(f"  - {p}")
+        return 1
+    print("OK: decode-kill recovery proof reproduces "
+          f"(delivery {rows[-1]['delivery_ratio']}, "
+          f"kills {rows[-1]['kills']}, zero repeats/drops, bit-exact)")
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--baseline", default="BENCH_CAPACITY.json")
@@ -467,8 +520,18 @@ def main() -> int:
                              "capacity within 5%% of isolated under the "
                              "10x-quota adversary, sheds typed over_quota, "
                              "noisy neighbor named")
+    parser.add_argument("--disagg", action="store_true",
+                        help="re-run the committed disaggregated "
+                             "prefill/decode chaos proof live "
+                             "(BENCH_DISAGG.json): a decode replica RST "
+                             "mid-stream must still recover via "
+                             "re-prefill with delivery 1.0 and zero "
+                             "repeated/dropped tokens, bit-exact")
+    parser.add_argument("--disagg-baseline", default="BENCH_DISAGG.json")
     args = parser.parse_args()
 
+    if args.disagg:
+        return disagg_recheck(args.disagg_baseline, args.attempts)
     if args.tenancy:
         return tenancy_recheck(args.duration_s, args.attempts)
     if args.federation:

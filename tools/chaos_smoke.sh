@@ -57,17 +57,23 @@
 # admission controller: compliant capacity within 5% of the isolated
 # baseline, zero compliant SLO breaches, the adversary's rejects all
 # typed over_quota, and the noisy neighbor named in the tenancy
-# snapshot.
+# snapshot. The disaggregation smoke (tests/test_disagg.py,
+# disagg_smoke marker) kills a decode replica mid-stream (proxy RST)
+# under disaggregated prefill/decode serving: the session must finish
+# via re-prefill recovery on the surviving decode replica with zero
+# repeated and zero dropped tokens, bit-exact vs the monolithic
+# reference stream.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke or disagg_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
     tests/test_dataplane_observe.py tests/test_trace_replay.py \
     tests/test_arena.py tests/test_admission.py tests/test_shard.py \
     tests/test_hotkey_cache.py tests/test_flight.py \
-    tests/test_federation.py tests/test_tenancy.py "$@"
+    tests/test_federation.py tests/test_tenancy.py \
+    tests/test_disagg.py "$@"
