@@ -95,6 +95,15 @@ client libraries (triton-inference-server/client), designed TPU-first:
   idempotent re-prefill with every token delivered exactly once, and a
   degraded role falls back to monolithic serving behind a typed
   ``RoleFallback`` event (docs/disaggregation.md).
+- ``client_tpu.pipeline``: client-side model-DAG pipelines — declared
+  ``Pipeline`` graphs of ``Stage``\\ s validated at construction (typed
+  ``PipelineConfigError``) and executed client-orchestrated by
+  ``PipelineClient``/``AioPipelineClient``; intermediates never
+  round-trip the host (shm-arena leases handed off by handle, 0 region
+  creates / 0 registration RPCs steady state, lifetime-planned slab
+  residency equal to the plan's high-water mark); one admission token +
+  one attempt budget per run, a failed stage cancels dependents and
+  raises ``StageFailed`` naming the stage (docs/pipelines.md).
 - ``client_tpu.utils``: Triton<->numpy dtype mapping with *native* bfloat16
   (via ml_dtypes), BYTES/BF16 wire serialization.
 - ``client_tpu.utils.shared_memory``: POSIX system shared memory data plane.

@@ -390,6 +390,94 @@ def _shard_section(layout, snap: Dict[str, Any]) -> Dict[str, Any]:
     return {"layout": layout.describe(), "shards": shards}
 
 
+def _pipeline_section(pipeline, urls, protocol, client_factory,
+                      timeout_s: float, runs: int = 4) -> Dict[str, Any]:
+    """Probe the declared model DAG: run it a few times through a
+    flight-armed PipelineClient over the fleet and report the waterfall
+    — per-stage latencies, each run's dominant flight-attribution key
+    (``pipeline:<stage>``), and the slab plan's high-water versus the
+    arena residency the probe actually observed."""
+    from .flight import FlightRecorder
+    from .pipeline import PipelineClient
+
+    feeds = {}
+    for name, (dtype, shape) in pipeline.inputs.items():
+        concrete = [1 if int(d) < 0 else int(d) for d in shape]
+        np_dtype = triton_to_np_dtype(dtype)
+        if np_dtype is None or np_dtype == np.object_:
+            feeds[name] = np.full(concrete, b"0", dtype=np.object_)
+        else:
+            feeds[name] = np.ones(concrete, dtype=np_dtype)
+    recorder = FlightRecorder(baseline_ratio=1.0)
+    tel = Telemetry(sample="always", flight=recorder)
+    section: Dict[str, Any] = {
+        "pipeline": pipeline.name,
+        "stages": list(pipeline.order),
+        "runs": 0,
+        "errors": [],
+    }
+    client = None
+    try:
+        client = PipelineClient(
+            list(urls), pipeline, protocol=protocol, telemetry=tel,
+            health_interval_s=None, client_factory=client_factory)
+        try:
+            # one unmeasured warmup run: the first execution bills every
+            # stage's jit compile, which would crown a fake hot stage
+            client.run(feeds, client_timeout=timeout_s)
+        except InferenceServerException:
+            pass  # a genuinely broken DAG will show up measured
+        warm_seqs = {t.seq for t in recorder.retained()}
+        samples: Dict[str, List[float]] = {}
+        for _ in range(max(1, runs)):
+            try:
+                res = client.run(feeds, client_timeout=timeout_s)
+                section["runs"] += 1
+                for sname, lat_s in res.stage_latency_s.items():
+                    samples.setdefault(sname, []).append(lat_s * 1e3)
+            except InferenceServerException as e:
+                section["errors"].append(str(e))
+        section["stage_ms"] = {
+            sname: {
+                "count": len(vals),
+                "avg_ms": round(sum(vals) / len(vals), 3),
+                "p50_ms": round(sorted_percentile(sorted(vals), 0.50), 3),
+                "max_ms": round(max(vals), 3),
+            }
+            for sname, vals in samples.items()}
+        stats = client.stats()
+        section["plan_high_water_bytes"] = stats.get(
+            "plan_high_water_bytes")
+        section["observed_high_water_bytes"] = stats.get(
+            "observed_high_water_bytes")
+        # per-run dominant attribution over the probe's own recorder:
+        # every timeline is retained (baseline_ratio=1.0), so this is
+        # the full measured population, not an anomaly sample
+        dominant: Dict[str, int] = {}
+        for timeline in recorder.retained():
+            if timeline.seq in warm_seqs:
+                continue
+            att = timeline.attribution()
+            key = att.get("dominant")
+            if key:
+                dominant[key] = dominant.get(key, 0) + 1
+        section["dominant"] = dominant
+        stage_rows = section["stage_ms"]
+        total_avg = sum(row.get("avg_ms", 0.0)
+                        for row in stage_rows.values())
+        if stage_rows and total_avg > 0:
+            hot = max(stage_rows, key=lambda k: stage_rows[k]["avg_ms"])
+            section["hot_stage"] = hot
+            section["hot_share"] = round(
+                stage_rows[hot]["avg_ms"] / total_avg, 4)
+    except InferenceServerException as e:
+        section["error"] = str(e)
+    finally:
+        if client is not None:
+            client.close()
+    return section
+
+
 def _registry_section(snapshot: Dict[str, Any], prefix: str) -> Dict[str, Any]:
     return {name: family for name, family in snapshot.items()
             if name.startswith(prefix) and family.get("series")}
@@ -454,6 +542,30 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
                 detail += f" ({fallbacks} RoleFallback events counted)"
             flags.append({"flag": "role_degraded", "url": None,
                           "role": role, "detail": detail})
+    # client-orchestrated DAG: one stage soaking up most of the graph's
+    # wall time is the pipeline's capacity ceiling — replicate THAT
+    # model, not the whole chain. Only meaningful with >= 2 stages (a
+    # one-stage pipeline trivially dominates itself) and flagged off the
+    # probe's own measured waterfall, not a heuristic.
+    pipe = snap.get("pipeline") or {}
+    hot = pipe.get("hot_stage")
+    if (hot is not None and len(pipe.get("stages", [])) >= 2
+            and pipe.get("hot_share", 0.0) >= 0.5):
+        row = (pipe.get("stage_ms") or {}).get(hot, {})
+        flags.append({
+            "flag": "pipeline_stage_hot", "url": None, "stage": hot,
+            "detail": (f"stage {hot!r} holds "
+                       f"{pipe['hot_share']:.0%} of the DAG's stage "
+                       f"time (avg {row.get('avg_ms', 0):.2f} ms over "
+                       f"{pipe.get('runs', 0)} probe runs) — scale "
+                       f"that model's replicas before the rest of the "
+                       f"chain")})
+    if pipe.get("errors"):
+        flags.append({
+            "flag": "pipeline_probe_errors", "url": None,
+            "detail": (f"{len(pipe['errors'])} of "
+                       f"{pipe['runs'] + len(pipe['errors'])} probe DAG "
+                       f"runs failed: {pipe['errors'][0]}")})
     for slo in snap.get("slos", []):
         if slo["breached"]:
             flags.append({
@@ -683,6 +795,8 @@ def collect_snapshot(
     shard_layout=None,
     cells=None,
     roles=None,
+    pipeline=None,
+    pipeline_runs: int = 4,
 ) -> Dict[str, Any]:
     """Probe the fleet and return the full snapshot dict (JSON-ready).
 
@@ -718,7 +832,16 @@ def collect_snapshot(
     RoleFallback events), and ``role_degraded`` is flagged for any role
     with members but zero routable ones — the state in which every
     role-aware session silently degrades to monolithic serving. With an
-    empty ``urls``, the probe covers the roles' urls."""
+    empty ``urls``, the probe covers the roles' urls.
+
+    ``pipeline``: a ``client_tpu.pipeline.Pipeline`` (or its spec
+    string: ``"chain"`` or an inline graph spec) declaring a client-
+    orchestrated model DAG: the doctor runs it ``pipeline_runs`` times
+    through a flight-armed probe ``PipelineClient`` over the fleet and
+    the snapshot gains a ``pipeline`` section (per-stage latency
+    waterfall, each run's dominant flight attribution, slab-plan vs
+    observed arena high-water) plus the ``pipeline_stage_hot`` anomaly
+    when one stage dominates the DAG's wall time."""
     if isinstance(cells, str):
         from .federation import parse_cells_spec
 
@@ -741,6 +864,10 @@ def collect_snapshot(
         from .shard import ShardLayout
 
         shard_layout = ShardLayout.parse(shard_layout, list(urls))
+    if isinstance(pipeline, str):
+        from .pipeline import resolve_pipeline
+
+        pipeline = resolve_pipeline(pipeline)
     tel = telemetry
     if tel is None:
         tel = Telemetry(sample="always", orca_format=orca_format,
@@ -832,6 +959,10 @@ def collect_snapshot(
                                                     probe_timeout_s)
         if shard_layout is not None:
             snap["shard"] = _shard_section(shard_layout, snap)
+        if pipeline is not None:
+            snap["pipeline"] = _pipeline_section(
+                pipeline, urls, protocol, client_factory,
+                probe_timeout_s, pipeline_runs)
         role_summary = pool.health_summary().get("roles")
         if role_summary:
             snap["roles"] = role_summary
@@ -976,6 +1107,33 @@ def render_summary(snap: Dict[str, Any]) -> str:
                 f"  {role:<10} {state:<10} healthy "
                 f"{row.get('healthy', '?')}/{row.get('endpoints', '?')}"
                 f"{extra}")
+    pipe = snap.get("pipeline")
+    if pipe:
+        lines.append("")
+        if "error" in pipe:
+            lines.append(f"pipeline ({pipe.get('pipeline')}): "
+                         f"{pipe['error']}")
+        else:
+            lines.append(
+                f"pipeline ({pipe['pipeline']}; "
+                f"{len(pipe.get('stages', []))} stages, "
+                f"{pipe.get('runs', 0)} probe runs):")
+            stage_ms = pipe.get("stage_ms") or {}
+            dominant = pipe.get("dominant") or {}
+            for sname in pipe.get("stages", []):
+                row = stage_ms.get(sname) or {}
+                hot = " HOT" if sname == pipe.get("hot_stage") and (
+                    pipe.get("hot_share", 0.0) >= 0.5) else ""
+                dom = dominant.get(f"pipeline:{sname}", 0)
+                lines.append(
+                    f"  {sname:<16} avg {row.get('avg_ms', 0):.2f} ms "
+                    f"p50 {row.get('p50_ms', 0):.2f} ms max "
+                    f"{row.get('max_ms', 0):.2f} ms  dominant in "
+                    f"{dom}/{pipe.get('runs', 0)} runs{hot}")
+            lines.append(
+                f"  arena high-water: plan "
+                f"{pipe.get('plan_high_water_bytes')}B observed "
+                f"{pipe.get('observed_high_water_bytes')}B")
     for fedrow in snap.get("cells") or []:
         if "error" in fedrow:
             lines.append("")
@@ -1198,6 +1356,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "events) and flags role_degraded for any "
                              "role with zero routable members "
                              "(client_tpu.disagg)")
+    parser.add_argument("--pipeline", default=None, metavar="SPEC",
+                        help="client-orchestrated model-DAG probe: "
+                             "'chain' (the zoo's tokenize->embed->rerank "
+                             "chain) or an inline graph spec runs the "
+                             "DAG through a flight-armed PipelineClient "
+                             "over the fleet, adds the pipeline section "
+                             "(per-stage waterfall, dominant flight "
+                             "attribution, slab-plan vs observed arena "
+                             "high-water) and flags pipeline_stage_hot "
+                             "when one stage dominates "
+                             "(client_tpu.pipeline)")
+    parser.add_argument("--pipeline-runs", type=int, default=4,
+                        help="probe DAG executions for --pipeline")
     parser.add_argument("--timeout", type=float, default=10.0,
                         help="per-call timeout (s) bounding every snapshot "
                              "RPC: health probes, probe infers, stats "
@@ -1232,7 +1403,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         churn_threshold_ops_s=args.churn_threshold,
         skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout,
         shard_layout=args.shard_layout, cells=args.cells,
-        roles=args.roles)
+        roles=args.roles, pipeline=args.pipeline,
+        pipeline_runs=args.pipeline_runs)
     print(render_summary(snap))
     if args.json_path:
         with open(args.json_path, "w") as f:

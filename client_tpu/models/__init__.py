@@ -9,6 +9,13 @@ TPU VM: the same wire contracts, but the compute runs on XLA.
 """
 
 from .base import Model, TensorSpec
+from .chain import (
+    ChainCore,
+    ChainEmbedModel,
+    ChainFusedModel,
+    ChainRerankModel,
+    ChainTokenizeModel,
+)
 from .decoder_batched import BatchedDecoderModel
 from .decoder_prefill import PrefillDecoderModel
 from .disagg import DisaggPrefillModel, KvDecodeModel
@@ -26,6 +33,11 @@ from .simple import (
 __all__ = [
     "AddSubModel",
     "BatchedDecoderModel",
+    "ChainCore",
+    "ChainEmbedModel",
+    "ChainFusedModel",
+    "ChainRerankModel",
+    "ChainTokenizeModel",
     "DisaggPrefillModel",
     "EnsembleModel",
     "EnsembleStep",

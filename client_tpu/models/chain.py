@@ -1,0 +1,151 @@
+"""The 3-stage chain fixtures behind ``client_tpu.pipeline``'s proofs.
+
+Four models over ONE shared parameter/step core (:class:`ChainCore`):
+
+- ``chain_tokenize``: RAW INT32[B,L] -> TOKENS INT32[B,L], a fixed
+  affine hash into the vocab (``(RAW * 31 + 7) % VOCAB``).
+- ``chain_embed``: TOKENS INT32[B,L] -> EMBED FP32[B,L,32], a seeded
+  embedding-table gather.
+- ``chain_rerank``: EMBED FP32[B,L,32] -> SCORES FP32[B,L], a seeded
+  linear projection.
+- ``chain_fused``: RAW INT32[B,L] -> SCORES FP32[B,L], the monolithic
+  reference running the SAME three compiled step functions end-to-end.
+
+Bit-exactness between a pipeline run of the three stages and one
+``chain_fused`` call is BY CONSTRUCTION, not by tolerance: the fused
+model composes the very jitted callables the stage models serve (the
+disagg.py weight-sharing proof pattern) — it never re-jits a fused
+program whose XLA fusion could reassociate the float math.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+import numpy as np
+
+from .base import Model, TensorSpec
+
+VOCAB = 997
+EMBED_DIM = 32
+_SEED = 20260807
+
+
+class ChainCore:
+    """Shared seeded parameters + lazily-jitted step functions for the
+    chain fixtures. ONE instance backs all four models so stage-by-stage
+    and fused execution run bit-identical compiled steps."""
+
+    def __init__(self, seed: int = _SEED):
+        rng = np.random.default_rng(seed)
+        self.table = rng.standard_normal(
+            (VOCAB, EMBED_DIM)).astype(np.float32)
+        self.proj = rng.standard_normal((EMBED_DIM,)).astype(np.float32)
+        self.bias = np.float32(rng.standard_normal())
+        self._lock = threading.Lock()
+        self._fns = None
+
+    def fns(self):
+        with self._lock:
+            if self._fns is None:
+                import jax
+                import jax.numpy as jnp
+
+                table = jnp.asarray(self.table)
+                proj = jnp.asarray(self.proj)
+                bias = jnp.asarray(self.bias)
+
+                @jax.jit
+                def tokenize(raw):
+                    return (raw * 31 + 7) % VOCAB
+
+                @jax.jit
+                def embed(tokens):
+                    return table[tokens % VOCAB]
+
+                @jax.jit
+                def rerank(embedded):
+                    return jnp.einsum("ble,e->bl", embedded, proj) + bias
+
+                self._fns = (tokenize, embed, rerank)
+            return self._fns
+
+
+_CORE: ChainCore = ChainCore()
+
+
+def chain_core() -> ChainCore:
+    """The module-level shared core (models default to it)."""
+    return _CORE
+
+
+class _ChainModel(Model):
+    def __init__(self, core: ChainCore = None):
+        super().__init__()
+        self.core = core or chain_core()
+
+
+class ChainTokenizeModel(_ChainModel):
+    """``chain_tokenize``: RAW INT32[B,L] -> TOKENS INT32[B,L]."""
+
+    name = "chain_tokenize"
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("RAW", "INT32", [-1, -1])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [TensorSpec("TOKENS", "INT32", [-1, -1])]
+
+    def execute(self, inputs, parameters) -> Dict[str, np.ndarray]:
+        tokenize, _, _ = self.core.fns()
+        return {"TOKENS": tokenize(inputs["RAW"])}
+
+
+class ChainEmbedModel(_ChainModel):
+    """``chain_embed``: TOKENS INT32[B,L] -> EMBED FP32[B,L,32]."""
+
+    name = "chain_embed"
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("TOKENS", "INT32", [-1, -1])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [TensorSpec("EMBED", "FP32", [-1, -1, EMBED_DIM])]
+
+    def execute(self, inputs, parameters) -> Dict[str, np.ndarray]:
+        _, embed, _ = self.core.fns()
+        return {"EMBED": embed(inputs["TOKENS"])}
+
+
+class ChainRerankModel(_ChainModel):
+    """``chain_rerank``: EMBED FP32[B,L,32] -> SCORES FP32[B,L]."""
+
+    name = "chain_rerank"
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("EMBED", "FP32", [-1, -1, EMBED_DIM])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [TensorSpec("SCORES", "FP32", [-1, -1])]
+
+    def execute(self, inputs, parameters) -> Dict[str, np.ndarray]:
+        _, _, rerank = self.core.fns()
+        return {"SCORES": rerank(inputs["EMBED"])}
+
+
+class ChainFusedModel(_ChainModel):
+    """``chain_fused``: the monolithic RAW -> SCORES reference, running
+    the same compiled steps the three stage models serve."""
+
+    name = "chain_fused"
+
+    def inputs(self) -> List[TensorSpec]:
+        return [TensorSpec("RAW", "INT32", [-1, -1])]
+
+    def outputs(self) -> List[TensorSpec]:
+        return [TensorSpec("SCORES", "FP32", [-1, -1])]
+
+    def execute(self, inputs, parameters) -> Dict[str, np.ndarray]:
+        tokenize, embed, rerank = self.core.fns()
+        return {"SCORES": rerank(embed(tokenize(inputs["RAW"])))}

@@ -207,6 +207,12 @@ class RepeatModel(Model):
 def default_model_zoo() -> List[Model]:
     """The fixture set every test/example expects to find on the server."""
     from .batched import BatchedMatMulModel
+    from .chain import (
+        ChainEmbedModel,
+        ChainFusedModel,
+        ChainRerankModel,
+        ChainTokenizeModel,
+    )
     from .decoder import TinyDecoderModel
     from .decoder_batched import BatchedDecoderModel
     from .decoder_prefill import PrefillDecoderModel
@@ -238,4 +244,11 @@ def default_model_zoo() -> List[Model]:
         # weights so the split stream is bit-exact vs tiny_lm_generate
         DisaggPrefillModel(decoder=decoder),
         KvDecodeModel(decoder=decoder),
+        # the pipeline chain (client_tpu/pipeline.py): three stages plus
+        # the fused reference, all over one shared ChainCore so DAG runs
+        # are bit-exact vs the single-model call
+        ChainTokenizeModel(),
+        ChainEmbedModel(),
+        ChainRerankModel(),
+        ChainFusedModel(),
     ]

@@ -136,6 +136,7 @@ class PerfRunner:
         cells_deadline_s: Optional[float] = 5.0,
         cells_attempt_timeout_s: Optional[float] = None,
         roles=None,
+        pipeline=None,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -228,6 +229,15 @@ class PerfRunner:
 
             roles = parse_cells_spec(roles)
         self.roles = roles
+        # client-orchestrated model-DAG replay (client_tpu.pipeline): a
+        # Pipeline or its spec string ("chain" or an inline graph spec);
+        # trace replay drives ``pipeline`` records (format v6) through a
+        # PipelineClient over the replay endpoints
+        if isinstance(pipeline, str):
+            from .pipeline import resolve_pipeline
+
+            pipeline = resolve_pipeline(pipeline)
+        self.pipeline = pipeline
         self.seed = seed
         # sharded scatter-gather (client_tpu.shard): a ShardLayout or a
         # spec string ("IN=0->OUT=0") resolved over --endpoints in order;
@@ -1480,6 +1490,13 @@ class PerfRunner:
                     "--roles 'prefill=u1;decode=u2' so the replayer can "
                     "build a DisaggClient over role-labeled endpoints "
                     "(client_tpu.disagg)")
+        if (any(r.kind == "pipeline" for r in records)
+                and self.pipeline is None):
+            raise ValueError(
+                "trace contains pipeline records: configure --pipeline "
+                "('chain' or an inline graph spec) so the replayer can "
+                "run them as client-orchestrated DAGs "
+                "(client_tpu.pipeline)")
         specs: List[SLOSpec] = [
             spec if isinstance(spec, SLOSpec) else parse_slo_spec(spec)
             for spec in slos]
@@ -1538,6 +1555,11 @@ class PerfRunner:
             # SLOs per record, like unaries, so warmup sessions land
             # nothing in the per-run Telemetry)
             resources.disagg = self._make_disagg_client()
+        if any(r.kind == "pipeline" for r in records):
+            # one PipelineClient (own pool, arena-backed) for the whole
+            # replay; per-stage latencies land in the resources and
+            # surface as the result row's ``pipeline_stages`` waterfall
+            resources.pipeline = self._make_pipeline_client()
         try:
             return self._run_trace_workers(
                 header, records, speed, replay_workers, specs, on_result,
@@ -1545,6 +1567,8 @@ class PerfRunner:
         finally:
             if resources.disagg is not None:
                 resources.disagg.close()
+            if resources.pipeline is not None:
+                resources.pipeline.close()
 
     def _run_trace_workers(self, header, records, speed, replay_workers,
                            specs, on_result, warmup, trace_duration,
@@ -1565,6 +1589,8 @@ class PerfRunner:
             finally:
                 warm_client.close()
                 self._telemetry = saved_telemetry
+            # warmup DAG runs must not land in the measured waterfall
+            resources.pipeline_stage_s.clear()
         client = self._make_client(replay_workers)
         try:
             # pools: let active probes mark replicas healthy BEFORE the
@@ -1634,6 +1660,16 @@ class PerfRunner:
             + (self.endpoints or [])))
         specs = [EndpointSpec(u, role=role_by_url.get(u)) for u in urls]
         return DisaggClient(specs, protocol=self.protocol)
+
+    def _make_pipeline_client(self):
+        """The replay's DAG executor: a PipelineClient over the replay
+        endpoints (its own arena-backed pool, so intermediate handoffs
+        ride cached shm registrations exactly like production runs)."""
+        from .pipeline import PipelineClient
+
+        urls = list(self.endpoints) if self.endpoints else [self.url]
+        return PipelineClient(urls, self.pipeline,
+                              protocol=self.protocol)
 
     def _replay_warmup(self, client, records, resources) -> None:
         """One best-effort dispatch per distinct (kind, model) BEFORE the
@@ -1780,6 +1816,12 @@ class PerfRunner:
                 rec.prompt_tokens, getattr(rec, "content_key", None))
             return list(resources.disagg.generate_stream(
                 tokens, max_tokens=int(rec.output_tokens)))
+        if rec.kind == "pipeline":
+            # the DAG runs on its own arena-backed pool; the measurement
+            # client plays no part in the stage dispatches
+            res = resources.pipeline.run(resources.feeds_for(rec))
+            resources.record_pipeline(res)
+            return res
         # non-sharded kinds bypass the scatter-gather wrapper (a sharded
         # client types-rejects streams and would scatter plain unaries)
         client = getattr(client, "inner", client)
@@ -1960,6 +2002,15 @@ class PerfRunner:
             "slo": slo_rows,
             "slo_ok": all(row["attained"] for row in slo_rows),
         }
+        if resources.pipeline_stage_s:
+            # only when the trace carried pipeline records: the per-stage
+            # latency waterfall across every measured DAG run
+            result["pipeline_stages"] = {
+                stage: dict(count=len(vals),
+                            **_latency_ms_row(sorted(vals)))
+                for stage, vals in
+                sorted(resources.pipeline_stage_s.items())
+            }
         if tenant_rows:
             # only when the trace carried tenant-attributed records:
             # tenantless replays keep byte-identical result rows
@@ -2017,7 +2068,16 @@ class _ReplayResources:
         # the replay's DisaggClient (set by the runner when the trace
         # carries prefill_decode records; closed by the runner)
         self.disagg = None
+        # the replay's PipelineClient + per-stage latency accumulator
+        # (set by the runner when the trace carries pipeline records)
+        self.pipeline = None
+        self.pipeline_stage_s: Dict[str, List[float]] = {}
+        self._pipeline_lock = threading.Lock()
+        self._feeds: Dict[Any, Dict[str, Any]] = {}
         for rec in records:
+            if rec.kind == "pipeline":
+                self.feeds_for(rec)
+                continue
             if rec.kind == "sequence":
                 self.seq_gates.setdefault(rec.seq_group, _SeqGate())
             elif rec.kind in ("generate_stream", "prefill_decode"):
@@ -2051,6 +2111,27 @@ class _ReplayResources:
                 inputs.append(inp)
             self._inputs[key] = inputs
         return inputs
+
+    def feeds_for(self, rec) -> Dict[str, Any]:
+        """One deterministic ndarray feed dict per distinct pipeline
+        record layout (PipelineClient.run() takes host arrays, not
+        InferInputs — the client owns the wire staging)."""
+        key = (rec.model,
+               tuple(sorted((name, rec.dtypes[name], tuple(shape))
+                            for name, shape in rec.shapes.items())))
+        feeds = self._feeds.get(key)
+        if feeds is None:
+            feeds = {
+                name: _random_tensor(rec.dtypes[name],
+                                     list(rec.shapes[name]), self._rng)
+                for name in sorted(rec.shapes)}
+            self._feeds[key] = feeds
+        return feeds
+
+    def record_pipeline(self, result) -> None:
+        with self._pipeline_lock:
+            for stage, lat_s in result.stage_latency_s.items():
+                self.pipeline_stage_s.setdefault(stage, []).append(lat_s)
 
     def tokens_for(self, prompt_tokens: int, content_key=None) -> list:
         key = (prompt_tokens, content_key)
@@ -2256,6 +2337,14 @@ def main(argv: Optional[List[str]] = None) -> int:
              "replay as two-leg sessions (client_tpu.disagg; see "
              "docs/disaggregation.md)")
     parser.add_argument(
+        "--pipeline", default=None, metavar="SPEC",
+        help="model-DAG spec for replaying 'pipeline' trace records "
+             "(format v6) as client-orchestrated graphs with "
+             "arena-resident intermediates: 'chain' (the zoo's "
+             "tokenize->embed->rerank chain) or an inline graph spec "
+             "(client_tpu.pipeline; see docs/pipelines.md); result rows "
+             "gain per-stage latency columns under 'pipeline_stages'")
+    parser.add_argument(
         "--home-cell", default=None,
         help="the locality-preferred cell (default: first in --cells)")
     parser.add_argument(
@@ -2354,6 +2443,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         canary_slo=args.canary_slo,
         canary_min_events=args.canary_min_events,
         roles=args.roles,
+        pipeline=args.pipeline,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside

@@ -1819,6 +1819,25 @@ class PoolClient(_PoolClientBase):
         self.pool.record_success(ep, time.monotonic() - t0)
         return result
 
+    def routed_infer(self, model_name: str, inputs, *args, **kwargs):
+        """One pool-routed infer WITHOUT the pool-level admission gate:
+        full routing/failover/hedging, but admission belongs to the
+        caller — the pipeline layer (``client_tpu.pipeline``) charges
+        ONE token per logical DAG run and dispatches each unpinned
+        stage here (the ``pinned_infer`` contract, minus the pin).
+        ``affinity_key=`` still lands the request on its key's home
+        replica under ``routing="affinity"``."""
+        kwargs = _fold_infer_args(args, kwargs)
+        affinity_key = kwargs.pop("affinity_key", None)
+        kwargs.pop("tenant", None)
+        sequence_id = kwargs.get("sequence_id", 0)
+        try:
+            return self._infer_routed(model_name, inputs, kwargs,
+                                      sequence_id, affinity_key)
+        except AdmissionRejected as e:
+            self._admission_note_shed(e)  # endpoint-limiter shed
+            raise
+
     def pinned_generate_stream(self, url: str, *args, **kwargs):
         """One SSE generate stream against the named replica: no routing,
         no failover and no pool-level admission gate — the disaggregated
@@ -2389,6 +2408,22 @@ class AioPoolClient(_PoolClientBase):
             self.pool.done(ep)
         self.pool.record_success(ep, time.monotonic() - t0)
         return result
+
+    async def routed_infer(self, model_name: str, inputs, *args,
+                           **kwargs):
+        """Async twin of the sync :meth:`PoolClient.routed_infer` (the
+        pipeline layer's per-stage dispatch: routed, admission-free)."""
+        self._ensure_prober()
+        kwargs = _fold_infer_args(args, kwargs)
+        affinity_key = kwargs.pop("affinity_key", None)
+        kwargs.pop("tenant", None)
+        sequence_id = kwargs.get("sequence_id", 0)
+        try:
+            return await self._infer_routed(model_name, inputs, kwargs,
+                                            sequence_id, affinity_key)
+        except AdmissionRejected as e:
+            self._admission_note_shed(e)
+            raise
 
     # -- streaming (HTTP generate extension) ----------------------------------
     def generate_stream(self, *args, **kwargs):

@@ -63,11 +63,11 @@ import numpy as np
 # a v1 reader still loads the v1-compatible records of a mixed trace, and
 # only records carrying newer-versioned semantics stamp their own ``v``
 # (the PR 8 forward-compat rule: skip-and-count, never fatal)
-TRACE_VERSION = 5
+TRACE_VERSION = 6
 BASE_VERSION = 1
 # record kinds introduced after the base format stamp their records with
 # the version that introduced them
-_KIND_VERSIONS = {"sharded": 2, "prefill_decode": 5}
+_KIND_VERSIONS = {"sharded": 2, "prefill_decode": 5, "pipeline": 6}
 # records carrying a zipfian ``content_key`` (the hot-key workload knob)
 # stamp v=3: a v2 loader skips exactly these, counted, and keeps the rest
 _CONTENT_KEY_VERSION = 3
@@ -78,7 +78,7 @@ _CONTENT_KEY_VERSION = 3
 _TENANT_VERSION = 4
 
 KINDS = ("unary", "generate_stream", "sequence", "sharded",
-         "prefill_decode")
+         "prefill_decode", "pipeline")
 
 # default tensor layouts per well-known zoo model, so generator specs can
 # name a model without restating its wire contract
@@ -91,6 +91,9 @@ _DEFAULT_LAYOUTS: Dict[str, Tuple[Dict[str, List[int]], Dict[str, str]]] = {
     # scatter-gather targets); replay tokens stay inside the VOCAB
     "decoder_lm_prefill": ({"TOKENS": [4, 8]}, {"TOKENS": "INT32"}),
     "decoder_lm_tp_prefill": ({"TOKENS": [4, 8]}, {"TOKENS": "INT32"}),
+    # the pipeline chain's feed layout (client_tpu/pipeline.py): the
+    # record's model names the PIPELINE, shapes/dtypes its declared feeds
+    "chain": ({"RAW": [1, 16]}, {"RAW": "INT32"}),
 }
 
 
@@ -198,7 +201,8 @@ class TraceRecord:
             "at_s": round(at_s, 6), "kind": kind, "model": model,
             "version": str(obj.get("model_version", "")),
         }
-        if kind in ("unary", "sequence", "sharded") and "shapes" not in obj:
+        if kind in ("unary", "sequence", "sharded", "pipeline") \
+                and "shapes" not in obj:
             raise TraceParseError(
                 line, f"{kind} requires shapes/dtypes")
         if "shapes" in obj:
@@ -564,6 +568,8 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
           shard_batch: Optional[int] = None,
           disagg_fraction: float = 0.0,
           disagg_model: str = "decoder_lm_kv_decode",
+          pipeline_fraction: float = 0.0,
+          pipeline_model: str = "chain",
           hot_key_alpha: float = 1.1,
           hot_key_universe: int = 0,
           shapes: Optional[Dict[str, List[int]]] = None,
@@ -593,12 +599,20 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
     the replayer drives through ``client_tpu.disagg.DisaggClient``
     (``--roles``), sized by the same heavy-tail prompt/output draws as
     streams. The default 0 draws nothing extra, so pre-v5 specs keep
-    producing byte-identical traces."""
+    producing byte-identical traces.
+
+    ``pipeline_fraction > 0`` carves a slice of arrivals into
+    ``pipeline`` records (format v6, stamped per record so v5 loaders
+    skip-and-count them): client-orchestrated model-DAG runs the
+    replayer drives through ``client_tpu.pipeline`` (``--pipeline``).
+    The record's ``model`` names the pipeline, its shapes/dtypes the
+    declared feeds. The default 0 draws nothing extra, so pre-v6 specs
+    keep producing byte-identical traces."""
     if (stream_fraction + seq_fraction + shard_fraction
-            + disagg_fraction > 1.0):
+            + disagg_fraction + pipeline_fraction > 1.0):
         raise ValueError(
             "stream_fraction + seq_fraction + shard_fraction + "
-            "disagg_fraction must be <= 1")
+            "disagg_fraction + pipeline_fraction must be <= 1")
     if seq_len_min < 1 or seq_len_max < seq_len_min:
         raise ValueError("need 1 <= seq_len_min <= seq_len_max")
     rng = np.random.default_rng(seed)
@@ -636,6 +650,17 @@ def mixed(seed: int = 0, duration_s: float = 10.0, rate: float = 50.0,
                 output_tokens=_heavy_tail_length(
                     rng, tail, output_mean, output_sigma, alpha, max_output),
                 prefill_role="prefill", decode_role="decode"))
+            continue
+        pipe_lo = (stream_fraction + seq_fraction + shard_fraction
+                   + disagg_fraction)
+        if pipeline_fraction and pipe_lo <= pick \
+                < pipe_lo + pipeline_fraction:
+            # one DAG run per arrival; no extra rng draws, so
+            # pipeline-less specs stay byte-identical
+            pipe_shapes, pipe_dtypes = _layout(pipeline_model)
+            records.append(TraceRecord(
+                at_s=t, kind="pipeline", model=pipeline_model,
+                shapes=pipe_shapes, dtypes=pipe_dtypes))
             continue
         if pick < stream_fraction:
             if pmf is not None:
@@ -776,7 +801,7 @@ GENERATORS = {
 
 # spec params that must stay strings when parsed from a spec
 _STR_PARAMS = {"model", "unary_model", "stream_model", "seq_model",
-               "shard_model", "disagg_model", "tail"}
+               "shard_model", "disagg_model", "pipeline_model", "tail"}
 
 
 def parse_gen_spec(spec: str) -> Tuple[str, Dict[str, Any]]:

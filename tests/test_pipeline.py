@@ -1,0 +1,540 @@
+"""Client-side model-DAG pipeline tests (ISSUE 18).
+
+The matrix the tentpole claims: (a) construction-time validation raises
+typed ``PipelineConfigError`` for cycles, missing producers, dtype/shape
+incompatibilities and unconsumed outputs/inputs; (b) a chain DAG run is
+BIT-exact vs the fused single-model reference on sync AND aio clients;
+(c) steady-state intermediate handoffs do zero region creates and zero
+registration RPCs, and every lease is returned; (d) peak arena residency
+equals the slab plan's high-water mark; (e) independent stages fan out
+concurrently; (f) a killed stage raises typed ``StageFailed`` naming the
+stage, cancels unstarted dependents and leaks zero leases (the
+``pipeline_smoke`` chaos marker); (g) ONE admission token covers the
+whole DAG run; (h) the flight recorder retains the ``pipeline`` layer's
+plan/dispatch/handoff/settle/release waterfall and ``attribution()``
+names the slow stage; (i) the committed BENCH_PIPELINE.json still claims
+what CI enforces; (j) trace v6 ``pipeline`` records round-trip, stay
+byte-identical for old specs, skip forward-compatibly, and replay
+through ``perf.py --pipeline`` with per-stage latency columns.
+"""
+
+import asyncio
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu import trace as trace_mod
+from client_tpu.admission import AdmissionController
+from client_tpu.doctor import collect_snapshot, render_summary
+from client_tpu.flight import FlightRecorder
+from client_tpu.models import default_model_zoo
+from client_tpu.models.simple import IdentityModel
+from client_tpu.observe import Telemetry
+from client_tpu.pipeline import (
+    AioPipelineClient,
+    Pipeline,
+    PipelineClient,
+    PipelineConfigError,
+    Stage,
+    StageFailed,
+    chain_pipeline,
+    resolve_pipeline,
+)
+from client_tpu.server import HttpInferenceServer, ServerCore
+from client_tpu.testing import ChaosProxy, Fault
+
+RAW = np.arange(16, dtype=np.int32).reshape(1, 16) * 3 + 1
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def fused_scores(server):
+    """The bit-exactness reference: chain_fused in ONE model call."""
+    client = httpclient.InferenceServerClient(server.url)
+    try:
+        inp = httpclient.InferInput("RAW", list(RAW.shape), "INT32")
+        inp.set_data_from_numpy(RAW)
+        res = client.infer("chain_fused", [inp])
+        return res.as_numpy("SCORES")
+    finally:
+        client.close()
+
+
+def _ident_stage(name, model, src, shape, dtype="INT32"):
+    return Stage(name, model, inputs={"INPUT0": src},
+                 outputs={"OUTPUT0": (dtype, list(shape))})
+
+
+# -- (a) construction-time validation ------------------------------------------
+def test_cycle_is_typed():
+    with pytest.raises(PipelineConfigError, match="cycle"):
+        Pipeline(
+            stages=[
+                Stage("a", "identity_fp32", inputs={"INPUT0": "b.OUTPUT0"},
+                      outputs={"OUTPUT0": ("FP32", [1, 4])}),
+                Stage("b", "identity_fp32", inputs={"INPUT0": "a.OUTPUT0"},
+                      outputs={"OUTPUT0": ("FP32", [1, 4])}),
+            ],
+            inputs={"X": ("FP32", [1, 4])},
+            outputs={"Y": "b.OUTPUT0"})
+
+
+def test_missing_producer_is_typed():
+    with pytest.raises(PipelineConfigError, match="unknown stage"):
+        Pipeline(
+            stages=[_ident_stage("a", "identity_fp32", "ghost.OUT",
+                                 [1, 4], "FP32")],
+            inputs={"X": ("FP32", [1, 4])},
+            outputs={"Y": "a.OUTPUT0"})
+
+
+def test_missing_output_on_producer_is_typed():
+    with pytest.raises(PipelineConfigError, match="does not declare"):
+        Pipeline(
+            stages=[
+                _ident_stage("a", "identity_fp32", "$.X", [1, 4], "FP32"),
+                _ident_stage("b", "identity_fp32", "a.NOPE", [1, 4],
+                             "FP32"),
+            ],
+            inputs={"X": ("FP32", [1, 4])},
+            outputs={"Y": "b.OUTPUT0"})
+
+
+def test_dtype_mismatch_is_typed():
+    with pytest.raises(PipelineConfigError, match="expects dtype"):
+        Pipeline(
+            stages=[
+                _ident_stage("a", "identity_fp32", "$.X", [1, 4], "FP32"),
+                Stage("b", "custom_identity_int32",
+                      inputs={"INPUT0": "a.OUTPUT0"},
+                      input_specs={"INPUT0": ("INT32", [1, 4])},
+                      outputs={"OUTPUT0": ("INT32", [1, 4])}),
+            ],
+            inputs={"X": ("FP32", [1, 4])},
+            outputs={"Y": "b.OUTPUT0"})
+
+
+def test_shape_mismatch_is_typed():
+    with pytest.raises(PipelineConfigError, match="expects shape"):
+        Pipeline(
+            stages=[
+                _ident_stage("a", "identity_fp32", "$.X", [1, 4], "FP32"),
+                Stage("b", "identity_fp32",
+                      inputs={"INPUT0": "a.OUTPUT0"},
+                      input_specs={"INPUT0": ("FP32", [2, 8])},
+                      outputs={"OUTPUT0": ("FP32", [2, 8])}),
+            ],
+            inputs={"X": ("FP32", [1, 4])},
+            outputs={"Y": "b.OUTPUT0"})
+
+
+def test_unconsumed_output_is_typed():
+    with pytest.raises(PipelineConfigError, match="unconsumed stage"):
+        Pipeline(
+            stages=[
+                _ident_stage("a", "identity_fp32", "$.X", [1, 4], "FP32"),
+                _ident_stage("b", "identity_fp32", "$.X", [1, 4], "FP32"),
+            ],
+            inputs={"X": ("FP32", [1, 4])},
+            outputs={"Y": "a.OUTPUT0"})  # b.OUTPUT0 is dead
+
+
+def test_unconsumed_input_is_typed():
+    with pytest.raises(PipelineConfigError, match="unconsumed pipeline"):
+        Pipeline(
+            stages=[_ident_stage("a", "identity_fp32", "$.X", [1, 4],
+                                 "FP32")],
+            inputs={"X": ("FP32", [1, 4]), "Z": ("FP32", [1, 4])},
+            outputs={"Y": "a.OUTPUT0"})
+
+
+def test_self_reference_is_typed():
+    with pytest.raises(PipelineConfigError, match="consume\\s+itself"):
+        Pipeline(
+            stages=[_ident_stage("a", "identity_fp32", "a.OUTPUT0",
+                                 [1, 4], "FP32")],
+            inputs={"X": ("FP32", [1, 4])},
+            outputs={"Y": "a.OUTPUT0"})
+
+
+def test_parse_grammar_round_trips():
+    spec = ("in RAW:INT32[1,16]; "
+            "tokenize=chain_tokenize(RAW=$.RAW)->TOKENS:INT32[1,16]; "
+            "embed=chain_embed(TOKENS=tokenize.TOKENS)"
+            "->EMBED:FP32[1,16,32]; "
+            "rerank=chain_rerank(EMBED=embed.EMBED)->SCORES:FP32[1,16]; "
+            "out SCORES=rerank.SCORES")
+    pipe = Pipeline.parse(spec)
+    ref = chain_pipeline()
+    assert pipe.order == ref.order
+    assert pipe.describe()["stages"] == ref.describe()["stages"]
+    assert resolve_pipeline("chain").order == ref.order
+    with pytest.raises(PipelineConfigError, match="unknown pipeline"):
+        resolve_pipeline("nonesuch")
+
+
+def test_plan_levels_and_high_water():
+    plan = chain_pipeline().plan()
+    # linear chain: each intermediate lives exactly one level
+    tokens = plan.tensors["tokenize.TOKENS"]
+    embed = plan.tensors["embed.EMBED"]
+    assert (tokens["birth"], tokens["death"]) == (0, 1)
+    assert (embed["birth"], embed["death"]) == (1, 2)
+    assert plan.high_water_bytes == max(plan.level_bytes)
+    assert plan.high_water_bytes > 0
+
+
+# -- (b) bit-exactness ---------------------------------------------------------
+def test_chain_bit_exact_vs_fused_sync(server, fused_scores):
+    client = PipelineClient([server.url], chain_pipeline(),
+                            protocol="http", health_interval_s=None)
+    try:
+        res = client.run({"RAW": RAW})
+        assert np.array_equal(res.as_numpy("SCORES"), fused_scores)
+        assert set(res.stage_latency_s) == {"tokenize", "embed", "rerank"}
+        assert res.plan_high_water_bytes == client.plan().high_water_bytes
+    finally:
+        client.close()
+
+
+def test_chain_bit_exact_vs_fused_aio(server, fused_scores):
+    async def go():
+        client = AioPipelineClient([server.url], chain_pipeline(),
+                                   protocol="http",
+                                   health_interval_s=None)
+        try:
+            res = await client.run({"RAW": RAW})
+            return res.as_numpy("SCORES")
+        finally:
+            await client.close()
+
+    assert np.array_equal(asyncio.run(go()), fused_scores)
+
+
+# -- (c) zero-copy steady state + (d) high-water == plan -----------------------
+def test_steady_state_zero_rpcs_and_plan_high_water(server, fused_scores):
+    client = PipelineClient([server.url], chain_pipeline(),
+                            protocol="http", health_interval_s=None)
+    try:
+        client.run({"RAW": RAW})  # warm: regions created, registered once
+        before = client.arena().stats()
+        for _ in range(3):
+            res = client.run({"RAW": RAW})
+            assert np.array_equal(res.as_numpy("SCORES"), fused_scores)
+            # peak residency is exactly what the plan promised
+            assert (res.arena_high_water_bytes
+                    == res.plan_high_water_bytes)
+        after = client.arena().stats()
+        assert after["regions_created"] == before["regions_created"]
+        assert (after["registrations_issued"]
+                == before["registrations_issued"])
+        # every intermediate returned (delta: the default arena is
+        # process-global, so other suites' long-lived leases — e.g. a
+        # response cache pinning views — may coexist)
+        assert after["leased_bytes"] == before["leased_bytes"]
+        stats = client.stats()
+        assert stats["runs"] == 4 and stats["failures"] == 0
+        assert (stats["observed_high_water_bytes"]
+                == stats["plan_high_water_bytes"])
+    finally:
+        client.close()
+
+
+# -- (e) fan-out concurrency ---------------------------------------------------
+def test_independent_stages_fan_out_concurrently():
+    zoo = default_model_zoo() + [
+        IdentityModel("slow_int32", "INT32", delay_s=0.4)]
+    srv = HttpInferenceServer(ServerCore(zoo)).start()
+    pipe = Pipeline(
+        stages=[
+            _ident_stage("a", "slow_int32", "$.X", [1, 16]),
+            _ident_stage("b", "slow_int32", "$.X", [1, 16]),
+            Stage("join", "simple",
+                  inputs={"INPUT0": "a.OUTPUT0", "INPUT1": "b.OUTPUT0"},
+                  outputs={"OUTPUT0": ("INT32", [1, 16]),
+                           "OUTPUT1": ("INT32", [1, 16])}),
+        ],
+        inputs={"X": ("INT32", [1, 16])},
+        outputs={"SUM": "join.OUTPUT0", "DIFF": "join.OUTPUT1"})
+    client = PipelineClient([srv.url], pipe, protocol="http",
+                            health_interval_s=None)
+    try:
+        client.run({"X": RAW})  # warm (jit compiles bill the first run)
+        t0 = time.monotonic()
+        res = client.run({"X": RAW})
+        wall = time.monotonic() - t0
+        assert np.array_equal(res.as_numpy("SUM"), RAW + RAW)
+        assert np.array_equal(res.as_numpy("DIFF"), RAW - RAW)
+        # two 0.4 s stages sequentially would be >= 0.8 s; concurrent
+        # fan-out keeps the DAG's critical path at one stage's delay
+        assert wall < 0.7, f"fan-out did not overlap: {wall:.3f}s"
+    finally:
+        client.close()
+        srv.stop()
+
+
+# -- (f) killed stage: typed failure, cancellation, zero leaks ------------------
+@pytest.mark.pipeline_smoke
+def test_killed_stage_typed_failure_cancels_dependents():
+    """The chaos proof: RST the endpoint one stage is pinned to; the run
+    must fail with StageFailed naming THAT stage, its dependents must
+    never dispatch, and no arena lease may leak."""
+    srv = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    victim = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    proxy = ChaosProxy("127.0.0.1", victim.port).start()
+    tel = Telemetry(flight=FlightRecorder(baseline_ratio=1.0))
+    pipe = Pipeline(
+        stages=[
+            Stage("tokenize", "chain_tokenize",
+                  inputs={"RAW": "$.RAW"},
+                  outputs={"TOKENS": ("INT32", [1, 16])},
+                  endpoint=proxy.url),
+            Stage("embed", "chain_embed",
+                  inputs={"TOKENS": "tokenize.TOKENS"},
+                  outputs={"EMBED": ("FP32", [1, 16, 32])},
+                  endpoint=srv.url),
+            Stage("rerank", "chain_rerank",
+                  inputs={"EMBED": "embed.EMBED"},
+                  outputs={"SCORES": ("FP32", [1, 16])},
+                  endpoint=srv.url),
+        ],
+        inputs={"RAW": ("INT32", [1, 16])},
+        outputs={"SCORES": "rerank.SCORES"})
+    client = PipelineClient([srv.url, proxy.url], pipe, protocol="http",
+                            health_interval_s=None, telemetry=tel)
+    try:
+        ok = client.run({"RAW": RAW})  # healthy first: proves the wiring
+        assert ok.as_numpy("SCORES").shape == (1, 16)
+        # baseline AFTER the healthy run: the default arena is
+        # process-global, so other suites' long-lived leases coexist
+        base_leased = client.arena().stats()["leased_bytes"]
+        proxy.fault = Fault("reset", after_bytes=0)
+        proxy.reset_active()
+        with pytest.raises(StageFailed) as ei:
+            client.run({"RAW": RAW}, client_timeout=10.0)
+        assert ei.value.stage == "tokenize"
+        assert ei.value.cause is not None
+        # dependents never dispatched: only the healthy run's settles
+        stats = client.stats()["stages"]
+        assert stats["embed"]["count"] == 1
+        assert stats["rerank"]["count"] == 1
+        assert client.arena().stats()["leased_bytes"] == base_leased
+        # heal: the same client recovers with no residue
+        proxy.heal()
+        res = client.run({"RAW": RAW})
+        assert res.as_numpy("SCORES").shape == (1, 16)
+        assert client.arena().stats()["leased_bytes"] == base_leased
+    finally:
+        client.close()
+        proxy.stop()
+        victim.stop()
+        srv.stop()
+
+
+def test_composition_rejections(server):
+    with pytest.raises(PipelineConfigError, match="substrate"):
+        PipelineClient(object(), chain_pipeline())
+    client = PipelineClient([server.url], chain_pipeline(),
+                            protocol="http", health_interval_s=None)
+    try:
+        with pytest.raises(PipelineConfigError, match="sequence"):
+            client.run({"RAW": RAW}, sequence_id=7)
+        with pytest.raises(PipelineConfigError, match="outputs"):
+            client.run({"RAW": RAW}, outputs=[])
+        with pytest.raises(PipelineConfigError, match="generate_stream"):
+            client.generate_stream("m", {})
+        with pytest.raises(PipelineConfigError, match="feeds"):
+            client.run({"RAW": RAW, "EXTRA": RAW})
+        with pytest.raises(PipelineConfigError, match="dtype"):
+            client.run({"RAW": RAW.astype(np.float32)})
+    finally:
+        client.close()
+
+
+# -- (g) one admission token per run -------------------------------------------
+def test_one_admission_token_per_run(server):
+    ctrl = AdmissionController()
+    client = PipelineClient([server.url], chain_pipeline(),
+                            protocol="http", health_interval_s=None,
+                            admission=ctrl)
+
+    def admitted_total():
+        return sum(lane["admitted_total"]
+                   for lane in ctrl.snapshot()["lanes"].values())
+
+    try:
+        base = admitted_total()
+        client.run({"RAW": RAW})
+        client.run({"RAW": RAW})
+        # 2 runs x 3 stages = 6 infers, but exactly ONE token each run:
+        # stages ride routed_infer/pinned_infer past the pool gate
+        assert admitted_total() == base + 2
+    finally:
+        client.close()
+
+
+# -- (h) flight waterfall ------------------------------------------------------
+def test_flight_retains_pipeline_waterfall(server):
+    tel = Telemetry(flight=FlightRecorder(baseline_ratio=1.0))
+    client = PipelineClient([server.url], chain_pipeline(),
+                            protocol="http", health_interval_s=None,
+                            telemetry=tel)
+    try:
+        client.run({"RAW": RAW})
+    finally:
+        client.close()
+    timelines = tel.flight.retained()
+    assert timelines
+    names = {(e[1], e[2]) for t in timelines for e in t.events}
+    for event in ("plan", "stage_dispatch", "handoff", "stage_settle",
+                  "release"):
+        assert ("pipeline", event) in names, event
+    # attribution names stages, not just the layer: pipeline:<stage>
+    keys = set()
+    for t in timelines:
+        keys.update(t.attribution()["ms"])
+    assert any(k.startswith("pipeline:") for k in keys), keys
+
+
+def test_doctor_pipeline_section_and_waterfall(server):
+    snap = collect_snapshot([server.url], model="simple",
+                            requests_per_endpoint=1, pipeline="chain",
+                            pipeline_runs=2)
+    pipe = snap["pipeline"]
+    assert pipe["stages"] == ["tokenize", "embed", "rerank"]
+    assert pipe["runs"] == 2 and not pipe["errors"]
+    assert set(pipe["stage_ms"]) == {"tokenize", "embed", "rerank"}
+    assert (pipe["observed_high_water_bytes"]
+            == pipe["plan_high_water_bytes"])
+    text = render_summary(snap)
+    assert "pipeline (chain" in text
+    assert "arena high-water" in text
+
+
+def test_doctor_flags_hot_stage():
+    snap = {"endpoints": [], "endpoint_stats": {}, "slos": [],
+            "pipeline": {"stages": ["a", "b"], "runs": 4,
+                         "hot_stage": "b", "hot_share": 0.85,
+                         "stage_ms": {"b": {"avg_ms": 40.0}},
+                         "errors": []}}
+    from client_tpu.doctor import _anomalies
+
+    flags = [f for f in _anomalies(snap, 10000.0, 250.0)
+             if f["flag"] == "pipeline_stage_hot"]
+    assert len(flags) == 1
+    assert flags[0]["stage"] == "b"
+    assert "85%" in flags[0]["detail"]
+
+
+# -- (i) committed artifact claims ---------------------------------------------
+def test_bench_pipeline_artifact_claims():
+    """CI re-validates the committed BENCH_PIPELINE.json: the bench's
+    own --check invariants plus the headline claims pinned explicitly."""
+    import tools.bench_pipeline as bench
+
+    doc = json.loads(
+        (Path(__file__).resolve().parent.parent
+         / "BENCH_PIPELINE.json").read_text())
+    assert bench.check_doc(doc) == []
+    assert doc["exactness"]["bit_exact"] is True
+    steady = doc["steady_state"]
+    assert steady["region_creates_per_run"] == 0
+    assert steady["registration_rpcs_per_run"] == 0
+    assert steady["leaked_lease_bytes"] == 0
+    versus = doc["dag_vs_sequential"]
+    assert versus["dag_p50_ms"] < versus["sequential_p50_ms"]
+    chaos = doc["chaos"]
+    assert chaos["typed_stage_failures"] > 0
+    assert chaos["leaked_lease_bytes"] == 0
+    assert chaos["recovered"] is True
+
+
+# -- (j) trace v6 --------------------------------------------------------------
+def test_trace_v6_pipeline_round_trip(tmp_path):
+    rec = trace_mod.TraceRecord(
+        at_s=0.25, kind="pipeline", model="chain",
+        shapes={"RAW": [1, 16]}, dtypes={"RAW": "INT32"})
+    path = tmp_path / "t.jsonl"
+    trace_mod.dump_trace([rec], str(path))
+    line = json.loads(path.read_text().splitlines()[1])
+    assert line["v"] == 6 and line["kind"] == "pipeline"
+    loaded = trace_mod.load_trace(str(path))
+    assert loaded.skipped == 0
+    [r] = loaded.records
+    assert (r.kind, r.model) == ("pipeline", "chain")
+    assert r.shapes == {"RAW": [1, 16]} and r.dtypes == {"RAW": "INT32"}
+
+
+def test_trace_v6_future_records_skip_and_count(tmp_path):
+    rec = trace_mod.TraceRecord(
+        at_s=0.25, kind="pipeline", model="chain",
+        shapes={"RAW": [1, 16]}, dtypes={"RAW": "INT32"})
+    old = trace_mod.TraceRecord(at_s=0.5, kind="unary", model="simple",
+                                shapes={"INPUT0": [1, 16],
+                                        "INPUT1": [1, 16]},
+                                dtypes={"INPUT0": "INT32",
+                                        "INPUT1": "INT32"})
+    path = tmp_path / "t.jsonl"
+    trace_mod.dump_trace([rec, old], str(path))
+    bumped = [json.loads(l) for l in path.read_text().splitlines()]
+    bumped[1]["v"] = 99  # a future format's record
+    path.write_text("\n".join(json.dumps(o) for o in bumped) + "\n")
+    loaded = trace_mod.load_trace(str(path))
+    assert loaded.skipped == 1
+    assert [r.kind for r in loaded.records] == ["unary"]
+
+
+def test_mixed_pipeline_fraction_zero_is_byte_identical():
+    a = trace_mod.dumps_trace(trace_mod.mixed(
+        duration_s=3.0, rate=20.0, seed=7))
+    b = trace_mod.dumps_trace(trace_mod.mixed(
+        duration_s=3.0, rate=20.0, seed=7, pipeline_fraction=0.0))
+    assert a == b
+
+
+def test_mixed_emits_pipeline_records():
+    records = trace_mod.mixed(duration_s=3.0, rate=30.0, seed=7,
+                              pipeline_fraction=0.5)
+    pipes = [r for r in records if r.kind == "pipeline"]
+    assert pipes
+    assert all(r.model == "chain" for r in pipes)
+    assert all(r.shapes == {"RAW": [1, 16]} for r in pipes)
+
+
+@pytest.mark.pipeline_smoke
+def test_replay_drives_pipeline_runs(server):
+    from client_tpu.perf import PerfRunner
+
+    tr = trace_mod.generate(
+        "mixed:duration_s=2,rate=12,stream_fraction=0.1,seq_fraction=0,"
+        "pipeline_fraction=0.5,unary_model=simple", seed=11)
+    n_pipe = tr.kind_counts()["pipeline"]
+    assert n_pipe > 0
+    runner = PerfRunner(server.url, "http", "simple", pipeline="chain")
+    res = runner.run_trace(tr, speed=4.0, replay_workers=8)
+    assert res["errors"] == 0
+    assert res["kinds"]["pipeline"]["ok"] == n_pipe
+    stages = res["pipeline_stages"]
+    assert set(stages) == {"tokenize", "embed", "rerank"}
+    # per-stage columns cover every measured DAG run, warmup excluded
+    assert all(row["count"] == n_pipe for row in stages.values())
+
+
+def test_replay_without_pipeline_is_typed(server):
+    from client_tpu.perf import PerfRunner
+
+    tr = trace_mod.generate(
+        "mixed:duration_s=1,rate=10,pipeline_fraction=0.5", seed=3)
+    runner = PerfRunner(server.url, "http", "simple")
+    with pytest.raises(ValueError, match="--pipeline"):
+        runner.run_trace(tr, speed=4.0)

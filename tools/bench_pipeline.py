@@ -1,0 +1,362 @@
+"""Generate BENCH_PIPELINE.json: the client-orchestrated model-DAG proof.
+
+Four arms over in-process replica servers (the same topology every other
+bench in this repo uses — CPU container numbers, honest about it):
+
+- **exactness**: the 3-stage chain DAG (``chain_tokenize`` ->
+  ``chain_embed`` -> ``chain_rerank``, intermediates handed off as
+  arena-resident shm leases) must be BIT-identical to the fused
+  ``chain_fused`` single-model reference — the two paths share one
+  ``ChainCore``'s weights and jitted step functions (models/chain.py).
+- **dag_vs_sequential**: the DAG at a batch whose intermediate tensors
+  are big enough to matter vs the naive client-side chaining baseline —
+  three sequential ``infer()`` calls that round-trip every intermediate
+  through host memory and back over the wire. The DAG must win at p50:
+  its intermediates never leave the server host (shm handle handoff).
+- **steady_state**: after warmup, N DAG runs must issue ZERO region
+  creates and ZERO registration RPCs, return every lease (residual
+  leased bytes 0), and peak arena residency must equal the slab plan's
+  high-water mark on every run.
+- **chaos**: the endpoint one stage is pinned to is RST mid-run
+  (ChaosProxy); every armed run must fail with a typed ``StageFailed``
+  naming that stage (never a partial result), unstarted dependents must
+  never dispatch, zero arena leases may leak, and the same client must
+  recover bit-exact after heal.
+
+``--check`` re-validates an existing artifact's acceptance invariants
+and exits nonzero on violation (tests/test_pipeline.py pins the same
+claims); ``tools/capacity_gate.py --pipeline`` re-RUNS the chaos arm
+live:
+
+    JAX_PLATFORMS=cpu python tools/bench_pipeline.py [-o BENCH_PIPELINE.json]
+    JAX_PLATFORMS=cpu python tools/bench_pipeline.py --check BENCH_PIPELINE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BATCH = 128   # EMBED intermediate = batch*length*32*4 B ~= 2 MiB: big
+LENGTH = 128  # enough that the sequential host round-trip visibly pays
+
+
+def _percentiles(samples_s):
+    xs = sorted(samples_s)
+    n = len(xs)
+    if not n:
+        return {}
+    pick = lambda q: xs[min(n - 1, int(q * (n - 1) + 0.5))]  # noqa: E731
+    return {
+        "avg": round(1e3 * sum(xs) / n, 3),
+        "p50": round(1e3 * pick(0.50), 3),
+        "p90": round(1e3 * pick(0.90), 3),
+        "p99": round(1e3 * pick(0.99), 3),
+    }
+
+
+def _raw(batch, length, seed=0xDA6):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2**16, size=(batch, length), dtype=np.int32)
+
+
+def _sequential_chain(client, mod, raw):
+    """The baseline the DAG is benchmarked against: naive client-side
+    chaining, every intermediate round-tripped through host memory."""
+    inp = mod.InferInput("RAW", list(raw.shape), "INT32")
+    inp.set_data_from_numpy(raw)
+    tokens = client.infer("chain_tokenize", [inp]).as_numpy("TOKENS")
+    inp = mod.InferInput("TOKENS", list(tokens.shape), "INT32")
+    inp.set_data_from_numpy(tokens)
+    embed = client.infer("chain_embed", [inp]).as_numpy("EMBED")
+    inp = mod.InferInput("EMBED", list(embed.shape), "FP32")
+    inp.set_data_from_numpy(embed)
+    return client.infer("chain_rerank", [inp]).as_numpy("SCORES")
+
+
+def run_chaos_arm(runs: int = 8, batch: int = 1, length: int = 16,
+                  seed: int = 0xDA6):
+    """The killed-stage proof, self-contained so ``capacity_gate.py
+    --pipeline`` can re-run it live: the chain's first stage is pinned
+    to a replica behind a ChaosProxy; every even run arms a persistent
+    RST of that endpoint. Armed runs must fail with a typed StageFailed
+    naming the pinned stage, dependents must never dispatch, no lease
+    may leak, and healed runs must stay bit-exact."""
+    import client_tpu.http as httpclient
+    from client_tpu.models import default_model_zoo
+    from client_tpu.pipeline import Pipeline, PipelineClient, Stage, StageFailed
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing import ChaosProxy, Fault
+
+    raw = _raw(batch, length, seed)
+    srv = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    victim = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    proxy = ChaosProxy("127.0.0.1", victim.port).start()
+    pipe = Pipeline(
+        stages=[
+            Stage("tokenize", "chain_tokenize", inputs={"RAW": "$.RAW"},
+                  outputs={"TOKENS": ("INT32", [batch, length])},
+                  endpoint=proxy.url),
+            Stage("embed", "chain_embed",
+                  inputs={"TOKENS": "tokenize.TOKENS"},
+                  outputs={"EMBED": ("FP32", [batch, length, 32])},
+                  endpoint=srv.url),
+            Stage("rerank", "chain_rerank",
+                  inputs={"EMBED": "embed.EMBED"},
+                  outputs={"SCORES": ("FP32", [batch, length])},
+                  endpoint=srv.url),
+        ],
+        inputs={"RAW": ("INT32", [batch, length])},
+        outputs={"SCORES": "rerank.SCORES"})
+    ref = httpclient.InferenceServerClient(srv.url)
+    inp = httpclient.InferInput("RAW", list(raw.shape), "INT32")
+    inp.set_data_from_numpy(raw)
+    want = ref.infer("chain_fused", [inp]).as_numpy("SCORES")
+    ref.close()
+    client = PipelineClient([srv.url, proxy.url], pipe, protocol="http",
+                            health_interval_s=None)
+    row = {"runs": runs, "completed": 0, "typed_stage_failures": 0,
+           "wrong_failures": 0, "dependents_dispatched": 0,
+           "leaked_lease_bytes": 0, "bit_exact": True, "recovered": False}
+    try:
+        client.run({"RAW": raw})  # warm the healthy path (jit compiles)
+        # delta baseline: the default arena is process-global, so a
+        # host process may hold unrelated long-lived leases
+        base_leased = client.arena().stats()["leased_bytes"]
+        for i in range(runs):
+            arm_kill = i % 2 == 0
+            if arm_kill:
+                proxy.fault = Fault("reset", after_bytes=0)
+                proxy.reset_active()
+            settles_before = client.stats()["stages"]["embed"]["count"]
+            try:
+                res = client.run({"RAW": raw}, client_timeout=10.0)
+            except StageFailed as e:
+                if e.stage == "tokenize":
+                    row["typed_stage_failures"] += 1
+                else:
+                    row["wrong_failures"] += 1
+                row["dependents_dispatched"] += (
+                    client.stats()["stages"]["embed"]["count"]
+                    - settles_before)
+            except Exception:
+                row["wrong_failures"] += 1
+            else:
+                row["completed"] += 1
+                row["bit_exact"] = row["bit_exact"] and np.array_equal(
+                    res.as_numpy("SCORES"), want)
+            if arm_kill:
+                proxy.heal()
+            row["leaked_lease_bytes"] += (
+                client.arena().stats()["leased_bytes"] - base_leased)
+        res = client.run({"RAW": raw})  # healed: the same client recovers
+        row["recovered"] = bool(np.array_equal(
+            res.as_numpy("SCORES"), want))
+    finally:
+        client.close()
+        proxy.stop()
+        victim.stop()
+        srv.stop()
+    return row
+
+
+def chaos_problems(row) -> list:
+    """The chaos arm's acceptance invariants (shared by --check and the
+    live capacity_gate --pipeline re-run)."""
+    problems = []
+    if row["runs"] <= 0:
+        problems.append("chaos arm ran no runs")
+    if row["typed_stage_failures"] <= 0:
+        problems.append("no killed-stage run produced a typed "
+                        "StageFailed naming the pinned stage")
+    if row["wrong_failures"] != 0:
+        problems.append(f"{row['wrong_failures']} failures were not the "
+                        "typed StageFailed for the killed stage")
+    if row["dependents_dispatched"] != 0:
+        problems.append(f"{row['dependents_dispatched']} dependent "
+                        "stages dispatched after their producer failed")
+    if row["leaked_lease_bytes"] != 0:
+        problems.append(f"{row['leaked_lease_bytes']} arena lease bytes "
+                        "leaked across failed runs")
+    if row["bit_exact"] is not True:
+        problems.append("surviving runs are not bit-exact vs the fused "
+                        "reference")
+    if row["recovered"] is not True:
+        problems.append("the client did not recover bit-exact after heal")
+    return problems
+
+
+def check_doc(data) -> list:
+    failures = []
+    exact = data["exactness"]
+    if exact["runs"] <= 0:
+        failures.append("exactness arm measured no runs")
+    if exact["bit_exact"] is not True:
+        failures.append("DAG runs are not bit-exact vs chain_fused")
+    versus = data["dag_vs_sequential"]
+    if versus["runs"] <= 0:
+        failures.append("dag_vs_sequential arm measured no runs")
+    if not versus.get("dag_ms") or not versus.get("sequential_ms"):
+        failures.append("dag_vs_sequential arm missing percentiles")
+    if versus["dag_p50_ms"] >= versus["sequential_p50_ms"]:
+        failures.append(
+            f"DAG p50 {versus['dag_p50_ms']} ms does not beat the "
+            f"sequential host-round-trip baseline "
+            f"{versus['sequential_p50_ms']} ms")
+    steady = data["steady_state"]
+    if steady["runs"] <= 0:
+        failures.append("steady-state arm measured no runs")
+    if steady["region_creates_per_run"] != 0:
+        failures.append("steady-state DAG runs created shm regions")
+    if steady["registration_rpcs_per_run"] != 0:
+        failures.append("steady-state DAG runs issued registration RPCs")
+    if steady["leaked_lease_bytes"] != 0:
+        failures.append("steady-state DAG runs leaked lease bytes")
+    if steady["high_water_matches_plan"] is not True:
+        failures.append("peak arena residency diverged from the slab "
+                        "plan's high-water mark")
+    failures.extend(chaos_problems(data["chaos"]))
+    return failures
+
+
+def check(path: str) -> int:
+    failures = check_doc(json.loads(Path(path).read_text()))
+    for msg in failures:
+        print(f"CHECK FAILED: {msg}", file=sys.stderr)
+    if not failures:
+        print(f"{path}: all model-DAG pipeline acceptance invariants "
+              "hold")
+    return 1 if failures else 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_PIPELINE.json")
+    parser.add_argument("--runs", type=int, default=30)
+    parser.add_argument("--chaos-runs", type=int, default=8)
+    parser.add_argument("--batch", type=int, default=BATCH)
+    parser.add_argument("--length", type=int, default=LENGTH)
+    parser.add_argument("--check", metavar="ARTIFACT",
+                        help="validate an existing artifact instead of "
+                             "benchmarking")
+    args = parser.parse_args()
+    if args.check:
+        return check(args.check)
+
+    import client_tpu.http as httpclient
+    from client_tpu.models import default_model_zoo
+    from client_tpu.pipeline import chain_pipeline, PipelineClient
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    raw = _raw(args.batch, args.length)
+    srv = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+
+    out = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "note": (
+            "client-orchestrated 3-stage chain DAG (client_tpu.pipeline) "
+            "over an in-process replica server: intermediates handed off "
+            "as arena-resident shm leases; the sequential baseline "
+            "chains the same three models with every intermediate "
+            "round-tripped through host memory; fused reference is "
+            "chain_fused (same ChainCore weights => bit-exactness is "
+            "checkable); CPU container numbers"
+        ),
+        "batch": args.batch,
+        "length": args.length,
+        "intermediate_bytes_per_run": int(
+            args.batch * args.length * 4            # TOKENS INT32
+            + args.batch * args.length * 32 * 4),   # EMBED FP32
+    }
+
+    client = PipelineClient([srv.url], chain_pipeline(args.batch,
+                                                      args.length),
+                            protocol="http", health_interval_s=None)
+    seq = httpclient.InferenceServerClient(srv.url)
+    try:
+        # -- exactness + dag_vs_sequential -------------------------------
+        inp = httpclient.InferInput("RAW", list(raw.shape), "INT32")
+        inp.set_data_from_numpy(raw)
+        want = seq.infer("chain_fused", [inp]).as_numpy("SCORES")
+        client.run({"RAW": raw})                      # jit + arena warmup
+        _sequential_chain(seq, httpclient, raw)       # same warmup
+        exact, dag_s, seq_s = True, [], []
+        for _ in range(args.runs):
+            t0 = time.perf_counter()
+            res = client.run({"RAW": raw})
+            dag_s.append(time.perf_counter() - t0)
+            exact = exact and np.array_equal(res.as_numpy("SCORES"), want)
+            t0 = time.perf_counter()
+            scores = _sequential_chain(seq, httpclient, raw)
+            seq_s.append(time.perf_counter() - t0)
+            exact = exact and np.array_equal(scores, want)
+        dag_ms, seq_ms = _percentiles(dag_s), _percentiles(seq_s)
+        out["exactness"] = {"runs": args.runs, "bit_exact": bool(exact)}
+        out["dag_vs_sequential"] = {
+            "runs": args.runs,
+            "dag_ms": dag_ms,
+            "sequential_ms": seq_ms,
+            "dag_p50_ms": dag_ms["p50"],
+            "sequential_p50_ms": seq_ms["p50"],
+            "speedup_p50": round(seq_ms["p50"] / dag_ms["p50"], 3),
+        }
+        print("exactness:", json.dumps(out["exactness"]))
+        print("dag_vs_sequential:", json.dumps(out["dag_vs_sequential"]))
+
+        # -- steady state: 0 region creates / registration RPCs ----------
+        arena = client.arena()
+        before = arena.stats()
+        plan_matches = True
+        t0 = time.perf_counter()
+        for _ in range(args.runs):
+            res = client.run({"RAW": raw})
+            plan_matches = plan_matches and (
+                res.arena_high_water_bytes == res.plan_high_water_bytes)
+        elapsed = time.perf_counter() - t0
+        after = arena.stats()
+        stage_ms = {name: row["avg_ms"] for name, row
+                    in client.stats()["stages"].items()}
+        out["steady_state"] = {
+            "runs": args.runs,
+            "region_creates_per_run": (
+                after["regions_created"] - before["regions_created"])
+            / args.runs,
+            "registration_rpcs_per_run": (
+                after["registrations_issued"]
+                - before["registrations_issued"]) / args.runs,
+            "leaked_lease_bytes": (after["leased_bytes"]
+                                   - before["leased_bytes"]),
+            "arena_hit_rate": after["hit_rate"],
+            "high_water_matches_plan": bool(plan_matches),
+            "plan_high_water_bytes": (
+                client.plan().high_water_bytes),
+            "stage_avg_ms": stage_ms,
+            "runs_per_s": round(args.runs / elapsed, 1),
+        }
+        print("steady_state:", json.dumps(out["steady_state"]))
+    finally:
+        seq.close()
+        client.close()
+        srv.stop()
+
+    # -- chaos: pinned stage endpoint RST mid-run (own stack) ------------
+    out["chaos"] = run_chaos_arm(runs=args.chaos_runs)
+    print("chaos:", json.dumps(out["chaos"]))
+
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    print(f"wrote {args.output}")
+    return check(args.output)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,14 @@ tools/bench_disagg.py): a decode replica RST mid-stream must still
 recover via re-prefill with delivery 1.0, zero repeated/dropped tokens,
 bit-exact vs the monolithic reference.
 
+With ``--pipeline`` the gate re-runs the committed model-DAG
+killed-stage proof live (``BENCH_PIPELINE.json``,
+tools/bench_pipeline.py): the chain DAG's first stage pinned behind a
+ChaosProxy is RST mid-run — armed runs must fail with a typed
+StageFailed naming that stage, dependents must never dispatch, zero
+arena lease bytes may leak, and the same client must recover bit-exact
+after heal.
+
 With ``--flight`` the gate proves the flight recorder is
 pay-for-what-you-use: the capacity arm replayed recorder-OFF at the
 standard floor must sustain (else INCONCLUSIVE — plain capacity
@@ -479,6 +487,55 @@ def disagg_recheck(baseline: str, attempts: int) -> int:
     return 0
 
 
+def pipeline_recheck(baseline: str, attempts: int) -> int:
+    """Re-RUN the committed model-DAG killed-stage proof live
+    (``BENCH_PIPELINE.json``, tools/bench_pipeline.py): the chain DAG's
+    first stage pinned behind a ChaosProxy, endpoint RST mid-run —
+    every armed run must fail with a typed StageFailed naming that
+    stage, dependents must never dispatch, zero arena lease bytes may
+    leak, and the same client must recover bit-exact after heal.
+    Retried ``attempts`` times; the exactness/dag_vs_sequential/
+    steady-state arms are validated from the committed artifact by
+    ``--check``/CI, not re-run here (the killed-stage arm is the
+    robustness claim)."""
+    import tools.bench_pipeline as bench
+
+    doc = json.loads(Path(baseline).read_text())
+    problems_committed = bench.check_doc(doc)
+    if problems_committed:
+        print("committed artifact already violates its invariants:")
+        for p in problems_committed:
+            print(f"  - {p}")
+        return 1
+    rows = []
+    for attempt in range(max(1, attempts)):
+        arm = bench.run_chaos_arm()
+        problems = bench.chaos_problems(arm)
+        rows.append({
+            "attempt": attempt + 1,
+            "typed_stage_failures": arm["typed_stage_failures"],
+            "dependents_dispatched": arm["dependents_dispatched"],
+            "leaked_lease_bytes": arm["leaked_lease_bytes"],
+            "bit_exact": arm["bit_exact"],
+            "recovered": arm["recovered"],
+            "problems": problems,
+        })
+        if not problems:
+            break
+    print(json.dumps({"pipeline": rows}, indent=2))
+    if rows[-1]["problems"]:
+        print("FAIL: killed-stage DAG failure is no longer typed, "
+              "contained, and leak-free:")
+        for p in rows[-1]["problems"]:
+            print(f"  - {p}")
+        return 1
+    print("OK: killed-stage proof reproduces "
+          f"({rows[-1]['typed_stage_failures']} typed StageFailed, "
+          "zero dependents dispatched, zero leaked lease bytes, "
+          "recovered bit-exact)")
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--baseline", default="BENCH_CAPACITY.json")
@@ -528,8 +585,20 @@ def main() -> int:
                              "re-prefill with delivery 1.0 and zero "
                              "repeated/dropped tokens, bit-exact")
     parser.add_argument("--disagg-baseline", default="BENCH_DISAGG.json")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="re-run the committed model-DAG "
+                             "killed-stage proof live "
+                             "(BENCH_PIPELINE.json): a pinned stage "
+                             "endpoint RST mid-run must produce a typed "
+                             "StageFailed naming the stage, dependents "
+                             "never dispatch, zero leaked arena leases, "
+                             "recovery bit-exact after heal")
+    parser.add_argument("--pipeline-baseline",
+                        default="BENCH_PIPELINE.json")
     args = parser.parse_args()
 
+    if args.pipeline:
+        return pipeline_recheck(args.pipeline_baseline, args.attempts)
     if args.disagg:
         return disagg_recheck(args.disagg_baseline, args.attempts)
     if args.tenancy:

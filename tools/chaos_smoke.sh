@@ -62,13 +62,19 @@
 # under disaggregated prefill/decode serving: the session must finish
 # via re-prefill recovery on the surviving decode replica with zero
 # repeated and zero dropped tokens, bit-exact vs the monolithic
-# reference stream.
+# reference stream. The pipeline smoke (tests/test_pipeline.py,
+# pipeline_smoke marker) RSTs the endpoint one DAG stage is pinned to
+# mid-run: the run must fail with a typed StageFailed naming that
+# stage, unstarted dependents must never dispatch, zero arena leases
+# may leak, and the same client must recover after heal; the replay
+# half drives v6 pipeline trace records through perf.py --pipeline
+# with per-stage latency columns.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke or disagg_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke or disagg_smoke or pipeline_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
@@ -76,4 +82,4 @@ exec env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_arena.py tests/test_admission.py tests/test_shard.py \
     tests/test_hotkey_cache.py tests/test_flight.py \
     tests/test_federation.py tests/test_tenancy.py \
-    tests/test_disagg.py "$@"
+    tests/test_disagg.py tests/test_pipeline.py "$@"
