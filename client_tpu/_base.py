@@ -130,6 +130,9 @@ class InferenceServerClientBase:
         self._resilience = None  # Optional[resilience.ResiliencePolicy]
         self._telemetry = None  # Optional[observe.Telemetry]
         self._shm_arena = None  # Optional[arena.ShmArena]
+        # None = process-default integrity policy; False = disabled;
+        # else an integrity.IntegrityPolicy
+        self._integrity = None
 
     def _call_plugin(self, request: Request) -> None:
         if self._plugin is not None:
@@ -296,6 +299,84 @@ class InferenceServerClientBase:
         value = result.get_response_header(_observe.ENDPOINT_LOAD_HEADER)
         if value is not None:
             tel.ingest_endpoint_load(self._url, value)
+
+    # -- response integrity --------------------------------------------------
+    def configure_integrity(self, policy) -> "InferenceServerClientBase":
+        """Install an ``integrity.IntegrityPolicy`` (``True`` = the process
+        default; ``None`` restores the default; ``False`` disables
+        validation for this client). Contract validation runs under the
+        process-default policy even when nothing is configured — every
+        ``InferResult`` is checked against its request before the caller
+        sees it (see docs/integrity.md)."""
+        if policy is True:
+            from .integrity import default_policy
+
+            policy = default_policy()
+        self._integrity = policy
+        return self
+
+    def integrity_policy(self):
+        """The effective policy: the configured one, the process default
+        when unconfigured, or None when explicitly disabled."""
+        policy = self._integrity
+        if policy is None:
+            from .integrity import default_policy
+
+            return default_policy()
+        if policy is False:
+            return None
+        return policy
+
+    def _integrity_check(self, result, inputs=None, outputs=None,
+                         request_id: str = "", model_name: str = "") -> None:
+        """Validate one unary ``InferResult`` before it reaches the caller.
+
+        Raises ``integrity.IntegrityError`` (status INTEGRITY_VIOLATION →
+        resilience's INVALID domain) on any contract violation; on the
+        happy path it is pure arithmetic over bytes already in memory."""
+        policy = self._integrity
+        if policy is False:
+            return
+        from . import integrity as _integrity
+
+        _integrity.check_result(
+            result, inputs, outputs, request_id,
+            url=getattr(self, "_url", "") or "", model_name=model_name,
+            policy=policy, telemetry=self._telemetry)
+
+    def _integrity_parse_note(self, err) -> None:
+        """Stamp this client's url on a parse-time ``IntegrityError`` (a
+        body the decoder could not even parse — torn JSON, overrun binary
+        sizes) and account it into the same stats/flight/telemetry
+        streams as post-parse contract violations. The caller re-raises;
+        parse violations bypass the contract on/off switch because an
+        undecodable body yields no result either way."""
+        from . import integrity as _integrity
+
+        policy = self._integrity
+        _integrity.note_parse_violation(
+            err, url=getattr(self, "_url", "") or "",
+            telemetry=self._telemetry,
+            policy=policy if policy not in (None, False) else None)
+
+    def _integrity_note_metadata(self, model_name: str, metadata) -> None:
+        """Fold a just-fetched model-metadata response into the effective
+        policy's contract cache — the only way the cache is ever
+        populated (responses never teach the contract: a byzantine
+        replica answering first could otherwise poison it)."""
+        policy = self.integrity_policy()
+        if policy is not None and model_name:
+            policy.note_metadata(model_name, metadata)
+
+    def _integrity_stream_checker(self, model_name: str = ""):
+        """A per-stream ``integrity.StreamChecker`` when the effective
+        policy opted into stream-index checks, else None."""
+        policy = self.integrity_policy()
+        if policy is None or not policy.stream_index:
+            return None
+        from .integrity import StreamChecker
+
+        return StreamChecker(getattr(self, "_url", "") or "", policy)
 
     # -- resilience ---------------------------------------------------------
     def configure_resilience(self, policy) -> "InferenceServerClientBase":

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import asyncio
 import atexit
+import hashlib
 import threading
 import uuid as _uuid
 import weakref
@@ -60,6 +61,7 @@ __all__ = [
     "ArenaError",
     "ArenaLeaseReleased",
     "ArenaLease",
+    "LeaseDigest",
     "ShmArena",
     "default_arena",
     "arenas",
@@ -90,6 +92,54 @@ def _round_class(nbytes: int, min_class: int, max_class: int) -> int:
     while c < nbytes:
         c <<= 1
     return c
+
+
+class LeaseDigest:
+    """A blake2b-128 seal over the first ``nbytes`` of a lease's slab.
+
+    Sealed when a response lands (output leases under an integrity
+    policy with ``digests=True``; ``disagg``'s KV handoff) and
+    re-verified at ``as_numpy()`` map time — a server that scribbles
+    over shared memory AFTER answering is caught before the first read.
+    The digest rides the lease object itself: no extra RPCs, ever.
+    """
+
+    DIGEST_SIZE = 16  # blake2b-128, matching disagg's KV handoff seal
+
+    __slots__ = ("nbytes", "hexdigest")
+
+    def __init__(self, nbytes: int, hexdigest: str):
+        self.nbytes = nbytes
+        self.hexdigest = hexdigest
+
+    @classmethod
+    def seal(cls, lease: "ArenaLease",
+             nbytes: Optional[int] = None) -> "LeaseDigest":
+        n = nbytes if nbytes is not None else (lease.nbytes
+                                               or lease.byte_size)
+        view = lease.memoryview()[:n]
+        return cls(n, hashlib.blake2b(
+            view, digest_size=cls.DIGEST_SIZE).hexdigest())
+
+    def compute(self, lease: "ArenaLease") -> str:
+        """The current content digest over this seal's span."""
+        view = lease.memoryview()[:self.nbytes]
+        return hashlib.blake2b(
+            view, digest_size=self.DIGEST_SIZE).hexdigest()
+
+    def verify(self, lease: "ArenaLease", url: str = "") -> None:
+        """Re-hash and compare; mismatch raises a typed ``digest``
+        ``integrity.IntegrityError`` (and counts into the process
+        integrity stats so doctor/perf surface it)."""
+        actual = self.compute(lease)
+        if actual != self.hexdigest:
+            from . import integrity as _integrity
+
+            _integrity.global_stats().record_violation("digest", url)
+            _flight.note("integrity", "violation", kind="digest",
+                         url=url, field=lease.region_name)
+            raise _integrity.IntegrityError(
+                "digest", url, lease.region_name, self.hexdigest, actual)
 
 
 class _ArenaRegion:
@@ -134,7 +184,8 @@ class ArenaLease:
     once fully released.
     """
 
-    __slots__ = ("_arena", "_region", "_offset", "_nbytes", "_refs")
+    __slots__ = ("_arena", "_region", "_offset", "_nbytes", "_refs",
+                 "_digest")
 
     def __init__(self, arena: "ShmArena", region: _ArenaRegion, offset: int,
                  nbytes: int):
@@ -143,6 +194,7 @@ class ArenaLease:
         self._offset = offset
         self._nbytes = nbytes
         self._refs = 1
+        self._digest: Optional[LeaseDigest] = None
 
     # -- identity ----------------------------------------------------------
     @property
@@ -183,6 +235,19 @@ class ArenaLease:
         return (f"ArenaLease(region={self.region_name!r}, offset={self._offset}"
                 f", class={self.byte_size}, nbytes={self._nbytes}, "
                 f"refs={self._refs})")
+
+    # -- integrity seal ----------------------------------------------------
+    def seal_digest(self, nbytes: Optional[int] = None) -> LeaseDigest:
+        """Seal the slab's current contents (first ``nbytes``, default the
+        staged span) under a :class:`LeaseDigest`; every later
+        ``as_numpy`` re-verifies it before mapping. A local ``write*``
+        drops the seal (the holder mutating its own slab is not
+        corruption)."""
+        self._digest = LeaseDigest.seal(self, nbytes)
+        return self._digest
+
+    def digest(self) -> Optional[LeaseDigest]:
+        return self._digest
 
     # -- refcount ----------------------------------------------------------
     def retain(self) -> "ArenaLease":
@@ -232,6 +297,7 @@ class ArenaLease:
     def write(self, data, offset: int = 0) -> int:
         """Copy ``data`` (bytes-like) into the slab; returns bytes written."""
         self._check_live()
+        self._digest = None  # a local write invalidates the seal
         data = memoryview(data).cast("B")
         self._check_span(len(data), offset, "write")
         rec = _observe._DATAPLANE
@@ -258,6 +324,7 @@ class ArenaLease:
             return self.write(serialize_bf16_tensor(arr).item(), offset)
         nbytes = arr.nbytes
         self._check_span(nbytes, offset, "write")
+        self._digest = None  # a local write invalidates the seal
         rec = _observe._DATAPLANE
         if rec is not None:
             rec.on_map(self.family, write=True)
@@ -275,6 +342,7 @@ class ArenaLease:
         pins the device buffer in the region's cache and mirrors to host
         unless the region is colocated. Returns bytes written."""
         self._check_live()
+        self._digest = None  # a local write invalidates the seal
         if self.family != "tpu":
             raise ArenaError("write_jax needs a tpu-family lease")
         from .utils.tpu_shared_memory import set_shared_memory_region_from_jax
@@ -296,6 +364,10 @@ class ArenaLease:
         BYTES/BF16 decode (one copy, as everywhere else).
         """
         self._check_live()
+        if self._digest is not None:
+            # sealed lease: re-verify the server's answer before mapping
+            # (a post-answer scribble raises typed, never aliases garbage)
+            self._digest.verify(self)
         if isinstance(datatype, str):
             triton_dtype = datatype
             np_dtype = (np.dtype(np.object_) if datatype == "BYTES"
@@ -908,15 +980,23 @@ class _BoundRequest:
     (``settle``), and attaches user-leased output leases to the result
     (``finish``) so ``as_numpy`` can serve zero-copy views."""
 
-    __slots__ = ("_promoted", "_out_leases")
+    __slots__ = ("_promoted", "_out_leases", "_seal_digests")
 
     def __init__(self):
         self._promoted: List[Tuple[Any, Any, ArenaLease]] = []
         self._out_leases: Optional[Dict[str, ArenaLease]] = None
+        self._seal_digests = False
 
     def finish(self, result) -> None:
         if self._out_leases:
             result._arena_output_leases = dict(self._out_leases)
+            if self._seal_digests:
+                # seal each output slab the moment the response lands:
+                # as_numpy re-verifies, so a server scribbling after its
+                # answer raises typed instead of aliasing garbage
+                for lease in self._out_leases.values():
+                    if not lease.released:
+                        lease.seal_digest()
 
     def settle(self) -> None:
         for inp, raw, lease in self._promoted:
@@ -987,6 +1067,13 @@ def _collect(client, arena: Optional[ShmArena], inputs, outputs,
         if ctx._out_leases is None:
             ctx._out_leases = {}
         ctx._out_leases[out.name()] = lease
+    if ctx is not None and ctx._out_leases:
+        # opt-in data-plane digests: seal output slabs at finish time
+        # when the owning client's integrity policy asks for them
+        policy_of = getattr(client, "integrity_policy", None)
+        if policy_of is not None:
+            policy = policy_of()
+            ctx._seal_digests = policy is not None and policy.digests
     return ensure, ctx
 
 

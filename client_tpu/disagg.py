@@ -72,13 +72,13 @@ Usage::
 
 from __future__ import annotations
 
-import hashlib
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from . import flight as _flight
+from .arena import LeaseDigest
 from ._tensor import InferInput, InferRequestedOutput
 from .admission import AdmissionRejected
 from .pool import (
@@ -119,7 +119,10 @@ DECODE_ROLE = "decode"
 PREFILL_LANE: Tuple[str, int] = ("disagg:prefill", 1)
 DECODE_LANE: Tuple[str, int] = ("disagg:decode", 1)
 
-_DIGEST_SIZE = 16  # blake2b-128: collision-safe for corruption detection
+# blake2b-128 (collision-safe for corruption detection): the hashing
+# itself now lives in arena.LeaseDigest, shared with the integrity
+# layer's opt-in output-slab seals
+_DIGEST_SIZE = LeaseDigest.DIGEST_SIZE
 
 
 class DisaggError(InferenceServerException):
@@ -208,8 +211,7 @@ class KvHandoff:
         if lease is None:
             raise DisaggError("handoff lease already released",
                               status="DISAGG_HANDOFF_CORRUPT")
-        view = lease.memoryview()[: self.nbytes]
-        return hashlib.blake2b(view, digest_size=_DIGEST_SIZE).hexdigest()
+        return LeaseDigest(self.nbytes, self.digest).compute(lease)
 
     def verify(self, url: str = "") -> None:
         """Raise :class:`HandoffCorrupt` unless the live slab still hashes
@@ -356,9 +358,7 @@ class _DisaggBase:
                        url: str) -> KvHandoff:
         """Digest + manifest over the slab the prefill just filled."""
         lease = kv_out._arena_lease
-        view = lease.memoryview()[:nbytes]
-        digest = hashlib.blake2b(
-            view, digest_size=_DIGEST_SIZE).hexdigest()
+        digest = LeaseDigest.seal(lease, nbytes).hexdigest
         pos = int(np.asarray(result.as_numpy("POS")).reshape(-1)[0])
         next_token = int(
             np.asarray(result.as_numpy("NEXT_TOKEN")).reshape(-1)[0])

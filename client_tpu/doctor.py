@@ -508,6 +508,28 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
         if stats.get("ejected"):
             flags.append({"flag": "endpoint_ejected", "url": url,
                           "detail": f"for {stats.get('ejected_for_s', 0)}s"})
+        # byzantine replica: this endpoint is RESPONDING — transport is
+        # healthy, the breaker sees successes — but what it returns fails
+        # contract validation. Health probes will never catch it; only the
+        # per-response integrity checks do. quarantined means it is
+        # currently ejected FOR wrongness (not latency/errors), which is
+        # the strongest possible signal that the replica itself is
+        # corrupt: restart or reimage it, don't wait for readmission.
+        if stats.get("quarantined"):
+            flags.append({
+                "flag": "byzantine_replica", "url": url,
+                "detail": (f"quarantined after "
+                           f"{stats.get('invalid_total', 0)} invalid "
+                           f"responses (quarantine #"
+                           f"{stats.get('quarantine_count', 0)}) — "
+                           "replica answers probes but returns corrupt "
+                           "payloads; restart or reimage it")})
+        elif stats.get("invalid_total"):
+            flags.append({
+                "flag": "byzantine_replica", "url": url,
+                "detail": (f"{stats['invalid_total']} responses failed "
+                           "integrity validation (below the quarantine "
+                           "threshold so far) — watch this replica")})
     # a sharded deployment has ZERO failover headroom: every logical
     # request needs EVERY pinned endpoint, so one degraded replica is a
     # whole-deployment outage, not an N-1 brownout — say so explicitly
@@ -797,6 +819,7 @@ def collect_snapshot(
     roles=None,
     pipeline=None,
     pipeline_runs: int = 4,
+    integrity: bool = False,
 ) -> Dict[str, Any]:
     """Probe the fleet and return the full snapshot dict (JSON-ready).
 
@@ -992,6 +1015,15 @@ def collect_snapshot(
             "before_probe": arena_leased_before,
             "after_probe": arena_leased_after,
         }
+        # response-integrity section: the process-wide validation
+        # counters (every contract-checked response in THIS process, not
+        # just the probe's own requests) next to the per-endpoint
+        # quarantine view the anomaly pass reads. The overhead
+        # percentiles answer "what does always-on validation cost" with
+        # measured ns, not an estimate.
+        if integrity:
+            from . import integrity as _integrity_mod
+            snap["integrity"] = _integrity_mod.global_stats().snapshot()
         snap["anomalies"] = _anomalies(
             snap, churn_threshold_ops_s, skew_warn_ms)
         return snap
@@ -1298,6 +1330,22 @@ def render_summary(snap: Dict[str, Any]) -> str:
                 f"  {row['verdict']:<10} {row['model']:<16} "
                 f"{row['duration_ms']:.1f} ms  dominant="
                 f"{row['dominant']}  trace={row['trace_id']}")
+    integ = snap.get("integrity")
+    if integ:
+        lines.append("")
+        oh = integ.get("overhead_ns") or {}
+        lines.append(
+            f"integrity: {integ['results']} results validated, "
+            f"{integ['checks']} checks, {integ['violations']} violations"
+            + (f"  overhead p50={oh['p50'] / 1e3:.1f}us "
+               f"p99={oh['p99'] / 1e3:.1f}us"
+               if oh.get("samples") else ""))
+        for kind, n in sorted((integ.get("violations_by_kind")
+                               or {}).items()):
+            lines.append(f"  violation kind {kind}: {n}")
+        for url, n in sorted((integ.get("violations_by_url")
+                              or {}).items()):
+            lines.append(f"  violating url {url}: {n}")
     anomalies = snap.get("anomalies") or []
     lines.append("")
     if anomalies:
@@ -1369,6 +1417,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(client_tpu.pipeline)")
     parser.add_argument("--pipeline-runs", type=int, default=4,
                         help="probe DAG executions for --pipeline")
+    parser.add_argument("--integrity", action="store_true",
+                        help="add the response-integrity section: the "
+                             "process-wide contract-validation counters "
+                             "(results checked, violations by kind and "
+                             "by url, measured per-response overhead "
+                             "p50/p99) from client_tpu.integrity; "
+                             "byzantine_replica anomalies are always "
+                             "flagged off endpoint quarantine state, "
+                             "with or without this flag")
     parser.add_argument("--timeout", type=float, default=10.0,
                         help="per-call timeout (s) bounding every snapshot "
                              "RPC: health probes, probe infers, stats "
@@ -1404,7 +1461,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout,
         shard_layout=args.shard_layout, cells=args.cells,
         roles=args.roles, pipeline=args.pipeline,
-        pipeline_runs=args.pipeline_runs)
+        pipeline_runs=args.pipeline_runs, integrity=args.integrity)
     print(render_summary(snap))
     if args.json_path:
         with open(args.json_path, "w") as f:

@@ -355,6 +355,15 @@ class CellState:
     def breaker_admits(self) -> bool:
         return self.breaker is None or self.breaker.would_admit()
 
+    def quarantine_dominated(self) -> bool:
+        """More than half this cell's replicas are quarantined for
+        contract-violating (byzantine) responses — the plan treats the
+        cell as down: a majority of demonstrably-lying replicas is worse
+        than a dead cell, and spillover is strictly safer."""
+        check = getattr(getattr(self.pool, "pool", None),
+                        "quarantine_dominated", None)
+        return bool(check()) if check is not None else False
+
     def record_transport(self, ok: bool) -> None:
         """Feed one fed-level transport outcome into the cell breaker
         (sheds and FATAL answers are NOT transport outcomes)."""
@@ -694,7 +703,8 @@ class _FederatedBase:
                 # refresh the shed window and release the hysteresis
                 if self._rng.random() >= self.spill_probe_ratio:
                     order = order[1:] + order[:1]
-        admitted = [c for c in order if c.breaker_admits()]
+        admitted = [c for c in order
+                    if c.breaker_admits() and not c.quarantine_dominated()]
         return admitted or order
 
     # -- sequence pinning helpers ---------------------------------------------

@@ -315,10 +315,14 @@ class InferenceServerClient(InferenceServerClientBase):
         self, model_name, model_version="", headers=None, client_timeout=None,
         as_json=True,
     ) -> Dict[str, Any]:
-        return self._call(
+        metadata = self._call(
             "ModelMetadata", {"name": model_name, "version": model_version},
             headers, client_timeout,
         )
+        # captured into the integrity contract cache: later responses
+        # are validated against this fetched truth (never vice versa)
+        self._integrity_note_metadata(model_name, metadata)
+        return metadata
 
     def get_model_config(
         self, model_name, model_version="", headers=None, client_timeout=None,
@@ -540,6 +544,10 @@ class InferenceServerClient(InferenceServerClientBase):
             result._response_headers = metadata_sink
             if actx is not None:
                 actx.finish(result)
+            # contract validation: the result never reaches the caller
+            # (nor the ORCA path below) un-checked
+            self._integrity_check(result, inputs, outputs, request_id,
+                                  model_name)
             timers.capture(RequestTimers.RECV_END)
         except BaseException as e:
             if span is not None:
@@ -608,6 +616,13 @@ class InferenceServerClient(InferenceServerClientBase):
                         self._orca_ingest(result)
                     except Exception:
                         pass
+                    # same contract check as the unary path: a violation
+                    # becomes the callback's typed error, never a result
+                    try:
+                        self._integrity_check(result, inputs, outputs,
+                                              request_id, model_name)
+                    except InferenceServerException as e:
+                        result, error = None, e
                 except grpc.RpcError as e:
                     error = _to_exception(e)
                 except Exception as e:  # cancelled etc.

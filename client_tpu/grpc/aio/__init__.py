@@ -216,9 +216,13 @@ class InferenceServerClient(InferenceServerClientBase):
         return await self._call("ServerMetadata", {}, headers, client_timeout)
 
     async def get_model_metadata(self, model_name, model_version="", headers=None, client_timeout=None):
-        return await self._call(
+        metadata = await self._call(
             "ModelMetadata", {"name": model_name, "version": model_version}, headers, client_timeout
         )
+        # captured into the integrity contract cache: later responses
+        # are validated against this fetched truth (never vice versa)
+        self._integrity_note_metadata(model_name, metadata)
+        return metadata
 
     async def get_model_config(self, model_name, model_version="", headers=None, client_timeout=None):
         return await self._call(
@@ -395,6 +399,10 @@ class InferenceServerClient(InferenceServerClientBase):
             result._response_headers = metadata_sink
             if actx is not None:
                 actx.finish(result)
+            # contract validation: the result never reaches the caller
+            # (nor the ORCA path below) un-checked
+            self._integrity_check(result, inputs, outputs, request_id,
+                                  model_name)
         except BaseException as e:
             if span is not None:
                 self._telemetry.finish(span, error=e)

@@ -41,6 +41,7 @@ from ..resilience import (
     classify_fault,
     connect_only_policy,
 )
+from ..integrity import IntegrityError
 from ..utils import InferenceServerException
 from ._infer_result import InferResult
 from ._utils import (
@@ -369,7 +370,11 @@ class InferenceServerClient(InferenceServerClientBase):
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
-        return self._json_of(self._get(path, headers, query_params))
+        metadata = self._json_of(self._get(path, headers, query_params))
+        # captured into the integrity contract cache: later responses
+        # are validated against this fetched truth (never vice versa)
+        self._integrity_note_metadata(model_name, metadata)
+        return metadata
 
     def get_model_config(
         self, model_name, model_version="", headers=None, query_params=None
@@ -664,12 +669,24 @@ class InferenceServerClient(InferenceServerClientBase):
             raise_if_error(resp.status, resp.data)
             t_deser = time.perf_counter_ns() if span is not None else 0
             header_length = resp.headers.get("Inference-Header-Content-Length")
-            result = InferResult.from_response_body(
-                resp.data, int(header_length) if header_length is not None else None
-            )
+            try:
+                result = InferResult.from_response_body(
+                    resp.data,
+                    int(header_length) if header_length is not None else None,
+                )
+            except IntegrityError as e:
+                # undecodable body (torn JSON, overrun binary sizes):
+                # attribute to this endpoint and account like any other
+                # integrity violation, then let it classify as INVALID
+                self._integrity_parse_note(e)
+                raise
             result._response_headers = dict(resp.headers)  # e.g. endpoint-load-metrics
             if actx is not None:
                 actx.finish(result)
+            # contract validation: the result never reaches the caller
+            # (nor the ORCA/verbose paths below) un-checked
+            self._integrity_check(result, inputs, outputs, request_id,
+                                  model_name)
         except BaseException as e:
             if span is not None:
                 self._telemetry.finish(span, error=e)
@@ -814,15 +831,22 @@ class InferenceServerClient(InferenceServerClientBase):
                 # mark at parse time (arrival), before the consumer runs;
                 # bound once so the disabled path is a single None check
                 mark = span.mark if span is not None else None
+                # opt-in stream-index integrity (strict monotonicity
+                # within THIS wire stream); None when the policy is off
+                checker = self._integrity_stream_checker(model_name)
                 try:
                     for chunk in resp.stream(8192, decode_content=True):
                         for payload in decoder.feed(chunk):
                             event = parse_sse_event(payload)
+                            if checker is not None:
+                                checker.observe(event)
                             if mark is not None:
                                 mark()
                             yield event
                     for payload in decoder.flush():
                         event = parse_sse_event(payload)
+                        if checker is not None:
+                            checker.observe(event)
                         if mark is not None:
                             mark()
                         yield event

@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from .._tensor import ArenaOutputsMixin
+from ..integrity import IntegrityError
 from ..utils import (
     InferenceServerException,
     deserialize_bf16_tensor,
@@ -22,14 +23,23 @@ from ..utils import (
 
 
 class InferResult(ArenaOutputsMixin):
-    """The result of an inference request over HTTP."""
+    """The result of an inference request over HTTP.
+
+    Body decoding raises typed :class:`~client_tpu.integrity.IntegrityError`
+    (status ``INTEGRITY_VIOLATION``) for malformed responses — torn or
+    non-UTF-8 JSON headers, header-length claims exceeding the body,
+    binary sizes overrunning the buffer — so a byzantine replica's torn
+    bytes classify into the ``invalid`` fault domain exactly like the
+    contract lies ``integrity.check_result`` catches post-parse. The
+    decoder does not know its endpoint; the frontend stamps the url on
+    via ``integrity.note_parse_violation``."""
 
     def __init__(self, response_body: bytes, header_length: Optional[int] = None):
         self._buffer = memoryview(response_body)
         if header_length is not None and header_length > len(response_body):
-            raise InferenceServerException(
-                f"malformed inference response: Inference-Header-Content-Length "
-                f"{header_length} exceeds the {len(response_body)}-byte body"
+            raise IntegrityError(
+                "malformed", "", "Inference-Header-Content-Length",
+                f"<= {len(response_body)}", str(header_length),
             )
         try:
             if header_length is None:
@@ -38,35 +48,49 @@ class InferResult(ArenaOutputsMixin):
             else:
                 self._response = json.loads(bytes(self._buffer[:header_length]))
                 self._binary_start = header_length
-        except json.JSONDecodeError as e:
-            raise InferenceServerException(
-                f"malformed inference response: {e}"
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError) as e:
+            raise IntegrityError(
+                "malformed", "", "response header", "valid JSON", str(e),
             ) from e
         if not isinstance(self._response, dict):
-            raise InferenceServerException(
-                "malformed inference response: header is not a JSON object"
+            raise IntegrityError(
+                "malformed", "", "response header", "a JSON object",
+                type(self._response).__name__,
             )
         # Map output name -> (start, end) into the binary tail, walked in
         # output order using each output's binary_data_size parameter.
         self._offsets: Dict[str, Tuple[int, int]] = {}
         cursor = self._binary_start
         for output in self._response.get("outputs", []):
+            if not isinstance(output, dict):
+                raise IntegrityError(
+                    "malformed", "", "outputs", "JSON objects",
+                    type(output).__name__,
+                )
             params = output.get("parameters", {})
-            size = params.get("binary_data_size")
+            size = params.get("binary_data_size") \
+                if isinstance(params, dict) else None
             if size is not None:
                 if not isinstance(size, int) or isinstance(size, bool) or size < 0:
-                    raise InferenceServerException(
-                        f"malformed inference response: output "
-                        f"'{output.get('name')}' has invalid binary_data_size "
-                        f"{size!r}"
+                    raise IntegrityError(
+                        "payload_size", "", str(output.get("name")),
+                        "a non-negative integer",
+                        f"invalid binary_data_size {size!r}",
                     )
                 if cursor + size > len(response_body):
-                    raise InferenceServerException(
-                        f"malformed inference response: output "
-                        f"'{output.get('name')}' declares {size} binary bytes "
-                        "beyond the body"
+                    raise IntegrityError(
+                        "tail", "", str(output.get("name")),
+                        f"{size} bytes within the body",
+                        f"claim reaches beyond the body "
+                        f"({len(response_body) - cursor} bytes remain)",
                     )
-                self._offsets[output["name"]] = (cursor, cursor + size)
+                name = output.get("name")
+                if not isinstance(name, str) or not name:
+                    raise IntegrityError(
+                        "output_name", "", "outputs",
+                        "a non-empty string name", repr(name),
+                    )
+                self._offsets[name] = (cursor, cursor + size)
                 cursor += size
 
     @classmethod
@@ -91,14 +115,21 @@ class InferResult(ArenaOutputsMixin):
 
     def get_output(self, name: str) -> Optional[Dict[str, Any]]:
         for output in self._response.get("outputs", []):
-            if output["name"] == name:
+            if output.get("name") == name:
                 return output
         return None
 
     def _decode(self, output: Dict[str, Any]) -> Optional[np.ndarray]:
-        name = output["name"]
-        datatype = output["datatype"]
-        shape = output["shape"]
+        # a corrupted-but-parseable header (fuzzers produce these by
+        # flipping bytes inside valid JSON) must fail TYPED here, never
+        # as KeyError/ValueError from the numpy plumbing below
+        name = output.get("name")
+        datatype = output.get("datatype")
+        shape = output.get("shape")
+        if not isinstance(datatype, str) or not isinstance(shape, list):
+            raise IntegrityError(
+                "malformed", "", f"output '{name}'",
+                "datatype and shape fields", repr(sorted(output))[:120])
         params = output.get("parameters", {})
         if "shared_memory_region" in params:
             lease = self._arena_lease_for(name)
@@ -122,18 +153,37 @@ class InferResult(ArenaOutputsMixin):
                         f"unknown datatype '{datatype}' for output '{name}'"
                     )
                 arr = np.frombuffer(raw, dtype=np_dtype)
-            return arr.reshape(shape)
+            return self._reshape(arr, shape, name)
         if "data" in output:
-            np_dtype = triton_to_np_dtype(datatype)
             if datatype == "BYTES":
                 arr = np.array(
                     [d.encode("utf-8") if isinstance(d, str) else d for d in output["data"]],
                     dtype=np.object_,
                 )
             else:
-                arr = np.array(output["data"], dtype=np_dtype)
-            return arr.reshape(shape)
+                np_dtype = triton_to_np_dtype(datatype)
+                if np_dtype is None:
+                    raise InferenceServerException(
+                        f"unknown datatype '{datatype}' for output '{name}'"
+                    )
+                try:
+                    arr = np.array(output["data"], dtype=np_dtype)
+                except (ValueError, TypeError, OverflowError) as e:
+                    raise IntegrityError(
+                        "malformed", "", f"output '{name}' data",
+                        datatype, str(e)) from None
+            return self._reshape(arr, shape, name)
         return None
+
+    @staticmethod
+    def _reshape(arr: np.ndarray, shape, name) -> np.ndarray:
+        try:
+            return arr.reshape(shape)
+        except (ValueError, TypeError) as e:
+            # element count vs claimed shape disagree: the header lied
+            raise IntegrityError(
+                "payload_size", "", f"output '{name}'",
+                shape, f"{arr.size} elements ({e})") from None
 
     def as_numpy(self, name: str) -> Optional[np.ndarray]:
         """Decode output ``name`` as a numpy array (zero-copy for fixed-width

@@ -26,6 +26,7 @@ from ...resilience import (
     RetryableStatusError,
     classify_fault,
 )
+from ...integrity import IntegrityError
 from ...utils import InferenceServerException
 from .._client import InferenceServerClient as _SyncClient
 from .._infer_result import InferResult
@@ -217,7 +218,11 @@ class InferenceServerClient(InferenceServerClientBase):
         path = f"v2/models/{quote(model_name)}"
         if model_version:
             path += f"/versions/{model_version}"
-        return await self._get_json(path, headers, query_params)
+        metadata = await self._get_json(path, headers, query_params)
+        # captured into the integrity contract cache: later responses
+        # are validated against this fetched truth (never vice versa)
+        self._integrity_note_metadata(model_name, metadata)
+        return metadata
 
     async def get_model_config(self, model_name, model_version="", headers=None, query_params=None):
         path = f"v2/models/{quote(model_name)}"
@@ -404,12 +409,24 @@ class InferenceServerClient(InferenceServerClientBase):
             raise_if_error(status, data)  # aiohttp auto-decodes Content-Encoding
             t_deser = time.perf_counter_ns() if span is not None else 0
             header_length = resp_headers.get("Inference-Header-Content-Length")
-            result = InferResult.from_response_body(
-                data, int(header_length) if header_length is not None else None
-            )
+            try:
+                result = InferResult.from_response_body(
+                    data,
+                    int(header_length) if header_length is not None else None,
+                )
+            except IntegrityError as e:
+                # undecodable body (torn JSON, overrun binary sizes):
+                # attribute to this endpoint and account like any other
+                # integrity violation, then let it classify as INVALID
+                self._integrity_parse_note(e)
+                raise
             result._response_headers = resp_headers  # e.g. endpoint-load-metrics
             if actx is not None:
                 actx.finish(result)
+            # contract validation: the result never reaches the caller
+            # (nor the ORCA/verbose paths below) un-checked
+            self._integrity_check(result, inputs, outputs, request_id,
+                                  model_name)
         except BaseException as e:
             if span is not None:
                 self._telemetry.finish(span, error=e)
@@ -509,14 +526,21 @@ class InferenceServerClient(InferenceServerClientBase):
                     # mark at parse time (arrival), before the consumer
                     # runs; bound once so the disabled path is a None check
                     mark = span.mark if span is not None else None
+                    # opt-in stream-index integrity (strict monotonicity
+                    # within THIS wire stream); None when the policy is off
+                    checker = self._integrity_stream_checker(model_name)
                     async for chunk in resp.content.iter_chunked(8192):
                         for payload in decoder.feed(chunk):
                             event = parse_sse_event(payload)
+                            if checker is not None:
+                                checker.observe(event)
                             if mark is not None:
                                 mark()
                             yield event
                     for payload in decoder.flush():
                         event = parse_sse_event(payload)
+                        if checker is not None:
+                            checker.observe(event)
                         if mark is not None:
                             mark()
                         yield event

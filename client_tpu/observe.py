@@ -1786,6 +1786,19 @@ class Telemetry:
             "client_tpu_pool_sequence_abandoned_total",
             "Sequence requests abandoned mid-flight (never re-sent)",
             ("url",))
+        # -- response integrity (client_tpu.integrity) ------------------------
+        self.integrity_checks_total = reg.counter(
+            "client_tpu_integrity_checks_total",
+            "Individual contract checks performed on responses",
+            ("kind", "url"))
+        self.integrity_violations_total = reg.counter(
+            "client_tpu_integrity_violations_total",
+            "Responses failing contract validation, by violated check",
+            ("kind", "url"))
+        self.pool_quarantines_total = reg.counter(
+            "client_tpu_pool_quarantines_total",
+            "Byzantine-replica quarantines (repeated INVALID responses)",
+            ("url",))
         self.hedges_fired_total = reg.counter(
             "client_tpu_hedges_fired_total",
             "Hedge copies issued to a second replica")
@@ -2562,6 +2575,15 @@ class Telemetry:
                     except ValueError:
                         pass
 
+    # -- response integrity ---------------------------------------------------
+    def integrity_checked(self, kind: str, url: str, checks: int = 1) -> None:
+        """Count the contract checks one validated response passed."""
+        self.integrity_checks_total.labels(kind, url or "").inc(checks)
+
+    def integrity_violation(self, kind: str, url: str) -> None:
+        """Count one response that failed contract validation."""
+        self.integrity_violations_total.labels(kind, url or "").inc()
+
     # -- pool bridge ---------------------------------------------------------
     def pool_observer(self, chain: Optional[Callable[[Any], None]] = None,
                       ) -> Callable[[Any], None]:
@@ -2570,6 +2592,7 @@ class Telemetry:
         Matches on type name so this module never imports the pool."""
         counters = {
             "EndpointEjected": self.pool_ejections_total,
+            "EndpointQuarantined": self.pool_quarantines_total,
             "EndpointReadmitted": self.pool_readmissions_total,
             "EndpointHealthChanged": self.pool_health_changes_total,
             "SequenceAbandoned": self.pool_sequence_abandoned_total,

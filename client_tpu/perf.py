@@ -137,6 +137,7 @@ class PerfRunner:
         cells_attempt_timeout_s: Optional[float] = None,
         roles=None,
         pipeline=None,
+        validate: bool = False,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -238,6 +239,7 @@ class PerfRunner:
 
             pipeline = resolve_pipeline(pipeline)
         self.pipeline = pipeline
+        self.validate = validate
         self.seed = seed
         # sharded scatter-gather (client_tpu.shard): a ShardLayout or a
         # spec string ("IN=0->OUT=0") resolved over --endpoints in order;
@@ -1096,6 +1098,46 @@ class PerfRunner:
             result["client_admission"] = admission_stats
         return result
 
+    def _integrity_stats(self) -> Optional[Dict[str, Any]]:
+        """Pre-run snapshot of the process-global integrity counters,
+        when ``--validate`` armed the row. Contract validation itself is
+        default-ON regardless — this flag only opts the RESULT ROW into
+        carrying the delta, so A/B artifacts stay byte-stable when
+        validation reporting is off."""
+        if not self.validate:
+            return None
+        from . import integrity
+
+        return integrity.global_stats().snapshot()
+
+    def _integrity_result(self, result: Dict[str, Any],
+                          before: Optional[Dict[str, Any]],
+                          ) -> Dict[str, Any]:
+        """Append ``client_integrity``: this run's delta of the global
+        validation counters (results checked, per-check count,
+        violations by kind) plus the overhead percentile window — the
+        measured nanoseconds the contract walk cost per response."""
+        if before is None:
+            return result
+        from . import integrity
+
+        after = integrity.global_stats().snapshot()
+        kinds = {
+            k: after["violations_by_kind"].get(k, 0)
+            - before["violations_by_kind"].get(k, 0)
+            for k in after.get("violations_by_kind", {})
+        }
+        result["client_integrity"] = {
+            "results": after["results"] - before["results"],
+            "checks": after["checks"] - before["checks"],
+            "violations": after["violations"] - before["violations"],
+            "violations_by_kind": {k: v for k, v in kinds.items() if v},
+            # the stats ring holds the most recent samples, which for a
+            # just-finished run IS the run's window
+            "overhead_ns": after.get("overhead_ns", {}),
+        }
+        return result
+
     def _federation_stats(self, client) -> Optional[Dict[str, Any]]:
         """The federation snapshot (per-cell spill/serve counters plus
         the shadow/canary views) when ``--cells`` is armed — appended to
@@ -1236,6 +1278,7 @@ class PerfRunner:
 
     def _run_closed(self, concurrency: int, measurement_requests: int,
                     shm_rec, shm_before) -> Dict[str, Any]:
+        integrity_before = self._integrity_stats()
         client = self._make_client(concurrency)
         if self.protocol == "native-grpc-async":
             # the shared instance must admit as many RPCs as we have
@@ -1272,7 +1315,8 @@ class PerfRunner:
         lat_sorted = sorted(latencies)
         n = len(lat_sorted)
         issued = n + len(errors) + len(sheds)
-        return self._federation_result(self._cache_result(
+        return self._integrity_result(
+            self._federation_result(self._cache_result(
             self._admission_result(
             self._shm_result(self._batch_result(
             self._observe_result({
@@ -1296,7 +1340,7 @@ class PerfRunner:
             "infer_per_sec": round(n / elapsed, 1) if elapsed > 0 else 0.0,
             "latency_ms": _latency_ms_row(lat_sorted),
         }), batch_stats), shm_rec, shm_before), admission_stats),
-            cache_stats), fed_stats)
+            cache_stats), fed_stats), integrity_before)
 
     def run_rate(self, rate: float, measurement_requests: int,
                  distribution: str = "constant",
@@ -1329,6 +1373,7 @@ class PerfRunner:
     def _run_open(self, rate: float, distribution: str, pool_size: int,
                   schedule: List[float], shm_rec,
                   shm_before) -> Dict[str, Any]:
+        integrity_before = self._integrity_stats()
         client = self._make_client(pool_size)
         if self.protocol == "native-grpc-async":
             client.set_async_concurrency(pool_size)
@@ -1378,7 +1423,8 @@ class PerfRunner:
         # denominator for every capacity claim (a saturated pool that
         # silently under-offers would otherwise flatter its own number)
         arrival_window = max(issues) if issues else 0.0
-        return self._federation_result(self._cache_result(
+        return self._integrity_result(
+            self._federation_result(self._cache_result(
             self._admission_result(
             self._shm_result(self._batch_result(
             self._observe_result({
@@ -1410,7 +1456,7 @@ class PerfRunner:
             "schedule_lag_ms": _lag_ms_row(lag_sorted),
             "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
         }), batch_stats), shm_rec, shm_before), admission_stats),
-            cache_stats), fed_stats)
+            cache_stats), fed_stats), integrity_before)
 
     # -- trace replay --------------------------------------------------------
     _SEQ_GATE_TIMEOUT_S = 60.0
@@ -1591,6 +1637,9 @@ class PerfRunner:
                 self._telemetry = saved_telemetry
             # warmup DAG runs must not land in the measured waterfall
             resources.pipeline_stage_s.clear()
+        # capture AFTER warmup: warmup traffic is contract-checked too
+        # and must not pollute the measured row's validation delta
+        integrity_before = self._integrity_stats()
         client = self._make_client(replay_workers)
         try:
             # pools: let active probes mark replicas healthy BEFORE the
@@ -1640,11 +1689,12 @@ class PerfRunner:
             fed_stats = self._federation_stats(client)
         finally:
             client.close()
-        return self._federation_result(self._cache_result(
+        return self._integrity_result(
+            self._federation_result(self._cache_result(
             self._admission_result(self._trace_result(
                 header, records, speed, elapsed, outcomes, errors, specs,
                 batch_stats, resources, request_slos), admission_stats),
-            cache_stats), fed_stats)
+            cache_stats), fed_stats), integrity_before)
 
     def _make_disagg_client(self):
         """The replay's disaggregated client: a DisaggClient over the
@@ -2231,6 +2281,14 @@ def main(argv: Optional[List[str]] = None) -> int:
              "p50/p99 cost) to each result",
     )
     parser.add_argument(
+        "--validate", action="store_true",
+        help="append a client_integrity row to each result: this run's "
+             "contract-validation delta (results checked, checks, "
+             "violations by kind) plus the measured per-response "
+             "validation overhead (ns p50/p99) — the A/A arm of "
+             "tools/bench_integrity.py reads exactly this block",
+    )
+    parser.add_argument(
         "--generate-stream", action="store_true",
         help="measure streamed generations instead of unary infers: each "
              "request drives one generate-extension SSE session to "
@@ -2444,6 +2502,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         canary_min_events=args.canary_min_events,
         roles=args.roles,
         pipeline=args.pipeline,
+        validate=args.validate,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside

@@ -98,6 +98,7 @@ from .admission import (
 from .resilience import (
     CONNECT,
     FATAL,
+    INVALID,
     SHED,
     TIMEOUT,
     TRANSIENT,
@@ -120,6 +121,7 @@ __all__ = [
     "EndpointEjected",
     "EndpointHealthChanged",
     "EndpointPool",
+    "EndpointQuarantined",
     "EndpointReadmitted",
     "EndpointSpec",
     "HedgePolicy",
@@ -244,6 +246,23 @@ class EndpointEjected(PoolEvent):
         self.window_s = window_s
         self.consecutive_failures = consecutive_failures
         self.ejection_count = ejection_count
+
+
+class EndpointQuarantined(PoolEvent):
+    """Byzantine-replica quarantine fired for ``url``: ``invalid_count``
+    contract-violating responses (resilience's INVALID domain) landed
+    inside the quarantine window, so the endpoint is ejected for
+    ``window_s`` with the usual exponential backoff. Unlike transport
+    ejection this is evidence the replica is WRONG, not slow — the
+    doctor's ``byzantine_replica`` anomaly names it from this state."""
+
+    __slots__ = ("window_s", "invalid_count", "quarantine_count")
+
+    def __init__(self, url, window_s, invalid_count, quarantine_count):
+        super().__init__(url)
+        self.window_s = window_s
+        self.invalid_count = invalid_count
+        self.quarantine_count = quarantine_count
 
 
 class EndpointReadmitted(PoolEvent):
@@ -380,6 +399,7 @@ class EndpointState:
         "last_ejection_end", "_wrr_current", "limiter", "shed_total",
         "_orca_weight", "affinity_routed", "affinity_rehomed",
         "affinity_spilled", "_affinity_keys",
+        "invalid_total", "quarantined", "quarantine_count", "_invalid_times",
     )
 
     def __init__(self, url: str, client: Any, policy: ResiliencePolicy,
@@ -410,6 +430,13 @@ class EndpointState:
         self.affinity_rehomed = 0
         self.affinity_spilled = 0
         self._affinity_keys: set = set()
+        # byzantine-replica accounting: contract-violating (INVALID)
+        # responses, the sliding timestamp window behind quarantine, and
+        # whether the CURRENT ejection is a quarantine (vs transport)
+        self.invalid_total = 0
+        self.quarantined = False
+        self.quarantine_count = 0
+        self._invalid_times: deque = deque()
 
 
 class EndpointPool:
@@ -433,6 +460,8 @@ class EndpointPool:
         on_event: Optional[Callable[[PoolEvent], None]] = None,
         load_lookup: Optional[Callable[[], Dict[str, Any]]] = None,
         affinity_bound: float = _AFFINITY_BOUND,
+        quarantine_after: int = 3,
+        quarantine_window_s: float = 30.0,
     ):
         """``load_lookup`` (``orca_weighted`` routing): a zero-arg callable
         returning ``{url: observe.EndpointLoad}`` containing ONLY
@@ -463,6 +492,13 @@ class EndpointPool:
         if affinity_bound < 1.0:
             raise ValueError("affinity_bound must be >= 1.0")
         self.affinity_bound = affinity_bound
+        if quarantine_after < 1:
+            raise ValueError("quarantine_after must be >= 1")
+        # byzantine quarantine: N INVALID (contract-violating) responses
+        # inside the sliding window ejects the endpoint (same backoff +
+        # max_ejected guard as transport ejection)
+        self.quarantine_after = quarantine_after
+        self.quarantine_window_s = quarantine_window_s
         self._clock = clock
         self._on_event = on_event
         self._load_lookup = load_lookup
@@ -502,6 +538,7 @@ class EndpointPool:
         for ep in self.endpoints:
             if ep.ejected and now >= ep.ejected_until:
                 ep.ejected = False
+                ep.quarantined = False
                 ep.consecutive_failures = 0
                 events.append(EndpointReadmitted(ep.url))
 
@@ -769,8 +806,11 @@ class EndpointPool:
             ep.consecutive_failures = 0
             if ep.ejected:
                 # proved itself (panic routing landed here and succeeded):
-                # readmit early rather than waiting out the window
+                # readmit early rather than waiting out the window — a
+                # contract-VALIDATED success even clears quarantine (the
+                # replica demonstrably answers correctly again)
                 ep.ejected = False
+                ep.quarantined = False
                 events.append(EndpointReadmitted(ep.url))
             if latency_s is not None:
                 self._latencies.append(latency_s)
@@ -813,6 +853,64 @@ class EndpointPool:
                         ep.url, window, ep.consecutive_failures,
                         ep.ejection_count))
         self._emit_all(events)
+
+    def record_invalid(self, ep: EndpointState) -> None:
+        """Feed one contract-violating (INVALID) response into the
+        byzantine quarantine: the endpoint ANSWERED — so this is neither
+        a breaker failure nor transport-outlier evidence — but
+        ``quarantine_after`` invalid responses inside
+        ``quarantine_window_s`` eject it with the usual exponential
+        backoff (and the ``max_ejected`` self-blind guard). Deliberately
+        NOT ``record_success``: a wrong answer must never readmit an
+        ejected endpoint early."""
+        events: List[PoolEvent] = []
+        with self._lock:
+            now = self._clock()
+            ep.invalid_total += 1
+            times = ep._invalid_times
+            times.append(now)
+            cutoff = now - self.quarantine_window_s
+            while times and times[0] < cutoff:
+                times.popleft()
+            if len(times) >= self.quarantine_after and not ep.ejected:
+                already = sum(
+                    1 for e in self.endpoints
+                    if e.ejected and e.ejected_until > now)
+                if already < self.max_ejected:
+                    if (ep.last_ejection_end
+                            and now - ep.last_ejection_end > self.ejection_decay_s):
+                        ep.ejection_count = 0  # long-healthy: forgive history
+                    window = min(
+                        self.base_ejection_s
+                        * (self.ejection_multiplier ** ep.ejection_count),
+                        self.max_ejection_s,
+                    )
+                    ep.ejected = True
+                    ep.quarantined = True
+                    ep.ejected_until = now + window
+                    ep.last_ejection_end = ep.ejected_until
+                    ep.ejection_count += 1
+                    ep.quarantine_count += 1
+                    invalid_count = len(times)
+                    times.clear()
+                    events.append(EndpointQuarantined(
+                        ep.url, window, invalid_count, ep.quarantine_count))
+                    _flight.note("integrity", "quarantine", url=ep.url,
+                                 window_s=window,
+                                 quarantine_count=ep.quarantine_count)
+        self._emit_all(events)
+
+    def quarantine_dominated(self) -> bool:
+        """More than half the endpoints currently sit in quarantine —
+        the federation layer treats such a cell as down (a majority of
+        demonstrably-lying replicas is worse than a dead cell: spillover
+        is strictly safer)."""
+        with self._lock:
+            now = self._clock()
+            quarantined = sum(
+                1 for ep in self.endpoints
+                if ep.quarantined and ep.ejected and ep.ejected_until > now)
+        return quarantined * 2 > len(self.endpoints)
 
     def set_health(self, ep: EndpointState, healthy: bool) -> None:
         events: List[PoolEvent] = []
@@ -859,6 +957,12 @@ class EndpointPool:
                     "shed_total": ep.shed_total,
                     "breaker_state": breaker.state if breaker is not None else None,
                     "resilience": ep.policy.stats.as_dict(),
+                    # byzantine view: contract-violating responses seen,
+                    # whether the current ejection is a quarantine, and
+                    # how many quarantines this endpoint has earned
+                    "invalid_total": ep.invalid_total,
+                    "quarantined": ep.quarantined and ejected,
+                    "quarantine_count": ep.quarantine_count,
                 }
                 if self.routing == AFFINITY:
                     # affinity view: how many picks landed here and why,
@@ -937,6 +1041,8 @@ class _PoolClientBase:
         ejection_multiplier: float = 2.0,
         max_ejection_s: float = 30.0,
         ejection_decay_s: float = 60.0,
+        quarantine_after: int = 3,
+        quarantine_window_s: float = 30.0,
         breaker_factory: Optional[Callable[[], Optional[CircuitBreaker]]] = None,
         endpoint_retry: Optional[RetryPolicy] = None,
         max_failover_attempts: Optional[int] = None,
@@ -1092,6 +1198,8 @@ class _PoolClientBase:
                 ejection_multiplier=ejection_multiplier,
                 max_ejection_s=max_ejection_s,
                 ejection_decay_s=ejection_decay_s,
+                quarantine_after=quarantine_after,
+                quarantine_window_s=quarantine_window_s,
                 clock=clock,
                 on_event=on_event,
                 # orca_weighted: weights come from the telemetry's
@@ -1298,12 +1406,15 @@ class _PoolClientBase:
         verdict: at least one replica is healthy, un-ejected and not
         breaker-open."""
         snap = self.pool.snapshot()
-        healthy = ejected = breaker_open = 0
-        outstanding = shed_total = 0
+        healthy = ejected = breaker_open = quarantined = 0
+        outstanding = shed_total = invalid_total = 0
         roles: Dict[str, Dict[str, Any]] = {}
         for stats in snap.values():
             if stats["ejected"]:
                 ejected += 1
+            if stats.get("quarantined"):
+                quarantined += 1
+            invalid_total += stats.get("invalid_total", 0)
             state = stats.get("breaker_state")
             # only a fully-open breaker is unroutable: half_open is MID
             # RECOVERY and actively admitting probes — counting it down
@@ -1334,6 +1445,12 @@ class _PoolClientBase:
             "outstanding": outstanding,
             "shed_total": shed_total,
             "available": healthy > 0,
+            # byzantine view: endpoints currently in quarantine + the
+            # cell-wide count of contract-violating responses; a
+            # quarantine-dominated cell is treated as down by federation
+            "quarantined": quarantined,
+            "invalid_total": invalid_total,
+            "quarantine_dominated": quarantined * 2 > len(snap),
         }
         if roles:
             # per-role availability (disaggregated prefill/decode): a
@@ -1372,7 +1489,12 @@ class _PoolClientBase:
         if isinstance(exc, CircuitOpenError):
             return ""  # nothing was sent; the breaker already knows
         domain = classify_fault(exc)
-        if domain == FATAL:
+        if domain == INVALID:
+            # the endpoint answered WRONG: not record_success (a wrong
+            # answer must never readmit an ejected endpoint early), not
+            # transport-outlier evidence — quarantine accounting
+            self.pool.record_invalid(ep)
+        elif domain == FATAL:
             # an application error proves the transport delivered the
             # request — for ejection purposes that is a success
             self.pool.record_success(ep)
@@ -1610,6 +1732,18 @@ class PoolClient(_PoolClientBase):
                 continue
             except Exception as e:
                 domain = self._record_attempt_failure(ep, e)
+                if domain == INVALID:
+                    # the endpoint answered WRONG (IntegrityError): never
+                    # retried on the SAME endpoint — an idempotent request
+                    # fails over to a different replica, a sequence
+                    # request raises (its state lives on a liar)
+                    last = e
+                    if not idempotent:
+                        self._sequence_event(ep, request_id, sequence_id, e)
+                        raise
+                    _flight.note("pool", "failover", url=ep.url,
+                                 domain=domain)
+                    continue
                 if domain in (FATAL, SHED):
                     # FATAL: the server answered; SHED: a client-local
                     # admission rejection — failover cannot help either
@@ -2230,6 +2364,16 @@ class AioPoolClient(_PoolClientBase):
                 continue
             except Exception as e:
                 domain = self._record_attempt_failure(ep, e)
+                if domain == INVALID:
+                    # answered WRONG: never same-endpoint retried; fail
+                    # over iff idempotent (see the sync twin)
+                    last = e
+                    if not idempotent:
+                        self._sequence_event(ep, request_id, sequence_id, e)
+                        raise
+                    _flight.note("pool", "failover", url=ep.url,
+                                 domain=domain)
+                    continue
                 if domain in (FATAL, SHED):
                     raise  # neither outcome is servable elsewhere
                 last = e

@@ -44,6 +44,7 @@ __all__ = [
     "TIMEOUT",
     "FATAL",
     "SHED",
+    "INVALID",
     "AttemptBudget",
     "CircuitBreaker",
     "CircuitOpenError",
@@ -63,10 +64,18 @@ FATAL = "fatal"          # corruption / protocol / application error: never retr
 SHED = "shed"            # admission control rejected it client-side: never sent,
 #                          never retried, and NOT a breaker/ejection signal —
 #                          accounting counts it as shed, not error
+INVALID = "invalid"      # the endpoint ANSWERED, but the answer failed contract
+#                          validation (integrity.IntegrityError): never retried
+#                          on the same endpoint, safe to fail over iff
+#                          idempotent, counted into the pool's quarantine window
 
 # client_tpu.admission.AdmissionRejected carries this status; matching on
 # the status string keeps this module free of an admission import
 _ADMISSION_REJECTED_STATUS = "ADMISSION_REJECTED"
+
+# client_tpu.integrity.IntegrityError carries this status; same pattern —
+# no integrity import here
+_INTEGRITY_VIOLATION_STATUS = "INTEGRITY_VIOLATION"
 
 # Exception type names (checked across the __cause__/__context__ chain, and
 # across each exception's MRO) that mark a request as never-sent.
@@ -168,6 +177,14 @@ def classify_fault(exc: BaseException) -> str:
         # a breaker outcome (see _record), counted as shed by harnesses
         return SHED
     chain = _chain(exc)
+    for e in chain:
+        if (isinstance(e, InferenceServerException)
+                and e.status() == _INTEGRITY_VIOLATION_STATUS):
+            # the transport worked end to end and the server answered —
+            # wrongly. Same-endpoint retry would re-trust a liar
+            # (retries_domain: unknown domain -> False); the pool fails
+            # over idempotent requests and counts it toward quarantine.
+            return INVALID
     names: List[str] = []
     for e in chain:
         names.extend(_type_names(e))

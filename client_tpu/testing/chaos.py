@@ -19,6 +19,14 @@ Fault vocabulary (see :class:`Fault`):
   (partial-write-then-stall).
 - ``flap``      — reset at accept on every ``every``-th connection
   (connection flapping).
+- ``corrupt``   — deliver the response intact up to ``after_bytes``
+  (skip the HTTP headers), then corrupt the next ``corrupt_bytes``
+  response bytes: seeded deterministic bit-flips
+  (``corrupt_mode="flip"``, the default) or a clean FIN truncation
+  (``corrupt_mode="truncate"`` — the body ends short of its
+  Content-Length). Transport stays perfectly healthy either way; only
+  the payload lies — the integrity layer's problem, not the retry
+  layer's.
 
 ``Fault.limit`` bounds how many connections a fault is applied to
 (``None`` = unlimited) — set ``limit=1`` to fault exactly the first
@@ -37,15 +45,16 @@ Usage::
 
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["ChaosCell", "ChaosProxy", "Fault"]
 
-_KINDS = ("latency", "reset", "blackhole", "stall", "flap")
+_KINDS = ("latency", "reset", "blackhole", "stall", "flap", "corrupt")
 
 
 class Fault:
@@ -58,16 +67,31 @@ class Fault:
         latency_s: float = 0.0,
         every: int = 1,
         limit: Optional[int] = None,
+        corrupt_bytes: int = 1,
+        corrupt_mode: str = "flip",
+        seed: int = 0,
     ):
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r} (one of {_KINDS})")
         if every < 1:
             raise ValueError("every must be >= 1")
+        if corrupt_mode not in ("flip", "truncate"):
+            raise ValueError(
+                f"corrupt_mode must be 'flip' or 'truncate', "
+                f"not {corrupt_mode!r}")
+        if corrupt_bytes < 1:
+            raise ValueError("corrupt_bytes must be >= 1")
         self.kind = kind
         self.after_bytes = after_bytes
         self.latency_s = latency_s
         self.every = every
         self.limit = limit
+        self.corrupt_bytes = corrupt_bytes
+        self.corrupt_mode = corrupt_mode
+        self.seed = seed
+        # seeded once per Fault: the same rule corrupts the same offsets
+        # with the same bit patterns, run after run (bench replayability)
+        self._rng = random.Random(seed)
         self._applied = 0
         self._lock = threading.Lock()
 
@@ -113,6 +137,11 @@ class _Connection:
         self._lock = threading.Lock()
         self._dead = False
         self._threads: List[threading.Thread] = []
+        # corrupt-fault state (s2c): where the first response's header
+        # block ends and how many body bytes have been forwarded since
+        self._hdr_done = False
+        self._hdr_scan = b""
+        self._body_seen = 0
 
     def run(self) -> None:
         fault = self.fault
@@ -199,6 +228,19 @@ class _Connection:
                     if tripped:
                         self.kill()
                         return
+                if fault is not None and fault.kind == "corrupt" and direction == "s2c":
+                    data, close_after = self._corrupt_s2c(data, fault)
+                    if data:
+                        dst.sendall(data)
+                    if close_after:
+                        # clean FIN: the client sees a short body against
+                        # its Content-Length — a payload lie, not a reset
+                        try:
+                            dst.shutdown(socket.SHUT_WR)
+                        except OSError:
+                            pass
+                        return
+                    continue
                 if fault is not None and fault.kind == "stall" and direction == "s2c":
                     with self._lock:
                         budget = fault.after_bytes - self.total_bytes
@@ -212,6 +254,42 @@ class _Connection:
                 dst.sendall(data)
         except OSError:
             self.kill()
+
+    def _corrupt_s2c(self, data: bytes, fault: Fault) -> "Tuple[bytes, bool]":
+        """Apply the corrupt fault to one s2c chunk.
+
+        Returns ``(bytes_to_forward, close_after)``. The first response's
+        HTTP header block passes through untouched (found by scanning for
+        the first blank line, spanning chunk boundaries); body bytes then
+        count toward the corruption window ``[after_bytes,
+        after_bytes + corrupt_bytes)``. ``flip`` XORs each window byte
+        with a seeded nonzero mask and forwards everything else intact —
+        sizes, framing and Content-Length all stay consistent, only the
+        payload lies. ``truncate`` forwards up to the window and then
+        FINs, a short read against the declared Content-Length."""
+        if not self._hdr_done:
+            merged = self._hdr_scan + data
+            pos = merged.find(b"\r\n\r\n")
+            if pos < 0:
+                self._hdr_scan = merged[-3:]
+                return data, False  # still inside the header block
+            self._hdr_done = True
+            body_at = pos + 4 - len(self._hdr_scan)
+            self._hdr_scan = b""
+            head, body = data[:body_at], data[body_at:]
+        else:
+            head, body = b"", data
+        lo = fault.after_bytes - self._body_seen
+        hi = lo + fault.corrupt_bytes
+        self._body_seen += len(body)
+        if fault.corrupt_mode == "truncate":
+            if lo >= len(body):
+                return head + body, False  # window not reached yet
+            return head + body[:max(0, lo)], True
+        out = bytearray(body)
+        for j in range(max(0, lo), min(len(out), hi)):
+            out[j] ^= fault._rng.randrange(1, 256)
+        return head + bytes(out), False
 
     def kill(self) -> None:
         with self._lock:

@@ -1,0 +1,475 @@
+"""End-to-end response integrity: contract validation, digests, quarantine.
+
+Proves the ISSUE acceptance criteria against LIVE wire bytes (the
+byzantine server of ``client_tpu.testing.byzantine``, never hand-built
+mocks): (a) every unary fault kind the server can tell raises a typed
+``IntegrityError`` with the right ``kind`` — and never returns a
+garbage numpy view; (b) SSE stream-index duplication/gaps raise typed
+``stream_index`` errors under an opted-in policy; (c) arena lease
+digests catch a post-answer scribble at ``as_numpy`` map time; (d) a
+3-replica pool with one byzantine member serves every request correctly
+(failover absorbs the lies), quarantines the liar after N invalid
+responses, fires ``EndpointQuarantined``, and surfaces it all through
+``endpoint_stats``/``health_summary`` and the doctor's
+``byzantine_replica`` anomaly; (e) ``perf.py --validate`` rows carry the
+``client_integrity`` block and compose with coalescing/caching; (f) the
+committed BENCH_INTEGRITY.json re-validates under its own ``--check``.
+
+The honest limits are pinned too: a pure payload ``bit_flip`` (sizes and
+headers all consistent) is DELIVERED by contract checking alone — that
+detectability boundary is exactly why digests exist (docs/integrity.md).
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu import integrity
+from client_tpu.arena import ShmArena
+from client_tpu.integrity import (
+    IntegrityError,
+    IntegrityPolicy,
+    StreamChecker,
+    event_index,
+)
+from client_tpu.models import default_model_zoo
+from client_tpu.pool import EndpointQuarantined, PoolClient
+from client_tpu.resilience import INVALID, classify_fault
+from client_tpu.server import HttpInferenceServer, ServerCore
+from client_tpu.testing import ByzantineHttpServer, ByzantinePlan, ChaosProxy, Fault
+
+SEEDED_RNG = lambda: random.Random(0x1D7E)  # noqa: E731
+
+
+# -- helpers ------------------------------------------------------------------
+def _simple_inputs():
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    in0.set_data_from_numpy(a)
+    in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    in1.set_data_from_numpy(b)
+    return a + b, a - b, [in0, in1]
+
+
+def _stats():
+    return integrity.global_stats().snapshot()
+
+
+@pytest.fixture(scope="module")
+def honest_url():
+    with HttpInferenceServer(ServerCore(default_model_zoo())) as server:
+        yield server.url
+
+
+# -- honest traffic validates clean -------------------------------------------
+def test_honest_responses_validate_clean(honest_url):
+    before = _stats()
+    expected_sum, expected_diff, inputs = _simple_inputs()
+    with httpclient.InferenceServerClient(honest_url) as client:
+        for _ in range(3):
+            result = client.infer("simple", inputs, request_id="rq-1")
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), expected_sum)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT1"), expected_diff)
+    after = _stats()
+    assert after["results"] - before["results"] >= 3
+    assert after["checks"] - before["checks"] > 0
+    assert after["violations"] == before["violations"]
+
+
+def test_metadata_primes_the_contract_cache(honest_url):
+    """get_model_metadata on any frontend feeds the policy's contract
+    cache for free — no extra RPC is ever made by the validator."""
+    policy = IntegrityPolicy()
+    with httpclient.InferenceServerClient(honest_url) as client:
+        client.configure_integrity(policy)
+        client.get_model_metadata("simple")
+    table = policy.metadata_for("simple")
+    assert table is not None
+    assert table["OUTPUT0"][0] == "INT32"
+    assert table["OUTPUT0"][1] == (1, 16)
+
+
+# -- unary byzantine faults raise typed, with the right kind ------------------
+# each lie's detectable kinds: when the process-default policy has the
+# model's metadata cached (any earlier get_model_metadata in this
+# process primes it), shape/dtype lies are caught by the metadata
+# contract FIRST; without it the payload-size arithmetic catches them
+@pytest.mark.parametrize("fault_kind,error_kinds", [
+    ("shape_lie", ("payload_size", "shape")),
+    ("dtype_lie", ("payload_size", "dtype")),
+    ("truncate", ("tail",)),
+    ("wrong_id", ("request_id",)),
+    ("garbage_json", ("malformed",)),
+])
+def test_unary_fault_raises_typed(fault_kind, error_kinds):
+    _, _, inputs = _simple_inputs()
+    srv = ByzantineHttpServer(
+        ServerCore(default_model_zoo()), kinds=(fault_kind,), seed=7)
+    srv.start()
+    try:
+        before = _stats()
+        with httpclient.InferenceServerClient(srv.url) as client:
+            with pytest.raises(IntegrityError) as excinfo:
+                client.infer("simple", inputs, request_id="rq-byz")
+        err = excinfo.value
+        assert err.kind in error_kinds, err
+        # attribution: the frontend stamped its endpoint url on the
+        # violation (parse-time errors are raised url-less by the decoder)
+        assert srv.url.replace("http://", "") in (err.url or srv.url)
+        # the violation is a non-retryable-same-endpoint INVALID fault
+        assert classify_fault(err) == INVALID
+        after = _stats()
+        assert after["violations"] - before["violations"] >= 1
+        delta_kinds = {
+            k: after["violations_by_kind"].get(k, 0)
+            - before["violations_by_kind"].get(k, 0)
+            for k in after["violations_by_kind"]}
+        assert sum(delta_kinds.get(k, 0) for k in error_kinds) >= 1
+    finally:
+        srv.stop()
+
+
+def test_bit_flip_is_contract_undetectable():
+    """A pure payload bit-flip keeps every size and header claim
+    consistent: contract validation DELIVERS it (values wrong). This is
+    the documented detectability boundary that digests/value checks
+    close — the test pins it so a future 'fix' can't silently pretend
+    contract checks catch it."""
+    expected_sum, expected_diff, inputs = _simple_inputs()
+    srv = ByzantineHttpServer(
+        ServerCore(default_model_zoo()), kinds=("bit_flip",), seed=7)
+    srv.start()
+    try:
+        with httpclient.InferenceServerClient(srv.url) as client:
+            result = client.infer("simple", inputs)  # no raise
+            got = np.concatenate([result.as_numpy("OUTPUT0").ravel(),
+                                  result.as_numpy("OUTPUT1").ravel()])
+        want = np.concatenate([expected_sum.ravel(), expected_diff.ravel()])
+        assert not np.array_equal(got, want), \
+            "seeded bit_flip did not corrupt the payload"
+    finally:
+        srv.stop()
+
+
+def test_fault_free_byzantine_plan_is_honest():
+    """limit=0 means the byzantine server IS the honest server: the
+    corruption layer adds nothing when no fault fires (A/A control for
+    every other test in this file)."""
+    expected_sum, _, inputs = _simple_inputs()
+    srv = ByzantineHttpServer(
+        ServerCore(default_model_zoo()), kinds=("shape_lie",), limit=0)
+    srv.start()
+    try:
+        with httpclient.InferenceServerClient(srv.url) as client:
+            result = client.infer("simple", inputs, request_id="rq-aa")
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), expected_sum)
+        assert srv.plan.stats()["corrupted"] == 0
+    finally:
+        srv.stop()
+
+
+# -- stream index checking ----------------------------------------------------
+def test_event_index_accepts_model_and_server_spellings():
+    assert event_index({"INDEX": [3]}) == 3
+    assert event_index({"index": 5}) == 5
+    assert event_index({"sequence_index": "7"}) == 7
+    assert event_index({"NEXT_TOKEN": [1]}) is None
+    assert event_index("not-a-dict") is None
+
+
+def test_stream_checker_monotone_ok_and_faults_raise():
+    checker = StreamChecker(url="u")
+    for i in range(3):
+        checker.observe({"INDEX": [i]})
+    checker.observe({"no_index": True})  # uncounted pass-through
+    assert checker.events == 3
+    with pytest.raises(IntegrityError) as excinfo:
+        checker.observe({"INDEX": [2]})  # duplicate
+    assert excinfo.value.kind == "stream_index"
+
+    gap = StreamChecker(url="u")
+    gap.observe({"INDEX": [0]})
+    with pytest.raises(IntegrityError):
+        gap.observe({"INDEX": [2]})  # skipped 1
+
+
+@pytest.mark.parametrize("fault_kind", ["dup_index", "drop_index"])
+def test_sse_stream_fault_raises_typed(fault_kind):
+    """Live SSE: tiny_lm_generate emits its own INDEX tensor; the
+    byzantine server duplicates or swallows the 3rd event and the
+    opted-in stream checker raises a typed stream_index violation."""
+    srv = ByzantineHttpServer(
+        ServerCore(default_model_zoo()), kinds=(fault_kind,), every=3)
+    srv.start()
+    try:
+        with httpclient.InferenceServerClient(srv.url) as client:
+            client.configure_integrity(
+                IntegrityPolicy(contract=True, stream_index=True))
+            with pytest.raises(IntegrityError) as excinfo:
+                for _ in client.generate_stream(
+                        "tiny_lm_generate",
+                        {"TOKENS": [1, 2, 3], "MAX_TOKENS": 8}):
+                    pass
+        assert excinfo.value.kind == "stream_index"
+    finally:
+        srv.stop()
+
+
+def test_sse_stream_clean_without_fault(honest_url):
+    """The same opted-in checker passes an honest stream untouched."""
+    with httpclient.InferenceServerClient(honest_url) as client:
+        client.configure_integrity(
+            IntegrityPolicy(contract=True, stream_index=True))
+        events = list(client.generate_stream(
+            "tiny_lm_generate", {"TOKENS": [1, 2, 3], "MAX_TOKENS": 6}))
+    assert len(events) >= 2
+    indices = [event_index(e) for e in events]
+    assert indices == list(range(indices[0], indices[0] + len(events)))
+
+
+# -- chaos proxy corrupt fault ------------------------------------------------
+def test_chaos_corrupt_flip_yields_typed_malformed(honest_url):
+    """Mid-path corruption (proxy bit-flips response body bytes while
+    framing stays consistent) surfaces as a typed IntegrityError — the
+    decoder never hands back a garbage view, never leaks struct or
+    UnicodeDecodeError."""
+    port = int(honest_url.rsplit(":", 1)[1].split("/")[0]) \
+        if ":" in honest_url else 8000
+    proxy = ChaosProxy("127.0.0.1", port).start()
+    try:
+        _, _, inputs = _simple_inputs()
+        proxy.fault = Fault("corrupt", corrupt_bytes=24, corrupt_mode="flip",
+                            seed=3)
+        with httpclient.InferenceServerClient(proxy.url) as client:
+            with pytest.raises(IntegrityError) as excinfo:
+                # flipped header bytes: torn JSON / bad sizes, kind varies
+                # by which bytes flip, but it is ALWAYS typed
+                client.infer("simple", inputs)
+        assert excinfo.value.kind in (
+            "malformed", "payload_size", "tail", "output_name",
+            "request_id")
+    finally:
+        proxy.stop()
+
+
+# -- arena digests ------------------------------------------------------------
+def test_lease_digest_catches_post_answer_scribble():
+    arena = ShmArena()
+    try:
+        lease = arena.lease(256)
+        data = np.arange(32, dtype=np.int64)
+        lease.write_numpy(data)
+        lease.seal_digest()
+        # clean read verifies and maps
+        np.testing.assert_array_equal(lease.as_numpy("INT64", [32]), data)
+        # a server scribbling AFTER answering (not via the lease API)
+        lease.memoryview()[8] ^= 0xFF
+        before = _stats()
+        with pytest.raises(IntegrityError) as excinfo:
+            lease.as_numpy("INT64", [32])
+        assert excinfo.value.kind == "digest"
+        after = _stats()
+        assert (after["violations_by_kind"].get("digest", 0)
+                > before["violations_by_kind"].get("digest", 0))
+        lease.release()
+    finally:
+        arena.close(force=True)
+
+
+def test_lease_local_write_drops_the_seal():
+    """The holder mutating its own slab is not corruption: any write*
+    invalidates the seal instead of poisoning every later read."""
+    arena = ShmArena()
+    try:
+        lease = arena.lease(128)
+        data = np.ones(16, dtype=np.int32)
+        lease.write_numpy(data)
+        lease.seal_digest()
+        assert lease.digest() is not None
+        lease.write_numpy(data * 2)
+        assert lease.digest() is None
+        np.testing.assert_array_equal(
+            lease.as_numpy("INT32", [16]), data * 2)
+        lease.release()
+    finally:
+        arena.close(force=True)
+
+
+# -- byzantine quarantine e2e -------------------------------------------------
+@pytest.mark.integrity_smoke
+def test_pool_quarantines_byzantine_replica_zero_corrupt_results():
+    """3 replicas, one lies on every response: the pool serves every
+    request with CORRECT values (failover absorbs each lie), the liar is
+    quarantined after quarantine_after invalid responses inside the
+    window, EndpointQuarantined fires, and the whole story is readable
+    from endpoint_stats/health_summary and the doctor anomaly."""
+    from client_tpu import doctor
+
+    honest = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+              for _ in range(2)]
+    byz = ByzantineHttpServer(
+        ServerCore(default_model_zoo()),
+        kinds=("shape_lie", "truncate", "garbage_json"), seed=0xB12A)
+    byz.start()
+    events = []
+    client = PoolClient(
+        [s.url for s in honest] + [byz.url], protocol="http",
+        routing="round_robin", health_interval_s=None,
+        quarantine_after=3, quarantine_window_s=30.0,
+        rng=SEEDED_RNG(), on_event=events.append,
+    )
+    byz_url = byz.url.replace("http://", "")
+    try:
+        expected_sum, expected_diff, inputs = _simple_inputs()
+        for _ in range(30):
+            result = client.infer("simple", inputs, client_timeout=10.0)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), expected_sum)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT1"), expected_diff)
+
+        stats = client.endpoint_stats()
+        assert stats[byz_url]["quarantined"] is True
+        assert stats[byz_url]["invalid_total"] >= 3
+        assert stats[byz_url]["quarantine_count"] >= 1
+        quarantine_events = [e for e in events
+                             if isinstance(e, EndpointQuarantined)]
+        assert quarantine_events and quarantine_events[0].url == byz_url
+        assert quarantine_events[0].invalid_count >= 3
+
+        summary = client.health_summary()
+        assert summary["quarantined"] >= 1
+
+        # the doctor rule names the byzantine replica from the same stats
+        anomalies = doctor._anomalies(
+            {"endpoints": [], "endpoint_stats": stats},
+            churn_threshold_ops_s=1e9, skew_warn_ms=1e9)
+        byz_flags = [a for a in anomalies
+                     if a.get("flag") == "byzantine_replica"]
+        assert byz_flags and byz_flags[0]["url"] == byz_url
+    finally:
+        client.close()
+        byz.stop()
+        for s in honest:
+            s.stop()
+
+
+def test_quarantine_dominated_pool():
+    """Unit-level: when a majority of endpoints sit in quarantine the
+    pool says so — federation treats such a cell as down rather than
+    routing into a byzantine-majority quorum."""
+    from client_tpu.pool import EndpointPool, EndpointState
+    from client_tpu.resilience import ResiliencePolicy
+
+    eps = [EndpointState(url, client=None,
+                         policy=ResiliencePolicy(breaker=None))
+           for url in ("a:1", "b:1", "c:1")]
+    pool = EndpointPool(eps, quarantine_after=2, quarantine_window_s=30.0)
+    assert pool.quarantine_dominated() is False
+    for url in ("a:1", "b:1"):
+        ep = next(e for e in pool.endpoints if e.url == url)
+        for _ in range(2):
+            pool.record_invalid(ep)
+    assert pool.quarantine_dominated() is True
+
+
+# -- perf --validate ----------------------------------------------------------
+@pytest.fixture(scope="module")
+def perf_url(honest_url):
+    return honest_url.replace("http://", "")
+
+
+def test_perf_validate_closed_loop_row(perf_url):
+    from client_tpu.perf import PerfRunner
+
+    runner = PerfRunner(perf_url, "http", "simple", validate=True)
+    out = runner.run(2, 20)
+    assert out["errors"] == 0, out.get("error_sample")
+    block = out["client_integrity"]
+    assert block["results"] >= 20
+    assert block["checks"] > 0
+    assert block["violations"] == 0
+    assert block["violations_by_kind"] == {}
+    assert block["overhead_ns"]["p50"] is not None
+
+
+def test_perf_validate_open_loop_row(perf_url):
+    from client_tpu.perf import PerfRunner
+
+    runner = PerfRunner(perf_url, "http", "simple", validate=True)
+    out = runner.run_rate(50.0, 25, pool_size=4)
+    assert out["errors"] == 0, out.get("error_sample")
+    assert out["client_integrity"]["results"] >= 25
+    assert out["client_integrity"]["violations"] == 0
+
+
+def test_perf_validate_off_means_no_block(perf_url):
+    from client_tpu.perf import PerfRunner
+
+    out = PerfRunner(perf_url, "http", "simple").run(1, 5)
+    assert "client_integrity" not in out
+
+
+def test_perf_validate_composes_with_coalesce_and_cache(perf_url):
+    """--validate composes: coalesced batches and cached hits still run
+    (or skip) validation coherently — the block reports what was
+    actually checked, and no violations appear on an honest server."""
+    from client_tpu.perf import PerfRunner
+
+    # coalescing needs a batchable model (simple is fixed [1,16])
+    coalesced = PerfRunner(perf_url, "http", "batched_matmul", validate=True,
+                           coalesce=True, batch_window_us=200.0).run(4, 24)
+    assert coalesced["errors"] == 0
+    assert coalesced["client_integrity"]["violations"] == 0
+    assert coalesced["client_integrity"]["results"] > 0
+
+    cached = PerfRunner(perf_url, "http", "simple", validate=True,
+                        cache=True).run(2, 16)
+    assert cached["errors"] == 0
+    assert cached["client_integrity"]["violations"] == 0
+
+
+def test_perf_validate_trace_replay_row(perf_url):
+    from client_tpu import trace as trace_mod
+    from client_tpu.perf import PerfRunner
+
+    tr = trace_mod.generate(
+        "mixed:duration_s=1,rate=20,stream_fraction=0,seq_fraction=0,"
+        "unary_model=simple", seed=5)
+    runner = PerfRunner(perf_url, "http", "simple", validate=True)
+    row = runner.run_trace(tr, speed=4.0, replay_workers=8)
+    assert row["errors"] == 0
+    assert row["client_integrity"]["results"] > 0
+    assert row["client_integrity"]["violations"] == 0
+
+
+# -- committed artifact -------------------------------------------------------
+def test_bench_integrity_artifact_claims():
+    """The committed BENCH_INTEGRITY.json must re-validate under its own
+    --check invariants (zero corrupt results delivered, the byzantine
+    replica named and quarantined, overhead within the A/A noise
+    floor)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    artifact = root / "BENCH_INTEGRITY.json"
+    assert artifact.exists(), "BENCH_INTEGRITY.json not committed"
+    doc = json.loads(artifact.read_text())
+    assert doc["byzantine"]["corrupt_delivered"] == 0
+    assert doc["byzantine"]["caller_errors"] == 0
+    assert doc["byzantine"]["quarantined_urls"]
+    assert doc["overhead"]["within_noise_floor"] is True
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "bench_integrity.py"),
+         "--check", str(artifact)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

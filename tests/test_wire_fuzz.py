@@ -140,3 +140,134 @@ def test_bytes_deserializer_never_crashes(case):
         assert out.dtype == np.object_
     except InferenceServerException:
         pass
+
+
+# -- response-side fuzzing -----------------------------------------------------
+# The request-side properties above pin the ENCODER/DECODER pair; these
+# pin the client's RESPONSE parse path against a byzantine or corrupted
+# server: whatever bytes arrive, the parser either produces a result
+# whose views are structurally sound or raises the TYPED client
+# exception (IntegrityError is a subclass) — never struct.error,
+# UnicodeDecodeError, KeyError, or a garbage-length numpy view.
+
+def _valid_response_body(rng: random.Random):
+    """One valid HTTP infer response: JSON header + binary tail."""
+    import json
+
+    n = rng.randint(1, 8)
+    data = bytes(rng.randbytes(4 * n))
+    header = {
+        "model_name": "m", "id": "rq",
+        "outputs": [{
+            "name": "OUT", "datatype": "INT32", "shape": [1, n],
+            "parameters": {"binary_data_size": 4 * n},
+        }],
+    }
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    return hdr + data, len(hdr)
+
+
+@pytest.mark.parametrize("case", range(200))
+def test_http_response_parser_never_crashes_on_garbage(case):
+    """Pure byte soup (with and without a header-length claim): the
+    response parser raises typed or returns a parsed result."""
+    from client_tpu.http._infer_result import InferResult
+    from client_tpu.utils import InferenceServerException
+
+    rng = random.Random((_SEED << 19) | case)
+    body = rng.randbytes(rng.randint(0, 160))
+    choice = rng.randrange(3)
+    header_length = (None if choice == 0
+                     else rng.randint(0, len(body) + 20) if choice == 1
+                     else len(body))
+    try:
+        InferResult.from_response_body(body, header_length)
+    except InferenceServerException:
+        pass  # the one legal failure mode (IntegrityError included)
+
+
+@pytest.mark.parametrize("case", range(200))
+def test_http_response_parser_mutated_valid_body(case):
+    """Mutations of a VALID response (truncation, over-length claims,
+    header bit-flips, appended junk): parse + as_numpy either succeed
+    with a structurally-sound array or raise typed — a wrong-size view
+    is never handed back."""
+    from client_tpu.http._infer_result import InferResult
+    from client_tpu.utils import InferenceServerException
+
+    rng = random.Random((_SEED << 20) | case)
+    body, json_size = _valid_response_body(rng)
+    mutation = rng.randrange(4)
+    if mutation == 0:    # truncate anywhere
+        body = body[: rng.randint(0, len(body))]
+    elif mutation == 1:  # claim more header than exists
+        json_size = json_size + rng.randint(1, 64)
+    elif mutation == 2:  # flip bytes inside the JSON header
+        buf = bytearray(body)
+        for _ in range(rng.randint(1, 4)):
+            buf[rng.randrange(json_size)] ^= rng.randrange(1, 256)
+        body = bytes(buf)
+    else:                # append junk past the declared tail
+        body = body + rng.randbytes(rng.randint(1, 32))
+    try:
+        result = InferResult.from_response_body(body, min(json_size,
+                                                          len(body)))
+        arr = result.as_numpy("OUT")
+        if arr is not None:
+            # a delivered view must be exactly the claimed span
+            assert arr.dtype == np.int32
+            assert arr.nbytes == 4 * arr.size
+    except InferenceServerException:
+        pass
+
+
+@pytest.mark.parametrize("case", range(150))
+def test_bytes_framing_walk_never_crashes(case):
+    """walk_bytes_framing on arbitrary buffers: returns the element
+    count it walked or raises a typed IntegrityError — the BYTES
+    length-prefix chain is walked to exhaustion, never trusted."""
+    from client_tpu.integrity import IntegrityError, walk_bytes_framing
+
+    rng = random.Random((_SEED << 21) | case)
+    if rng.random() < 0.5:
+        buf = rng.randbytes(rng.randint(0, 80))
+    else:
+        # framing-shaped: a few length-prefixed elements, then corruption
+        parts = []
+        for _ in range(rng.randint(1, 4)):
+            blob = rng.randbytes(rng.randint(0, 12))
+            parts.append(len(blob).to_bytes(4, "little") + blob)
+        buf = b"".join(parts) + rng.randbytes(rng.randint(0, 8))
+    count = rng.randint(0, 8)
+    try:
+        walked = walk_bytes_framing(buf, count, "u", "f")
+        assert isinstance(walked, int)
+    except IntegrityError:
+        pass
+
+
+@pytest.mark.parametrize("case", range(150))
+def test_sse_event_parser_never_crashes(case):
+    """Generate-stream SSE payload soup: parse_sse_event returns a dict
+    or raises the typed client exception — non-UTF-8 and non-object
+    payloads must not leak UnicodeDecodeError/AttributeError."""
+    import json
+
+    from client_tpu.http._utils import parse_sse_event
+    from client_tpu.utils import InferenceServerException
+
+    rng = random.Random((_SEED << 22) | case)
+    choice = rng.randrange(3)
+    if choice == 0:
+        payload = rng.randbytes(rng.randint(0, 60))
+    elif choice == 1:
+        payload = json.dumps(rng.choice(
+            [[1, 2], "str", 7, None, {"INDEX": [rng.randint(-5, 5)]},
+             {"error": "boom"}])).encode()
+    else:
+        payload = b'{"OUT": [' + rng.randbytes(rng.randint(0, 10)) + b"]}"
+    try:
+        event = parse_sse_event(payload)
+        assert isinstance(event, dict)
+    except InferenceServerException:
+        pass

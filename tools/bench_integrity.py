@@ -1,0 +1,299 @@
+"""Generate BENCH_INTEGRITY.json: the end-to-end response-integrity proof.
+
+Two arms over in-process replica servers (the same topology every other
+bench in this repo uses — CPU container numbers, honest about it):
+
+- **overhead**: the A/A cost story for always-on contract validation.
+  Closed-loop perf against one honest replica, three runs: validation
+  OFF twice (their p50 delta IS the measurement noise floor — same
+  binary, same arm, nothing changed) and validation ON once. The claim
+  is that the ON/OFF p50 delta sits within the A/A noise floor — plus
+  the directly-measured per-response validation cost (ns p50/p99 from
+  the ``client_integrity`` row ``perf.py --validate`` appends), which is
+  the honest number the latency delta merely bounds from above.
+- **byzantine**: a 3-replica pool where one replica LIES (seeded
+  deterministic corruption: shape lies, dtype lies, truncations, wrong
+  request ids — ``client_tpu.testing.byzantine``). Every response is
+  value-checked against the known ``simple`` sum/diff contract. The
+  claims: ZERO corrupt results delivered to the caller, ZERO
+  caller-visible errors (failover absorbed every lie), the byzantine
+  replica is NAMED — quarantined by the pool mid-replay (typed
+  ``EndpointQuarantined``) and flagged as a ``byzantine_replica``
+  anomaly by the doctor's rules.
+
+``bit_flip`` is deliberately absent from the byzantine arm's fault mix:
+a same-size payload bit-flip with consistent headers is invisible to
+any client-side structural check (docs/integrity.md "detectability") —
+putting it in would either deliver corrupt values (failing the claim
+for a documented reason) or require value redundancy the wire protocol
+does not carry. The contract layer's claim is every STRUCTURAL lie.
+
+``--check`` re-validates an existing artifact's acceptance invariants
+and exits nonzero on violation (tests/test_integrity.py pins the same
+claims); ``tools/capacity_gate.py --integrity`` re-RUNS the byzantine
+arm live:
+
+    JAX_PLATFORMS=cpu python tools/bench_integrity.py [-o BENCH_INTEGRITY.json]
+    JAX_PLATFORMS=cpu python tools/bench_integrity.py --check BENCH_INTEGRITY.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BYZANTINE_KINDS = ("shape_lie", "dtype_lie", "truncate", "wrong_id",
+                   "garbage_json")
+
+
+def run_overhead_arm(requests: int = 300, concurrency: int = 4):
+    """A/A: validation-off twice (noise floor), validation-on once."""
+    from client_tpu import integrity
+    from client_tpu.models import default_model_zoo
+    from client_tpu.perf import PerfRunner
+    from client_tpu.server import HttpInferenceServer, ServerCore
+
+    srv = HttpInferenceServer(ServerCore(default_model_zoo())).start()
+    policy = integrity.default_policy()
+    rows = {}
+    try:
+        url = srv.url
+        # one discarded warmup run: server-side jit + connection setup
+        # must not land in ANY arm (it would drown the comparison)
+        PerfRunner(url, model_name="simple").run(
+            concurrency=concurrency, measurement_requests=requests // 2)
+        for arm, contract in (("off_a", False), ("off_b", False),
+                              ("on", True)):
+            policy.contract = contract
+            row = PerfRunner(
+                url, model_name="simple", validate=contract,
+            ).run(concurrency=concurrency, measurement_requests=requests)
+            rows[arm] = {
+                "requests": row["requests"],
+                "errors": row["errors"],
+                "latency_ms": row["latency_ms"],
+                "infer_per_sec": row["infer_per_sec"],
+            }
+            if contract:
+                rows[arm]["client_integrity"] = row.get("client_integrity")
+    finally:
+        policy.contract = True  # never leave the process default off
+        srv.stop()
+        srv.close()
+    noise_ms = abs(rows["off_a"]["latency_ms"]["p50"]
+                   - rows["off_b"]["latency_ms"]["p50"])
+    delta_ms = abs(rows["on"]["latency_ms"]["p50"]
+                   - rows["off_a"]["latency_ms"]["p50"])
+    # within-noise criterion: the ON arm's p50 shift must not exceed the
+    # A/A floor by more than the floor itself again (2x) plus a 250 us
+    # absolute guard for CPU-container scheduler jitter — generous, but
+    # the directly-measured ns cost below is the number that matters
+    within = delta_ms <= max(2.0 * noise_ms, 0.25)
+    return {
+        "requests_per_arm": requests,
+        "concurrency": concurrency,
+        "arms": rows,
+        "aa_noise_floor_ms": round(noise_ms, 4),
+        "on_off_delta_ms": round(delta_ms, 4),
+        "within_noise_floor": bool(within),
+        "validation_overhead_ns": (rows["on"].get("client_integrity") or {}
+                                   ).get("overhead_ns"),
+    }
+
+
+def run_byzantine_arm(requests: int = 40, seed: int = 0xB12A,
+                      quarantine_after: int = 3):
+    """The quarantine proof, self-contained so ``capacity_gate.py
+    --integrity`` can re-run it live: two honest replicas plus one
+    byzantine replica in a round-robin pool; every result value-checked
+    against the known sum/diff contract."""
+    from client_tpu import doctor, integrity
+    from client_tpu._tensor import InferInput
+    from client_tpu.models import default_model_zoo
+    from client_tpu.pool import EndpointQuarantined, PoolClient
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing.byzantine import ByzantineHttpServer
+
+    honest = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+              for _ in range(2)]
+    byz = ByzantineHttpServer(
+        ServerCore(default_model_zoo()),
+        kinds=BYZANTINE_KINDS, seed=seed)
+    byz.start()
+    stats_before = integrity.global_stats().snapshot()
+    events = []
+    pool = PoolClient(
+        [s.url for s in honest] + [byz.url], protocol="http",
+        health_interval_s=None, routing="round_robin",
+        quarantine_after=quarantine_after,
+        on_event=events.append)
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    row = {
+        "requests": requests,
+        "replicas": 3,
+        "byzantine_url": byz.url,
+        "fault_kinds": list(BYZANTINE_KINDS),
+        "corrupt_delivered": 0,
+        "caller_errors": 0,
+    }
+    try:
+        for i in range(requests):
+            i0 = InferInput("INPUT0", [1, 16], "INT32")
+            i0.set_data_from_numpy(a)
+            i1 = InferInput("INPUT1", [1, 16], "INT32")
+            i1.set_data_from_numpy(b)
+            try:
+                result = pool.infer("simple", [i0, i1],
+                                    request_id=f"byz-{i}")
+                out0 = result.as_numpy("OUTPUT0")
+                out1 = result.as_numpy("OUTPUT1")
+                if (not np.array_equal(out0, a + b)
+                        or not np.array_equal(out1, a - b)):
+                    row["corrupt_delivered"] += 1
+            except Exception:
+                row["caller_errors"] += 1
+        stats = pool.endpoint_stats()
+        quarantined = [url for url, s in stats.items()
+                       if s.get("quarantined")]
+        row["quarantined_urls"] = quarantined
+        row["byzantine_invalid_total"] = stats.get(
+            byz.url, {}).get("invalid_total", 0)
+        row["quarantine_events"] = sum(
+            1 for e in events if isinstance(e, EndpointQuarantined))
+        summary = pool.health_summary()
+        row["health_summary"] = {
+            k: summary.get(k)
+            for k in ("quarantined", "invalid_total", "quarantine_dominated")}
+        # the doctor's anomaly rules over exactly this pool state: the
+        # byzantine replica must be NAMED, not just counted
+        flags = doctor._anomalies(
+            {"endpoints": [], "endpoint_stats": stats},
+            churn_threshold_ops_s=1e9, skew_warn_ms=1e9)
+        row["doctor_anomalies"] = [
+            f for f in flags if f["flag"] == "byzantine_replica"]
+    finally:
+        pool.close()
+        byz.stop()
+        byz.close()
+        for s in honest:
+            s.stop()
+            s.close()
+    plan_stats = byz.plan.stats()
+    row["faults_injected"] = plan_stats["corrupted"]
+    after = integrity.global_stats().snapshot()
+    row["violations_recorded"] = (after["violations"]
+                                  - stats_before["violations"])
+    return row
+
+
+def byzantine_problems(row) -> list:
+    """The byzantine arm's acceptance invariants (shared by --check and
+    the live capacity_gate --integrity re-run)."""
+    problems = []
+    if row["requests"] <= 0:
+        problems.append("byzantine arm ran no requests")
+    if row.get("faults_injected", 0) <= 0:
+        problems.append("the byzantine replica never actually corrupted "
+                        "a response")
+    if row["corrupt_delivered"] != 0:
+        problems.append(f"{row['corrupt_delivered']} corrupt results "
+                        "were delivered to the caller")
+    if row["caller_errors"] != 0:
+        problems.append(f"{row['caller_errors']} requests surfaced "
+                        "errors instead of failing over to an honest "
+                        "replica")
+    if row.get("byzantine_url") not in (row.get("quarantined_urls") or []):
+        problems.append("the byzantine replica was not quarantined")
+    if row.get("quarantine_events", 0) <= 0:
+        problems.append("no typed EndpointQuarantined event fired")
+    if row.get("violations_recorded", 0) <= 0:
+        problems.append("no integrity violations were recorded")
+    anomalies = row.get("doctor_anomalies") or []
+    if not any(a.get("url") == row.get("byzantine_url")
+               for a in anomalies):
+        problems.append("doctor rules did not name the byzantine "
+                        "replica (byzantine_replica anomaly missing)")
+    return problems
+
+
+def check_doc(data) -> list:
+    failures = []
+    overhead = data["overhead"]
+    if overhead["requests_per_arm"] <= 0:
+        failures.append("overhead arm measured no requests")
+    for arm in ("off_a", "off_b", "on"):
+        arm_row = overhead["arms"].get(arm) or {}
+        if arm_row.get("errors", 1) != 0:
+            failures.append(f"overhead arm {arm} had request errors")
+    if overhead.get("within_noise_floor") is not True:
+        failures.append(
+            f"validation ON p50 delta {overhead.get('on_off_delta_ms')} ms "
+            f"exceeds the A/A noise floor "
+            f"{overhead.get('aa_noise_floor_ms')} ms")
+    ns = overhead.get("validation_overhead_ns") or {}
+    if not ns.get("samples"):
+        failures.append("overhead arm carries no measured per-response "
+                        "validation cost (client_integrity.overhead_ns)")
+    failures.extend(byzantine_problems(data["byzantine"]))
+    return failures
+
+
+def check(path: str) -> int:
+    failures = check_doc(json.loads(Path(path).read_text()))
+    for msg in failures:
+        print(f"CHECK FAILED: {msg}", file=sys.stderr)
+    if not failures:
+        print(f"{path}: all response-integrity acceptance invariants hold")
+    return 1 if failures else 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-o", "--output", default="BENCH_INTEGRITY.json")
+    parser.add_argument("--overhead-requests", type=int, default=300)
+    parser.add_argument("--byzantine-requests", type=int, default=40)
+    parser.add_argument("--check", metavar="ARTIFACT",
+                        help="validate an existing artifact instead of "
+                             "benchmarking")
+    args = parser.parse_args()
+    if args.check:
+        return check(args.check)
+
+    out = {
+        "generated_unix": int(time.time()),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "note": (
+            "end-to-end response integrity (client_tpu.integrity) over "
+            "in-process replica servers on CPU: contract-validation "
+            "overhead vs an A/A noise floor, and the byzantine-replica "
+            "quarantine proof (client_tpu.testing.byzantine) — zero "
+            "corrupt results delivered, the lying replica named by the "
+            "pool's quarantine and the doctor's anomaly rules"),
+    }
+    print("running overhead (A/A) arm ...", flush=True)
+    out["overhead"] = run_overhead_arm(requests=args.overhead_requests)
+    print(json.dumps(out["overhead"], indent=2))
+    print("running byzantine quarantine arm ...", flush=True)
+    out["byzantine"] = run_byzantine_arm(requests=args.byzantine_requests)
+    print(json.dumps(out["byzantine"], indent=2))
+
+    failures = check_doc(out)
+    Path(args.output).write_text(json.dumps(out, indent=2) + "\n")
+    print(f"wrote {args.output}")
+    for msg in failures:
+        print(f"ACCEPTANCE FAILED: {msg}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

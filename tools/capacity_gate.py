@@ -43,6 +43,15 @@ StageFailed naming that stage, dependents must never dispatch, zero
 arena lease bytes may leak, and the same client must recover bit-exact
 after heal.
 
+With ``--integrity`` the gate re-runs the committed byzantine-replica
+quarantine proof live (``BENCH_INTEGRITY.json``,
+tools/bench_integrity.py): a fresh 3-replica pool with one seeded
+lying replica must deliver ZERO corrupt results and ZERO caller
+errors, quarantine the lying replica (typed ``EndpointQuarantined``)
+and have the doctor's rules name it as a ``byzantine_replica``
+anomaly. The overhead (A/A) arm is validated from the committed
+artifact by ``--check``/CI, not re-run here.
+
 With ``--flight`` the gate proves the flight recorder is
 pay-for-what-you-use: the capacity arm replayed recorder-OFF at the
 standard floor must sustain (else INCONCLUSIVE — plain capacity
@@ -487,6 +496,55 @@ def disagg_recheck(baseline: str, attempts: int) -> int:
     return 0
 
 
+def integrity_recheck(baseline: str, attempts: int) -> int:
+    """Re-RUN the committed byzantine-replica quarantine proof live
+    (``BENCH_INTEGRITY.json``, tools/bench_integrity.py): a fresh
+    3-replica pool with one seeded lying replica — zero corrupt results
+    delivered, zero caller errors, the lying replica quarantined and
+    named by the doctor's byzantine_replica rule. Retried ``attempts``
+    times; the overhead (A/A) arm is validated from the committed
+    artifact by ``--check``/CI, not re-run here (the quarantine arm is
+    the robustness claim)."""
+    import tools.bench_integrity as bench
+
+    doc = json.loads(Path(baseline).read_text())
+    problems_committed = bench.check_doc(doc)
+    if problems_committed:
+        print("committed artifact already violates its invariants:")
+        for p in problems_committed:
+            print(f"  - {p}")
+        return 1
+    rows = []
+    for attempt in range(max(1, attempts)):
+        arm = bench.run_byzantine_arm()
+        problems = bench.byzantine_problems(arm)
+        rows.append({
+            "attempt": attempt + 1,
+            "corrupt_delivered": arm["corrupt_delivered"],
+            "caller_errors": arm["caller_errors"],
+            "faults_injected": arm["faults_injected"],
+            "quarantined_urls": arm["quarantined_urls"],
+            "byzantine_url": arm["byzantine_url"],
+            "doctor_named_it": any(
+                a.get("url") == arm["byzantine_url"]
+                for a in arm.get("doctor_anomalies") or []),
+            "problems": problems,
+        })
+        if not problems:
+            break
+    print(json.dumps({"integrity": rows}, indent=2))
+    if rows[-1]["problems"]:
+        print("FAIL: the byzantine-replica quarantine proof no longer "
+              "reproduces:")
+        for p in rows[-1]["problems"]:
+            print(f"  - {p}")
+        return 1
+    print("OK: byzantine quarantine proof reproduces (zero corrupt "
+          f"results over {rows[-1]['faults_injected']} injected faults; "
+          f"{rows[-1]['byzantine_url']} quarantined and named)")
+    return 0
+
+
 def pipeline_recheck(baseline: str, attempts: int) -> int:
     """Re-RUN the committed model-DAG killed-stage proof live
     (``BENCH_PIPELINE.json``, tools/bench_pipeline.py): the chain DAG's
@@ -595,8 +653,17 @@ def main() -> int:
                              "recovery bit-exact after heal")
     parser.add_argument("--pipeline-baseline",
                         default="BENCH_PIPELINE.json")
+    parser.add_argument("--integrity", action="store_true",
+                        help="re-run the committed byzantine-replica "
+                             "quarantine proof live (zero corrupt "
+                             "results, lying replica quarantined and "
+                             "named) instead of the capacity probe")
+    parser.add_argument("--integrity-baseline",
+                        default="BENCH_INTEGRITY.json")
     args = parser.parse_args()
 
+    if args.integrity:
+        return integrity_recheck(args.integrity_baseline, args.attempts)
     if args.pipeline:
         return pipeline_recheck(args.pipeline_baseline, args.attempts)
     if args.disagg:

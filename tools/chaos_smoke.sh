@@ -68,13 +68,20 @@
 # stage, unstarted dependents must never dispatch, zero arena leases
 # may leak, and the same client must recover after heal; the replay
 # half drives v6 pipeline trace records through perf.py --pipeline
-# with per-stage latency columns.
+# with per-stage latency columns. The integrity smoke (tests/
+# test_integrity.py, integrity_smoke marker) runs a 3-replica pool
+# where one replica is a live byzantine server lying on every response
+# (shape/dtype lies, truncated tails, garbage JSON): every request must
+# still return CORRECT values via failover, the liar must be
+# quarantined after N contract-invalid responses (EndpointQuarantined
+# fired, quarantine visible in endpoint_stats/health_summary), and the
+# doctor's byzantine_replica anomaly must name its url.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke or disagg_smoke or pipeline_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke or disagg_smoke or pipeline_smoke or integrity_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
@@ -82,4 +89,5 @@ exec env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_arena.py tests/test_admission.py tests/test_shard.py \
     tests/test_hotkey_cache.py tests/test_flight.py \
     tests/test_federation.py tests/test_tenancy.py \
-    tests/test_disagg.py tests/test_pipeline.py "$@"
+    tests/test_disagg.py tests/test_pipeline.py \
+    tests/test_integrity.py "$@"
