@@ -74,6 +74,20 @@ client libraries (triton-inference-server/client), designed TPU-first:
   fast healthy majority wholesale; exporters, the ``tail_divergence``
   anomaly, and ``doctor --postmortem`` bundles
   (docs/observability.md "Flight recorder & postmortems").
+- ``client_tpu.watch``: continuous monitoring — a background
+  ``Watchtower`` over the live telemetry with three pillars: a
+  crash-safe **black box** (mmap-backed on-disk ring of checksummed
+  records the flight recorder and metrics registry drain into, so
+  ``doctor --blackbox PATH`` reconstructs retained timelines, metric
+  snapshots and alerts after a ``kill -9``; torn tails skipped, never
+  raised); **multi-window burn-rate alerting** (fast/slow dual-window
+  burn over declared SLOs plus watermark rules on breaker/quarantine/
+  shed/arena gauges, typed ``Alert`` edges deduplicated to pluggable
+  sinks); and **seeded deterministic changepoint detection** (CUSUM/
+  Page-Hinkley over the windowed p99/shed streams, each trip attributed
+  via flight ``tail_divergence`` to the endpoint or layer that moved —
+  or named a fleet shift) (docs/observability.md "Continuous monitoring
+  & black box").
 - ``client_tpu.arena``: the pooled shm arena — size-class slab allocator
   over both shared-memory packages with ref-counted leases, LRU watermark
   trimming and per-endpoint cached server registrations; the transparent

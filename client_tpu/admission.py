@@ -582,6 +582,20 @@ class AdmissionController:
             snap["tenancy"] = self.tenancy.snapshot()
         return snap
 
+    def watch_gauges(self) -> Dict[str, Any]:
+        """The watchtower's gauge-source contract: cumulative totals the
+        tower differences per tick into a live shed rate, plus the
+        instantaneous pressure gauges."""
+        limiter = self.limiter.snapshot()
+        with self._lock:
+            return {
+                "admitted_total": self.admitted_total,
+                "shed_total": self.shed_total,
+                "inflight": self._inflight,
+                "limit": limiter["limit"],
+                "collapsed": limiter["limit"] <= limiter["min_limit"],
+            }
+
     # -- internals ------------------------------------------------------------
     def _lane(self, label: str, rank: int) -> _Lane:
         lane = self._lanes.get(label)

@@ -782,6 +782,28 @@ def _anomalies(snap: Dict[str, Any], churn_threshold_ops_s: float,
                                f"{median_lat:.1f} ms, server compute "
                                f"explains {excess_compute_ms:.1f} ms of "
                                f"the {excess_lat_ms:.1f} ms excess")})
+    # continuous-monitoring verdicts: the watchtower's ACTIVE alerts are
+    # incidents in progress, distinct from the point-in-time probe flags
+    # above. A changepoint trip is surfaced with the endpoint/layer the
+    # flight divergence named (or the fleet-shift verdict) so the
+    # snapshot says what moved, not just that something did.
+    watch_sec = snap.get("watch") or {}
+    for alert in watch_sec.get("active", []) or []:
+        kind = alert.get("kind")
+        evidence = alert.get("evidence") or {}
+        if kind == "changepoint":
+            flags.append({
+                "flag": "changepoint", "url": None,
+                "detail": (f"{alert.get('source')}: moved to "
+                           f"{evidence.get('value')} from baseline "
+                           f"{evidence.get('baseline_mean')} — "
+                           f"{evidence.get('moved', 'fleet_shift')}")})
+        else:
+            flags.append({
+                "flag": "alert_firing", "url": None,
+                "detail": (f"{kind}:{alert.get('source')} "
+                           f"severity={alert.get('severity')} since "
+                           f"{alert.get('fired_unix')}")})
     return flags
 
 
@@ -820,6 +842,7 @@ def collect_snapshot(
     pipeline=None,
     pipeline_runs: int = 4,
     integrity: bool = False,
+    watch: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Probe the fleet and return the full snapshot dict (JSON-ready).
 
@@ -1024,6 +1047,13 @@ def collect_snapshot(
         if integrity:
             from . import integrity as _integrity_mod
             snap["integrity"] = _integrity_mod.global_stats().snapshot()
+        # continuous-monitoring section: --watch SECONDS runs a live
+        # fast-tick watchtower over the probe telemetry (burn + watermark
+        # + changepoint rules all armed); without it, a process-global
+        # watchtower (enable_watchtower) is snapshotted if installed
+        watch_section = _watch_status(tel, watch)
+        if watch_section is not None:
+            snap["watch"] = watch_section
         snap["anomalies"] = _anomalies(
             snap, churn_threshold_ops_s, skew_warn_ms)
         return snap
@@ -1033,6 +1063,39 @@ def collect_snapshot(
             fed.close()
         if scoped_recorder:
             observe.install_dataplane(None)
+
+
+def _watch_status(tel: Telemetry, watch_s: Optional[float],
+                  ) -> Optional[Dict[str, Any]]:
+    """The snapshot's ``watch`` section. ``watch_s`` > 0 arms a scoped
+    fast-tick watchtower on the probe telemetry for that long (live
+    mode); otherwise the process-global watchtower is snapshotted if one
+    is installed, and the section is omitted entirely if not."""
+    from . import watch as watch_mod
+
+    if watch_s is not None and watch_s > 0:
+        tower = watch_mod.Watchtower(
+            tel, interval_s=max(float(watch_s) / 20.0, 0.05))
+        try:
+            deadline = time.monotonic() + float(watch_s)
+            while True:
+                tower.tick()
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(tower.interval_s)
+            return tower.snapshot()
+        finally:
+            tower.stop()
+    tower = watch_mod.watchtower()
+    return tower.snapshot() if tower is not None else None
+
+
+# every section the bundle PROMOTES to its top level when the snapshot
+# carries it — the completeness contract tests pin the bundle to: a new
+# snapshot section must be added here (and to the docs) or the
+# completeness test fails, so the bundle can't silently go stale again
+POSTMORTEM_SECTIONS = ("tenancy", "roles", "integrity", "pipeline",
+                       "shard", "cells", "watch")
 
 
 def postmortem_bundle(snapshot: Dict[str, Any],
@@ -1045,13 +1108,32 @@ def postmortem_bundle(snapshot: Dict[str, Any],
     metrics snapshot and the SLO report. One file answers "what was the
     fleet doing, and why were the slow requests slow" without a live
     process to interrogate — write it the moment the incident happens,
-    not after the evidence has aged out of the rings."""
+    not after the evidence has aged out of the rings.
+
+    ``sections`` is the completeness manifest: every key the snapshot
+    carries, verbatim — a reader (or the completeness test) checks it
+    against the snapshot instead of trusting the bundle's age. The
+    :data:`POSTMORTEM_SECTIONS` present in the snapshot (tenancy, roles,
+    integrity, pipeline, shard, cells, watch) are additionally promoted
+    to the bundle's top level for direct access, and a live
+    process-global watchtower contributes its alert state as ``watch``
+    even when the snapshot predates it."""
     bundle: Dict[str, Any] = {
         "kind": "client_tpu_postmortem",
-        "version": 1,
+        "version": 2,
         "generated_unix": int(time.time()),
         "snapshot": snapshot,
+        "sections": sorted(snapshot.keys()),
     }
+    for section in POSTMORTEM_SECTIONS:
+        if section in snapshot:
+            bundle[section] = snapshot[section]
+    if "watch" not in bundle:
+        from . import watch as watch_mod
+
+        tower = watch_mod.watchtower()
+        if tower is not None:
+            bundle["watch"] = tower.snapshot()
     recorder = getattr(telemetry, "flight", None) \
         if telemetry is not None else None
     if recorder is not None:
@@ -1346,6 +1428,30 @@ def render_summary(snap: Dict[str, Any]) -> str:
         for url, n in sorted((integ.get("violations_by_url")
                               or {}).items()):
             lines.append(f"  violating url {url}: {n}")
+    watch_sec = snap.get("watch")
+    if watch_sec:
+        lines.append("")
+        tick = watch_sec.get("tick_ns") or {}
+        lines.append(
+            f"watch: {watch_sec.get('ticks', 0)} ticks, "
+            f"{watch_sec.get('alerts_fired_total', 0)} alerts fired / "
+            f"{watch_sec.get('alerts_resolved_total', 0)} resolved, "
+            f"{watch_sec.get('changepoint_trips', 0)} changepoint trips"
+            + (f"  (tick p50={tick['p50'] / 1e3:.1f}us "
+               f"p99={tick['p99'] / 1e3:.1f}us)" if tick else ""))
+        for alert in watch_sec.get("active", []) or []:
+            ev = alert.get("evidence") or {}
+            moved = ev.get("moved") or ev.get("divergence", {})
+            lines.append(
+                f"  FIRING {alert.get('kind')}:{alert.get('source')} "
+                f"severity={alert.get('severity')}"
+                + (f"  moved={moved}" if moved else ""))
+        for row in (watch_sec.get("recent") or [])[-4:]:
+            if row.get("state") == "resolved":
+                lines.append(
+                    f"  resolved {row.get('kind')}:{row.get('source')} "
+                    f"after "
+                    f"{(row.get('resolved_unix') or 0) - (row.get('fired_unix') or 0):.1f}s")
     anomalies = snap.get("anomalies") or []
     lines.append("")
     if anomalies:
@@ -1355,6 +1461,52 @@ def render_summary(snap: Dict[str, Any]) -> str:
             lines.append(f"  !! {flag['flag']}{where}: {flag['detail']}")
     else:
         lines.append("no anomalies detected")
+    return "\n".join(lines)
+
+
+def _render_blackbox(doc: Dict[str, Any]) -> str:
+    """Human-readable rendering of a :func:`watch.blackbox_report`
+    reconstruction — what the operator reads after the kill -9."""
+    lines = [f"client_tpu blackbox reconstruction — {doc['path']}"]
+    if not doc.get("ok"):
+        lines.append(f"  UNREADABLE: {doc.get('note')}")
+        return "\n".join(lines)
+    scan = doc.get("scan") or {}
+    lines.append(
+        f"  {doc.get('records', 0)} records verified "
+        f"({scan.get('rejected', 0)} rejected by checksum/format) from a "
+        f"{scan.get('capacity_bytes', 0)}B ring")
+    meta = doc.get("meta")
+    if meta:
+        lines.append(f"  writer: pid={meta.get('pid')} "
+                     f"started_unix={meta.get('started_unix')} "
+                     f"interval={meta.get('interval_s')}s")
+    lines.append(
+        f"  flight timelines recovered: {doc.get('timelines_recovered', 0)}"
+        f" (showing last {len(doc.get('timelines') or [])})")
+    for tl in (doc.get("timelines") or [])[-6:]:
+        lines.append(
+            f"    {tl.get('verdict', '?'):<10} {tl.get('model', ''):<16} "
+            f"{tl.get('duration_ms', 0):.1f} ms  "
+            f"dominant={(tl.get('attribution') or {}).get('dominant')}")
+    metrics = doc.get("metrics")
+    lines.append(
+        f"  metrics snapshots recovered: "
+        f"{doc.get('metrics_snapshots_recovered', 0)}"
+        + (f" (last carries {len(metrics)} families)" if metrics else ""))
+    alerts = doc.get("alerts") or []
+    lines.append(f"  alerts recovered: {len(alerts)}")
+    for alert in alerts[-6:]:
+        lines.append(
+            f"    {alert.get('state', '?'):<9} "
+            f"{alert.get('kind')}:{alert.get('source')} "
+            f"severity={alert.get('severity')} "
+            f"fired_unix={alert.get('fired_unix')}")
+    last = doc.get("last_alert")
+    if last:
+        lines.append(
+            f"  last alert: {last.get('kind')}:{last.get('source')} "
+            f"({last.get('state')})")
     return "\n".join(lines)
 
 
@@ -1439,12 +1591,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "flight recorder's full retained timelines; "
                              "arms a flight recorder on the probe "
                              "telemetry)")
+    parser.add_argument("--watch", type=float, default=None,
+                        metavar="SECONDS",
+                        help="live continuous-monitoring mode: arm a "
+                             "fast-tick Watchtower (burn-rate, watermark "
+                             "and changepoint rules) over the probe "
+                             "telemetry for SECONDS, and add the watch "
+                             "section (active alerts, detector states, "
+                             "tick overhead) plus the alert_firing/"
+                             "changepoint anomalies (client_tpu.watch)")
+    parser.add_argument("--blackbox", dest="blackbox_path", default=None,
+                        metavar="PATH",
+                        help="read a crash-safe black-box ring file "
+                             "(client_tpu.watch.BlackBox) instead of "
+                             "probing a fleet: reconstructs the retained "
+                             "flight timelines, the last metrics "
+                             "snapshot and the alert history from the "
+                             "ring alone — works after a kill -9, needs "
+                             "no live process; torn records are skipped, "
+                             "never fatal")
     parser.add_argument("--fail-on-anomaly", action="store_true",
                         help="exit 1 when any anomaly is flagged")
     args = parser.parse_args(argv)
+    if args.blackbox_path:
+        from . import watch as watch_mod
+
+        doc = watch_mod.blackbox_report(args.blackbox_path)
+        print(_render_blackbox(doc))
+        if args.json_path:
+            with open(args.json_path, "w") as f:
+                json.dump(doc, f, indent=2, default=str)
+            print(f"\nblackbox report written to {args.json_path}")
+        return 0 if doc["ok"] else 1
     if not args.urls and not args.cells and not args.roles:
-        parser.error("give replica urls, --cells 'a=u1+u2;b=u3', or "
-                     "--roles 'prefill=u1;decode=u2'")
+        parser.error("give replica urls, --cells 'a=u1+u2;b=u3', "
+                     "--roles 'prefill=u1;decode=u2', or --blackbox PATH")
 
     tel = None
     if args.postmortem_path:
@@ -1461,7 +1642,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         skew_warn_ms=args.skew_warn_ms, probe_timeout_s=args.timeout,
         shard_layout=args.shard_layout, cells=args.cells,
         roles=args.roles, pipeline=args.pipeline,
-        pipeline_runs=args.pipeline_runs, integrity=args.integrity)
+        pipeline_runs=args.pipeline_runs, integrity=args.integrity,
+        watch=args.watch)
     print(render_summary(snap))
     if args.json_path:
         with open(args.json_path, "w") as f:

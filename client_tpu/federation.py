@@ -968,6 +968,26 @@ class _FederatedBase:
             return sum(n for cell in self.cells.values()
                        for n in cell.spill_out.values())
 
+    def watch_gauges(self) -> Dict[str, Any]:
+        """The watchtower's gauge-source contract: how many cells are
+        down (breaker open) and their NAMES, plus whether spillover is
+        carrying traffic right now."""
+        down: List[str] = []
+        spill_active = 0
+        with self._lock:
+            names = list(self.cells)
+            for name, cell in self.cells.items():
+                if cell.breaker is not None and cell.breaker.state == "open":
+                    down.append(name)
+                if cell.spill_active:
+                    spill_active += 1
+        return {
+            "cells": len(names),
+            "cells_down": len(down),
+            "down_cells": sorted(down),
+            "spill_active": spill_active,
+        }
+
     # -- surface plumbing ------------------------------------------------------
     def configure_resilience(self, policy):
         raise InferenceServerException(

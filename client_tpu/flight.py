@@ -72,9 +72,12 @@ __all__ = [
 
 # retained-timeline verdicts, roughly most-severe first. "disrupted" is
 # the stream-specific verdict (the stream reconnected mid-flight but
-# finished); "baseline" is the healthy-contrast reservoir sample.
+# finished); "baseline" is the healthy-contrast reservoir sample; "mark"
+# is an out-of-band marker timeline (the watchtower's ``watch.alert``
+# edges land in the ring this way — requestless, but retained so a
+# postmortem reads alerts interleaved with the requests they explain).
 FLIGHT_VERDICTS = (
-    "error", "shed", "slo_breach", "slow", "disrupted", "baseline")
+    "error", "shed", "slo_breach", "slow", "disrupted", "baseline", "mark")
 
 # The active scratch for the request being processed on this thread/task.
 # contextvars give thread- AND asyncio-task-locality in one mechanism;
@@ -358,6 +361,11 @@ class FlightRecorder:
         self._commit_retained_ns: deque = deque(maxlen=4096)
         self._commit_dropped_ns: deque = deque(maxlen=4096)
         self._telemetry_ref: Optional[Callable[[], Any]] = None
+        # commit tap: called with every RETAINED timeline, outside the
+        # ring lock (the watchtower's black box drains timelines to disk
+        # through this). None = one attribute load + branch per commit.
+        self._commit_tap: Optional[Callable[["FlightTimeline"], None]] \
+            = None
 
     # -- lifecycle (the per-request path) ------------------------------------
     def begin(self, frontend: str, model: str = "",
@@ -460,6 +468,12 @@ class FlightRecorder:
                 self._evicted += 1
             self._ring.append(timeline)
             self._commit_retained_ns.append(time.perf_counter_ns() - t0)
+        tap = self._commit_tap
+        if tap is not None:
+            try:
+                tap(timeline)
+            except Exception:
+                pass  # a sick tap must never fail the request
         return verdict
 
     def commit_stream(self, span, error: Optional[BaseException] = None,
@@ -532,7 +546,46 @@ class FlightRecorder:
                 self._evicted += 1
             self._ring.append(timeline)
             self._commit_retained_ns.append(time.perf_counter_ns() - t0)
+        tap = self._commit_tap
+        if tap is not None:
+            try:
+                tap(timeline)
+            except Exception:
+                pass
         return verdict
+
+    def set_commit_tap(
+            self, tap: Optional[Callable[["FlightTimeline"], None]]) -> None:
+        """Install (or clear, with None) the retained-timeline tap: called
+        with every timeline the verdict keeps, after the ring append and
+        outside the ring lock. With no tap the commit path pays one
+        attribute load + branch (the BENCH_WATCH.json disabled-path
+        claim). Exceptions from the tap are swallowed."""
+        self._commit_tap = tap
+
+    def mark(self, layer: str, event: str, **attrs) -> Optional[str]:
+        """Retain one out-of-band single-event marker timeline (verdict
+        ``mark``) with no request context — the watchtower records its
+        ``watch.alert`` firing/resolved edges here so every alert is
+        attributable next to the request timelines around it. Marks show
+        in :meth:`last_anomalies` (they ARE worth explaining) but never
+        count as tail evidence for :meth:`tail_divergence`."""
+        if not self.enabled:
+            return None
+        now = time.perf_counter_ns()
+        scratch = _Scratch("watch", "", event, self.max_events)
+        scratch.start_ns = now
+        scratch.events.append((now, layer, event, attrs or None))
+        scratch.committed = True
+        with self._lock:
+            timeline = FlightTimeline(
+                self._next_seq(), "mark", scratch, now, None)
+            self._counts["mark"] += 1
+            self._events_committed += 1
+            if len(self._ring) == self._ring.maxlen:
+                self._evicted += 1
+            self._ring.append(timeline)
+        return "mark"
 
     # -- read side -----------------------------------------------------------
     def retained(self, count: Optional[int] = None) -> List[FlightTimeline]:

@@ -430,6 +430,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self._collectors: List[Callable[[], None]] = []
+        # scrape-drain hooks: called with the finished snapshot dict at
+        # the end of every snapshot() — the watchtower's black box drains
+        # metric state to disk through this. Empty list = one branch.
+        self._drains: List[Callable[[Dict[str, Any]], None]] = []
         self.max_series_per_metric = max(0, int(max_series_per_metric))
         self.exemplars = bool(exemplars)
         self._dropped_labelsets: Optional[Counter] = None
@@ -480,6 +484,22 @@ class MetricsRegistry:
     def add_collector(self, fn: Callable[[], None]) -> None:
         with self._lock:
             self._collectors.append(fn)
+
+    def add_drain(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Register a scrape-drain hook: called (outside the registry
+        lock, exceptions swallowed) with the snapshot dict at the end of
+        every :meth:`snapshot`. The watchtower's crash-safe black box
+        subscribes here so metric state survives a ``kill -9``; with no
+        drains registered the cost is one empty-list branch."""
+        with self._lock:
+            self._drains.append(fn)
+
+    def remove_drain(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        with self._lock:
+            try:
+                self._drains.remove(fn)
+            except ValueError:
+                pass
 
     def _run_collectors(self) -> None:
         with self._lock:
@@ -598,7 +618,73 @@ class MetricsRegistry:
                     "help": metric.help,
                     "series": series_out,
                 }
+        if self._drains:
+            for fn in list(self._drains):
+                try:
+                    fn(out)
+                except Exception:
+                    pass  # a sick drain must never break the scrape
         return out
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, Any]) -> "MetricsRegistry":
+        """Rebuild a registry from a :meth:`snapshot` dict so that
+        ``MetricsRegistry.from_snapshot(s).snapshot() == s`` — the
+        offline half of the black-box metrics drain: a postmortem (or
+        ``doctor --blackbox``) reloads the last scraped state into real
+        instruments and queries them as if the process were alive.
+        Histogram bucket edges are recovered from the cumulative bucket
+        rows (``+Inf`` excluded) and the per-bucket counts decumulated;
+        exemplars restore when present. The restored registry has no
+        cardinality cap (it holds exactly the series the snapshot did —
+        a second fold would corrupt the parity contract)."""
+        exemplars = any(
+            "exemplars" in row
+            for doc in snap.values() for row in doc.get("series", ()))
+        reg = cls(max_series_per_metric=0, exemplars=exemplars)
+        for name, doc in snap.items():
+            kind = doc.get("kind", "untyped")
+            help_text = doc.get("help", "")
+            series = doc.get("series", [])
+            labelnames = tuple(series[0]["labels"]) if series else ()
+            if kind == "histogram":
+                if series:
+                    edges = tuple(float(b["le"])
+                                  for b in series[0]["buckets"]
+                                  if b["le"] != "+Inf")
+                else:
+                    edges = DEFAULT_LATENCY_BUCKETS_S
+                metric = reg.histogram(name, help_text, labelnames,
+                                       buckets=edges)
+                for row in series:
+                    s = metric.labels(*(row["labels"][n]
+                                        for n in labelnames))
+                    finite = [b for b in row["buckets"]
+                              if b["le"] != "+Inf"]
+                    counts = []
+                    cum_prev = 0
+                    for b in finite:
+                        counts.append(int(b["count"]) - cum_prev)
+                        cum_prev = int(b["count"])
+                    counts.append(int(row["count"]) - cum_prev)
+                    s.counts = counts
+                    s.sum = float(row["sum"])
+                    s.count = int(row["count"])
+                    for ex in row.get("exemplars", ()):
+                        if s.exemplars is None:
+                            s.exemplars = [None] * (len(edges) + 1)
+                        idx = (len(edges) if ex["le"] == "+Inf"
+                               else list(edges).index(float(ex["le"])))
+                        s.exemplars[idx] = (ex["trace_id"], ex["value"],
+                                            ex["ts"])
+            else:
+                factory = reg.gauge if kind == "gauge" else reg.counter
+                metric = factory(name, help_text, labelnames)
+                for row in series:
+                    s = metric.labels(*(row["labels"][n]
+                                        for n in labelnames))
+                    s.value = float(row["value"])
+        return reg
 
 
 # -- data-plane (shm lifecycle) accounting ------------------------------------
@@ -1364,6 +1450,57 @@ class WindowedSketch:
         idx = bisect_right(self.buckets, float(edge))
         return sum(counts[:idx]) / total
 
+    def merged_recent(self, window_s: float) -> Tuple[List[int], int, float]:
+        """(per-bucket counts, total count, sum) over only the NEWEST
+        sub-windows covering the last ``window_s`` seconds — the fast-
+        window tap behind multi-window burn-rate alerting
+        (``client_tpu.watch``): one sketch answers both the slow (full-
+        window) and fast (recent sub-windows) burn question without a
+        second ingest path. ``window_s`` rounds UP to whole sub-windows
+        (never narrower than asked), clamped to the full window."""
+        with self._lock:
+            self._rotate_locked()
+            k = min(self.subwindows,
+                    max(1, int(-(-float(window_s) // self._sub_s))))
+            counts = [0] * (len(self.buckets) + 1)
+            total = 0
+            total_sum = 0.0
+            period = self._period or 0
+            for i in range(k):
+                slot = (period - i) % self.subwindows
+                for j, n in enumerate(self._counts[slot]):
+                    counts[j] += n
+                total += self._ns[slot]
+                total_sum += self._sums[slot]
+            return counts, total, total_sum
+
+    def quantile_recent(self, q: float, window_s: float) -> float:
+        """:meth:`quantile` over only the last ``window_s`` seconds (the
+        changepoint watchdog's per-tick sample)."""
+        counts, total, _ = self.merged_recent(window_s)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        lower = 0.0
+        for i, edge in enumerate(self.buckets):
+            prev = cum
+            cum += counts[i]
+            if cum >= rank:
+                frac = (rank - prev) / max(counts[i], 1)
+                return lower + (edge - lower) * min(max(frac, 0.0), 1.0)
+            lower = edge
+        return self.buckets[-1]
+
+    def fraction_le_recent(self, edge: float, window_s: float) -> float:
+        """:meth:`fraction_le` over only the last ``window_s`` seconds
+        (the FAST half of a multi-window burn evaluation)."""
+        counts, total, _ = self.merged_recent(window_s)
+        if total == 0:
+            return 1.0
+        idx = bisect_right(self.buckets, float(edge))
+        return sum(counts[:idx]) / total
+
     def snapshot(self) -> Dict[str, Any]:
         """A JSON-pure snapshot (``json.loads(json.dumps(s)) == s``) that
         :meth:`from_snapshot` restores bit-for-bit."""
@@ -1455,8 +1592,16 @@ class SLO:
         if self.bad is not None:
             self.bad.inc()
 
-    def burn_rate(self) -> float:
-        bad_fraction = 1.0 - self.window.fraction_le(self.threshold_ms)
+    def burn_rate(self, window_s: Optional[float] = None) -> float:
+        """Windowed bad fraction over the error budget. ``window_s``
+        restricts the read to the newest sub-windows of the same sketch
+        (the FAST window of multi-window burn alerting — see
+        ``client_tpu.watch``); None reads the full declared window."""
+        if window_s is None:
+            bad_fraction = 1.0 - self.window.fraction_le(self.threshold_ms)
+        else:
+            bad_fraction = 1.0 - self.window.fraction_le_recent(
+                self.threshold_ms, window_s)
         return bad_fraction / (1.0 - self.objective)
 
     def breached(self) -> bool:
@@ -2091,6 +2236,12 @@ class Telemetry:
                         slo.observe_failure()
                     else:
                         slo.observe(total_s * 1e3)
+            # windowed request-latency tap: the same sliding-sketch family
+            # the stream metrics use, keyed ``request_ms`` — the
+            # watchtower's changepoint stream and the fast-window burn
+            # evaluation read it (fold-side: never the per-request path)
+            self._stream_window("request_ms", span.frontend).observe(
+                total_s * 1e3)
 
     # -- stream span lifecycle ----------------------------------------------
     def begin_stream(self, frontend: str, model: str = "",
@@ -2253,6 +2404,13 @@ class Telemetry:
 
     def slos(self) -> List[SLO]:
         return list(self._slos)
+
+    def stream_windows(self) -> Dict[Tuple[str, str], WindowedSketch]:
+        """The live windowed sketches keyed ``(metric, frontend)``,
+        including the ``request_ms`` tap — the watchtower's changepoint
+        detectors sample these per tick."""
+        with self._windows_lock:
+            return dict(self._stream_windows)
 
     def slo_report(self) -> List[Dict[str, Any]]:
         """One :meth:`SLO.report` row per declared SLO, after folding any
@@ -2431,6 +2589,15 @@ class Telemetry:
                 self._admission_collector_installed = True
                 self.registry.add_collector(self._collect_admission)
         return controller
+
+    def pools(self) -> List[Any]:
+        """The live registered pools (dead weakrefs skipped) — the
+        watchtower's breaker/quarantine watermark gauges read their
+        ``watch_gauges()``/health summaries."""
+        with self._pools_lock:
+            refs = list(self._pools)
+        return [pool for pool in (ref() for ref in refs)
+                if pool is not None]
 
     def admission_controllers(self) -> List[Any]:
         """The live attached controllers (dead weakrefs skipped) —

@@ -138,6 +138,7 @@ class PerfRunner:
         roles=None,
         pipeline=None,
         validate: bool = False,
+        watch: bool = False,
     ):
         """``retries``: arm a resilience policy (RetryPolicy with
         ``retries``+1 attempts) on every measurement client — benchmarks
@@ -240,6 +241,12 @@ class PerfRunner:
             pipeline = resolve_pipeline(pipeline)
         self.pipeline = pipeline
         self.validate = validate
+        # --watch: arm a continuous Watchtower (client_tpu.watch) on each
+        # measurement run's telemetry and append a client_watch block
+        # (alerts fired/resolved by kind, tick overhead, changepoint
+        # trips) to every result row
+        self.watch = watch
+        self._watchtower = None
         self.seed = seed
         # sharded scatter-gather (client_tpu.shard): a ShardLayout or a
         # spec string ("IN=0->OUT=0") resolved over --endpoints in order;
@@ -970,7 +977,7 @@ class PerfRunner:
         """A fresh Telemetry per measurement run (sample=always, ring sized
         to hold every request) so each result row's phase breakdown covers
         exactly that run."""
-        if not (self.observe or self.flight):
+        if not (self.observe or self.flight or self.watch):
             return
         from .observe import Telemetry
 
@@ -981,6 +988,41 @@ class PerfRunner:
             trace_capacity=max(measurement_requests, 1024),
             orca_format=self._orca_format,
             flight=self._make_flight())
+        self._arm_watch()
+
+    def _arm_watch(self):
+        """A run-scoped Watchtower over the run's telemetry: background
+        ticks during the measurement window, final synchronous tick and
+        stats harvest in :meth:`_watch_result`."""
+        if not self.watch or self._telemetry is None:
+            return
+        from .watch import Watchtower
+
+        if self._watchtower is not None:
+            self._watchtower.stop()
+        self._watchtower = Watchtower(
+            self._telemetry, interval_s=0.25).start()
+
+    def _watch_result(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        """Append ``client_watch``: the run's continuous-monitoring
+        verdicts (alerts fired/resolved by kind, the active set, tick
+        overhead p50/p99, changepoint trips)."""
+        tower, self._watchtower = self._watchtower, None
+        if tower is None:
+            return result
+        tower.tick()  # short runs still get at least one full evaluation
+        tower.stop()
+        stats = tower.stats()
+        result["client_watch"] = {
+            "ticks": stats["ticks"],
+            "tick_ns": stats.get("tick_ns"),
+            "alerts_fired": stats["alerts_fired"],
+            "alerts_resolved": stats["alerts_resolved"],
+            "alerts_active": stats["alerts_active"],
+            "changepoint_trips": stats["changepoint_trips"],
+            "active": [a.as_dict() for a in tower.active_alerts()],
+        }
+        return result
 
     def _arm_dataplane(self):
         """Scoped shm accounting for shm-mode runs: reuse an already
@@ -1315,7 +1357,7 @@ class PerfRunner:
         lat_sorted = sorted(latencies)
         n = len(lat_sorted)
         issued = n + len(errors) + len(sheds)
-        return self._integrity_result(
+        return self._watch_result(self._integrity_result(
             self._federation_result(self._cache_result(
             self._admission_result(
             self._shm_result(self._batch_result(
@@ -1340,7 +1382,7 @@ class PerfRunner:
             "infer_per_sec": round(n / elapsed, 1) if elapsed > 0 else 0.0,
             "latency_ms": _latency_ms_row(lat_sorted),
         }), batch_stats), shm_rec, shm_before), admission_stats),
-            cache_stats), fed_stats), integrity_before)
+            cache_stats), fed_stats), integrity_before))
 
     def run_rate(self, rate: float, measurement_requests: int,
                  distribution: str = "constant",
@@ -1423,7 +1465,7 @@ class PerfRunner:
         # denominator for every capacity claim (a saturated pool that
         # silently under-offers would otherwise flatter its own number)
         arrival_window = max(issues) if issues else 0.0
-        return self._integrity_result(
+        return self._watch_result(self._integrity_result(
             self._federation_result(self._cache_result(
             self._admission_result(
             self._shm_result(self._batch_result(
@@ -1456,7 +1498,7 @@ class PerfRunner:
             "schedule_lag_ms": _lag_ms_row(lag_sorted),
             "delayed_pct": round(100.0 * delayed / issued, 1) if issued else 0.0,
         }), batch_stats), shm_rec, shm_before), admission_stats),
-            cache_stats), fed_stats), integrity_before)
+            cache_stats), fed_stats), integrity_before))
 
     # -- trace replay --------------------------------------------------------
     _SEQ_GATE_TIMEOUT_S = 60.0
@@ -1560,6 +1602,7 @@ class PerfRunner:
             stream_window_s=window_s,
             orca_format=self._orca_format,
             flight=self._make_flight())
+        self._arm_watch()
         # request_ms SLOs are fed PER TRACE RECORD from the replay's own
         # outcome accounting, NOT from telemetry spans: under coalescing
         # every batch adds an inner-dispatch span and under hedging every
@@ -1689,12 +1732,12 @@ class PerfRunner:
             fed_stats = self._federation_stats(client)
         finally:
             client.close()
-        return self._integrity_result(
+        return self._watch_result(self._integrity_result(
             self._federation_result(self._cache_result(
             self._admission_result(self._trace_result(
                 header, records, speed, elapsed, outcomes, errors, specs,
                 batch_stats, resources, request_slos), admission_stats),
-            cache_stats), fed_stats), integrity_before)
+            cache_stats), fed_stats), integrity_before))
 
     def _make_disagg_client(self):
         """The replay's disaggregated client: a DisaggClient over the
@@ -2289,6 +2332,15 @@ def main(argv: Optional[List[str]] = None) -> int:
              "tools/bench_integrity.py reads exactly this block",
     )
     parser.add_argument(
+        "--watch", action="store_true",
+        help="arm a continuous Watchtower (client_tpu.watch: multi-"
+             "window SLO burn, watermark gauges, changepoint detectors) "
+             "on each measurement run and append a client_watch block "
+             "(alerts fired/resolved by kind, tick overhead p50/p99, "
+             "changepoint trips) to every result row — closed-loop, "
+             "open-loop and trace replay alike",
+    )
+    parser.add_argument(
         "--generate-stream", action="store_true",
         help="measure streamed generations instead of unary infers: each "
              "request drives one generate-extension SSE session to "
@@ -2503,6 +2555,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         roles=args.roles,
         pipeline=args.pipeline,
         validate=args.validate,
+        watch=args.watch,
     )
     try:
         # trace mode does its own per-(kind, model) warmup inside

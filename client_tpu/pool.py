@@ -976,6 +976,32 @@ class EndpointPool:
                     }
         return out
 
+    def watch_gauges(self) -> Dict[str, Any]:
+        """The watchtower's gauge-source contract: flat pressure gauges
+        plus the endpoint NAMES behind them, so a watermark alert can say
+        *which* replica is quarantined, not just how many."""
+        snap = self.snapshot()
+        breaker_open_urls: List[str] = []
+        quarantined_urls: List[str] = []
+        unrouteable = 0
+        for key, stats in snap.items():
+            url = key.partition("#")[0]
+            if stats.get("breaker_state") == "open":
+                breaker_open_urls.append(url)
+            if stats.get("quarantined"):
+                quarantined_urls.append(url)
+            if not (stats["healthy"] and not stats["ejected"]
+                    and stats.get("breaker_state") != "open"):
+                unrouteable += 1
+        return {
+            "endpoints": len(snap),
+            "breakers_open": len(breaker_open_urls),
+            "breaker_open_urls": sorted(set(breaker_open_urls)),
+            "quarantined": len(quarantined_urls),
+            "quarantined_urls": sorted(set(quarantined_urls)),
+            "unrouteable": unrouteable,
+        }
+
 
 # the shared positional-prefix folder lives in _base (the batching
 # dispatcher folds the same prefix); legacy aliases kept for callers
@@ -1481,6 +1507,11 @@ class _PoolClientBase:
                     if load is not None:
                         stats["load"] = load.as_dict()
         return out
+
+    def watch_gauges(self) -> Dict[str, Any]:
+        """The watchtower's gauge-source contract (delegates to the
+        :class:`EndpointPool`, which is what telemetry registers)."""
+        return self.pool.watch_gauges()
 
     def _record_attempt_failure(self, ep: EndpointState,
                                 exc: BaseException) -> str:

@@ -142,7 +142,7 @@ def test_verdict_matrix():
     stats = rec.stats()
     assert stats["retained"] == {
         "error": 1, "shed": 2, "slo_breach": 1, "slow": 0,
-        "disrupted": 0, "baseline": 0}
+        "disrupted": 0, "baseline": 0, "mark": 0}
     assert stats["dropped"] == 1
     assert rec.stats()["retained_fraction"] == 0.8
 
@@ -664,7 +664,7 @@ def test_postmortem_bundle_schema_round_trip():
             requests_per_endpoint=3, probe_timeout_s=10.0)
         bundle = doctor.postmortem_bundle(snap, tel)
     assert bundle["kind"] == "client_tpu_postmortem"
-    assert bundle["version"] == 1
+    assert bundle["version"] == 2
     for key in ("snapshot", "flight", "metrics", "slo_report"):
         assert key in bundle, sorted(bundle)
     # snapshot carries the flight summary section + the fleet state the

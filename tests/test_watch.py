@@ -1,0 +1,742 @@
+"""Continuous monitoring (``client_tpu.watch``): the crash-safe black
+box, multi-window burn-rate alerting, and the changepoint watchdog.
+
+Covers the three pillars plus this PR's satellite audits:
+
+- black-box ring round-trip, wrap, reopen recovery — and the torn-write
+  contract: the reader must return a valid, typed subset of what was
+  written under truncation at EVERY record boundary, mid-record cuts and
+  seeded bit flips, never an exception, never a garbage record;
+- deterministic CUSUM / Page-Hinkley detectors (same stream, same
+  verdicts; trip on a real shift; re-learn after the trip instead of
+  re-alerting a persistent level);
+- fast/slow dual-window burn evaluation with firing/resolved edge
+  semantics, deduplication, watermark hysteresis, and sinks;
+- ``MetricsRegistry.snapshot``/``from_snapshot`` round-trip parity over
+  the full family catalog (federation, tenancy, integrity, shard —
+  every family added since the registry landed);
+- ``doctor.postmortem_bundle`` completeness: the bundle must carry
+  every section the snapshot has (the ``sections`` manifest) so it
+  can't silently go stale again;
+- the ``watch_smoke`` chaos marker: a live 3-replica pool with one
+  latency-faulted replica — the watchdog must fire BEFORE the fault
+  heals, naming the faulted endpoint, and resolve after heal;
+- the committed BENCH_WATCH.json re-validates under its own --check.
+"""
+
+import json
+import os
+import random
+import struct
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu import watch
+from client_tpu.flight import FlightRecorder
+from client_tpu.models import default_model_zoo
+from client_tpu.observe import SLO, MetricsRegistry, Telemetry, WindowedSketch
+from client_tpu.server import HttpInferenceServer, ServerCore
+from client_tpu.testing import ChaosProxy, Fault
+from client_tpu.watch import (
+    Alert,
+    BlackBox,
+    Cusum,
+    JsonlSink,
+    PageHinkley,
+    Watchtower,
+    blackbox_report,
+    read_blackbox,
+)
+
+SEEDED = lambda: random.Random(0xB1AB0)  # noqa: E731
+
+
+# -- black box: round-trip ----------------------------------------------------
+def test_blackbox_roundtrip(tmp_path):
+    path = str(tmp_path / "ring.bbx")
+    bb = BlackBox(path, capacity_bytes=1 << 16)
+    payloads = [{"i": i, "tag": "x" * (i % 37)} for i in range(50)]
+    for p in payloads:
+        assert bb.append("timeline", p)
+    bb.append("alert", {"kind": "slo_burn", "source": "slo:p95"})
+    bb.close()
+    rep = read_blackbox(path)
+    assert rep.ok and rep.note == ""
+    assert len(rep.records) == 51
+    assert [r.seq for r in rep.records] == list(range(1, 52))
+    assert [r.data for r in rep.records[:50]] == payloads
+    assert all(r.kind == "timeline" for r in rep.records[:50])
+    assert rep.last("alert").data["source"] == "slo:p95"
+    assert rep.stats["rejected"] == 0
+
+
+def test_blackbox_wrap_keeps_newest(tmp_path):
+    path = str(tmp_path / "ring.bbx")
+    bb = BlackBox(path, capacity_bytes=4096)
+    for i in range(300):
+        bb.append("metrics", {"i": i, "pad": "y" * 40})
+    stats = bb.stats()
+    bb.close()
+    assert stats["wrapped"] > 0
+    rep = read_blackbox(path)
+    assert rep.ok
+    # the newest record always survives; everything returned is genuine
+    assert rep.records[-1].data["i"] == 299
+    assert all(r.data["pad"] == "y" * 40 for r in rep.records)
+
+
+def test_blackbox_oversize_dropped_not_raised(tmp_path):
+    bb = BlackBox(str(tmp_path / "r.bbx"), capacity_bytes=4096)
+    assert not bb.append("metrics", {"blob": "z" * 10000})
+    assert bb.append("meta", {"ok": 1})
+    assert bb.stats()["dropped_oversize"] == 1
+    bb.close()
+
+
+def test_blackbox_reopen_continues_sequence(tmp_path):
+    path = str(tmp_path / "r.bbx")
+    bb = BlackBox(path, capacity_bytes=1 << 14)
+    for i in range(10):
+        bb.append("timeline", {"i": i})
+    bb.close()
+    bb2 = BlackBox(path)  # recover capacity + seq from the file
+    assert bb2.stats()["next_seq"] == 11
+    bb2.append("meta", {"resumed": True})
+    bb2.close()
+    rep = read_blackbox(path)
+    assert [r.seq for r in rep.records] == list(range(1, 12))
+    assert rep.records[-1].kind == "meta"
+
+
+def test_blackbox_reader_never_raises_on_missing_or_garbage(tmp_path):
+    rep = read_blackbox(str(tmp_path / "nope.bbx"))
+    assert not rep.ok and "unreadable" in rep.note
+    garbage = tmp_path / "garbage.bbx"
+    garbage.write_bytes(b"not a blackbox at all" * 10)
+    rep = read_blackbox(str(garbage))
+    assert not rep.ok and rep.records == []
+    report = blackbox_report(str(garbage))
+    assert report["ok"] is False and "alerts" not in report
+
+
+# -- black box: torn-write recovery (satellite) -------------------------------
+def _written_ring(tmp_path, n=24):
+    """A ring with n records of varied sizes; returns (path, originals)."""
+    path = str(tmp_path / "torn.bbx")
+    bb = BlackBox(path, capacity_bytes=1 << 13)
+    originals = []
+    for i in range(n):
+        data = {"i": i, "pad": "p" * ((i * 7) % 53)}
+        bb.append("timeline", data)
+        originals.append(data)
+    bb.close()
+    return path, originals
+
+
+def _assert_valid_subset(rep, originals):
+    """The torn-write contract: whatever comes back is typed and IS one
+    of the records that were written — a prefix-by-seq subset, never an
+    exception, never garbage."""
+    assert rep.ok  # file header intact in these scenarios
+    seen_seqs = []
+    for rec in rep.records:
+        assert isinstance(rec.kind, str) and rec.kind == "timeline"
+        assert isinstance(rec.data, dict)
+        assert rec.data == originals[rec.seq - 1], rec.seq
+        seen_seqs.append(rec.seq)
+    assert seen_seqs == sorted(seen_seqs)
+
+
+def test_blackbox_truncation_at_every_boundary(tmp_path):
+    path, originals = _written_ring(tmp_path)
+    raw = Path(path).read_bytes()
+    # every 8-aligned offset in the data region is a potential record
+    # boundary; truncating there (and mid-record, at every +8) must
+    # always yield a valid subset
+    for cut in range(64, len(raw) + 1, 8):
+        clipped = tmp_path / "cut.bbx"
+        clipped.write_bytes(raw[:cut])
+        rep = read_blackbox(str(clipped))
+        _assert_valid_subset(rep, originals)
+    # unaligned mid-record cuts too (every record boundary ±3)
+    for cut in range(67, len(raw), 64):
+        clipped = tmp_path / "cut2.bbx"
+        clipped.write_bytes(raw[:cut])
+        _assert_valid_subset(read_blackbox(str(clipped)), originals)
+
+
+def test_blackbox_bitflips_never_yield_garbage(tmp_path):
+    path, originals = _written_ring(tmp_path)
+    raw = bytearray(Path(path).read_bytes())
+    rng = SEEDED()
+    for _ in range(200):
+        pos = rng.randrange(len(raw))
+        bit = 1 << rng.randrange(8)
+        flipped = bytearray(raw)
+        flipped[pos] ^= bit
+        target = tmp_path / "flip.bbx"
+        target.write_bytes(bytes(flipped))
+        rep = read_blackbox(str(target))  # must never raise
+        if not rep.ok:
+            # the flip hit the file header magic — nothing is trusted
+            assert rep.records == []
+            continue
+        for rec in rep.records:
+            # every surviving record is bit-exact one of the originals
+            # (a flip in CRC-covered bytes kills its record; a flip in
+            # padding/reserved bytes leaves the record bit-exact)
+            assert rec.data == originals[rec.seq - 1]
+
+
+def test_blackbox_torn_header_is_skipped(tmp_path):
+    """A record whose header was never completed (payload-first write
+    order's kill -9 window) must be invisible to the reader."""
+    path, originals = _written_ring(tmp_path, n=5)
+    raw = bytearray(Path(path).read_bytes())
+    # corrupt the LAST record's crc field (offset within its header):
+    # find it by scanning valid records, then flip its crc bytes
+    rep = read_blackbox(path)
+    assert len(rep.records) == 5
+    # zero out 16 bytes somewhere in the tail record's region
+    raw[-24:-8] = b"\x00" * 16
+    Path(path).write_bytes(bytes(raw))
+    rep2 = read_blackbox(path)
+    _assert_valid_subset(rep2, originals)
+
+
+# -- detectors ----------------------------------------------------------------
+def test_cusum_deterministic_and_trips_on_shift():
+    rng = SEEDED()
+    xs = [10 + rng.gauss(0, 0.4) for _ in range(40)] \
+        + [24 + rng.gauss(0, 0.4) for _ in range(20)]
+    a, b = Cusum(warmup=16), Cusum(warmup=16)
+    va = [a.update(x) for x in xs]
+    vb = [b.update(x) for x in xs]
+    assert va == vb  # seeded stream -> identical verdicts
+    assert True in va
+    assert va.index(True) >= 40  # never during the baseline
+    assert a.trips == sum(va)
+
+
+def test_cusum_no_trip_on_stationary_noise():
+    rng = SEEDED()
+    det = Cusum(warmup=24)
+    assert not any(det.update(50 + rng.gauss(0, 2.0)) for _ in range(400))
+
+
+def test_cusum_relearns_after_trip_instead_of_realerting():
+    rng = SEEDED()
+    det = Cusum(warmup=12)
+    for _ in range(20):
+        det.update(10 + rng.gauss(0, 0.3))
+    shifted = [25 + rng.gauss(0, 0.3) for _ in range(60)]
+    verdicts = [det.update(x) for x in shifted]
+    assert verdicts.count(True) == 1  # one trip, then the new level is
+    # learned during re-warmup — a persistent shift is not re-alerted
+    assert abs(det.mean - 25) < 2.0
+
+
+def test_page_hinkley_trips_and_resets():
+    rng = SEEDED()
+    det = PageHinkley(delta=0.05, threshold=20.0, min_samples=8)
+    baseline = [5 + rng.gauss(0, 0.2) for _ in range(30)]
+    assert not any(det.update(x) for x in baseline)
+    assert any(det.update(9.0) for _ in range(40))
+    assert det.trips == 1
+    assert det.n < 10  # reset re-entered warmup
+
+
+# -- windowed-sketch recent reads ---------------------------------------------
+def test_windowed_sketch_recent_reads():
+    clock = [0.0]
+    sk = WindowedSketch(window_s=60, subwindows=6, buckets=(10.0, 100.0),
+                        clock=lambda: clock[0])
+    for _ in range(50):
+        sk.observe(5.0)  # old, lands in period 0
+    clock[0] = 55.0  # newest subwindow, 5 periods later
+    for _ in range(10):
+        sk.observe(200.0)
+    counts, total, _ = sk.merged_recent(10.0)
+    assert total == 10  # only the newest subwindow
+    assert sk.fraction_le_recent(10.0, 10.0) == 0.0
+    assert sk.fraction_le_recent(10.0, 60.0) == pytest.approx(50 / 60)
+    assert sk.quantile_recent(0.5, 10.0) >= 100.0  # overflow bucket
+    assert sk.quantile_recent(0.5, 60.0) <= 10.0
+    counts_all, total_all, _ = sk.merged_recent(60.0)
+    assert total_all == 60
+
+
+# -- burn-rate + edge semantics -----------------------------------------------
+class _StubTelemetry:
+    """The minimal surface Watchtower reads; every hook overridable."""
+
+    def __init__(self, slos=(), windows=None, pools=(), ctrls=(),
+                 feds=(), flight=None):
+        self._slos = list(slos)
+        self._windows = dict(windows or {})
+        self._pools = list(pools)
+        self._ctrls = list(ctrls)
+        self._feds = list(feds)
+        self.flight = flight
+        self.registry = MetricsRegistry()
+
+    def _fold_pending(self):
+        pass
+
+    def _fold_stream_pending(self):
+        pass
+
+    def slos(self):
+        return list(self._slos)
+
+    def stream_windows(self):
+        return dict(self._windows)
+
+    def pools(self):
+        return list(self._pools)
+
+    def admission_controllers(self):
+        return [(c, "pool") for c in self._ctrls]
+
+    def federations(self):
+        return [(f, "pool") for f in self._feds]
+
+
+def test_multi_window_burn_fires_only_when_both_windows_burn():
+    clock = [0.0]
+    slo = SLO("req_p95", "request_ms", threshold_ms=50.0, objective=0.95,
+              window_s=60.0, clock=lambda: clock[0])
+    # long healthy history fills the slow window with good events
+    for _ in range(200):
+        slo.observe(5.0)
+    tel = _StubTelemetry(slos=[slo])
+    wt = Watchtower(tel, interval_s=0.01, fast_window_s=10.0,
+                    changepoint=False)
+    assert wt.tick() == []  # healthy: nothing fires
+    # a fresh burst of bad events lands in the NEWEST subwindow: the
+    # fast window burns hard while the slow window still carries the
+    # healthy history
+    clock[0] = 55.0
+    for _ in range(30):
+        slo.observe(500.0)
+    assert slo.burn_rate(10.0) > 6.0
+    edges = wt.tick()
+    assert [e.kind for e in edges] == ["slo_burn"]
+    assert edges[0].state == "firing"
+    assert edges[0].evidence["fast_burn"] > edges[0].evidence["slow_burn"]
+    # deduplication: the same still-burning condition does not re-emit
+    assert wt.tick() == []
+    assert len(wt.active_alerts()) == 1
+    # the fast window ages out -> resolved edge
+    clock[0] = 120.0
+    for _ in range(50):
+        slo.observe(5.0)
+    edges = wt.tick()
+    assert [e.state for e in edges] == ["resolved"]
+    assert wt.active_alerts() == []
+    stats = wt.stats()
+    assert stats["alerts_fired"] == {"slo_burn": 1}
+    assert stats["alerts_resolved"] == {"slo_burn": 1}
+
+
+def test_slow_window_guard_blocks_blip_alerts():
+    """A fast-window spike on an otherwise healthy slow window must NOT
+    page when the slow burn stays under its threshold — the entire point
+    of multi-window burn."""
+    clock = [0.0]
+    slo = SLO("req_p95", "request_ms", threshold_ms=50.0, objective=0.95,
+              window_s=600.0, clock=lambda: clock[0])
+    for _ in range(3000):
+        slo.observe(5.0)
+    clock[0] = 550.0
+    for _ in range(3):  # 3 bad of 3003: slow burn ~0.02x
+        slo.observe(500.0)
+    tel = _StubTelemetry(slos=[slo])
+    wt = Watchtower(tel, interval_s=0.01, fast_window_s=100.0,
+                    changepoint=False)
+    assert slo.burn_rate(100.0) > 6.0  # fast window IS burning
+    assert slo.burn_rate() < 1.0  # slow window is not
+    assert wt.tick() == []
+
+
+class _StubPool:
+    def __init__(self, gauges):
+        self.gauges = gauges
+
+    def watch_gauges(self):
+        return self.gauges
+
+
+def test_watermark_fires_and_resolves_with_names(tmp_path):
+    pool = _StubPool({"breakers_open": 0, "quarantined": 1,
+                      "unrouteable": 1,
+                      "quarantined_urls": ["http://liar:8000"],
+                      "breaker_open_urls": []})
+    sink_path = str(tmp_path / "alerts.jsonl")
+    tel = _StubTelemetry(pools=[pool])
+    wt = Watchtower(tel, interval_s=0.01, changepoint=False,
+                    sinks=(JsonlSink(sink_path),))
+    edges = wt.tick()
+    assert [e.source for e in edges] == ["gauge:pool.quarantined"]
+    assert edges[0].evidence["urls"] == ["http://liar:8000"]
+    assert wt.tick() == []  # dedup while the condition holds
+    pool.gauges = dict(pool.gauges, quarantined=0, quarantined_urls=[])
+    edges = wt.tick()
+    assert [e.state for e in edges] == ["resolved"]
+    lines = [json.loads(line)
+             for line in Path(sink_path).read_text().splitlines()]
+    assert [row["state"] for row in lines] == ["firing", "resolved"]
+
+
+class _StubCtrl:
+    def __init__(self):
+        self.admitted = 0
+        self.shed = 0
+
+    def watch_gauges(self):
+        return {"admitted_total": self.admitted, "shed_total": self.shed,
+                "inflight": 0, "limit": 8, "collapsed": False}
+
+
+def test_shed_rate_watermark_uses_tick_deltas_with_hysteresis():
+    ctrl = _StubCtrl()
+    tel = _StubTelemetry(ctrls=[ctrl])
+    wt = Watchtower(tel, interval_s=0.01, changepoint=False,
+                    shed_rate_watermark=0.5)
+    wt.tick()  # establishes the baseline totals; no rate yet
+    ctrl.admitted, ctrl.shed = 10, 40  # 80% shed this tick
+    edges = wt.tick()
+    assert [e.source for e in edges] == ["gauge:admission.shed_rate"]
+    assert edges[0].evidence["value"] == pytest.approx(0.8)
+    # hysteresis: 0.3 is under the 0.5 threshold but over clear=0.25
+    ctrl.admitted, ctrl.shed = 80, 70
+    assert wt.tick() == []
+    assert len(wt.active_alerts()) == 1
+    ctrl.admitted, ctrl.shed = 180, 71  # ~1% shed: clears
+    edges = wt.tick()
+    assert [e.state for e in edges] == ["resolved"]
+
+
+class _StubFlight:
+    def __init__(self, divergence):
+        self.divergence = divergence
+        self.marks = []
+
+    def tail_divergence(self, *a, **kw):
+        return self.divergence
+
+    def mark(self, layer, event, **attrs):
+        self.marks.append((layer, event, attrs))
+
+
+def test_changepoint_names_moved_endpoint_and_autoresolves():
+    clock = [0.0]
+    sk = WindowedSketch(window_s=60, subwindows=6,
+                        buckets=(1.0, 10.0, 100.0, 1000.0),
+                        clock=lambda: clock[0])
+    flight = _StubFlight({"dominant": "pool:http://bad:1", "tail_count": 12,
+                          "tail_share": 0.9, "baseline_count": 4,
+                          "baseline_share": 0.1})
+    tel = _StubTelemetry(windows={("request_ms", "http"): sk}, flight=flight)
+    wt = Watchtower(tel, interval_s=0.01, fast_window_s=60.0,
+                    cusum_warmup=6, min_stream_count=4)
+    for _ in range(8):  # warm the detector on a healthy p99
+        for _ in range(6):
+            sk.observe(5.0)
+        wt.tick()
+    for _ in range(40):  # the stream moves
+        sk.observe(500.0)
+    edges = []
+    for _ in range(4):
+        edges += wt.tick()
+        if edges:
+            break
+    assert edges, wt.snapshot()["detectors"]
+    [alert] = [e for e in edges if e.kind == "changepoint"]
+    assert alert.evidence["moved"] == "pool:http://bad:1"
+    assert alert.source == "changepoint:request_ms:http:p99"
+    # every alert edge lands a flight mark for attribution
+    assert ("watch", "alert") == flight.marks[-1][:2]
+    assert wt.stats()["changepoint_trips"] >= 1
+    # the trip is an EVENT: it auto-resolves on the next clean tick
+    resolved = wt.tick()
+    assert any(e.state == "resolved" for e in resolved)
+
+
+def test_sick_sink_never_breaks_the_tick():
+    def bad_sink(alert):
+        raise RuntimeError("sink down")
+
+    pool = _StubPool({"breakers_open": 2, "quarantined": 0,
+                      "unrouteable": 2, "quarantined_urls": [],
+                      "breaker_open_urls": ["a", "b"]})
+    wt = Watchtower(_StubTelemetry(pools=[pool]), interval_s=0.01,
+                    changepoint=False, sinks=(bad_sink,))
+    edges = wt.tick()  # must not raise
+    assert [e.source for e in edges] == ["gauge:pool.breakers_open"]
+
+
+def test_watchtower_blackbox_drains_and_stats(tmp_path):
+    path = str(tmp_path / "wt.bbx")
+    rec = FlightRecorder(rng=SEEDED(), baseline_ratio=1.0)
+    tel = Telemetry(sample="always", flight=rec)
+    wt = Watchtower(tel, interval_s=0.01, blackbox=path,
+                    metrics_every_ticks=1)
+    # the commit tap drains retained timelines into the ring
+    scratch = rec.begin("pool", "m")
+    rec.commit(scratch)
+    wt.tick()
+    wt.stop()
+    rep = read_blackbox(path)
+    kinds = {r.kind for r in rep.records}
+    assert {"meta", "timeline", "metrics"} <= kinds
+    doc = blackbox_report(path)
+    assert doc["ok"] and doc["timelines_recovered"] == 1
+    # stop() must disarm the tap and the drain
+    assert rec._commit_tap is None
+    assert tel.registry._drains == []
+
+
+def test_disabled_path_is_inert():
+    """With no watchtower armed the hot paths must see exactly the
+    None-tap / empty-drains fast path."""
+    rec = FlightRecorder(rng=SEEDED(), baseline_ratio=1.0)
+    assert rec._commit_tap is None
+    reg = MetricsRegistry()
+    assert reg._drains == []
+    scratch = rec.begin("pool", "m")
+    assert rec.commit(scratch) == "baseline"  # no tap consulted
+    reg.counter("client_tpu_x_total", "x", ()).labels().inc()
+    reg.snapshot()  # no drains consulted
+    assert watch.watchtower() is None
+
+
+def test_flight_mark_does_not_pollute_tail_divergence():
+    rec = FlightRecorder(rng=SEEDED(), baseline_ratio=0.0)
+    for _ in range(12):
+        rec.mark("watch", "alert", kind="slo_burn")
+    assert rec.stats()["retained"].get("mark") == 12
+    # marks are retained (visible in last_anomalies) but the slow-tail
+    # divergence must ignore them: they are annotations, not requests
+    assert rec.tail_divergence(min_tail=4) is None
+
+
+# -- registry snapshot round-trip parity (satellite) --------------------------
+# the full family catalog: every metric family the client exports today,
+# one representative per (kind, labelset) shape — including everything
+# added since the registry landed (federation, tenancy, integrity, shard)
+_CATALOG = [
+    ("counter", "client_tpu_requests_total", ("frontend", "model")),
+    ("counter", "client_tpu_retries_total", ("frontend", "reason")),
+    ("counter", "client_tpu_federation_spill_total", ("from_cell", "to_cell")),
+    ("counter", "client_tpu_federation_shadow_total", ("cell", "outcome")),
+    ("counter", "client_tpu_tenant_shed_total", ("tenant", "reason")),
+    ("counter", "client_tpu_tenant_admitted_total", ("tenant",)),
+    ("counter", "client_tpu_integrity_checks_total", ("kind",)),
+    ("counter", "client_tpu_integrity_violations_total", ("kind", "url")),
+    ("counter", "client_tpu_shard_requests_total", ("outcome",)),
+    ("counter", "client_tpu_shard_subrequests_total", ("shard", "outcome")),
+    ("counter", "client_tpu_slo_events_total", ("slo", "outcome")),
+    ("gauge", "client_tpu_federation_cell_healthy", ("cell",)),
+    ("gauge", "client_tpu_federation_canary_weight", ("cell",)),
+    ("gauge", "client_tpu_tenant_quota_tokens", ("tenant",)),
+    ("gauge", "client_tpu_admission_limit", ("scope",)),
+    ("gauge", "client_tpu_pool_endpoint_healthy", ("url",)),
+    ("gauge", "client_tpu_slo_burn_rate", ("slo",)),
+    ("histogram", "client_tpu_request_seconds", ("frontend", "model")),
+    ("histogram", "client_tpu_phase_seconds", ("frontend", "phase")),
+    ("histogram", "client_tpu_shard_skew_seconds", ()),
+]
+
+
+@pytest.mark.parametrize("kind,name,labelnames", _CATALOG,
+                         ids=[row[1] for row in _CATALOG])
+def test_registry_snapshot_roundtrip_parity(kind, name, labelnames):
+    """from_snapshot(snapshot()) must reproduce the snapshot byte-for-
+    byte for every family in the catalog — the contract doctor
+    --blackbox relies on to requery crash-recovered metrics."""
+    rng = SEEDED()
+    reg = MetricsRegistry(exemplars=(kind == "histogram"))
+    if kind == "histogram":
+        metric = reg.histogram(name, "help text", labelnames,
+                               buckets=(0.001, 0.01, 0.1, 1.0))
+    else:
+        factory = reg.gauge if kind == "gauge" else reg.counter
+        metric = factory(name, "help text", labelnames)
+    for i in range(3):  # several series per family
+        labels = tuple(f"v{i}_{ln}" for ln in labelnames)
+        series = metric.labels(*labels)
+        if kind == "histogram":
+            for _ in range(17):
+                series.observe(rng.random() * 2.0)
+            with series._lock:  # exemplar on a finite bucket and +Inf
+                series._exemplar(1, f"trace-{i}", 0.005)
+                series._exemplar(len(series.buckets), f"tail-{i}", 5.0)
+        elif kind == "counter":
+            series.inc(rng.randrange(1, 500))
+        else:
+            series.set(rng.random() * 100 - 50)
+        if not labelnames:
+            break  # a label-less family has exactly one series
+    snap = reg.snapshot()
+    restored = MetricsRegistry.from_snapshot(snap)
+    assert restored.snapshot()[name] == snap[name]
+
+
+def test_registry_roundtrip_whole_live_telemetry():
+    """Whole-registry parity on a real Telemetry with SLOs and stream
+    windows armed — not just the catalog's synthetic series."""
+    tel = Telemetry(sample="always")
+    slo = tel.track_slo("req_p95", "request_ms", 50.0, objective=0.95,
+                        window_s=30.0)
+    for v in (5.0, 8.0, 120.0):
+        slo.observe(v)
+    snap = tel.registry.snapshot()
+    restored = MetricsRegistry.from_snapshot(snap)
+    assert restored.snapshot() == snap
+
+
+# -- postmortem completeness (satellite) --------------------------------------
+def test_postmortem_bundle_carries_every_snapshot_section():
+    from client_tpu import doctor
+
+    core = ServerCore(default_model_zoo())
+    with HttpInferenceServer(core) as server:
+        url = f"127.0.0.1:{server.port}"
+        tel = Telemetry(sample="always", flight=True)
+        snap = doctor.collect_snapshot(
+            [url], requests_per_endpoint=2, telemetry=tel,
+            integrity=True, watch=0.2)
+        bundle = doctor.postmortem_bundle(snap, tel)
+    # the completeness manifest: every section the snapshot has,
+    # verbatim — so the bundle can never silently go stale again
+    assert bundle["sections"] == sorted(snap.keys())
+    assert bundle["version"] >= 2
+    # every declared promotable section present in the snapshot is
+    # promoted to the bundle's top level
+    for section in doctor.POSTMORTEM_SECTIONS:
+        if section in snap:
+            assert bundle[section] == snap[section], section
+    # the sections this PR folds in are actually exercised here
+    assert "integrity" in bundle
+    assert "watch" in bundle and bundle["watch"]["ticks"] > 0
+    assert bundle["flight"]["timelines"] is not None
+    assert bundle["metrics"]
+    json.dumps(bundle, default=str)  # JSON-pure end to end
+
+
+# -- live chaos smoke ---------------------------------------------------------
+@pytest.mark.watch_smoke
+def test_watch_smoke_names_faulted_replica_before_heal(tmp_path):
+    """3-replica pool, one replica behind a latency proxy, a live
+    fast-tick Watchtower over the pool's telemetry: an alert must fire
+    BEFORE the fault heals, its evidence must name the faulted endpoint
+    (flight tail divergence), and the conditions must resolve after
+    heal. The same edges must be recoverable from the black-box ring."""
+    from client_tpu.pool import PoolClient
+
+    core = ServerCore(default_model_zoo())
+    servers = [HttpInferenceServer(core).start() for _ in range(3)]
+    proxy = ChaosProxy("127.0.0.1", servers[0].port).start()
+    faulted_url = f"127.0.0.1:{proxy.port}"
+    urls = [faulted_url] + [f"127.0.0.1:{s.port}" for s in servers[1:]]
+    # small ring + short threshold window: the rolling slow threshold
+    # re-learns the post-fault mix at its next refresh and the ring then
+    # rotates to faulted-only tail entries within a few hundred requests
+    rec = FlightRecorder(rng=SEEDED(), capacity=48, slow_quantile=0.8,
+                         threshold_window=96, threshold_min_samples=48,
+                         baseline_ratio=0.05)
+    tel = Telemetry(sample="always", flight=rec)
+    tel.track_slo("req_p95", "request_ms", 50.0, objective=0.95,
+                  window_s=12.0)
+    ring = str(tmp_path / "smoke.bbx")
+    wt = Watchtower(tel, interval_s=0.2, blackbox=ring,
+                    fast_window_s=4.0, cusum_warmup=6, min_stream_count=4)
+    pool = PoolClient(urls, protocol="http", telemetry=tel,
+                      routing="round_robin", health_interval_s=None)
+
+    def _traffic(n):
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        for i in range(n):
+            in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+            in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+            in0.set_data_from_numpy(a)
+            in1.set_data_from_numpy(b)
+            pool.infer("simple", [in0, in1])
+            if i % 8 == 7:
+                wt.tick()
+
+    try:
+        _traffic(96)  # healthy baseline: detectors warm, no alerts
+        assert wt.stats()["alerts_fired_total"] == 0, wt.history()
+        proxy.fault = Fault("latency", latency_s=0.05)
+        proxy.reset_active()  # pooled conns re-dial into the fault
+        fault_t0 = time.monotonic()
+        named = None
+        for _ in range(16):  # up to ~512 post-fault requests
+            _traffic(32)
+            # history rows carry fire-time evidence; ACTIVE alerts keep
+            # refreshing theirs each tick as the slow tail accumulates
+            candidates = [a.as_dict() for a in wt.active_alerts()] \
+                + list(wt.history())
+            for alert in candidates:
+                if alert["state"] != "firing":
+                    continue
+                ev = alert.get("evidence") or {}
+                div = ev.get("divergence") or {}
+                moved = ev.get("moved") or div.get("dominant") or ""
+                if faulted_url in str(moved):
+                    named = alert
+                    break
+            if named:
+                break
+        detect_s = time.monotonic() - fault_t0
+        proxy.heal()  # the fault outlived detection by construction
+        proxy.reset_active()  # pooled conns re-dial into the healed path
+        assert named is not None, wt.history()
+        assert named["kind"] in ("slo_burn", "changepoint")
+        # after heal: traffic recovers and every condition resolves
+        deadline = time.monotonic() + 20.0
+        while wt.active_alerts() and time.monotonic() < deadline:
+            _traffic(16)
+            time.sleep(0.2)
+        assert wt.active_alerts() == [], [
+            a.as_dict() for a in wt.active_alerts()]
+        assert detect_s < 60.0
+    finally:
+        pool.close()
+        wt.stop()
+        proxy.stop()
+        for s in servers:
+            s.stop()
+    # the alert edges survived in the crash-safe ring
+    rep = read_blackbox(ring)
+    recovered = [r.data for r in rep.records if r.kind == "alert"]
+    assert any(r["state"] == "firing" for r in recovered)
+    assert any(r["state"] == "resolved" for r in recovered)
+
+
+# -- bench artifact claims ----------------------------------------------------
+def test_bench_watch_artifact_claims():
+    """The committed BENCH_WATCH.json must re-validate under its own
+    --check invariants (disabled path ~ns, enabled tick quantified,
+    chaos arms detect in time and name the fault, A/A soak fires zero
+    alerts, kill-9 reconstruction recovers timelines + the last
+    alert)."""
+    root = Path(__file__).resolve().parent.parent
+    artifact = root / "BENCH_WATCH.json"
+    assert artifact.exists(), "BENCH_WATCH.json not committed"
+    proc = subprocess.run(
+        [sys.executable, str(root / "tools" / "bench_watch.py"),
+         "--check", str(artifact)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr

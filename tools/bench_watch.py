@@ -1,0 +1,621 @@
+"""Generate BENCH_WATCH.json: the continuous-monitoring overhead and
+time-to-detect proof.
+
+Seven measurements back the watchtower's claims:
+
+1. **Disabled path** — a process with no watchtower armed pays exactly
+   one attribute-read branch on the flight commit path (``_commit_tap
+   is None``) and one on the metrics scrape path (``if self._drains``).
+   Both are timed in chunks; the committed medians are the
+   ~nanoseconds-when-off claim.
+
+2. **Enabled tick cost** — a populated telemetry (SLOs, stream windows,
+   live registry) under a real :class:`~client_tpu.watch.Watchtower`:
+   the full tick (fold + burn + gauges + changepoints + blackbox drain)
+   timed over hundreds of ticks.
+
+3. **Chaos: latency** — 3 replicas, one behind a 50 ms latency proxy
+   armed mid-run: time-to-detect until an alert NAMES the faulted
+   endpoint (via the flight tail divergence), detection strictly inside
+   the fault window.
+
+4. **Chaos: byzantine** — 2 honest replicas + 1 live byzantine server
+   lying on every response: the quarantine watermark must fire and name
+   the liar's url.
+
+5. **Chaos: cell blackhole** — a 2-cell federation whose home cell goes
+   dark mid-run: the ``cells_down`` watermark must fire and name the
+   cell.
+
+6. **A/A soak** — the same 3-replica topology with NO fault: the
+   watchtower must fire ZERO alerts over the whole soak (the
+   false-positive bar for the seeded detectors and burn thresholds).
+
+7. **kill -9 reconstruction** — a child process serving live traffic
+   with the black box armed is SIGKILLed mid-run (after an alert
+   fired); ``doctor --blackbox`` must reconstruct timelines, metric
+   snapshots and the last alert from the ring file alone.
+
+``--check`` re-validates the committed artifact (CI'd by
+``tests/test_watch.py::test_bench_watch_artifact_claims``);
+``tools/capacity_gate.py --watch`` re-runs the A/A and detection arms
+live.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_watch.py [-o BENCH_WATCH.json]
+    JAX_PLATFORMS=cpu python tools/bench_watch.py --check [BENCH_WATCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BRANCH_OPS = 200_000
+TICKS = 400
+CHAOS_LATENCY_S = 0.05
+FAULT_BUDGET_S = 90.0
+AA_REQUESTS = 480
+KILL9_TIMEOUT_S = 60.0
+
+
+def _percentiles(samples_ns: List[float]) -> Dict[str, float]:
+    from client_tpu.utils import sorted_percentile
+
+    s = sorted(samples_ns)
+    return {
+        "p50": round(sorted_percentile(s, 0.5), 1),
+        "p90": round(sorted_percentile(s, 0.9), 1),
+        "p99": round(sorted_percentile(s, 0.99), 1),
+    }
+
+
+def _simple_inputs():
+    import numpy as np
+
+    import client_tpu.http as httpclient
+
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    in0.set_data_from_numpy(a)
+    in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    in1.set_data_from_numpy(b)
+    return [in0, in1]
+
+
+def bench_disabled() -> Dict[str, Any]:
+    """The two branches every hot path pays when NO watchtower is armed:
+    the flight commit tap check and the registry drains check."""
+    from client_tpu.flight import FlightRecorder
+    from client_tpu.observe import MetricsRegistry
+
+    rec = FlightRecorder(capacity=8)
+    reg = MetricsRegistry()
+    assert rec._commit_tap is None and reg._drains == []
+    chunk = 1000
+    chunks: List[float] = []
+    for _ in range(BRANCH_OPS // chunk):
+        t0 = time.perf_counter_ns()
+        for _ in range(chunk):
+            if rec._commit_tap is not None:  # the flight-commit branch
+                raise AssertionError
+            if reg._drains:  # the metrics-scrape branch
+                raise AssertionError
+        chunks.append((time.perf_counter_ns() - t0) / chunk)
+    return {
+        "ops": BRANCH_OPS,
+        "branch_ns": _percentiles(chunks),
+        "note": "both disabled-path branches together (commit tap is "
+                "None + drains list empty), per-op over 1k-op chunks",
+    }
+
+
+def bench_tick() -> Dict[str, Any]:
+    """Full tick cost over a POPULATED telemetry: SLOs with traffic in
+    their windows, stream windows feeding changepoint detectors, and a
+    black-box ring draining periodic metric snapshots."""
+    import random
+
+    from client_tpu.flight import FlightRecorder
+    from client_tpu.observe import Telemetry
+    from client_tpu.watch import Watchtower
+
+    rng = random.Random(0xBE9C)
+    rec = FlightRecorder(rng=random.Random(1), baseline_ratio=0.1)
+    tel = Telemetry(sample="off", flight=rec)
+    slo_fast = tel.track_slo("req_p95", "request_ms", 50.0,
+                             objective=0.95, window_s=60.0)
+    slo_ttft = tel.track_slo("ttft_p99", "ttft_ms", 200.0,
+                             objective=0.99, window_s=60.0)
+    with tempfile.TemporaryDirectory() as tmp:
+        wt = Watchtower(tel, interval_s=0.05,
+                        blackbox=os.path.join(tmp, "tick.bbx"),
+                        metrics_every_ticks=10)
+        try:
+            for _ in range(TICKS):
+                for _ in range(8):  # fresh samples between ticks
+                    slo_fast.observe(abs(rng.gauss(8.0, 3.0)))
+                    slo_ttft.observe(abs(rng.gauss(40.0, 10.0)))
+                wt.tick()
+            stats = wt.stats()
+        finally:
+            wt.stop()
+    return {
+        "ticks": stats["ticks"],
+        "tick_ns": stats["tick_ns"],
+        "alerts_fired_total": stats["alerts_fired_total"],
+        "blackbox": stats["blackbox"],
+    }
+
+
+def _drive(pool, wt, n: int, tick_every: int = 8) -> None:
+    for i in range(n):
+        pool.infer("simple", _simple_inputs())
+        if i % tick_every == tick_every - 1:
+            wt.tick()
+
+
+def _first_named(wt, needle: str) -> Optional[Dict[str, Any]]:
+    """The first firing alert whose evidence names ``needle`` (active
+    alerts refresh their evidence every tick; history keeps edges)."""
+    candidates = [a.as_dict() for a in wt.active_alerts()]
+    candidates += list(wt.history())
+    for alert in candidates:
+        if alert["state"] != "firing":
+            continue
+        ev = alert.get("evidence") or {}
+        div = ev.get("divergence") or {}
+        named = " ".join(str(x) for x in (
+            ev.get("moved"), div.get("dominant"),
+            ev.get("urls"), ev.get("cells")))
+        if needle in named:
+            return alert
+    return None
+
+
+def bench_chaos_latency() -> Dict[str, Any]:
+    """Time-to-detect a latency-faulted replica, by name."""
+    import random
+
+    from client_tpu.flight import FlightRecorder
+    from client_tpu.models import default_model_zoo
+    from client_tpu.observe import Telemetry
+    from client_tpu.pool import PoolClient
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing import ChaosProxy, Fault
+    from client_tpu.watch import Watchtower
+
+    core = ServerCore(default_model_zoo())
+    servers = [HttpInferenceServer(core).start() for _ in range(3)]
+    proxy = ChaosProxy("127.0.0.1", servers[0].port).start()
+    faulted_url = f"127.0.0.1:{proxy.port}"
+    urls = [faulted_url] + [f"127.0.0.1:{s.port}" for s in servers[1:]]
+    rec = FlightRecorder(rng=random.Random(1), capacity=48,
+                         slow_quantile=0.8, threshold_window=96,
+                         threshold_min_samples=48, baseline_ratio=0.05)
+    tel = Telemetry(sample="always", flight=rec)
+    tel.track_slo("req_p95", "request_ms", 50.0, objective=0.95,
+                  window_s=12.0)
+    wt = Watchtower(tel, interval_s=0.2, fast_window_s=4.0,
+                    cusum_warmup=6, min_stream_count=4)
+    pool = PoolClient(urls, protocol="http", telemetry=tel,
+                      routing="round_robin", health_interval_s=None)
+    named = None
+    detected_s = None
+    try:
+        _drive(pool, wt, 96)  # healthy baseline
+        baseline_fired = wt.stats()["alerts_fired_total"]
+        proxy.fault = Fault("latency", latency_s=CHAOS_LATENCY_S)
+        proxy.reset_active()
+        fault_t0 = time.monotonic()
+        while time.monotonic() - fault_t0 < FAULT_BUDGET_S:
+            _drive(pool, wt, 32)
+            named = _first_named(wt, faulted_url)
+            if named:
+                detected_s = time.monotonic() - fault_t0
+                break
+        fault_duration_s = time.monotonic() - fault_t0
+        proxy.heal()
+    finally:
+        pool.close()
+        wt.stop()
+        proxy.stop()
+        for s in servers:
+            s.stop()
+    return {
+        "chaos_latency_ms": CHAOS_LATENCY_S * 1e3,
+        "faulted_url": faulted_url,
+        "baseline_alerts": baseline_fired,
+        "detected": named is not None,
+        "detect_s": round(detected_s, 3) if detected_s else None,
+        "fault_duration_s": round(fault_duration_s, 3),
+        "fault_budget_s": FAULT_BUDGET_S,
+        "alert_kind": named["kind"] if named else None,
+        "alert_source": named["source"] if named else None,
+    }
+
+
+def bench_chaos_byzantine() -> Dict[str, Any]:
+    """Time-to-detect a byzantine replica: the quarantine watermark must
+    fire and name the liar's url."""
+    from client_tpu.models import default_model_zoo
+    from client_tpu.observe import Telemetry
+    from client_tpu.pool import PoolClient
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing import ByzantineHttpServer
+    from client_tpu.watch import Watchtower
+
+    honest = [HttpInferenceServer(ServerCore(default_model_zoo())).start()
+              for _ in range(2)]
+    byz = ByzantineHttpServer(
+        ServerCore(default_model_zoo()),
+        kinds=("shape_lie", "truncate", "garbage_json"), seed=0xB12A)
+    byz.start()
+    byz_url = byz.url.replace("http://", "")
+    tel = Telemetry(sample="off")
+    wt = Watchtower(tel, interval_s=0.1, changepoint=False)
+    pool = PoolClient(
+        [s.url for s in honest] + [byz.url], protocol="http",
+        routing="round_robin", health_interval_s=None, telemetry=tel,
+        quarantine_after=3, quarantine_window_s=30.0)
+    named = None
+    detected_s = None
+    t0 = time.monotonic()
+    try:
+        while time.monotonic() - t0 < FAULT_BUDGET_S:
+            _drive(pool, wt, 16, tick_every=4)
+            named = _first_named(wt, byz_url)
+            if named:
+                detected_s = time.monotonic() - t0
+                break
+        duration_s = time.monotonic() - t0
+    finally:
+        pool.close()
+        wt.stop()
+        byz.stop()
+        for s in honest:
+            s.stop()
+    return {
+        "byzantine_url": byz_url,
+        "detected": named is not None,
+        "detect_s": round(detected_s, 3) if detected_s else None,
+        "fault_duration_s": round(duration_s, 3),
+        "alert_kind": named["kind"] if named else None,
+        "alert_source": named["source"] if named else None,
+    }
+
+
+def bench_chaos_blackhole() -> Dict[str, Any]:
+    """Time-to-detect a blackholed home cell: the cells_down watermark
+    must fire and name the cell."""
+    from client_tpu.federation import FederatedClient
+    from client_tpu.models import default_model_zoo
+    from client_tpu.observe import Telemetry
+    from client_tpu.resilience import CircuitBreaker
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.testing import ChaosCell, ChaosProxy
+    from client_tpu.watch import Watchtower
+
+    cores = [ServerCore(default_model_zoo()) for _ in range(2)]
+    servers = [HttpInferenceServer(c).start() for c in cores]
+    proxies = [ChaosProxy("127.0.0.1", s.port).start() for s in servers]
+    cell_a = ChaosCell([proxies[0]])
+    tel = Telemetry(sample="off")
+    wt = Watchtower(tel, interval_s=0.1, changepoint=False)
+    fed = FederatedClient(
+        {"a": [proxies[0].url], "b": [proxies[1].url]}, home="a",
+        protocol="http", telemetry=tel,
+        cell_breaker_factory=lambda: CircuitBreaker(
+            min_calls=2, recovery_time_s=30.0),
+        default_deadline_s=8.0, per_attempt_timeout_s=0.5,
+        pool_kwargs={"health_interval_s": None})
+    named = None
+    detected_s = None
+    try:
+        for _ in range(10):  # healthy warm-up through the home cell
+            fed.infer("simple", _simple_inputs(), client_timeout=8.0)
+        wt.tick()
+        baseline_fired = wt.stats()["alerts_fired_total"]
+        cell_a.blackhole()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < FAULT_BUDGET_S:
+            for _ in range(4):
+                fed.infer("simple", _simple_inputs(), client_timeout=8.0)
+                wt.tick()
+            named = _first_named(wt, "a")
+            if named:
+                detected_s = time.monotonic() - t0
+                break
+        duration_s = time.monotonic() - t0
+        cell_a.heal(reset_active=True)
+    finally:
+        fed.close()
+        wt.stop()
+        for p in proxies:
+            p.stop()
+        for s in servers:
+            s.stop()
+    return {
+        "blackholed_cell": "a",
+        "baseline_alerts": baseline_fired,
+        "detected": named is not None,
+        "detect_s": round(detected_s, 3) if detected_s else None,
+        "fault_duration_s": round(duration_s, 3),
+        "alert_kind": named["kind"] if named else None,
+        "alert_source": named["source"] if named else None,
+    }
+
+
+def bench_aa_soak() -> Dict[str, Any]:
+    """A/A: the latency-arm topology with NO fault — the watchtower must
+    fire zero alerts across the whole soak."""
+    import random
+
+    from client_tpu.flight import FlightRecorder
+    from client_tpu.models import default_model_zoo
+    from client_tpu.observe import Telemetry
+    from client_tpu.pool import PoolClient
+    from client_tpu.server import HttpInferenceServer, ServerCore
+    from client_tpu.watch import Watchtower
+
+    core = ServerCore(default_model_zoo())
+    servers = [HttpInferenceServer(core).start() for _ in range(3)]
+    urls = [f"127.0.0.1:{s.port}" for s in servers]
+    rec = FlightRecorder(rng=random.Random(1), capacity=48,
+                         slow_quantile=0.8, threshold_window=96,
+                         threshold_min_samples=48, baseline_ratio=0.05)
+    tel = Telemetry(sample="always", flight=rec)
+    tel.track_slo("req_p95", "request_ms", 50.0, objective=0.95,
+                  window_s=12.0)
+    pool = PoolClient(urls, protocol="http", telemetry=tel,
+                      routing="round_robin", health_interval_s=None)
+    try:
+        for _ in range(32):  # jit/connection warm-up outside the watch
+            pool.infer("simple", _simple_inputs())
+        wt = Watchtower(tel, interval_s=0.2, fast_window_s=4.0,
+                        cusum_warmup=6, min_stream_count=4)
+        t0 = time.monotonic()
+        _drive(pool, wt, AA_REQUESTS)
+        elapsed = time.monotonic() - t0
+        stats = wt.stats()
+        wt.stop()
+    finally:
+        pool.close()
+        for s in servers:
+            s.stop()
+    return {
+        "requests": AA_REQUESTS,
+        "elapsed_s": round(elapsed, 3),
+        "ticks": stats["ticks"],
+        "alerts_fired_total": stats["alerts_fired_total"],
+        "changepoint_trips": stats["changepoint_trips"],
+    }
+
+
+_KILL9_CHILD = r"""
+import os, random, sys
+sys.path.insert(0, {root!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import client_tpu.http as httpclient
+from client_tpu.flight import FlightRecorder
+from client_tpu.models import default_model_zoo
+from client_tpu.observe import Telemetry
+from client_tpu.pool import PoolClient
+from client_tpu.server import HttpInferenceServer, ServerCore
+from client_tpu.watch import Watchtower
+
+ring = sys.argv[1]
+core = ServerCore(default_model_zoo())
+server = HttpInferenceServer(core).start()
+rec = FlightRecorder(rng=random.Random(1), baseline_ratio=1.0)
+tel = Telemetry(sample="always", flight=rec)
+# an impossible objective so the burn alert fires quickly and the ring
+# provably carries an alert record before the parent pulls the plug
+tel.track_slo("req_p99", "request_ms", 0.01, objective=0.9, window_s=8.0)
+wt = Watchtower(tel, interval_s=0.05, blackbox=ring,
+                metrics_every_ticks=2, changepoint=False)
+pool = PoolClient(["127.0.0.1:" + str(server.port)],
+                  protocol="http", telemetry=tel, routing="round_robin",
+                  health_interval_s=None)
+a = np.arange(16, dtype=np.int32).reshape(1, 16)
+b = np.ones((1, 16), dtype=np.int32)
+i = 0
+while True:  # runs until SIGKILL — no clean shutdown, ever
+    in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    in0.set_data_from_numpy(a)
+    in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    in1.set_data_from_numpy(b)
+    pool.infer("simple", [in0, in1])
+    i += 1
+    if i % 4 == 0:
+        wt.tick()
+"""
+
+
+def bench_kill9() -> Dict[str, Any]:
+    """SIGKILL a child mid-replay; ``doctor --blackbox`` must rebuild
+    the story from the ring file alone."""
+    from client_tpu.watch import read_blackbox
+
+    root = str(Path(__file__).resolve().parent.parent)
+    with tempfile.TemporaryDirectory() as tmp:
+        ring = os.path.join(tmp, "kill9.bbx")
+        script = os.path.join(tmp, "child.py")
+        Path(script).write_text(_KILL9_CHILD.format(root=root))
+        child = subprocess.Popen(
+            [sys.executable, script, ring],
+            cwd=root, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        saw = set()
+        t0 = time.monotonic()
+        try:
+            while time.monotonic() - t0 < KILL9_TIMEOUT_S:
+                if child.poll() is not None:
+                    raise RuntimeError("kill9 child exited prematurely")
+                if os.path.exists(ring):
+                    rep = read_blackbox(ring)
+                    saw = {r.kind for r in rep.records}
+                    if {"timeline", "metrics", "alert"} <= saw:
+                        break
+                time.sleep(0.25)
+        finally:
+            # kill -9, no shutdown hooks: the ring is all that survives
+            if child.poll() is None:
+                os.kill(child.pid, signal.SIGKILL)
+            child.wait()
+        armed = {"timeline", "metrics", "alert"} <= saw
+        report = os.path.join(tmp, "report.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "client_tpu.doctor",
+             "--blackbox", ring, "--json", report],
+            cwd=root, capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        doc: Dict[str, Any] = {}
+        if proc.returncode == 0 and os.path.exists(report):
+            doc = json.loads(Path(report).read_text())
+    return {
+        "armed_before_kill": armed,
+        "record_kinds": sorted(saw),
+        "doctor_rc": proc.returncode,
+        "reconstruction_ok": bool(doc.get("ok")),
+        "timelines_recovered": doc.get("timelines_recovered", 0),
+        "metrics_snapshots_recovered": doc.get(
+            "metrics_snapshots_recovered", 0),
+        "last_alert_kind": (doc.get("last_alert") or {}).get("kind"),
+        "scan": doc.get("scan"),
+    }
+
+
+def check(doc: Dict[str, Any]) -> int:
+    """Re-validate the committed artifact's invariants; 0 = all hold."""
+    problems: List[str] = []
+    disabled = doc["disabled"]
+    if disabled["branch_ns"]["p50"] > 250.0:
+        problems.append(
+            f"disabled-path branch median {disabled['branch_ns']['p50']} "
+            "ns is not the claimed one-branch cost")
+    tick = doc["tick"]
+    if not tick["tick_ns"] or tick["tick_ns"]["p50"] <= 0:
+        problems.append("enabled tick cost was not measured")
+    if tick["tick_ns"] and tick["tick_ns"]["p50"] > 5e6:
+        problems.append(
+            f"enabled tick median {tick['tick_ns']['p50']} ns exceeds "
+            "the 5 ms budget")
+    if tick["alerts_fired_total"] != 0:
+        problems.append("tick-cost arm fired alerts on healthy traffic")
+    for arm in ("chaos_latency", "chaos_byzantine", "chaos_blackhole"):
+        row = doc[arm]
+        if not row["detected"]:
+            problems.append(f"{arm}: the fault was never detected by name")
+            continue
+        if row["detect_s"] is None \
+                or row["detect_s"] > row["fault_duration_s"] + 1e-9:
+            problems.append(
+                f"{arm}: detection ({row['detect_s']}s) did not land "
+                f"inside the fault window ({row['fault_duration_s']}s)")
+    if doc["chaos_latency"].get("baseline_alerts", 0) != 0:
+        problems.append("chaos_latency fired alerts during the healthy "
+                        "baseline phase")
+    aa = doc["aa_soak"]
+    if aa["alerts_fired_total"] != 0:
+        problems.append(
+            f"A/A soak fired {aa['alerts_fired_total']} alerts — the "
+            "zero-false-positive bar does not hold")
+    if aa["ticks"] <= 0 or aa["requests"] <= 0:
+        problems.append("A/A soak did not actually run")
+    k9 = doc["kill9"]
+    if not k9["armed_before_kill"]:
+        problems.append("kill9 child never wrote timeline+metrics+alert "
+                        "records before the kill")
+    if k9["doctor_rc"] != 0 or not k9["reconstruction_ok"]:
+        problems.append("doctor --blackbox could not reconstruct from "
+                        "the ring after kill -9")
+    if k9["timelines_recovered"] <= 0:
+        problems.append("kill9 reconstruction recovered no timelines")
+    if k9["metrics_snapshots_recovered"] <= 0:
+        problems.append("kill9 reconstruction recovered no metric "
+                        "snapshots")
+    if not k9["last_alert_kind"]:
+        problems.append("kill9 reconstruction recovered no alert")
+    for p in problems:
+        print(f"CHECK FAIL: {p}")
+    if not problems:
+        print("CHECK OK: all committed continuous-monitoring claims hold")
+    return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("artifact", nargs="?", default=None,
+                        help="artifact path for --check (defaults to -o)")
+    parser.add_argument("-o", "--output", default="BENCH_WATCH.json")
+    parser.add_argument("--check", action="store_true",
+                        help="validate the committed artifact instead of "
+                             "re-measuring")
+    args = parser.parse_args(argv)
+
+    if args.check:
+        path = args.artifact or args.output
+        return check(json.loads(Path(path).read_text()))
+
+    doc: Dict[str, Any] = {
+        "generated_unix": int(time.time()),
+        "platform": {
+            "python": platform.python_version(),
+            "machine": platform.machine(),
+            "system": platform.system(),
+        },
+    }
+    print("1/7 disabled-path branch cost ...")
+    doc["disabled"] = bench_disabled()
+    print(f"    p50 {doc['disabled']['branch_ns']['p50']} ns")
+    print("2/7 enabled tick cost ...")
+    doc["tick"] = bench_tick()
+    print(f"    tick p50 {doc['tick']['tick_ns']['p50']} ns over "
+          f"{doc['tick']['ticks']} ticks")
+    print("3/7 chaos: latency-faulted replica ...")
+    doc["chaos_latency"] = bench_chaos_latency()
+    print(f"    detected={doc['chaos_latency']['detected']} in "
+          f"{doc['chaos_latency']['detect_s']}s "
+          f"({doc['chaos_latency']['alert_kind']})")
+    print("4/7 chaos: byzantine replica ...")
+    doc["chaos_byzantine"] = bench_chaos_byzantine()
+    print(f"    detected={doc['chaos_byzantine']['detected']} in "
+          f"{doc['chaos_byzantine']['detect_s']}s "
+          f"({doc['chaos_byzantine']['alert_source']})")
+    print("5/7 chaos: cell blackhole ...")
+    doc["chaos_blackhole"] = bench_chaos_blackhole()
+    print(f"    detected={doc['chaos_blackhole']['detected']} in "
+          f"{doc['chaos_blackhole']['detect_s']}s "
+          f"({doc['chaos_blackhole']['alert_source']})")
+    print("6/7 A/A soak (no fault) ...")
+    doc["aa_soak"] = bench_aa_soak()
+    print(f"    {doc['aa_soak']['requests']} requests, "
+          f"{doc['aa_soak']['alerts_fired_total']} alerts")
+    print("7/7 kill -9 reconstruction ...")
+    doc["kill9"] = bench_kill9()
+    print(f"    doctor rc={doc['kill9']['doctor_rc']}, timelines="
+          f"{doc['kill9']['timelines_recovered']}, last alert="
+          f"{doc['kill9']['last_alert_kind']}")
+    rc = check(doc)
+    Path(args.output).write_text(json.dumps(doc, indent=2) + "\n")
+    print(f"wrote {args.output}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,13 +75,21 @@
 # still return CORRECT values via failover, the liar must be
 # quarantined after N contract-invalid responses (EndpointQuarantined
 # fired, quarantine visible in endpoint_stats/health_summary), and the
-# doctor's byzantine_replica anomaly must name its url.
+# doctor's byzantine_replica anomaly must name its url. The
+# continuous-monitoring smoke (tests/test_watch.py, watch_smoke marker)
+# runs a 3-replica pool with one replica behind a latency fault under a
+# live fast-tick Watchtower: an alert (changepoint or SLO burn) must
+# fire BEFORE the fault heals, its evidence must name the faulted
+# endpoint via the flight recorder's tail divergence, and the condition
+# must resolve after heal — time-to-detect < fault duration, proven on
+# live traffic, with the same alert edges recoverable from the
+# crash-safe black-box ring.
 #
 # Usage: tools/chaos_smoke.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python -m pytest -q \
-    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke or disagg_smoke or pipeline_smoke or integrity_smoke' \
+    -m 'chaos_smoke or observe_smoke or stream_observe_smoke or batch_smoke or doctor_smoke or replay_smoke or arena_smoke or admission_smoke or shard_smoke or hotkey_smoke or flight_smoke or federation_smoke or tenancy_smoke or disagg_smoke or pipeline_smoke or integrity_smoke or watch_smoke' \
     -p no:cacheprovider \
     tests/test_resilience.py tests/test_pool.py tests/test_observe.py \
     tests/test_stream_observe.py tests/test_client_batching.py \
@@ -90,4 +98,4 @@ exec env JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_hotkey_cache.py tests/test_flight.py \
     tests/test_federation.py tests/test_tenancy.py \
     tests/test_disagg.py tests/test_pipeline.py \
-    tests/test_integrity.py "$@"
+    tests/test_integrity.py tests/test_watch.py "$@"
